@@ -1,0 +1,26 @@
+"""glom_tpu — a TPU-native (JAX/XLA/Pallas/pjit) framework with the
+capabilities of lucidrains/glom-pytorch (Hinton's GLOM, arXiv:2102.12627).
+
+Public surface (superset of the reference's single ``Glom`` export,
+`/root/reference/glom_pytorch/__init__.py:1`):
+
+  * ``Glom`` — torch-ergonomics class shim (same ctor/forward kwargs)
+  * ``GlomConfig`` / ``TrainConfig`` — frozen dataclass configs
+  * ``glom_tpu.models`` — functional ``init``/``apply`` core (lax.scan forward)
+  * ``glom_tpu.ops`` — patch embed, grouped FF, consensus attention
+  * ``glom_tpu.kernels`` — Pallas fused consensus kernel
+  * ``glom_tpu.parallel`` — mesh/sharding rules, pjit train step, ring consensus
+  * ``glom_tpu.training`` — denoising-SSL trainer, data, metrics
+  * ``glom_tpu.checkpoint`` — save/restore of param+opt pytrees
+  * ``glom_tpu.convert`` — torch state_dict <-> jax pytree converter
+
+Subpackages are listed for the full framework; consult each module's
+docstring for status.
+"""
+
+from glom_tpu.config import GlomConfig, TrainConfig
+from glom_tpu.models.shim import Glom
+
+__version__ = "0.1.0"
+
+__all__ = ["Glom", "GlomConfig", "TrainConfig", "__version__"]

@@ -1,0 +1,98 @@
+"""Configuration for the TPU-native GLOM framework.
+
+The reference's configuration surface is exactly six ctor kwargs
+(`/root/reference/glom_pytorch/glom_pytorch.py:78-87`) plus three forward kwargs
+(`:110`).  ``GlomConfig`` mirrors those names 1:1 so the torch-style shim
+(`glom_tpu.models.shim.Glom`) is trivial, and adds the TPU-only knobs
+(dtypes, remat, pallas/ring paths) that the reference delegated to torch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class GlomConfig:
+    """Model config.  Field names/defaults mirror the reference ctor
+    (`glom_pytorch.py:80-86`); extras are TPU-execution knobs."""
+
+    # -- reference-parity fields (glom_pytorch.py:80-86) --
+    dim: int = 512
+    levels: int = 6
+    image_size: int = 224
+    patch_size: int = 14
+    consensus_self: bool = False
+    local_consensus_radius: int = 0
+
+    # -- reference-implicit constants --
+    channels: int = 3          # hard-coded 3 in the reference (glom_pytorch.py:96)
+    ff_mult: int = 4           # hidden mult of GroupedFeedForward (glom_pytorch.py:24)
+
+    # -- TPU execution knobs (no reference equivalent) --
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: Optional[jnp.dtype] = None   # None => use param dtype
+    remat: bool = False                         # jax.checkpoint the scan body
+    attention_impl: str = "dense"               # "dense" | "pallas" | "ring"
+
+    def __post_init__(self):
+        if self.image_size % self.patch_size != 0:
+            raise ValueError(
+                f"image_size {self.image_size} not divisible by patch_size {self.patch_size}"
+            )
+        if self.levels < 2:
+            raise ValueError("levels must be >= 2 (top_down uses levels-1 groups)")
+        if self.attention_impl not in ("dense", "pallas", "ring"):
+            raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+
+    # -- derived quantities (glom_pytorch.py:90-91,112) --
+    @property
+    def num_patches_side(self) -> int:
+        return self.image_size // self.patch_size
+
+    @property
+    def num_patches(self) -> int:
+        return self.num_patches_side ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size ** 2 * self.channels
+
+    @property
+    def default_iters(self) -> int:
+        # "twice the number of levels ... for information to propagate up and
+        # back down" (glom_pytorch.py:112)
+        return 2 * self.levels
+
+    @property
+    def state_shape(self) -> Tuple[int, int]:
+        """Per-(batch, patch) level-state shape ``(levels, dim)``."""
+        return (self.levels, self.dim)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Config of the denoising-SSL training recipe (README.md:56-90 of the
+    reference, which ships it as documentation only — here it is framework
+    code) plus the distributed-execution fields the reference lacks."""
+
+    batch_size: int = 8
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.0
+    iters: Optional[int] = None          # None => model default (2*levels)
+    # README.md:83 reads the state at time index 7 of 13 and the top level.
+    loss_timestep: Optional[int] = None  # None => iters // 2 + 1
+    loss_level: int = -1                 # top level
+    noise_std: float = 1.0               # img + randn_like(img)  (README.md:74)
+    steps: int = 100
+    log_every: int = 10
+    checkpoint_every: int = 0            # 0 => disabled
+    checkpoint_dir: Optional[str] = None
+    seed: int = 0
+    # mesh axes: data-parallel x model(level)-parallel x sequence(column)-parallel
+    mesh_shape: Tuple[int, ...] = (1, 1, 1)
+    mesh_axes: Tuple[str, ...] = ("data", "model", "seq")
+    donate: bool = True

@@ -1,0 +1,13 @@
+from glom_tpu.models.glom import init, apply, param_count, make_consensus_fn
+from glom_tpu.models.heads import patches_to_images_init, patches_to_images_apply
+from glom_tpu.models.shim import Glom
+
+__all__ = [
+    "init",
+    "apply",
+    "param_count",
+    "make_consensus_fn",
+    "patches_to_images_init",
+    "patches_to_images_apply",
+    "Glom",
+]

@@ -1,0 +1,181 @@
+"""Functional GLOM model: ``init`` / ``apply``.
+
+Reference analogue: ``class Glom`` (`glom_pytorch.py:77-150`).  Where the
+reference drives a Python ``for`` loop that launches ~10 kernels per
+iteration from the host (`:131-145`), this implementation traces the entire
+iterative update as ONE XLA graph: a ``lax.scan`` carrying the ``(b, n, L, d)``
+level state, with the per-iteration hidden states as the scan's stacked
+outputs.  That single-graph property is the BASELINE.json north star and is
+what lets XLA fuse/pipeline the whole 12-iteration forward on the MXU.
+
+Semantics pinned to the reference (SURVEY.md §2.1):
+  * fresh image tokens re-attached at the bottom every iteration (`:132`)
+  * bottom_up over entries [0..L-1] of the (tokens + levels) stack (`:134`)
+  * top_down over entries [2..L] plus positional embeddings, zero-padded at
+    the top level (`:136-137`); pos-embs touch ONLY the top-down input
+  * consensus attention on the PREVIOUS iteration's state (`:139`)
+  * equal-weight mean with divisors [4,...,4,3] (`:128-129,141-144`)
+  * ``return_all`` prepends the t=0 state => ``(iters+1, b, n, L, d)``
+    (`:126,147-148`)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from glom_tpu.config import GlomConfig
+from glom_tpu.ops.consensus import consensus_attention
+from glom_tpu.ops.feedforward import grouped_ff_apply, grouped_ff_init
+from glom_tpu.ops.masks import local_consensus_mask
+from glom_tpu.ops.patch import patch_embed_apply, patch_embed_init
+
+
+def init(rng: jax.Array, config: GlomConfig) -> dict:
+    """Build the parameter pytree.
+
+    Layout (names stable; the torch<->jax converter in ``glom_tpu.convert``
+    maps the reference state_dict onto exactly these leaves):
+      patch_embed/{w,b}   Linear(p^2*c, d)            (`glom_pytorch.py:96`)
+      pos_emb             (n, d) ~ N(0,1)             (`:98`)
+      init_levels         (L, d) ~ N(0,1)             (`:101`)
+      bottom_up/{w1,b1,w2,b2}   L groups              (`:104`)
+      top_down/{w1,b1,w2,b2}    L-1 groups            (`:105`)
+    Consensus attention has zero parameters (`:38-73`).
+    """
+    c = config
+    k_pe, k_pos, k_init, k_bu, k_td = jax.random.split(rng, 5)
+    dt = c.param_dtype
+    return {
+        "patch_embed": patch_embed_init(k_pe, c.patch_dim, c.dim, dt),
+        "pos_emb": jax.random.normal(k_pos, (c.num_patches, c.dim), dt),
+        "init_levels": jax.random.normal(k_init, (c.levels, c.dim), dt),
+        "bottom_up": grouped_ff_init(k_bu, c.dim, c.levels, c.ff_mult, dt),
+        "top_down": grouped_ff_init(k_td, c.dim, c.levels - 1, c.ff_mult, dt),
+    }
+
+
+def param_count(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+
+
+def _update_step(params, bottom_level, pos_embs, divisors, consensus_fn, levels):
+    """One GLOM iteration (`glom_pytorch.py:131-145`), as a pure function of
+    the carried ``levels`` state."""
+    # (b, n, L+1, d): tokens re-attached at the bottom each iteration (`:132`)
+    levels_with_input = jnp.concatenate([bottom_level, levels], axis=-2)
+
+    bottom_up_out = grouped_ff_apply(params["bottom_up"], levels_with_input[..., :-1, :])
+
+    top_down_in = levels_with_input[..., 2:, :] + pos_embs
+    top_down_out = grouped_ff_apply(params["top_down"], top_down_in)
+    # zero contribution at the top level (`:137`)
+    top_down_out = jnp.pad(top_down_out, ((0, 0), (0, 0), (0, 1), (0, 0)))
+
+    consensus = consensus_fn(levels)
+
+    new_levels = (levels + bottom_up_out + top_down_out + consensus) / divisors
+    return new_levels
+
+
+def make_consensus_fn(config: GlomConfig):
+    """Resolve the attention implementation: XLA-dense (always-correct path),
+    Pallas fused kernel, or ring-sharded — all numerically interchangeable."""
+    mask = None
+    if config.local_consensus_radius > 0:
+        mask = jnp.asarray(local_consensus_mask(config.num_patches_side, config.local_consensus_radius))
+
+    if config.attention_impl == "dense":
+        return functools.partial(
+            consensus_attention, attend_self=config.consensus_self, non_local_mask=mask
+        )
+    if config.attention_impl == "pallas":
+        try:
+            from glom_tpu.kernels.consensus_pallas import consensus_attention_pallas
+        except ImportError as e:
+            raise NotImplementedError(
+                "attention_impl='pallas' requires glom_tpu.kernels.consensus_pallas"
+            ) from e
+        return functools.partial(
+            consensus_attention_pallas, attend_self=config.consensus_self, non_local_mask=mask
+        )
+    if config.attention_impl == "ring":
+        try:
+            from glom_tpu.parallel.ring import ring_consensus_attention
+        except ImportError as e:
+            raise NotImplementedError(
+                "attention_impl='ring' requires glom_tpu.parallel.ring"
+            ) from e
+        return functools.partial(
+            ring_consensus_attention, attend_self=config.consensus_self, non_local_mask=mask
+        )
+    raise ValueError(config.attention_impl)
+
+
+def apply(
+    params: dict,
+    img: jax.Array,
+    *,
+    config: GlomConfig,
+    iters: Optional[int] = None,
+    levels: Optional[jax.Array] = None,
+    return_all: bool = False,
+) -> jax.Array:
+    """Forward pass.
+
+    Args mirror ``Glom.forward(img, iters, levels, return_all)``
+    (`glom_pytorch.py:110`).  ``iters`` is a static Python int (scan length);
+    distinct values recompile — the documented cost of the single-graph
+    design (SURVEY.md §7 hard part b).
+
+    Returns ``(b, n, L, d)`` or, with ``return_all``, ``(iters+1, b, n, L, d)``
+    including the t=0 state.
+    """
+    c = config
+    if iters is None:
+        iters = c.default_iters
+    compute_dtype = c.compute_dtype or c.param_dtype
+    if img.dtype != compute_dtype:
+        img = img.astype(compute_dtype)
+    if compute_dtype != c.param_dtype:
+        params = jax.tree_util.tree_map(lambda p: p.astype(compute_dtype), params)
+
+    tokens = patch_embed_apply(params["patch_embed"], img, c.patch_size)  # (b, n, d)
+    b, n, _ = tokens.shape
+
+    pos_embs = params["pos_emb"][None, :, None, :]        # (1, n, 1, d)  (`:117-118`)
+    bottom_level = tokens[:, :, None, :]                  # (b, n, 1, d)  (`:120-121`)
+
+    if levels is None:
+        levels = jnp.broadcast_to(
+            params["init_levels"][None, None, :, :], (b, n, c.levels, c.dim)
+        ).astype(compute_dtype)                           # (`:123-124`)
+    else:
+        levels = levels.astype(compute_dtype)
+
+    # divisors [4,...,4,3]: top level has no top-down contribution (`:128-129`)
+    divisors = np.full((c.levels, 1), 4.0, dtype=np.float32)
+    divisors[-1] = 3.0
+    divisors = jnp.asarray(divisors, compute_dtype)
+
+    consensus_fn = make_consensus_fn(c)
+    step = functools.partial(
+        _update_step, params, bottom_level, pos_embs, divisors, consensus_fn
+    )
+    if c.remat:
+        step = jax.checkpoint(step)
+
+    def body(carry, _):
+        new = step(carry)
+        return new, (new if return_all else None)
+
+    final, ys = jax.lax.scan(body, levels, None, length=iters)
+
+    if return_all:
+        # prepend the t=0 state to match (iters+1, ...) (`:126,148`)
+        return jnp.concatenate([levels[None], ys], axis=0)
+    return final

@@ -1,0 +1,31 @@
+"""Decoder heads.
+
+Reference analogue: the README's ``patches_to_images`` recipe —
+``nn.Linear(512, 14*14*3)`` + un-patchify Rearrange (`README.md:78-81`).
+The reference ships it as user code in documentation; here it is a
+framework-owned head used by the denoising-SSL trainer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from glom_tpu.config import GlomConfig
+from glom_tpu.ops.patch import unpatchify
+
+
+def patches_to_images_init(rng: jax.Array, config: GlomConfig, dtype=jnp.float32) -> dict:
+    """Linear(dim, p^2*c) with torch default init (U(-1/sqrt(fan_in), ...))."""
+    kw, kb = jax.random.split(rng)
+    bound = config.dim ** -0.5
+    return {
+        "w": jax.random.uniform(kw, (config.dim, config.patch_dim), dtype, -bound, bound),
+        "b": jax.random.uniform(kb, (config.patch_dim,), dtype, -bound, bound),
+    }
+
+
+def patches_to_images_apply(params: dict, tokens: jax.Array, config: GlomConfig) -> jax.Array:
+    """``(b, n, dim) -> (b, c, H, W)`` reconstruction (`README.md:78-84`)."""
+    patches = tokens @ params["w"] + params["b"]
+    return unpatchify(patches, config.patch_size, config.image_size, config.channels)

@@ -1,0 +1,88 @@
+"""Torch-ergonomics shim.
+
+Reference analogue: the public API surface, ``from glom_pytorch import Glom``
+(`glom_pytorch/__init__.py:1`) with ctor kwargs at `glom_pytorch.py:78-87`
+and ``forward(img, iters=None, levels=None, return_all=False)`` at `:110`.
+
+``Glom`` here is a thin stateful wrapper over the functional core
+(`glom_tpu.models.glom.init/apply`): it owns a param pytree and jit-caches
+``apply`` per (iters, return_all, has_state) signature.  Everything heavy
+lives in the pure functions; the class is ergonomics only.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from glom_tpu.config import GlomConfig
+from glom_tpu.models import glom as glom_model
+
+
+class Glom:
+    """Drop-in for the reference ``Glom`` module: same ctor kwargs, same
+    ``__call__`` kwargs, same output shapes.  Extra TPU knobs (dtypes, remat,
+    attention_impl) pass through to :class:`GlomConfig`."""
+
+    def __init__(
+        self,
+        *,
+        dim: int = 512,
+        levels: int = 6,
+        image_size: int = 224,
+        patch_size: int = 14,
+        consensus_self: bool = False,
+        local_consensus_radius: int = 0,
+        rng: Optional[jax.Array] = None,
+        params: Optional[dict] = None,
+        **tpu_kwargs,
+    ):
+        self.config = GlomConfig(
+            dim=dim,
+            levels=levels,
+            image_size=image_size,
+            patch_size=patch_size,
+            consensus_self=consensus_self,
+            local_consensus_radius=local_consensus_radius,
+            **tpu_kwargs,
+        )
+        if params is not None:
+            self.params = params
+        else:
+            if rng is None:
+                rng = jax.random.PRNGKey(0)
+            self.params = glom_model.init(rng, self.config)
+
+    @functools.cached_property
+    def _jitted(self):
+        cfg = self.config
+
+        @functools.partial(jax.jit, static_argnames=("iters", "return_all", "has_state"))
+        def fwd(params, img, state, *, iters, return_all, has_state):
+            return glom_model.apply(
+                params,
+                img,
+                config=cfg,
+                iters=iters,
+                levels=state if has_state else None,
+                return_all=return_all,
+            )
+
+        return fwd
+
+    def __call__(self, img, iters=None, levels=None, return_all=False):
+        img = jnp.asarray(img)
+        if iters is None:
+            iters = self.config.default_iters
+        has_state = levels is not None
+        state = jnp.asarray(levels) if has_state else jnp.zeros((), self.config.param_dtype)
+        return self._jitted(
+            self.params, img, state, iters=int(iters), return_all=bool(return_all), has_state=has_state
+        )
+
+    @property
+    def num_params(self) -> int:
+        return glom_model.param_count(self.params)

@@ -1,0 +1,30 @@
+"""Building-block ops for the TPU-native GLOM stack.
+
+Reference analogue: the internal (non-exported) modules of
+`/root/reference/glom_pytorch/glom_pytorch.py:23-73` (``GroupedFeedForward``,
+``ConsensusAttention``) plus the patch-embedding pipeline at `:94-97`.
+Everything here is a pure function on pytrees — no modules, no hidden state —
+so the whole model traces into a single XLA graph.
+"""
+
+from glom_tpu.ops.patch import patchify, unpatchify, patch_embed_init, patch_embed_apply
+from glom_tpu.ops.feedforward import grouped_ff_init, grouped_ff_apply
+from glom_tpu.ops.consensus import (
+    TOKEN_ATTEND_SELF_VALUE,
+    l2_normalize,
+    consensus_attention,
+)
+from glom_tpu.ops.masks import local_consensus_mask
+
+__all__ = [
+    "patchify",
+    "unpatchify",
+    "patch_embed_init",
+    "patch_embed_apply",
+    "grouped_ff_init",
+    "grouped_ff_apply",
+    "TOKEN_ATTEND_SELF_VALUE",
+    "l2_normalize",
+    "consensus_attention",
+    "local_consensus_mask",
+]

@@ -1,0 +1,65 @@
+"""Dense consensus attention.
+
+Reference analogue: ``ConsensusAttention.forward`` (`glom_pytorch.py:56-73`).
+At every level l, each patch column attends over all columns at the same
+level: queries are the raw level states, keys are L2-normalized states
+(`:58`), values are the raw states (`:72`), scale ``d**-0.5`` (`:60`).
+
+Two mask subtleties pinned by the reference:
+  * self-exclusion is SOFT — the diagonal logit is set to ``-5e-4``
+    (`TOKEN_ATTEND_SELF_VALUE`, `:11,65`), not -inf; a column still assigns
+    itself near-uniform probability.
+  * the locality mask is HARD — blocked pairs get ``-finfo.max`` (`:68-69`).
+
+This module is the always-correct XLA path (einsum -> where -> softmax ->
+einsum; XLA fuses the masking into the softmax).  The flash-style Pallas
+kernel in ``glom_tpu.kernels`` and the ring-sharded version in
+``glom_tpu.parallel.ring`` must match it bit-for-behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+# Soft self-mask logit value (`glom_pytorch.py:11`).
+TOKEN_ATTEND_SELF_VALUE = -5e-4
+
+
+def l2_normalize(x: jax.Array, axis: int = -1, eps: float = 1e-12) -> jax.Array:
+    """L2 normalize with torch ``F.normalize`` semantics: divide by
+    ``max(||x||_2, eps)`` (`glom_pytorch.py:58`)."""
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True))
+    return x / jnp.maximum(norm, eps)
+
+
+def consensus_attention(
+    levels: jax.Array,
+    *,
+    attend_self: bool = False,
+    non_local_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """``(b, n, l, d) -> (b, n, l, d)`` per-level cross-column consensus.
+
+    ``non_local_mask``: optional boolean ``(n, n)``, True = blocked
+    (from :func:`glom_tpu.ops.masks.local_consensus_mask`).
+    """
+    d = levels.shape[-1]
+    q = levels
+    k = l2_normalize(levels, axis=-1)
+
+    sim = jnp.einsum("bild,bjld->blij", q, k) * (d ** -0.5)
+
+    if not attend_self:
+        n = levels.shape[1]
+        eye = jnp.eye(n, dtype=bool)
+        sim = jnp.where(eye[None, None, :, :], jnp.asarray(TOKEN_ATTEND_SELF_VALUE, sim.dtype), sim)
+
+    if non_local_mask is not None:
+        max_neg = -jnp.finfo(sim.dtype).max
+        sim = jnp.where(non_local_mask[None, None, :, :], max_neg, sim)
+
+    attn = jax.nn.softmax(sim, axis=-1)
+    return jnp.einsum("blij,bjld->bild", attn, levels)

@@ -1,0 +1,48 @@
+"""Grouped per-level feed-forward nets.
+
+Reference analogue: ``GroupedFeedForward`` (`glom_pytorch.py:23-36`) — per-level
+independent MLPs ``d -> mult*d -> d`` with GELU, which the reference implements
+as two grouped 1x1 ``nn.Conv1d`` over an ``(l*d)``-channel layout so all
+levels run in one kernel launch.
+
+TPU-native design: grouped 1x1 convs are exactly batched matmuls with the
+group (level) axis as a batch dimension.  We store the weights as stacked
+``(groups, d_in, d_out)`` tensors and contract with ``jnp.einsum`` — XLA lowers
+this to a single batched ``dot_general`` on the MXU and fuses bias + GELU into
+it, with no conv machinery.  The level axis doubles as the natural
+tensor/expert-parallel sharding axis (SURVEY.md §2.3).
+
+GELU: torch ``nn.GELU()`` defaults to the *exact* erf formulation, so we call
+``jax.nn.gelu(approximate=False)`` (JAX defaults to the tanh approximation).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def grouped_ff_init(
+    rng: jax.Array, dim: int, groups: int, mult: int = 4, dtype=jnp.float32
+) -> dict:
+    """Init matching torch grouped-Conv1d defaults: kaiming_uniform(a=sqrt(5))
+    on weights => U(-1/sqrt(fan_in), 1/sqrt(fan_in)) with fan_in = in_ch/groups;
+    bias likewise.  Layout: ``w1 (g, d, mult*d)``, ``w2 (g, mult*d, d)``."""
+    hidden = dim * mult
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+    b1 = dim ** -0.5       # fan_in of conv1: total_dim/groups = dim
+    b2 = hidden ** -0.5    # fan_in of conv2: total_dim*mult/groups = hidden
+    return {
+        "w1": jax.random.uniform(k1, (groups, dim, hidden), dtype, -b1, b1),
+        "b1": jax.random.uniform(k2, (groups, hidden), dtype, -b1, b1),
+        "w2": jax.random.uniform(k3, (groups, hidden, dim), dtype, -b2, b2),
+        "b2": jax.random.uniform(k4, (groups, dim), dtype, -b2, b2),
+    }
+
+
+def grouped_ff_apply(params: dict, x: jax.Array) -> jax.Array:
+    """``(b, n, g, d) -> (b, n, g, d)``; group g applies its own MLP
+    (`glom_pytorch.py:29-32` semantics, one batched dot_general per layer)."""
+    h = jnp.einsum("bngd,gdh->bngh", x, params["w1"]) + params["b1"]
+    h = jax.nn.gelu(h, approximate=False)
+    return jnp.einsum("bngh,ghd->bngd", h, params["w2"]) + params["b2"]

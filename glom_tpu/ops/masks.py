@@ -1,0 +1,24 @@
+"""Setup-time attention masks.
+
+Reference analogue: the ``local_consensus_radius`` machinery of
+``ConsensusAttention.__init__`` (`glom_pytorch.py:44-54`): a euclidean
+``cdist`` over the patch grid, thresholded at the radius, registered as a
+buffer.  Under JAX this is a NumPy precompute closed over as a constant —
+no buffers, no in-place ops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def local_consensus_mask(num_patches_side: int, radius: float) -> np.ndarray:
+    """Boolean ``(n, n)`` mask, True where patches are FURTHER apart than
+    ``radius`` (i.e. attention must be blocked), matching
+    `glom_pytorch.py:45-53` (meshgrid 'ij' -> (h w) coords -> cdist > r)."""
+    side = np.arange(num_patches_side)
+    hh, ww = np.meshgrid(side, side, indexing="ij")
+    coords = np.stack([hh.reshape(-1), ww.reshape(-1)], axis=-1).astype(np.float32)
+    diff = coords[:, None, :] - coords[None, :, :]
+    dist = np.sqrt((diff ** 2).sum(-1))
+    return dist > radius

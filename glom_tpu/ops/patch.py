@@ -1,0 +1,55 @@
+"""Patchification and patch embedding.
+
+Reference analogue: ``image_to_tokens`` — einops Rearrange
+``'b c (h p1) (w p2) -> b (h w) (p1 p2 c)'`` followed by
+``nn.Linear(patch_size**2 * 3, dim)`` (`glom_pytorch.py:94-97`), and the
+README decoder head's inverse rearrange (`README.md:80`).
+
+The patch layout contract matters for weight conversion: within a patch the
+flattened feature order is (row, col, channel) — p1 outermost, then p2, then c
+— exactly the reference's ``(p1 p2 c)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from einops import rearrange
+
+
+def patchify(img: jax.Array, patch_size: int) -> jax.Array:
+    """``(b, c, H, W) -> (b, n, p*p*c)`` with the reference's feature order."""
+    return rearrange(
+        img, "b c (h p1) (w p2) -> b (h w) (p1 p2 c)", p1=patch_size, p2=patch_size
+    )
+
+
+def unpatchify(patches: jax.Array, patch_size: int, image_size: int, channels: int = 3) -> jax.Array:
+    """``(b, n, p*p*c) -> (b, c, H, W)`` — inverse of :func:`patchify`;
+    mirrors the README decoder's Rearrange (`README.md:80`)."""
+    h = image_size // patch_size
+    return rearrange(
+        patches,
+        "b (h w) (p1 p2 c) -> b c (h p1) (w p2)",
+        p1=patch_size,
+        p2=patch_size,
+        h=h,
+        c=channels,
+    )
+
+
+def patch_embed_init(rng: jax.Array, patch_dim: int, dim: int, dtype=jnp.float32) -> dict:
+    """Linear(patch_dim, dim) with torch's default init: weight and bias
+    ~ U(-1/sqrt(fan_in), 1/sqrt(fan_in))."""
+    kw, kb = jax.random.split(rng)
+    bound = patch_dim ** -0.5
+    return {
+        "w": jax.random.uniform(kw, (patch_dim, dim), dtype, -bound, bound),
+        "b": jax.random.uniform(kb, (dim,), dtype, -bound, bound),
+    }
+
+
+def patch_embed_apply(params: dict, img: jax.Array, patch_size: int) -> jax.Array:
+    """``(b, c, H, W) -> (b, n, dim)`` tokens (`glom_pytorch.py:94-97,114`)."""
+    patches = patchify(img, patch_size)
+    return patches @ params["w"] + params["b"]

@@ -1,0 +1,21 @@
+"""Test harness config.
+
+Forces CPU with a faked 8-device topology (SURVEY.md §4.4: the standard JAX
+trick for testing pjit/shard_map/collectives without a pod).
+
+Note: this environment's sitecustomize registers an `axon` TPU plugin and
+overrides ``jax_platforms`` via ``jax.config.update`` — so the env var alone
+is not enough; we must update the config after importing jax (before any
+backend initializes).
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,132 @@
+"""Pure-NumPy oracle of the GLOM forward pass.
+
+An independent reimplementation of the reference semantics as pinned down
+op-by-op in SURVEY.md §2.1 (citations into
+/root/reference/glom_pytorch/glom_pytorch.py) — used to cross-check the JAX
+implementation without importing either torch or the framework under test.
+Written for clarity over speed; float64 throughout so the oracle itself
+contributes ~no rounding error.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+TOKEN_ATTEND_SELF_VALUE = -5e-4
+
+
+def gelu_exact(x):
+    from scipy.special import erf  # scipy ships with the image's numpy stack
+
+    return 0.5 * x * (1.0 + erf(x / np.sqrt(2.0)))
+
+
+def _gelu(x):
+    try:
+        return gelu_exact(x)
+    except ImportError:  # erf via tanh-free math: use math.erf elementwise
+        import math
+
+        return 0.5 * x * (1.0 + np.vectorize(math.erf)(x / np.sqrt(2.0)))
+
+
+def patchify(img, p):
+    """b c (h p1) (w p2) -> b (h w) (p1 p2 c)"""
+    b, c, H, W = img.shape
+    h, w = H // p, W // p
+    x = img.reshape(b, c, h, p, w, p)            # b c h p1 w p2
+    x = x.transpose(0, 2, 4, 3, 5, 1)            # b h w p1 p2 c
+    return x.reshape(b, h * w, p * p * c)
+
+
+def grouped_ff(params, x):
+    """x: (b, n, g, d); independent per-group MLP d -> 4d -> d with exact GELU."""
+    h = np.einsum("bngd,gdh->bngh", x, params["w1"]) + params["b1"]
+    h = _gelu(h)
+    return np.einsum("bngh,ghd->bngd", h, params["w2"]) + params["b2"]
+
+
+def l2_normalize(x, eps=1e-12):
+    norm = np.sqrt((x * x).sum(-1, keepdims=True))
+    return x / np.maximum(norm, eps)
+
+
+def softmax(x, axis=-1):
+    m = x.max(axis=axis, keepdims=True)
+    e = np.exp(x - m)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def consensus_attention(levels, attend_self=False, non_local_mask=None):
+    b, n, L, d = levels.shape
+    q = levels
+    k = l2_normalize(levels)
+    sim = np.einsum("bild,bjld->blij", q, k) * (d ** -0.5)
+    if not attend_self:
+        eye = np.eye(n, dtype=bool)
+        sim = np.where(eye[None, None], TOKEN_ATTEND_SELF_VALUE, sim)
+    if non_local_mask is not None:
+        sim = np.where(non_local_mask[None, None], -np.finfo(sim.dtype).max, sim)
+    attn = softmax(sim, axis=-1)
+    return np.einsum("blij,bjld->bild", attn, levels)
+
+
+def local_mask(num_patches_side, radius):
+    side = np.arange(num_patches_side)
+    hh, ww = np.meshgrid(side, side, indexing="ij")
+    coords = np.stack([hh.ravel(), ww.ravel()], -1).astype(np.float64)
+    dist = np.sqrt(((coords[:, None] - coords[None]) ** 2).sum(-1))
+    return dist > radius
+
+
+def glom_forward(
+    params,
+    img,
+    *,
+    dim,
+    levels_n,
+    image_size,
+    patch_size,
+    consensus_self=False,
+    local_consensus_radius=0,
+    iters=None,
+    levels=None,
+    return_all=False,
+):
+    """Full reference-semantics forward in float64 NumPy."""
+    params = {
+        k: ({kk: np.asarray(vv, np.float64) for kk, vv in v.items()} if isinstance(v, dict) else np.asarray(v, np.float64))
+        for k, v in params.items()
+    }
+    img = np.asarray(img, np.float64)
+    if iters is None:
+        iters = 2 * levels_n
+
+    tokens = patchify(img, patch_size) @ params["patch_embed"]["w"] + params["patch_embed"]["b"]
+    b, n, _ = tokens.shape
+    pos = params["pos_emb"][None, :, None, :]
+    bottom = tokens[:, :, None, :]
+
+    if levels is None:
+        levels = np.broadcast_to(params["init_levels"][None, None], (b, n, levels_n, dim)).copy()
+    else:
+        levels = np.asarray(levels, np.float64)
+
+    mask = local_mask(image_size // patch_size, local_consensus_radius) if local_consensus_radius > 0 else None
+
+    divisors = np.full((levels_n, 1), 4.0)
+    divisors[-1] = 3.0
+
+    hiddens = [levels]
+    for _ in range(iters):
+        lwi = np.concatenate([bottom, levels], axis=-2)
+        bu = grouped_ff(params["bottom_up"], lwi[..., :-1, :])
+        td = grouped_ff(params["top_down"], lwi[..., 2:, :] + pos)
+        td = np.concatenate([td, np.zeros_like(td[..., :1, :])], axis=-2)
+        cons = consensus_attention(levels, attend_self=consensus_self, non_local_mask=mask)
+        levels = (levels + bu + td + cons) / divisors
+        hiddens.append(levels)
+
+    if return_all:
+        return np.stack(hiddens)
+    return levels

@@ -1,0 +1,190 @@
+"""Model-level tests: shape contracts, oracle parity, stateful carry, grads
+(SURVEY.md §4.1-4.3)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from glom_tpu.config import GlomConfig
+from glom_tpu.models import glom as glom_model
+from glom_tpu.models.shim import Glom
+import oracle
+
+TINY = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4)
+
+
+def _np_params(params):
+    return jax.tree_util.tree_map(np.asarray, params)
+
+
+def _oracle_kwargs(c: GlomConfig):
+    return dict(
+        dim=c.dim,
+        levels_n=c.levels,
+        image_size=c.image_size,
+        patch_size=c.patch_size,
+        consensus_self=c.consensus_self,
+        local_consensus_radius=c.local_consensus_radius,
+    )
+
+
+def test_output_shapes_default_config_numbers():
+    """Default config derived numbers from SURVEY.md §2.1: n=256, params
+    23,532,544."""
+    c = GlomConfig()
+    assert c.num_patches == 256
+    assert c.default_iters == 12
+    params = glom_model.init(jax.random.PRNGKey(0), c)
+    assert glom_model.param_count(params) == 23_532_544
+
+
+@pytest.mark.parametrize("return_all", [False, True])
+def test_forward_shapes(return_all):
+    c = TINY
+    params = glom_model.init(jax.random.PRNGKey(0), c)
+    img = jax.random.normal(jax.random.PRNGKey(1), (2, 3, c.image_size, c.image_size))
+    out = glom_model.apply(params, img, config=c, iters=5, return_all=return_all)
+    n = c.num_patches
+    if return_all:
+        assert out.shape == (6, 2, n, c.levels, c.dim)
+    else:
+        assert out.shape == (2, n, c.levels, c.dim)
+
+
+@pytest.mark.parametrize(
+    "cfg",
+    [
+        TINY,
+        GlomConfig(dim=16, levels=3, image_size=16, patch_size=4, consensus_self=True),
+        GlomConfig(dim=16, levels=3, image_size=16, patch_size=4, local_consensus_radius=1),
+    ],
+    ids=["default", "consensus_self", "local_radius"],
+)
+def test_oracle_parity(cfg):
+    """fp32 JAX forward matches the float64 NumPy oracle (SURVEY.md §4.2)."""
+    params = glom_model.init(jax.random.PRNGKey(0), cfg)
+    img = jax.random.normal(jax.random.PRNGKey(1), (2, 3, cfg.image_size, cfg.image_size))
+    got = np.asarray(glom_model.apply(params, img, config=cfg, iters=4, return_all=True))
+    want = oracle.glom_forward(
+        _np_params(params), np.asarray(img), iters=4, return_all=True, **_oracle_kwargs(cfg)
+    )
+    np.testing.assert_allclose(got, want, atol=2e-4)
+
+
+def test_return_all_includes_t0():
+    c = TINY
+    params = glom_model.init(jax.random.PRNGKey(0), c)
+    img = jax.random.normal(jax.random.PRNGKey(1), (1, 3, c.image_size, c.image_size))
+    all_states = glom_model.apply(params, img, config=c, iters=3, return_all=True)
+    # t=0 is the broadcast init_levels (glom_pytorch.py:126)
+    init = np.broadcast_to(
+        np.asarray(params["init_levels"])[None, None], all_states.shape[1:]
+    )
+    np.testing.assert_allclose(np.asarray(all_states[0]), init, rtol=1e-6)
+    # final state equals the non-return_all output
+    final = glom_model.apply(params, img, config=c, iters=3)
+    np.testing.assert_allclose(np.asarray(all_states[-1]), np.asarray(final), rtol=1e-6)
+
+
+def test_stateful_carry_matches_oracle():
+    """Video recipe (README.md:94-111): carried levels skip the init path."""
+    c = TINY
+    params = glom_model.init(jax.random.PRNGKey(0), c)
+    img1 = jax.random.normal(jax.random.PRNGKey(1), (1, 3, c.image_size, c.image_size))
+    img2 = jax.random.normal(jax.random.PRNGKey(2), (1, 3, c.image_size, c.image_size))
+    s1 = glom_model.apply(params, img1, config=c, iters=4)
+    s2 = glom_model.apply(params, img2, config=c, iters=3, levels=s1)
+    w1 = oracle.glom_forward(_np_params(params), np.asarray(img1), iters=4, **_oracle_kwargs(c))
+    w2 = oracle.glom_forward(
+        _np_params(params), np.asarray(img2), iters=3, levels=w1, **_oracle_kwargs(c)
+    )
+    np.testing.assert_allclose(np.asarray(s2), w2, atol=2e-4)
+
+
+def test_top_level_divisor_and_zero_pad():
+    """Top level gets no top-down term and divides by 3 (glom_pytorch.py:128-137).
+    Construct a single iteration and check against manual computation."""
+    c = TINY
+    params = glom_model.init(jax.random.PRNGKey(0), c)
+    img = jax.random.normal(jax.random.PRNGKey(1), (1, 3, c.image_size, c.image_size))
+    out = np.asarray(glom_model.apply(params, img, config=c, iters=1, return_all=True))
+    p = _np_params(params)
+    tokens = oracle.patchify(np.asarray(img, np.float64), c.patch_size) @ np.asarray(
+        p["patch_embed"]["w"], np.float64
+    ) + p["patch_embed"]["b"]
+    n = tokens.shape[1]
+    levels0 = np.broadcast_to(p["init_levels"][None, None], (1, n, c.levels, c.dim))
+    lwi = np.concatenate([tokens[:, :, None, :], levels0], axis=-2)
+    bu = oracle.grouped_ff({k: np.asarray(v, np.float64) for k, v in p["bottom_up"].items()}, lwi[..., :-1, :])
+    cons = oracle.consensus_attention(np.asarray(levels0, np.float64))
+    # top level: (prev + bottom_up + consensus) / 3 — top-down is the zero pad
+    top_manual = (levels0[..., -1, :] + bu[..., -1, :] + cons[..., -1, :]) / 3.0
+    np.testing.assert_allclose(out[1][..., -1, :], top_manual, atol=1e-4)
+
+
+def test_grad_flows_and_finite():
+    """Autodiff through the scan: MSE on final top level; grads finite and
+    nonzero for every param leaf (SURVEY.md §4.3)."""
+    c = TINY
+    params = glom_model.init(jax.random.PRNGKey(0), c)
+    img = jax.random.normal(jax.random.PRNGKey(1), (1, 3, c.image_size, c.image_size))
+
+    def loss_fn(p):
+        out = glom_model.apply(p, img, config=c, iters=3)
+        return jnp.mean(out[..., -1, :] ** 2)
+
+    grads = jax.grad(loss_fn)(params)
+    for path, g in jax.tree_util.tree_flatten_with_path(grads)[0]:
+        g = np.asarray(g)
+        assert np.all(np.isfinite(g)), path
+        assert np.any(g != 0), path
+
+
+def test_grad_init_levels_zero_when_state_carried():
+    """grad flows to init_levels ONLY on the no-carried-state path
+    (SURVEY.md §4.3)."""
+    c = TINY
+    params = glom_model.init(jax.random.PRNGKey(0), c)
+    img = jax.random.normal(jax.random.PRNGKey(1), (1, 3, c.image_size, c.image_size))
+    state = jnp.zeros((1, c.num_patches, c.levels, c.dim))
+
+    def loss_fn(p):
+        out = glom_model.apply(p, img, config=c, iters=2, levels=state)
+        return jnp.mean(out ** 2)
+
+    grads = jax.grad(loss_fn)(params)
+    np.testing.assert_array_equal(np.asarray(grads["init_levels"]), 0.0)
+
+
+def test_remat_matches_no_remat():
+    c = TINY
+    c_remat = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4, remat=True)
+    params = glom_model.init(jax.random.PRNGKey(0), c)
+    img = jax.random.normal(jax.random.PRNGKey(1), (1, 3, c.image_size, c.image_size))
+
+    def loss(p, cfg):
+        return jnp.mean(glom_model.apply(p, img, config=cfg, iters=3) ** 2)
+
+    g1 = jax.grad(loss)(params, c)
+    g2 = jax.grad(loss)(params, c_remat)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6), g1, g2
+    )
+
+
+def test_shim_api():
+    """Torch-ergonomics shim: ctor kwargs + forward kwargs of the reference
+    (glom_pytorch.py:78-87,110)."""
+    model = Glom(dim=16, levels=3, image_size=16, patch_size=4)
+    img = np.random.default_rng(0).standard_normal((1, 3, 16, 16)).astype(np.float32)
+    out = model(img, iters=6)
+    assert out.shape == (1, 16, 3, 16)
+    all_out = model(img, iters=6, return_all=True)
+    assert all_out.shape == (7, 1, 16, 3, 16)
+    # stateful carry (README.md:94-111)
+    out2 = model(img, levels=out, iters=2)
+    assert out2.shape == out.shape
+    # default iters = 2*levels
+    assert model(img).shape == (1, 16, 3, 16)
+    assert model.num_params == glom_model.param_count(model.params)

@@ -1,0 +1,115 @@
+"""Unit tests for the building-block ops (SURVEY.md §4.1)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from glom_tpu.ops import (
+    TOKEN_ATTEND_SELF_VALUE,
+    consensus_attention,
+    grouped_ff_apply,
+    grouped_ff_init,
+    l2_normalize,
+    local_consensus_mask,
+    patchify,
+    unpatchify,
+)
+import oracle
+
+
+def test_patchify_layout():
+    """Feature order within a patch must be (p1, p2, c) — reference layout
+    (glom_pytorch.py:95)."""
+    rng = np.random.default_rng(0)
+    img = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    got = np.asarray(patchify(jnp.asarray(img), 4))
+    want = oracle.patchify(img, 4)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # spot-check a single element: patch (row 0, col 1), in-patch pixel (2,3), channel 1
+    assert got[0, 1, (2 * 4 + 3) * 3 + 1] == pytest.approx(img[0, 1, 2, 4 + 3])
+
+
+def test_unpatchify_roundtrip():
+    rng = np.random.default_rng(1)
+    img = jnp.asarray(rng.standard_normal((2, 3, 12, 12)).astype(np.float32))
+    back = unpatchify(patchify(img, 4), 4, 12, 3)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(img))
+
+
+def test_grouped_ff_independence():
+    """Group g's output depends only on group g's input (grouped conv
+    semantics, glom_pytorch.py:29-31)."""
+    key = jax.random.PRNGKey(0)
+    params = grouped_ff_init(key, dim=8, groups=3, mult=4)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 5, 3, 8))
+    y0 = grouped_ff_apply(params, x)
+    x2 = x.at[:, :, 1, :].set(0.0)  # perturb only group 1
+    y1 = grouped_ff_apply(params, x2)
+    assert not np.allclose(y0[:, :, 1], y1[:, :, 1])
+    np.testing.assert_array_equal(np.asarray(y0[:, :, 0]), np.asarray(y1[:, :, 0]))
+    np.testing.assert_array_equal(np.asarray(y0[:, :, 2]), np.asarray(y1[:, :, 2]))
+
+
+def test_grouped_ff_matches_oracle():
+    key = jax.random.PRNGKey(2)
+    params = grouped_ff_init(key, dim=16, groups=4, mult=4)
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 7, 4, 16))
+    got = np.asarray(grouped_ff_apply(params, x))
+    want = oracle.grouped_ff(
+        {k: np.asarray(v) for k, v in params.items()}, np.asarray(x, np.float64)
+    )
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_l2_normalize_torch_semantics():
+    x = jnp.array([[3.0, 4.0], [0.0, 0.0]])
+    y = np.asarray(l2_normalize(x))
+    np.testing.assert_allclose(y[0], [0.6, 0.8], rtol=1e-6)
+    # zero vector: torch F.normalize divides by eps -> stays zero, no NaN
+    np.testing.assert_array_equal(y[1], [0.0, 0.0])
+
+
+def test_consensus_matches_oracle_all_configs():
+    rng = np.random.default_rng(4)
+    levels = rng.standard_normal((2, 9, 3, 8)).astype(np.float32)
+    mask = local_consensus_mask(3, 1.0)
+    for attend_self in (False, True):
+        for m in (None, mask):
+            got = np.asarray(
+                consensus_attention(
+                    jnp.asarray(levels),
+                    attend_self=attend_self,
+                    non_local_mask=jnp.asarray(m) if m is not None else None,
+                )
+            )
+            want = oracle.consensus_attention(
+                levels.astype(np.float64), attend_self=attend_self, non_local_mask=m
+            )
+            np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_consensus_soft_self_mask_is_soft():
+    """The self mask is -5e-4, NOT -inf: a column must still attend to itself
+    with near-uniform weight (glom_pytorch.py:11,65)."""
+    levels = jnp.ones((1, 4, 1, 8))  # identical columns
+    out = consensus_attention(levels, attend_self=False)
+    # identical values => output equals input regardless of weights
+    np.testing.assert_allclose(np.asarray(out), np.asarray(levels), rtol=1e-6)
+    # but the self weight must be close to (not exactly 0 as -inf would give)
+    d = 8
+    sim_self = TOKEN_ATTEND_SELF_VALUE
+    sim_other = (1.0 / np.sqrt(d)) * np.sqrt(d)  # q.k_hat for all-ones vectors
+    w = np.exp([sim_self, sim_other, sim_other, sim_other])
+    w /= w.sum()
+    assert w[0] > 0.05  # soft: self weight stays well above the 0 that -inf would give
+
+
+def test_local_mask_geometry():
+    mask = local_consensus_mask(3, 1.0)
+    assert mask.shape == (9, 9)
+    assert not mask[0, 0]
+    assert not mask[0, 1]      # right neighbour, dist 1
+    assert not mask[0, 3]      # below neighbour, dist 1
+    assert mask[0, 4]          # diagonal, dist sqrt(2) > 1
+    assert mask[0, 8]
