@@ -1,0 +1,136 @@
+"""Checkpoint / resume.
+
+The reference has no checkpoint subsystem — it inherits
+``nn.Module.state_dict()`` (SURVEY.md §5).  Here checkpoints are explicit:
+named pytrees (params, optimizer state, training RNG, ...) plus a step
+counter, written as a single ``.npz`` (flattened by '/'-joined key paths)
+with a JSON manifest.  No framework dependency, deterministic layout,
+loadable from NumPy alone.  All writes are atomic (tmp + rename) so a crash
+never leaves a torn checkpoint or manifest; stale tmp files from crashed
+writers are swept on the next save.  Multi-host: only process 0 writes;
+restore places leaves onto the template's shardings via device_put.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+_SEP = "/"
+
+
+def _entry_str(p) -> str:
+    """Render one key-path entry: DictKey(.key), GetAttrKey(.name),
+    SequenceKey/FlattenedIndexKey(.idx)."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(p, attr):
+            return str(getattr(p, attr))
+    return str(p)
+
+
+def _flatten(tree: Any) -> dict:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        flat[_SEP.join(_entry_str(p) for p in path)] = np.asarray(leaf)
+    return flat
+
+
+def _atomic_write(directory: str, name: str, write_fn) -> str:
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            write_fn(f)
+        path = os.path.join(directory, name)
+        os.replace(tmp, path)
+        return path
+    except BaseException:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
+
+
+def save(directory: str, step: int, trees: Dict[str, Any], *, keep: int = 3) -> str:
+    """Write ``<dir>/ckpt_<step>.npz`` holding every named pytree in
+    ``trees`` (e.g. ``{"params": ..., "opt": ..., "rng": ...}``) plus an
+    atomic manifest; prune to ``keep`` newest.  Returns the path."""
+    if jax.process_index() != 0:
+        return ""
+    os.makedirs(directory, exist_ok=True)
+    arrays = {}
+    for name, tree in trees.items():
+        if tree is None:
+            continue
+        arrays.update(
+            {(f"{name}{_SEP}{k}" if k else name): v for k, v in _flatten(tree).items()}
+        )
+    path = _atomic_write(directory, f"ckpt_{step}.npz", lambda f: np.savez(f, **arrays))
+    _atomic_write(
+        directory,
+        "manifest.json",
+        lambda f: f.write(json.dumps({"latest_step": step, "path": path}).encode()),
+    )
+    _prune(directory, keep)
+    return path
+
+
+def _prune(directory: str, keep: int) -> None:
+    ckpts = sorted(
+        (f for f in os.listdir(directory) if f.startswith("ckpt_") and f.endswith(".npz")),
+        key=lambda f: int(f[len("ckpt_"):-len(".npz")]),
+    )
+    for f in ckpts[:-keep] if keep > 0 else []:
+        os.remove(os.path.join(directory, f))
+    # sweep tmp files orphaned by crashed writers
+    for f in os.listdir(directory):
+        if f.endswith(".tmp"):
+            os.remove(os.path.join(directory, f))
+
+
+def latest_step(directory: str) -> Optional[int]:
+    manifest = os.path.join(directory, "manifest.json")
+    if not os.path.exists(manifest):
+        return None
+    with open(manifest) as f:
+        return json.load(f)["latest_step"]
+
+
+def restore(
+    directory: str,
+    templates: Dict[str, Any],
+    *,
+    step: Optional[int] = None,
+) -> Tuple[int, Dict[str, Any]]:
+    """Restore ``(step, {name: pytree})``; templates supply structure and
+    (for jax.Array leaves) target shardings."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint manifest in {directory}")
+    with np.load(os.path.join(directory, f"ckpt_{step}.npz")) as data:
+        arrays = dict(data)
+
+    def unflatten(template, prefix):
+        flat_paths = jax.tree_util.tree_flatten_with_path(template)
+        leaves = []
+        for path, leaf in flat_paths[0]:
+            key = prefix + _SEP + _SEP.join(_entry_str(p) for p in path) if path else prefix
+            arr = arrays[key]
+            if arr.shape != np.shape(leaf):
+                raise ValueError(
+                    f"shape mismatch for {key}: ckpt {arr.shape} vs template {np.shape(leaf)}"
+                )
+            if isinstance(leaf, jax.Array):
+                arr = jax.device_put(arr.astype(leaf.dtype), leaf.sharding)
+            leaves.append(arr)
+        return jax.tree_util.tree_unflatten(flat_paths[1], leaves)
+
+    restored = {
+        name: (unflatten(tpl, name) if tpl is not None else None)
+        for name, tpl in templates.items()
+    }
+    return step, restored

@@ -92,7 +92,8 @@ class TrainConfig:
     checkpoint_every: int = 0            # 0 => disabled
     checkpoint_dir: Optional[str] = None
     seed: int = 0
-    # mesh axes: data-parallel x model(level)-parallel x sequence(column)-parallel
-    mesh_shape: Tuple[int, ...] = (1, 1, 1)
+    # mesh axes: data-parallel x model(tensor)-parallel x sequence(column)-parallel
+    # None => all devices on the data axis (the north-star pure-DP layout)
+    mesh_shape: Optional[Tuple[int, ...]] = None
     mesh_axes: Tuple[str, ...] = ("data", "model", "seq")
     donate: bool = True

@@ -1,0 +1,24 @@
+"""Parallelism: device meshes, sharding rules, collectives.
+
+The reference contains zero parallelism/communication code (SURVEY.md §2.3);
+all of this subpackage is TPU-native framework machinery:
+
+  * ``mesh.py`` — mesh construction over (data, model, seq) axes; multi-host init
+  * ``sharding.py`` — PartitionSpec rules for params/batch/state (DP + TP/EP + SP)
+  * ``ring.py`` — ring (sequence-parallel) consensus attention via shard_map +
+    ppermute with a running softmax — the ring-attention analogue for columns
+
+The communication backend is XLA collectives (psum/all_gather/ppermute) over
+ICI within a slice, DCN across slices — no NCCL/MPI anywhere.
+"""
+
+from glom_tpu.parallel.mesh import make_mesh, initialize_distributed
+from glom_tpu.parallel.sharding import param_pspecs, batch_pspec, state_pspec
+
+__all__ = [
+    "make_mesh",
+    "initialize_distributed",
+    "param_pspecs",
+    "batch_pspec",
+    "state_pspec",
+]

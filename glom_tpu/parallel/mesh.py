@@ -1,0 +1,73 @@
+"""Device mesh construction and multi-host initialization.
+
+TPU-native replacement for the distributed-init machinery the reference
+lacks entirely (no torch.distributed/NCCL/MPI — SURVEY.md §2.3).  A
+``jax.sharding.Mesh`` over axes ``(data, model, seq)`` is the framework's
+entire "communication backend": pjit-partitioned graphs emit XLA collectives
+(psum for grad reduction, all_gather/ppermute for the sharded consensus)
+that ride ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+DEFAULT_AXES = ("data", "model", "seq")
+
+
+def make_mesh(
+    mesh_shape: Optional[Sequence[int]] = None,
+    axis_names: Sequence[str] = DEFAULT_AXES,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh over the available devices.
+
+    ``mesh_shape=None`` puts every device on the ``data`` axis (pure DP —
+    the BASELINE.json north-star layout).  Shapes may use ``-1`` for one
+    inferred axis.  Uses ``jax.experimental.mesh_utils`` device ordering so
+    ICI-adjacent devices land on the fastest-varying axis.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if mesh_shape is None:
+        mesh_shape = (n,) + (1,) * (len(axis_names) - 1)
+    mesh_shape = list(mesh_shape)
+    if -1 in mesh_shape:
+        known = int(np.prod([s for s in mesh_shape if s != -1]))
+        mesh_shape[mesh_shape.index(-1)] = n // known
+    if int(np.prod(mesh_shape)) != n:
+        raise ValueError(f"mesh_shape {tuple(mesh_shape)} does not cover {n} devices")
+
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(tuple(mesh_shape), devices=list(devices))
+    except Exception:
+        # fallback: row-major reshape (fine for CPU/fake meshes)
+        dev_array = np.asarray(list(devices)).reshape(tuple(mesh_shape))
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bring-up: ``jax.distributed.initialize``.  On single-host
+    (or under the test harness) this is a no-op.  A host failure means
+    restart-from-checkpoint (SURVEY.md §5 failure-detection note); there is
+    no elasticity in v1."""
+    if num_processes is None or num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

@@ -1,0 +1,66 @@
+"""Sharding placement for whole training states.
+
+Maps a PartitionSpec rule-tree for *params* onto an arbitrary training-state
+pytree (optimizer moments mirror the param tree as a path suffix — e.g.
+optax's ``ScaleByAdamState.mu['glom']['bottom_up']['w1']`` — so specs are
+resolved by longest matching key-path suffix; scalars and unmatched leaves
+replicate).  This is the glue that lets one set of sharding rules
+(``glom_tpu.parallel.sharding``) place params, Adam moments, and any future
+state without per-optimizer code.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _flatten_specs(spec_tree: Any) -> dict:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+        spec_tree, is_leaf=lambda x: isinstance(x, P)
+    )[0]:
+        key = tuple(str(p.key) if hasattr(p, "key") else str(p.idx) for p in path)
+        flat[key] = leaf
+    return flat
+
+
+def _path_key(path) -> tuple:
+    keys = []
+    for p in path:
+        if hasattr(p, "key"):
+            keys.append(str(p.key))
+        elif hasattr(p, "name"):
+            keys.append(str(p.name))
+        elif hasattr(p, "idx"):
+            keys.append(str(p.idx))
+    return tuple(keys)
+
+
+def resolve_pspec(path_key: tuple, flat_specs: dict, ndim: int) -> P:
+    """Longest spec key-path that is a suffix-aligned subsequence tail of
+    ``path_key`` wins; fall back to replication."""
+    best, best_len = None, -1
+    for key, spec in flat_specs.items():
+        if len(key) <= len(path_key) and path_key[-len(key):] == key and len(key) > best_len:
+            # spec rank must fit leaf rank
+            if len([a for a in spec]) <= ndim or spec == P():
+                best, best_len = spec, len(key)
+    return best if best is not None else P()
+
+
+def state_shardings(mesh: Mesh, abstract_state: Any, param_spec_tree: Any) -> Any:
+    """Build a NamedSharding pytree mirroring ``abstract_state`` (from
+    ``jax.eval_shape``), resolving each leaf's spec by param-path suffix."""
+    flat_specs = _flatten_specs(param_spec_tree)
+
+    def leaf_sharding(path, leaf):
+        ndim = len(leaf.shape)
+        if ndim == 0:
+            return NamedSharding(mesh, P())
+        spec = resolve_pspec(_path_key(path), flat_specs, ndim)
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, abstract_state)

@@ -1,0 +1,74 @@
+"""Sharding rules: how GLOM's params and activations lay out on the mesh.
+
+The reference has no sharding story (SURVEY.md §2.3); these rules are the
+TPU-native design:
+
+  * **data** — batch dimension of images/state (pure DP; grad psum over ICI).
+  * **model** — tensor-parallel axis: the ``mult*dim`` hidden of every
+    per-level MLP is sharded, so each device holds a slice of every level's
+    FF (w1 column-sharded, w2 row-sharded; XLA inserts the psum on the way
+    out).  The ``levels`` group axis is deliberately NOT the TP axis —
+    with L=6 it's too coarse and it would also be the natural EP axis; the
+    EP-style level sharding is available via ``level_sharded_pspecs``.
+  * **seq** — sequence/context-parallel axis: the ``n`` patch-column axis of
+    activations.  The dense consensus lets XLA all-gather keys; the ring
+    implementation (``glom_tpu.parallel.ring``) exchanges K/V blocks via
+    ppermute instead.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from glom_tpu.config import GlomConfig
+
+
+def param_pspecs(config: GlomConfig, *, model_axis: str = "model") -> dict:
+    """PartitionSpec pytree matching ``glom_tpu.models.glom.init``.
+
+    TP layout: FF hidden dim sharded over ``model_axis``; everything else
+    replicated (patch-embed/pos-emb/init-levels are tiny)."""
+    ff = {
+        "w1": P(None, None, model_axis),   # (g, d, h): shard h
+        "b1": P(None, model_axis),         # (g, h)
+        "w2": P(None, model_axis, None),   # (g, h, d): shard h (contracting)
+        "b2": P(None, None),               # (g, d) replicated
+    }
+    return {
+        "patch_embed": {"w": P(None, None), "b": P(None)},
+        "pos_emb": P(None, None),
+        "init_levels": P(None, None),
+        "bottom_up": dict(ff),
+        "top_down": dict(ff),
+    }
+
+
+def level_sharded_pspecs(config: GlomConfig, *, model_axis: str = "model") -> dict:
+    """EP-style alternative: each device owns whole level-MLPs (shard the
+    group axis).  Deterministic routing — levels are always resident
+    (SURVEY.md §2.3 'EP-shaped but deterministic').  Requires
+    ``levels % mesh[model] == 0`` and ``(levels-1) % mesh[model] == 0``,
+    so it is mostly useful for large-L configs."""
+    ff = {
+        "w1": P(model_axis, None, None),
+        "b1": P(model_axis, None),
+        "w2": P(model_axis, None, None),
+        "b2": P(model_axis, None),
+    }
+    return {
+        "patch_embed": {"w": P(None, None), "b": P(None)},
+        "pos_emb": P(None, None),
+        "init_levels": P(None, None),
+        "bottom_up": dict(ff),
+        "top_down": dict(ff),
+    }
+
+
+def batch_pspec(data_axis: str = "data") -> P:
+    """Images ``(b, c, H, W)``: shard batch."""
+    return P(data_axis)
+
+
+def state_pspec(data_axis: str = "data", seq_axis: str = "seq") -> P:
+    """Level state ``(b, n, L, d)``: batch over data, columns over seq."""
+    return P(data_axis, seq_axis)

@@ -1,0 +1,13 @@
+"""Training subsystem.
+
+The reference ships its training recipe as README documentation only
+(`/root/reference/README.md:56-112`) — no loop, no optimizer, no data, no
+metrics.  Here it is framework code: the denoising-SSL objective
+(``denoise.py``), a mesh-aware jitted train step and loop (``trainer.py``),
+data pipelines (``data.py``), and JSONL metrics (``metrics.py``).
+"""
+
+from glom_tpu.training.denoise import make_loss_fn, make_step_fn, make_train_step, DenoiseState
+from glom_tpu.training.trainer import Trainer
+
+__all__ = ["make_loss_fn", "make_step_fn", "make_train_step", "DenoiseState", "Trainer"]

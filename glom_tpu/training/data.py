@@ -1,0 +1,144 @@
+"""Data pipelines.
+
+The reference ships none (the README trains on ``torch.randn`` images).
+Pipelines here:
+
+  * ``synthetic`` — deterministic host-side random images; the zero-egress
+    default and the bench workload.
+  * ``folder`` — ``.npy``/``.npz`` image arrays from a local directory
+    (e.g. a pre-exported CIFAR-10/ImageNet dump), resized by patch-aligned
+    center crop/tile; no network access required.
+
+Batches are NCHW float32 in [-1, 1] (matching the reference's standardized
+``randn`` statistics).  A background-thread prefetcher overlaps host batch
+prep with device compute — the host↔device pipelining role a torch
+DataLoader would play.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+def synthetic_batches(
+    batch_size: int, image_size: int, channels: int = 3, seed: int = 0
+) -> Iterator[np.ndarray]:
+    """Endless deterministic stream of standard-normal images."""
+    rng = np.random.default_rng(seed)
+    while True:
+        yield rng.standard_normal(
+            (batch_size, channels, image_size, image_size), dtype=np.float32
+        )
+
+
+def folder_batches(
+    directory: str,
+    batch_size: int,
+    image_size: int,
+    channels: int = 3,
+    seed: int = 0,
+) -> Iterator[np.ndarray]:
+    """Stream batches from ``.npy``/``.npz`` files holding ``(N, C, H, W)`` or
+    ``(N, H, W, C)`` uint8/float arrays; normalized to zero-mean/unit-ish
+    range and resized by nearest-neighbor to the model's image size."""
+    files = sorted(
+        os.path.join(directory, f)
+        for f in os.listdir(directory)
+        if f.endswith((".npy", ".npz"))
+    )
+    if not files:
+        raise FileNotFoundError(f"no .npy/.npz files in {directory}")
+    arrays = []
+    for f in files:
+        if f.endswith(".npz"):
+            with np.load(f) as z:
+                arrays.extend(z[k] for k in z.files if z[k].ndim == 4)
+        else:
+            arrays.append(np.load(f))
+    data = np.concatenate(arrays, axis=0)
+    if data.shape[-1] in (1, 3) and data.shape[1] not in (1, 3):
+        data = data.transpose(0, 3, 1, 2)  # NHWC -> NCHW
+    if data.dtype == np.uint8:
+        data = data.astype(np.float32) / 127.5 - 1.0
+    else:
+        data = data.astype(np.float32)
+    data = _resize_nchw(data, image_size)
+    if data.shape[1] != channels:
+        raise ValueError(f"dataset has {data.shape[1]} channels, model expects {channels}")
+
+    rng = np.random.default_rng(seed)
+    n = data.shape[0]
+    while True:
+        idx = rng.integers(0, n, size=batch_size)
+        yield data[idx]
+
+
+def _resize_nchw(data: np.ndarray, size: int) -> np.ndarray:
+    """Nearest-neighbor resize to size x size (no image libs in the
+    zero-egress image); handles non-square inputs per axis."""
+    h, w = data.shape[2], data.shape[3]
+    if h != size:
+        data = data[:, :, (np.arange(size) * h / size).astype(np.int64)]
+    if w != size:
+        data = data[:, :, :, (np.arange(size) * w / size).astype(np.int64)]
+    return data
+
+
+class Prefetcher:
+    """Bounded background-thread prefetch of host batches (the data-loader
+    overlap role; device transfer happens at dispatch inside jit).  Producer
+    exceptions are captured and re-raised on the consumer side — a pipeline
+    error must not masquerade as end-of-data."""
+
+    def __init__(self, it: Iterator[np.ndarray], depth: int = 2):
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._it = it
+        self._done = object()
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        try:
+            for item in self._it:
+                self._q.put(item)
+        except BaseException as e:  # re-raised in __next__
+            self._error = e
+        finally:
+            self._q.put(self._done)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._done:
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item
+
+
+def make_batches(
+    kind: str,
+    batch_size: int,
+    image_size: int,
+    channels: int = 3,
+    seed: int = 0,
+    data_dir: Optional[str] = None,
+    prefetch: int = 2,
+) -> Iterator[np.ndarray]:
+    if kind == "synthetic":
+        it = synthetic_batches(batch_size, image_size, channels, seed)
+    elif kind == "folder":
+        if data_dir is None:
+            raise ValueError("folder data source needs data_dir")
+        it = folder_batches(data_dir, batch_size, image_size, channels, seed)
+    else:
+        raise ValueError(f"unknown data source {kind!r}")
+    return Prefetcher(it, prefetch) if prefetch > 0 else it

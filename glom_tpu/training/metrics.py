@@ -1,0 +1,40 @@
+"""Metrics / logging / observability.
+
+Absent from the reference (SURVEY.md §5).  A dependency-free JSONL scalar
+logger: one JSON object per line to stdout and/or a file — loss, imgs/sec,
+step time, grad norm — the metrics of record in BASELINE.md.  Multi-host:
+only process 0 emits.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import IO, Optional
+
+import jax
+
+
+class MetricLogger:
+    def __init__(self, path: Optional[str] = None, stream: Optional[IO] = None):
+        self._emit = jax.process_index() == 0
+        self._stream = stream if stream is not None else sys.stdout
+        self._file = open(path, "a") if (path and self._emit) else None
+        self._t0 = time.time()
+
+    def log(self, step: int, **scalars) -> None:
+        if not self._emit:
+            return
+        rec = {"step": int(step), "time": round(time.time() - self._t0, 3)}
+        for k, v in scalars.items():
+            rec[k] = float(v)
+        line = json.dumps(rec)
+        print(line, file=self._stream, flush=True)
+        if self._file:
+            self._file.write(line + "\n")
+            self._file.flush()
+
+    def close(self) -> None:
+        if self._file:
+            self._file.close()

@@ -1,0 +1,100 @@
+"""CLI training entry point: ``python -m glom_tpu.training.train``.
+
+The reference has no launcher/CLI at all (SURVEY.md §1 'scheduler/runtime/
+CLI: absent').  Flags mirror GlomConfig/TrainConfig field names 1:1.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+
+from glom_tpu.config import GlomConfig, TrainConfig
+from glom_tpu.parallel.mesh import initialize_distributed
+from glom_tpu.training.data import make_batches
+from glom_tpu.training.metrics import MetricLogger
+from glom_tpu.training.trainer import Trainer
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="GLOM denoising-SSL training (TPU-native)")
+    # model
+    p.add_argument("--dim", type=int, default=512)
+    p.add_argument("--levels", type=int, default=6)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--patch-size", type=int, default=14)
+    p.add_argument("--consensus-self", action="store_true")
+    p.add_argument("--local-consensus-radius", type=int, default=0)
+    p.add_argument("--bf16", action="store_true", help="bf16 compute (params stay fp32)")
+    p.add_argument("--remat", action="store_true")
+    p.add_argument("--attention-impl", default="dense", choices=["dense", "pallas", "ring"])
+    # training
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--weight-decay", type=float, default=0.0)
+    p.add_argument("--iters", type=int, default=None)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--noise-std", type=float, default=1.0)
+    # data
+    p.add_argument("--data", default="synthetic", choices=["synthetic", "folder"])
+    p.add_argument("--data-dir", default=None)
+    # parallelism
+    p.add_argument("--mesh", type=int, nargs="+", default=None,
+                   help="mesh shape over (data, model, seq); default: all-data")
+    # checkpointing / logging
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--log-file", default=None)
+    # multi-host
+    p.add_argument("--coordinator", default=None)
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    initialize_distributed(args.coordinator, args.num_processes, args.process_id)
+
+    import jax.numpy as jnp
+
+    config = GlomConfig(
+        dim=args.dim,
+        levels=args.levels,
+        image_size=args.image_size,
+        patch_size=args.patch_size,
+        consensus_self=args.consensus_self,
+        local_consensus_radius=args.local_consensus_radius,
+        compute_dtype=jnp.bfloat16 if args.bf16 else None,
+        remat=args.remat,
+        attention_impl=args.attention_impl,
+    )
+    train_cfg = TrainConfig(
+        batch_size=args.batch_size,
+        learning_rate=args.lr,
+        weight_decay=args.weight_decay,
+        iters=args.iters,
+        noise_std=args.noise_std,
+        steps=args.steps,
+        log_every=args.log_every,
+        checkpoint_every=args.checkpoint_every,
+        checkpoint_dir=args.checkpoint_dir,
+        seed=args.seed,
+        mesh_shape=tuple(args.mesh) if args.mesh else None,
+    )
+
+    trainer = Trainer(config, train_cfg, logger=MetricLogger(path=args.log_file))
+    batches = make_batches(
+        args.data, args.batch_size, args.image_size,
+        config.channels, args.seed, args.data_dir,
+    )
+    final = trainer.fit(batches)
+    if jax.process_index() == 0:
+        print({"final": final})
+
+
+if __name__ == "__main__":
+    main()
