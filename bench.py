@@ -1,0 +1,78 @@
+"""Benchmark of record.
+
+Measures sustained denoising-SSL training throughput (imgs/sec/chip) for the
+flagship reference config — Glom(dim=512, levels=6, image=224, patch=14),
+iters=12, the BASELINE.json metric of record — on the attached device, and
+prints ONE JSON line.
+
+``vs_baseline`` compares against the BASELINE.json north-star rate of
+>2,000 imgs/sec aggregate on a v4-32 slice, i.e. 62.5 imgs/sec/chip
+(the reference itself publishes no numbers — BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+NORTH_STAR_IMGS_PER_SEC_PER_CHIP = 2000.0 / 32.0
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=0, help="0 = auto by device kind")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--fp32", action="store_true", help="disable bf16 compute")
+    p.add_argument("--no-remat", action="store_true",
+                   help="disable scan-body rematerialization (needs small batch)")
+    p.add_argument("--attention-impl", default="dense", choices=["dense", "pallas", "ring"])
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from glom_tpu.config import GlomConfig, TrainConfig
+    from glom_tpu.training.data import synthetic_batches
+    from glom_tpu.training.trainer import Trainer
+    from glom_tpu.training.metrics import MetricLogger
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    batch = args.batch_size or (32 if on_tpu else 4)
+
+    config = GlomConfig(
+        compute_dtype=jnp.float32 if args.fp32 else jnp.bfloat16,
+        remat=not args.no_remat,
+        attention_impl=args.attention_impl,
+    )
+    train = TrainConfig(batch_size=batch, iters=12, log_every=0)
+    trainer = Trainer(config, train, logger=MetricLogger(stream=__import__("sys").stderr))
+
+    batches = synthetic_batches(batch, config.image_size)
+    img = jax.device_put(next(batches), trainer._batch_sh)
+
+    state = trainer.state
+    for _ in range(args.warmup):
+        state, metrics = trainer._step(state, img)
+    jax.block_until_ready(state.params)
+
+    t0 = time.time()
+    for _ in range(args.steps):
+        state, metrics = trainer._step(state, img)
+    jax.block_until_ready(state.params)
+    dt = time.time() - t0
+
+    imgs_per_sec = batch * args.steps / dt
+    per_chip = imgs_per_sec / jax.device_count()
+    result = {
+        "metric": "denoise_ssl_train_imgs_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "imgs/sec/chip",
+        "vs_baseline": round(per_chip / NORTH_STAR_IMGS_PER_SEC_PER_CHIP, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

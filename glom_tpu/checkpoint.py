@@ -74,16 +74,21 @@ def save(directory: str, step: int, trees: Dict[str, Any], *, keep: int = 3) -> 
         "manifest.json",
         lambda f: f.write(json.dumps({"latest_step": step, "path": path}).encode()),
     )
-    _prune(directory, keep)
+    _prune(directory, keep, protect=step)
     return path
 
 
-def _prune(directory: str, keep: int) -> None:
+def _prune(directory: str, keep: int, *, protect: Optional[int] = None) -> None:
+    """Keep the ``keep`` newest checkpoints, never deleting ``protect`` (the
+    step the manifest points at — matters when saving a step lower than
+    stale higher-numbered checkpoints after a rollback)."""
     ckpts = sorted(
         (f for f in os.listdir(directory) if f.startswith("ckpt_") and f.endswith(".npz")),
         key=lambda f: int(f[len("ckpt_"):-len(".npz")]),
     )
     for f in ckpts[:-keep] if keep > 0 else []:
+        if protect is not None and f == f"ckpt_{protect}.npz":
+            continue
         os.remove(os.path.join(directory, f))
     # sweep tmp files orphaned by crashed writers
     for f in os.listdir(directory):

@@ -50,7 +50,11 @@ def make_mesh(
 
         dev_array = mesh_utils.create_device_mesh(tuple(mesh_shape), devices=list(devices))
     except Exception:
-        # fallback: row-major reshape (fine for CPU/fake meshes)
+        # Row-major fallback is only safe where ICI topology doesn't exist
+        # (CPU/fake meshes); on real TPUs a silent arbitrary layout would be
+        # an invisible collective-throughput regression — re-raise there.
+        if any(d.platform != "cpu" for d in devices):
+            raise
         dev_array = np.asarray(list(devices)).reshape(tuple(mesh_shape))
     return Mesh(dev_array, tuple(axis_names))
 

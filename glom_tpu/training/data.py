@@ -57,7 +57,10 @@ def folder_batches(
     for f in files:
         if f.endswith(".npz"):
             with np.load(f) as z:
-                arrays.extend(z[k] for k in z.files if z[k].ndim == 4)
+                for k in z.files:
+                    arr = z[k]  # decompress once
+                    if arr.ndim == 4:
+                        arrays.append(arr)
         else:
             arrays.append(np.load(f))
     data = np.concatenate(arrays, axis=0)

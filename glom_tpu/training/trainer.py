@@ -54,6 +54,11 @@ class Trainer:
         self.tx = tx
         self.logger = logger or MetricLogger()
 
+        if len(train.mesh_axes) < 2:
+            raise ValueError(
+                f"mesh_axes needs at least (data, model) axes, got {train.mesh_axes}; "
+                "use mesh_shape=(N, 1, 1) for pure DP"
+            )
         data_axis, model_axis = train.mesh_axes[0], train.mesh_axes[1]
         if train.batch_size % self.mesh.shape[data_axis] != 0:
             raise ValueError(
@@ -84,7 +89,13 @@ class Trainer:
 
     # -- checkpointing ----------------------------------------------------
     def save(self, directory: str) -> str:
-        host_state = jax.device_get(self.state)
+        if jax.process_count() > 1:
+            # sharded leaves may span non-addressable devices; gather first
+            from jax.experimental import multihost_utils
+
+            host_state = multihost_utils.process_allgather(self.state)
+        else:
+            host_state = jax.device_get(self.state)
         return ckpt_lib.save(
             directory,
             int(host_state.step),
