@@ -1,0 +1,12 @@
+"""Pallas TPU kernels — the hand-scheduled fast paths.
+
+The reference's kernel layer is PyTorch's dispatch (SURVEY.md §2.2: grouped
+conv1d, cuBLAS einsums, softmax).  Here XLA fusion covers most of it; Pallas
+is used where fusion isn't enough: the consensus attention, fused end-to-end
+(normalize keys -> QK^T -> masks -> softmax -> AV) so attention weights never
+round-trip through HBM.
+"""
+
+from glom_tpu.kernels.consensus_pallas import consensus_attention_pallas
+
+__all__ = ["consensus_attention_pallas"]

@@ -1,0 +1,179 @@
+"""Fused consensus attention as a Pallas TPU kernel.
+
+Replaces the XLA path of ``glom_tpu.ops.consensus.consensus_attention``
+(reference semantics: `glom_pytorch.py:56-73`) with one kernel per
+(batch, level, query-block):
+
+    L2-normalize keys -> QK^T (MXU) -> soft self-mask / hard locality mask
+    -> softmax -> AV (MXU)
+
+all in VMEM — the ``(n, n)`` attention weights never exist in HBM.  Keys and
+values for a (batch, level) pair stay VMEM-resident (n*d*2 floats ≈ 2 MB at
+the n=1024/d=512 scale), queries are blocked.  For column counts beyond
+VMEM, use the ring path (``glom_tpu.parallel.ring``), which is the sharded
+analogue of the same online-softmax math.
+
+Backward: ``jax.custom_vjp`` whose cotangent rule is the plain-XLA dense
+formulation — numerically identical, and the forward memory win (no n²
+materialization on the hot inference/rollout path) is kept.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from glom_tpu.ops.consensus import TOKEN_ATTEND_SELF_VALUE, consensus_attention
+
+
+def _pick_block(n: int, cap: int = 256) -> int:
+    """Largest divisor of n that is a multiple of 8 (fp32 sublane tile) and
+    <= cap; falls back to n itself (single block)."""
+    for bi in range(min(cap, n), 7, -1):
+        if n % bi == 0 and bi % 8 == 0:
+            return bi
+    return n
+
+
+def _kernel(q_ref, kv_ref, o_ref, *, scale, attend_self, block_i, n):
+    q = q_ref[0, 0].astype(jnp.float32)          # (Bi, d)
+    kv = kv_ref[0, 0].astype(jnp.float32)        # (n, d)
+
+    # keys: L2 normalize with torch F.normalize semantics (max(||k||, eps))
+    norm = jnp.sqrt(jnp.sum(kv * kv, axis=-1, keepdims=True))
+    k = kv / jnp.maximum(norm, 1e-12)
+
+    sim = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale                                     # (Bi, n)
+
+    if not attend_self:
+        i_ids = jax.lax.broadcasted_iota(jnp.int32, (block_i, n), 0)
+        i_ids = i_ids + pl.program_id(2) * block_i
+        j_ids = jax.lax.broadcasted_iota(jnp.int32, (block_i, n), 1)
+        sim = jnp.where(i_ids == j_ids, jnp.float32(TOKEN_ATTEND_SELF_VALUE), sim)
+
+    attn = jax.nn.softmax(sim, axis=-1)
+    out = jnp.dot(attn, kv, preferred_element_type=jnp.float32)
+    o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def _kernel_masked(q_ref, kv_ref, mask_ref, o_ref, *, scale, attend_self, block_i, n):
+    q = q_ref[0, 0].astype(jnp.float32)
+    kv = kv_ref[0, 0].astype(jnp.float32)
+
+    norm = jnp.sqrt(jnp.sum(kv * kv, axis=-1, keepdims=True))
+    k = kv / jnp.maximum(norm, 1e-12)
+
+    sim = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+
+    if not attend_self:
+        i_ids = jax.lax.broadcasted_iota(jnp.int32, (block_i, n), 0)
+        i_ids = i_ids + pl.program_id(2) * block_i
+        j_ids = jax.lax.broadcasted_iota(jnp.int32, (block_i, n), 1)
+        sim = jnp.where(i_ids == j_ids, jnp.float32(TOKEN_ATTEND_SELF_VALUE), sim)
+
+    sim = jnp.where(mask_ref[:] != 0, -jnp.finfo(jnp.float32).max, sim)
+
+    attn = jax.nn.softmax(sim, axis=-1)
+    out = jnp.dot(attn, kv, preferred_element_type=jnp.float32)
+    o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def _forward(levels, mask_i8, *, attend_self, interpret):
+    b, n, L, d = levels.shape
+    x = jnp.transpose(levels, (0, 2, 1, 3))       # (b, L, n, d)
+    block_i = _pick_block(n)
+    grid = (b, L, n // block_i)
+    scale = d ** -0.5
+
+    q_spec = pl.BlockSpec(
+        (1, 1, block_i, d), lambda ib, il, ii: (ib, il, ii, 0), memory_space=pltpu.VMEM
+    )
+    kv_spec = pl.BlockSpec(
+        (1, 1, n, d), lambda ib, il, ii: (ib, il, 0, 0), memory_space=pltpu.VMEM
+    )
+    out_spec = pl.BlockSpec(
+        (1, 1, block_i, d), lambda ib, il, ii: (ib, il, ii, 0), memory_space=pltpu.VMEM
+    )
+    out_shape = jax.ShapeDtypeStruct((b, L, n, d), levels.dtype)
+
+    if mask_i8 is None:
+        kern = functools.partial(
+            _kernel, scale=scale, attend_self=attend_self, block_i=block_i, n=n
+        )
+        y = pl.pallas_call(
+            kern,
+            grid=grid,
+            in_specs=[q_spec, kv_spec],
+            out_specs=out_spec,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(x, x)
+    else:
+        mask_spec = pl.BlockSpec(
+            (block_i, n), lambda ib, il, ii: (ii, 0), memory_space=pltpu.VMEM
+        )
+        kern = functools.partial(
+            _kernel_masked, scale=scale, attend_self=attend_self, block_i=block_i, n=n
+        )
+        y = pl.pallas_call(
+            kern,
+            grid=grid,
+            in_specs=[q_spec, kv_spec, mask_spec],
+            out_specs=out_spec,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(x, x, mask_i8)
+
+    return jnp.transpose(y, (0, 2, 1, 3))         # (b, n, L, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _consensus_pallas(levels, mask_i8, attend_self, interpret):
+    return _forward(levels, mask_i8, attend_self=attend_self, interpret=interpret)
+
+
+def _fwd(levels, mask_i8, attend_self, interpret):
+    out = _forward(levels, mask_i8, attend_self=attend_self, interpret=interpret)
+    return out, (levels, mask_i8)
+
+
+def _bwd(attend_self, interpret, res, g):
+    levels, mask_i8 = res
+    mask = mask_i8.astype(bool) if mask_i8 is not None else None
+    _, vjp = jax.vjp(
+        lambda x: consensus_attention(x, attend_self=attend_self, non_local_mask=mask),
+        levels,
+    )
+    (dlevels,) = vjp(g)
+    return (dlevels, None)
+
+
+_consensus_pallas.defvjp(_fwd, _bwd)
+
+
+def consensus_attention_pallas(
+    levels: jax.Array,
+    *,
+    attend_self: bool = False,
+    non_local_mask: Optional[jax.Array] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Drop-in for :func:`glom_tpu.ops.consensus.consensus_attention`.
+
+    ``interpret=None`` auto-selects interpreter mode off-TPU (CPU tests);
+    pass ``False``/``True`` to force."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    mask_i8 = None
+    if non_local_mask is not None:
+        mask_i8 = non_local_mask.astype(jnp.int8)
+    return _consensus_pallas(levels, mask_i8, attend_self, interpret)

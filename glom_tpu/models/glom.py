@@ -82,12 +82,20 @@ def _update_step(params, bottom_level, pos_embs, divisors, consensus_fn, levels)
     return new_levels
 
 
+def resolve_locality_mask(config: GlomConfig) -> Optional[jax.Array]:
+    """Boolean (n, n) blocked-pair mask when ``local_consensus_radius > 0``
+    (`glom_pytorch.py:44-54`), else None."""
+    if config.local_consensus_radius > 0:
+        return jnp.asarray(
+            local_consensus_mask(config.num_patches_side, config.local_consensus_radius)
+        )
+    return None
+
+
 def make_consensus_fn(config: GlomConfig):
     """Resolve the attention implementation: XLA-dense (always-correct path),
     Pallas fused kernel, or ring-sharded — all numerically interchangeable."""
-    mask = None
-    if config.local_consensus_radius > 0:
-        mask = jnp.asarray(local_consensus_mask(config.num_patches_side, config.local_consensus_radius))
+    mask = resolve_locality_mask(config)
 
     if config.attention_impl == "dense":
         return functools.partial(
@@ -124,6 +132,7 @@ def apply(
     iters: Optional[int] = None,
     levels: Optional[jax.Array] = None,
     return_all: bool = False,
+    consensus_fn=None,
 ) -> jax.Array:
     """Forward pass.
 
@@ -134,6 +143,10 @@ def apply(
 
     Returns ``(b, n, L, d)`` or, with ``return_all``, ``(iters+1, b, n, L, d)``
     including the t=0 state.
+
+    ``consensus_fn`` overrides the config-resolved attention implementation —
+    used by the Trainer to inject a mesh-bound ring consensus
+    (``glom_tpu.parallel.ring.make_ring_consensus``).
     """
     c = config
     if iters is None:
@@ -162,7 +175,8 @@ def apply(
     divisors[-1] = 3.0
     divisors = jnp.asarray(divisors, compute_dtype)
 
-    consensus_fn = make_consensus_fn(c)
+    if consensus_fn is None:
+        consensus_fn = make_consensus_fn(c)
     step = functools.partial(
         _update_step, params, bottom_level, pos_embs, divisors, consensus_fn
     )

@@ -1,0 +1,146 @@
+"""Ring (sequence-parallel) consensus attention.
+
+The reference's consensus materializes the full ``(b, l, n, n)`` similarity in
+one einsum (`glom_pytorch.py:60`) — O(n²) memory and all-to-all over columns.
+For large images (BASELINE.json config 4: 384/16 → n=576, and beyond) the
+TPU-native answer is ring attention over the column axis:
+
+  * the ``n`` patch columns are sharded over the mesh's ``seq`` axis;
+  * each device keeps its queries resident and rotates (normalized-key,
+    value) blocks around the ring with ``lax.ppermute`` over ICI;
+  * softmax is computed *online* (running max / weighted accumulator, flash
+    style) so the full n×n similarity never exists anywhere.
+
+Numerics match ``glom_tpu.ops.consensus.consensus_attention`` — including the
+soft −5e-4 self-mask (applied only where global i == global j) and the hard
+locality mask (sliced per (my block, incoming block) from the precomputed
+(n, n) mask) — which the equivalence tests assert on a faked 8-device mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from glom_tpu.ops.consensus import TOKEN_ATTEND_SELF_VALUE, l2_normalize
+
+
+def _ring_consensus_local(
+    levels: jax.Array,
+    *,
+    axis_name: str,
+    attend_self: bool,
+    non_local_mask: Optional[jax.Array],
+) -> jax.Array:
+    """Per-shard body (runs inside shard_map).  ``levels``: (b, n_local, L, d)
+    local block; returns the consensus output for the local columns."""
+    b, n_local, L, d = levels.shape
+    size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+
+    q = levels
+    k0 = l2_normalize(levels, axis=-1)
+    v0 = levels
+    scale = d ** -0.5
+
+    i_global = my_idx * n_local + jnp.arange(n_local)          # (n_local,)
+
+    acc0 = jnp.zeros((b, L, n_local, d), jnp.float32)
+    m0 = jnp.full((b, L, n_local), -jnp.inf, jnp.float32)
+    den0 = jnp.zeros((b, L, n_local), jnp.float32)
+
+    def step(carry, s):
+        k, v, acc, m, den = carry
+        # after s rotations we hold the block originally owned by shard
+        # (my_idx + s) mod size
+        src = (my_idx + s) % size
+        j_global = src * n_local + jnp.arange(n_local)
+
+        sim = jnp.einsum("bild,bjld->blij", q, k).astype(jnp.float32) * scale
+
+        if not attend_self:
+            self_mask = i_global[:, None] == j_global[None, :]
+            sim = jnp.where(self_mask[None, None], TOKEN_ATTEND_SELF_VALUE, sim)
+        if non_local_mask is not None:
+            rows = non_local_mask[i_global]                      # (n_local, n)
+            block = jax.lax.dynamic_slice(
+                rows, (0, src * n_local), (n_local, n_local)
+            )
+            sim = jnp.where(block[None, None], -jnp.finfo(jnp.float32).max, sim)
+
+        m_new = jnp.maximum(m, sim.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(sim - m_new[..., None])
+        acc = acc * corr[..., None] + jnp.einsum(
+            "blij,bjld->blid", p, v.astype(jnp.float32)
+        )
+        den = den * corr + p.sum(axis=-1)
+
+        # rotate k/v one step around the ring (skip after the last use)
+        perm = [(r, (r - 1) % size) for r in range(size)]
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        return (k, v, acc, m_new, den), None
+
+    (_, _, acc, _, den), _ = jax.lax.scan(
+        step, (k0, v0, acc0, m0, den0), jnp.arange(size)
+    )
+    out = acc / den[..., None]
+    return jnp.einsum("blid->bild", out).astype(levels.dtype)
+
+
+def ring_consensus_attention(
+    levels: jax.Array,
+    *,
+    attend_self: bool = False,
+    non_local_mask: Optional[jax.Array] = None,
+    axis_name: str = "seq",
+) -> jax.Array:
+    """Collective form: call INSIDE shard_map/pjit where ``axis_name`` is a
+    bound mesh axis and ``levels`` holds this shard's columns."""
+    return _ring_consensus_local(
+        levels, axis_name=axis_name, attend_self=attend_self, non_local_mask=non_local_mask
+    )
+
+
+def make_ring_consensus(
+    mesh: Mesh,
+    *,
+    attend_self: bool = False,
+    non_local_mask: Optional[jax.Array] = None,
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+):
+    """Build a drop-in consensus fn ``(b, n, L, d) -> (b, n, L, d)`` that
+    shards columns over ``seq_axis`` (and batch over ``data_axis``) and runs
+    the ring exchange.  Usable under an outer jit; XLA sees only ppermutes —
+    the n×n similarity never materializes."""
+    spec = P(data_axis, seq_axis, None, None)
+    body = functools.partial(
+        _ring_consensus_local,
+        axis_name=seq_axis,
+        attend_self=attend_self,
+        non_local_mask=non_local_mask,
+    )
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec,),
+        out_specs=spec,
+        check_vma=False,
+    )
+
+    def consensus_fn(levels: jax.Array) -> jax.Array:
+        n = levels.shape[1]
+        n_shards = mesh.shape[seq_axis]
+        if n % n_shards != 0:
+            raise ValueError(
+                f"n={n} patch columns not divisible by seq-axis size {n_shards}"
+            )
+        return sharded(levels)
+
+    return consensus_fn

@@ -46,7 +46,7 @@ def init_state(
     return DenoiseState(params, tx.init(params), jnp.zeros((), jnp.int32), k_train)
 
 
-def make_loss_fn(config: GlomConfig, train: TrainConfig):
+def make_loss_fn(config: GlomConfig, train: TrainConfig, *, consensus_fn=None):
     """loss(params, img, rng) -> (loss, recon).  Mirrors README.md:74-88."""
     iters = train.iters if train.iters is not None else config.default_iters
     timestep = train.loss_timestep if train.loss_timestep is not None else iters // 2 + 1
@@ -57,7 +57,8 @@ def make_loss_fn(config: GlomConfig, train: TrainConfig):
         noise = jax.random.normal(rng, img.shape, img.dtype) * train.noise_std
         noised = img + noise
         all_levels = glom_model.apply(
-            params["glom"], noised, config=config, iters=iters, return_all=True
+            params["glom"], noised, config=config, iters=iters, return_all=True,
+            consensus_fn=consensus_fn,
         )
         tokens = all_levels[timestep, :, :, train.loss_level]   # (b, n, d)
         recon = patches_to_images_apply(params["decoder"], tokens, config)
@@ -67,10 +68,16 @@ def make_loss_fn(config: GlomConfig, train: TrainConfig):
     return loss_fn
 
 
-def make_step_fn(config: GlomConfig, train: TrainConfig, tx: optax.GradientTransformation):
+def make_step_fn(
+    config: GlomConfig,
+    train: TrainConfig,
+    tx: optax.GradientTransformation,
+    *,
+    consensus_fn=None,
+):
     """Un-jitted train step ``state, img -> state, metrics`` — the body the
     Trainer jits with explicit shardings/donation."""
-    loss_fn = make_loss_fn(config, train)
+    loss_fn = make_loss_fn(config, train, consensus_fn=consensus_fn)
 
     def step_fn(state: DenoiseState, img: jax.Array) -> Tuple[DenoiseState, dict]:
         rng, rng_noise = jax.random.split(state.rng)

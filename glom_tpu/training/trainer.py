@@ -80,8 +80,27 @@ class Trainer:
         )
         self.state = init_fn()
 
+        consensus_fn = None
+        if config.attention_impl == "ring":
+            from glom_tpu.models.glom import resolve_locality_mask
+            from glom_tpu.parallel.ring import make_ring_consensus
+
+            if len(train.mesh_axes) < 3:
+                raise ValueError(
+                    "attention_impl='ring' needs a third (seq) mesh axis; "
+                    f"got mesh_axes={train.mesh_axes}"
+                )
+            seq_axis = train.mesh_axes[2]
+            consensus_fn = make_ring_consensus(
+                self.mesh,
+                attend_self=config.consensus_self,
+                non_local_mask=resolve_locality_mask(config),
+                data_axis=data_axis,
+                seq_axis=seq_axis,
+            )
+
         self._step = jax.jit(
-            denoise.make_step_fn(config, train, tx),
+            denoise.make_step_fn(config, train, tx, consensus_fn=consensus_fn),
             in_shardings=(self._state_sh, self._batch_sh),
             out_shardings=(self._state_sh, NamedSharding(self.mesh, P())),
             donate_argnums=(0,) if train.donate else (),

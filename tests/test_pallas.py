@@ -1,0 +1,54 @@
+"""Pallas fused consensus kernel tests (interpret mode on CPU): parity with
+the dense XLA path for every mask config, gradient parity via the custom
+VJP, and full-model equivalence with attention_impl='pallas'."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from glom_tpu.config import GlomConfig
+from glom_tpu.kernels.consensus_pallas import consensus_attention_pallas, _pick_block
+from glom_tpu.models import glom as glom_model
+from glom_tpu.ops.consensus import consensus_attention
+from glom_tpu.ops.masks import local_consensus_mask
+
+
+def test_pick_block():
+    assert _pick_block(256) == 256
+    assert _pick_block(1024) == 256
+    assert _pick_block(576) == 192
+    assert _pick_block(16) == 16
+    assert _pick_block(9) == 9  # fallback: single odd block
+
+
+@pytest.mark.parametrize("attend_self", [False, True])
+@pytest.mark.parametrize("use_mask", [False, True])
+def test_pallas_matches_dense(attend_self, use_mask):
+    rng = np.random.default_rng(0)
+    levels = jnp.asarray(rng.standard_normal((2, 16, 3, 32)).astype(np.float32))
+    mask = jnp.asarray(local_consensus_mask(4, 1.5)) if use_mask else None
+    want = consensus_attention(levels, attend_self=attend_self, non_local_mask=mask)
+    got = consensus_attention_pallas(
+        levels, attend_self=attend_self, non_local_mask=mask
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_pallas_grad_matches_dense():
+    rng = np.random.default_rng(1)
+    levels = jnp.asarray(rng.standard_normal((1, 16, 2, 16)).astype(np.float32))
+
+    g_dense = jax.grad(lambda x: jnp.sum(consensus_attention(x) ** 2))(levels)
+    g_pallas = jax.grad(lambda x: jnp.sum(consensus_attention_pallas(x) ** 2))(levels)
+    np.testing.assert_allclose(np.asarray(g_pallas), np.asarray(g_dense), atol=1e-5)
+
+
+def test_model_with_pallas_attention_matches_dense():
+    c_dense = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4)
+    c_pallas = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4, attention_impl="pallas")
+    params = glom_model.init(jax.random.PRNGKey(0), c_dense)
+    img = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 16, 16))
+    out_d = glom_model.apply(params, img, config=c_dense, iters=3)
+    out_p = glom_model.apply(params, img, config=c_pallas, iters=3)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_d), atol=1e-4)
