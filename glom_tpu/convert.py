@@ -1,0 +1,119 @@
+"""Torch <-> JAX weight conversion.
+
+Maps the reference's ``state_dict`` layout onto this framework's param pytree
+so reference-trained weights load into the shim (SURVEY.md §5 checkpoint
+note).  The reference implements the per-level MLPs as grouped 1x1 Conv1d
+(`glom_pytorch.py:29-31`) whose weights are ``(out_ch, in_ch/groups, 1)``;
+here they are stacked ``(groups, d_in, d_out)`` matmul tensors, so each conv
+weight reshapes to ``(groups, d_out, d_in)`` and transposes its last two
+axes.  The ``non_local_mask`` buffer (present in the state_dict only when
+``local_consensus_radius > 0``, `glom_pytorch.py:44,54`) is config-derived
+here and is ignored on import / regenerated on export.
+
+Reference state_dict keys:
+    image_to_tokens.1.{weight,bias}     Linear(p^2*3, dim)
+    pos_emb.weight                      Embedding(n, dim)
+    init_levels                         (L, dim)
+    bottom_up.net.1.{weight,bias}       Conv1d(L*d, L*4d, 1, groups=L)
+    bottom_up.net.3.{weight,bias}       Conv1d(L*4d, L*d, 1, groups=L)
+    top_down.net.1.{weight,bias}        Conv1d((L-1)*d, (L-1)*4d, 1, groups=L-1)
+    top_down.net.3.{weight,bias}        Conv1d((L-1)*4d, (L-1)*d, 1, groups=L-1)
+    (attention.non_local_mask)          buffer, config-dependent
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from glom_tpu.config import GlomConfig
+
+
+def _np(x) -> np.ndarray:
+    """Accept torch tensors or arrays without importing torch."""
+    if hasattr(x, "detach"):
+        x = x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+def _conv_to_stack(weight, bias, groups: int):
+    """Grouped 1x1 Conv1d (out_ch, in_ch/g, 1) -> stacked matmul
+    (g, d_in, d_out) + (g, d_out)."""
+    w = _np(weight)
+    out_ch, d_in, k = w.shape
+    if k != 1 or out_ch % groups:
+        raise ValueError(f"unexpected conv weight shape {w.shape} for {groups} groups")
+    d_out = out_ch // groups
+    w = w[..., 0].reshape(groups, d_out, d_in).transpose(0, 2, 1)
+    b = _np(bias).reshape(groups, d_out)
+    return w, b
+
+
+def _stack_to_conv(w, b):
+    """(g, d_in, d_out) + (g, d_out) -> grouped Conv1d weight/bias."""
+    g, d_in, d_out = w.shape
+    weight = np.ascontiguousarray(w.transpose(0, 2, 1).reshape(g * d_out, d_in, 1))
+    bias = np.ascontiguousarray(b.reshape(g * d_out))
+    return weight, bias
+
+
+def torch_to_jax(state_dict: Dict[str, Any], config: GlomConfig) -> dict:
+    """Reference ``Glom.state_dict()`` -> param pytree for
+    ``glom_tpu.models.glom.apply``."""
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    L = config.levels
+
+    bu_w1, bu_b1 = _conv_to_stack(sd["bottom_up.net.1.weight"], sd["bottom_up.net.1.bias"], L)
+    bu_w2, bu_b2 = _conv_to_stack(sd["bottom_up.net.3.weight"], sd["bottom_up.net.3.bias"], L)
+    td_w1, td_b1 = _conv_to_stack(sd["top_down.net.1.weight"], sd["top_down.net.1.bias"], L - 1)
+    td_w2, td_b2 = _conv_to_stack(sd["top_down.net.3.weight"], sd["top_down.net.3.bias"], L - 1)
+
+    dt = np.dtype(config.param_dtype)
+    params = {
+        "patch_embed": {
+            # torch Linear weight is (out, in); ours is (in, out)
+            "w": sd["image_to_tokens.1.weight"].T,
+            "b": sd["image_to_tokens.1.bias"],
+        },
+        "pos_emb": sd["pos_emb.weight"],
+        "init_levels": sd["init_levels"],
+        "bottom_up": {"w1": bu_w1, "b1": bu_b1, "w2": bu_w2, "b2": bu_b2},
+        "top_down": {"w1": td_w1, "b1": td_b1, "w2": td_w2, "b2": td_b2},
+    }
+    import jax
+
+    return jax.tree_util.tree_map(lambda a: np.ascontiguousarray(a, dtype=dt), params)
+
+
+def jax_to_torch(params: dict, config: GlomConfig) -> Dict[str, np.ndarray]:
+    """Param pytree -> reference-layout state_dict (numpy values; call
+    ``torch.from_numpy`` on each to load into the torch module)."""
+    bu = params["bottom_up"]
+    td = params["top_down"]
+    bu1_w, bu1_b = _stack_to_conv(_np(bu["w1"]), _np(bu["b1"]))
+    bu3_w, bu3_b = _stack_to_conv(_np(bu["w2"]), _np(bu["b2"]))
+    td1_w, td1_b = _stack_to_conv(_np(td["w1"]), _np(td["b1"]))
+    td3_w, td3_b = _stack_to_conv(_np(td["w2"]), _np(td["b2"]))
+
+    sd = {
+        "image_to_tokens.1.weight": np.ascontiguousarray(_np(params["patch_embed"]["w"]).T),
+        "image_to_tokens.1.bias": _np(params["patch_embed"]["b"]),
+        "pos_emb.weight": _np(params["pos_emb"]),
+        "init_levels": _np(params["init_levels"]),
+        "bottom_up.net.1.weight": bu1_w,
+        "bottom_up.net.1.bias": bu1_b,
+        "bottom_up.net.3.weight": bu3_w,
+        "bottom_up.net.3.bias": bu3_b,
+        "top_down.net.1.weight": td1_w,
+        "top_down.net.1.bias": td1_b,
+        "top_down.net.3.weight": td3_w,
+        "top_down.net.3.bias": td3_b,
+    }
+    if config.local_consensus_radius > 0:
+        from glom_tpu.ops.masks import local_consensus_mask
+
+        sd["attention.non_local_mask"] = local_consensus_mask(
+            config.num_patches_side, config.local_consensus_radius
+        )[None]
+    return sd

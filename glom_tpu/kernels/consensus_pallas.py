@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from glom_tpu.ops.consensus import TOKEN_ATTEND_SELF_VALUE, consensus_attention
+from glom_tpu.ops.consensus import TOKEN_ATTEND_SELF_VALUE, consensus_attention, l2_normalize
 
 
 def _pick_block(n: int, cap: int = 256) -> int:
@@ -40,13 +40,15 @@ def _pick_block(n: int, cap: int = 256) -> int:
     return n
 
 
-def _kernel(q_ref, kv_ref, o_ref, *, scale, attend_self, block_i, n):
+def _kernel(q_ref, kv_ref, *refs, scale, attend_self, block_i, n, has_mask):
+    """One fused consensus block.  ``refs`` is (mask_ref, o_ref) when
+    ``has_mask`` (selected statically in ``_forward``), else (o_ref,)."""
+    mask_ref = refs[0] if has_mask else None
+    o_ref = refs[-1]
+
     q = q_ref[0, 0].astype(jnp.float32)          # (Bi, d)
     kv = kv_ref[0, 0].astype(jnp.float32)        # (n, d)
-
-    # keys: L2 normalize with torch F.normalize semantics (max(||k||, eps))
-    norm = jnp.sqrt(jnp.sum(kv * kv, axis=-1, keepdims=True))
-    k = kv / jnp.maximum(norm, 1e-12)
+    k = l2_normalize(kv, axis=-1)                # torch F.normalize semantics
 
     sim = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -58,29 +60,8 @@ def _kernel(q_ref, kv_ref, o_ref, *, scale, attend_self, block_i, n):
         j_ids = jax.lax.broadcasted_iota(jnp.int32, (block_i, n), 1)
         sim = jnp.where(i_ids == j_ids, jnp.float32(TOKEN_ATTEND_SELF_VALUE), sim)
 
-    attn = jax.nn.softmax(sim, axis=-1)
-    out = jnp.dot(attn, kv, preferred_element_type=jnp.float32)
-    o_ref[0, 0] = out.astype(o_ref.dtype)
-
-
-def _kernel_masked(q_ref, kv_ref, mask_ref, o_ref, *, scale, attend_self, block_i, n):
-    q = q_ref[0, 0].astype(jnp.float32)
-    kv = kv_ref[0, 0].astype(jnp.float32)
-
-    norm = jnp.sqrt(jnp.sum(kv * kv, axis=-1, keepdims=True))
-    k = kv / jnp.maximum(norm, 1e-12)
-
-    sim = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
-
-    if not attend_self:
-        i_ids = jax.lax.broadcasted_iota(jnp.int32, (block_i, n), 0)
-        i_ids = i_ids + pl.program_id(2) * block_i
-        j_ids = jax.lax.broadcasted_iota(jnp.int32, (block_i, n), 1)
-        sim = jnp.where(i_ids == j_ids, jnp.float32(TOKEN_ATTEND_SELF_VALUE), sim)
-
-    sim = jnp.where(mask_ref[:] != 0, -jnp.finfo(jnp.float32).max, sim)
+    if mask_ref is not None:
+        sim = jnp.where(mask_ref[:] != 0, -jnp.finfo(jnp.float32).max, sim)
 
     attn = jax.nn.softmax(sim, axis=-1)
     out = jnp.dot(attn, kv, preferred_element_type=jnp.float32)
@@ -105,33 +86,26 @@ def _forward(levels, mask_i8, *, attend_self, interpret):
     )
     out_shape = jax.ShapeDtypeStruct((b, L, n, d), levels.dtype)
 
-    if mask_i8 is None:
-        kern = functools.partial(
-            _kernel, scale=scale, attend_self=attend_self, block_i=block_i, n=n
+    has_mask = mask_i8 is not None
+    kern = functools.partial(
+        _kernel, scale=scale, attend_self=attend_self, block_i=block_i, n=n,
+        has_mask=has_mask,
+    )
+    in_specs = [q_spec, kv_spec]
+    operands = [x, x]
+    if has_mask:
+        in_specs.append(
+            pl.BlockSpec((block_i, n), lambda ib, il, ii: (ii, 0), memory_space=pltpu.VMEM)
         )
-        y = pl.pallas_call(
-            kern,
-            grid=grid,
-            in_specs=[q_spec, kv_spec],
-            out_specs=out_spec,
-            out_shape=out_shape,
-            interpret=interpret,
-        )(x, x)
-    else:
-        mask_spec = pl.BlockSpec(
-            (block_i, n), lambda ib, il, ii: (ii, 0), memory_space=pltpu.VMEM
-        )
-        kern = functools.partial(
-            _kernel_masked, scale=scale, attend_self=attend_self, block_i=block_i, n=n
-        )
-        y = pl.pallas_call(
-            kern,
-            grid=grid,
-            in_specs=[q_spec, kv_spec, mask_spec],
-            out_specs=out_spec,
-            out_shape=out_shape,
-            interpret=interpret,
-        )(x, x, mask_i8)
+        operands.append(mask_i8)
+    y = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*operands)
 
     return jnp.transpose(y, (0, 2, 1, 3))         # (b, n, L, d)
 

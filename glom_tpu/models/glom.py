@@ -112,14 +112,11 @@ def make_consensus_fn(config: GlomConfig):
             consensus_attention_pallas, attend_self=config.consensus_self, non_local_mask=mask
         )
     if config.attention_impl == "ring":
-        try:
-            from glom_tpu.parallel.ring import ring_consensus_attention
-        except ImportError as e:
-            raise NotImplementedError(
-                "attention_impl='ring' requires glom_tpu.parallel.ring"
-            ) from e
-        return functools.partial(
-            ring_consensus_attention, attend_self=config.consensus_self, non_local_mask=mask
+        raise ValueError(
+            "attention_impl='ring' needs a device mesh binding the seq axis; "
+            "use the Trainer (which injects it), or pass "
+            "consensus_fn=glom_tpu.parallel.ring.make_ring_consensus(mesh, ...) "
+            "to apply() yourself"
         )
     raise ValueError(config.attention_impl)
 

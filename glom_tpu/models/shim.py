@@ -56,6 +56,16 @@ class Glom:
                 rng = jax.random.PRNGKey(0)
             self.params = glom_model.init(rng, self.config)
 
+    @classmethod
+    def from_torch_state_dict(cls, state_dict, **kwargs) -> "Glom":
+        """Build from a reference ``Glom.state_dict()`` (torch tensors or
+        arrays) — the migration path for reference-trained weights."""
+        model = cls(**kwargs)
+        from glom_tpu.convert import torch_to_jax
+
+        model.params = torch_to_jax(state_dict, model.config)
+        return model
+
     @functools.cached_property
     def _jitted(self):
         cfg = self.config

@@ -53,11 +53,9 @@ def _ring_consensus_local(
     m0 = jnp.full((b, L, n_local), -jnp.inf, jnp.float32)
     den0 = jnp.zeros((b, L, n_local), jnp.float32)
 
-    def step(carry, s):
-        k, v, acc, m, den = carry
-        # after s rotations we hold the block originally owned by shard
-        # (my_idx + s) mod size
-        src = (my_idx + s) % size
+    def block_update(acc, m, den, k, v, src):
+        """Online-softmax accumulation of one (normalized-key, value) block
+        originally owned by shard ``src``."""
         j_global = src * n_local + jnp.arange(n_local)
 
         sim = jnp.einsum("bild,bjld->blij", q, k).astype(jnp.float32) * scale
@@ -79,16 +77,24 @@ def _ring_consensus_local(
             "blij,bjld->blid", p, v.astype(jnp.float32)
         )
         den = den * corr + p.sum(axis=-1)
+        return acc, m_new, den
 
-        # rotate k/v one step around the ring (skip after the last use)
+    # local block first (no rotation), then size-1 rotate-and-accumulate
+    # steps — exactly size-1 ppermutes, none wasted
+    acc, m, den = block_update(acc0, m0, den0, k0, v0, my_idx)
+
+    def step(carry, s):
+        k, v, acc, m, den = carry
         perm = [(r, (r - 1) % size) for r in range(size)]
         k = jax.lax.ppermute(k, axis_name, perm)
         v = jax.lax.ppermute(v, axis_name, perm)
-        return (k, v, acc, m_new, den), None
+        acc, m, den = block_update(acc, m, den, k, v, (my_idx + s) % size)
+        return (k, v, acc, m, den), None
 
-    (_, _, acc, _, den), _ = jax.lax.scan(
-        step, (k0, v0, acc0, m0, den0), jnp.arange(size)
-    )
+    if size > 1:
+        (_, _, acc, _, den), _ = jax.lax.scan(
+            step, (k0, v0, acc, m, den), jnp.arange(1, size)
+        )
     out = acc / den[..., None]
     return jnp.einsum("blid->bild", out).astype(levels.dtype)
 

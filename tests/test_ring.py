@@ -53,6 +53,20 @@ def test_ring_grad_matches_dense(mesh):
     np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_dense), atol=1e-4)
 
 
+def test_ring_without_mesh_raises_clearly():
+    """attention_impl='ring' on the plain apply path (no mesh) must explain
+    itself rather than dying inside shard_map."""
+    c = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4, attention_impl="ring")
+    params = jax.tree_util.tree_map(
+        lambda x: x,
+        __import__("glom_tpu.models.glom", fromlist=["init"]).init(jax.random.PRNGKey(0), c),
+    )
+    img = jnp.zeros((1, 3, 16, 16))
+    from glom_tpu.models import glom as gm
+    with pytest.raises(ValueError, match="needs a device mesh"):
+        gm.apply(params, img, config=c, iters=1)
+
+
 def test_ring_rejects_indivisible_n(mesh):
     levels = jnp.zeros((1, 18, 2, 8))
     ring_fn = make_ring_consensus(mesh)
