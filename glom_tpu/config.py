@@ -91,9 +91,17 @@ class TrainConfig:
     log_every: int = 10
     checkpoint_every: int = 0            # 0 => disabled
     checkpoint_dir: Optional[str] = None
+    profile_dir: Optional[str] = None    # jax.profiler trace of a 3-step window
     seed: int = 0
     # mesh axes: data-parallel x model(tensor)-parallel x sequence(column)-parallel
     # None => all devices on the data axis (the north-star pure-DP layout)
     mesh_shape: Optional[Tuple[int, ...]] = None
     mesh_axes: Tuple[str, ...] = ("data", "model", "seq")
+    # how params use the model axis: "tp" shards every FF's hidden dim,
+    # "ep" shards whole level-MLPs (expert-style), "replicated" ignores it
+    param_sharding: str = "tp"
     donate: bool = True
+
+    def __post_init__(self):
+        if self.param_sharding not in ("tp", "ep", "replicated"):
+            raise ValueError(f"unknown param_sharding {self.param_sharding!r}")

@@ -43,24 +43,43 @@ def param_pspecs(config: GlomConfig, *, model_axis: str = "model") -> dict:
     }
 
 
-def level_sharded_pspecs(config: GlomConfig, *, model_axis: str = "model") -> dict:
+def level_sharded_pspecs(
+    config: GlomConfig, *, axis_size: int, model_axis: str = "model"
+) -> dict:
     """EP-style alternative: each device owns whole level-MLPs (shard the
     group axis).  Deterministic routing — levels are always resident
-    (SURVEY.md §2.3 'EP-shaped but deterministic').  Requires
-    ``levels % mesh[model] == 0`` and ``(levels-1) % mesh[model] == 0``,
-    so it is mostly useful for large-L configs."""
-    ff = {
-        "w1": P(model_axis, None, None),
-        "b1": P(model_axis, None),
-        "w2": P(model_axis, None, None),
-        "b2": P(model_axis, None),
-    }
+    (SURVEY.md §2.3 'EP-shaped but deterministic').
+
+    ``levels`` (bottom_up groups) and ``levels - 1`` (top_down groups) are
+    coprime, so each net is group-sharded only when its own group count
+    divides ``axis_size`` (the mesh's model-axis extent), and replicated
+    otherwise — with a loud warning, since a replicated net wastes the
+    model axis entirely."""
+    import warnings
+
+    def ff(name: str, groups: int) -> dict:
+        shard = axis_size > 1 and groups % axis_size == 0
+        if axis_size > 1 and not shard:
+            warnings.warn(
+                f"param_sharding='ep': {name} has {groups} groups, not divisible "
+                f"by model-axis size {axis_size} — replicating it (no memory "
+                f"saving on this net)",
+                stacklevel=3,
+            )
+        g_axis = model_axis if shard else None
+        return {
+            "w1": P(g_axis, None, None),
+            "b1": P(g_axis, None),
+            "w2": P(g_axis, None, None),
+            "b2": P(g_axis, None),
+        }
+
     return {
         "patch_embed": {"w": P(None, None), "b": P(None)},
         "pos_emb": P(None, None),
         "init_levels": P(None, None),
-        "bottom_up": dict(ff),
-        "top_down": dict(ff),
+        "bottom_up": ff("bottom_up", config.levels),
+        "top_down": ff("top_down", config.levels - 1),
     }
 
 

@@ -1,0 +1,64 @@
+"""Tracing / profiling / numerics debugging.
+
+All absent from the reference (SURVEY.md §5 — it relies on the user wiring
+torch.profiler).  TPU-native equivalents:
+
+  * ``trace(logdir)`` — context manager over ``jax.profiler`` emitting
+    TensorBoard/Perfetto traces (the Trainer exposes it via
+    ``TrainConfig``-level ``profile_dir`` wiring in ``fit``).
+  * ``cost_analysis(fn, *args)`` — XLA's compiler cost model for a jitted
+    callable: FLOPs, bytes accessed, peak memory — usable because the whole
+    forward is one ``lax.scan`` graph.
+  * ``debug_nans(enable)`` — global NaN checking (``jax_debug_nans``); the
+    functional-core replacement for a race/sanitizer story: there is no
+    shared mutable state to race on, numerics are the failure mode that
+    remains.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str, *, create_perfetto_trace: bool = False):
+    """Profile everything inside the block into ``logdir`` (TensorBoard
+    `profile` plugin / Perfetto)."""
+    jax.profiler.start_trace(logdir, create_perfetto_trace=create_perfetto_trace)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region for traces: ``with annotate("consensus"): ...``"""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def cost_analysis(fn, *args, **kwargs) -> Dict[str, Any]:
+    """Compile ``fn`` for the current backend and return XLA's cost analysis
+    (flops, bytes accessed, ...).  ``fn`` must be jit-wrapped or jittable."""
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    lowered = jitted.lower(*args, **kwargs)
+    compiled = lowered.compile()
+    analysis = compiled.cost_analysis()
+    if isinstance(analysis, list):  # some backends return [dict]
+        analysis = analysis[0]
+    return dict(analysis)
+
+
+def memory_analysis(fn, *args, **kwargs):
+    """Compiled memory footprint summary (argument/output/temp/generated)."""
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    compiled = jitted.lower(*args, **kwargs).compile()
+    return compiled.memory_analysis()
+
+
+def debug_nans(enable: bool = True) -> None:
+    """Toggle eager NaN detection inside jitted code (re-runs the offending
+    primitive un-jitted and raises with its location)."""
+    jax.config.update("jax_debug_nans", enable)

@@ -44,9 +44,13 @@ def parse_args(argv=None):
     # parallelism
     p.add_argument("--mesh", type=int, nargs="+", default=None,
                    help="mesh shape over (data, model, seq); default: all-data")
+    p.add_argument("--param-sharding", default="tp", choices=["tp", "ep", "replicated"],
+                   help="how params use the model axis")
     # checkpointing / logging
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--profile-dir", default=None,
+                   help="emit a jax.profiler trace of a 3-step window here")
     p.add_argument("--log-file", default=None)
     # multi-host
     p.add_argument("--coordinator", default=None)
@@ -82,8 +86,10 @@ def main(argv=None):
         log_every=args.log_every,
         checkpoint_every=args.checkpoint_every,
         checkpoint_dir=args.checkpoint_dir,
+        profile_dir=args.profile_dir,
         seed=args.seed,
         mesh_shape=tuple(args.mesh) if args.mesh else None,
+        param_sharding=args.param_sharding,
     )
 
     trainer = Trainer(config, train_cfg, logger=MetricLogger(path=args.log_file))

@@ -66,10 +66,19 @@ class Trainer:
                 f"{self.mesh.shape[data_axis]}"
             )
 
-        spec_tree = {
-            "glom": param_pspecs(config, model_axis=model_axis),
-            "decoder": _decoder_specs(),
-        }
+        if train.param_sharding == "tp":
+            glom_specs = param_pspecs(config, model_axis=model_axis)
+        elif train.param_sharding == "ep":
+            from glom_tpu.parallel.sharding import level_sharded_pspecs
+
+            glom_specs = level_sharded_pspecs(
+                config, model_axis=model_axis, axis_size=self.mesh.shape[model_axis]
+            )
+        else:  # replicated
+            glom_specs = jax.tree_util.tree_map(
+                lambda _: P(), param_pspecs(config), is_leaf=lambda x: isinstance(x, P)
+            )
+        spec_tree = {"glom": glom_specs, "decoder": _decoder_specs()}
         rng = jax.random.PRNGKey(train.seed)
         abstract = jax.eval_shape(lambda: denoise.init_state(rng, config, tx))
         self._state_sh = state_shardings(self.mesh, abstract, spec_tree)
@@ -146,7 +155,20 @@ class Trainer:
         last_saved = -1
         window_t0, window_imgs = time.time(), 0
         start_step = int(jax.device_get(self.state.step))
+        profiling = False
         for i in range(start_step, steps):
+            if cfg.profile_dir:
+                # trace a 3-step post-warmup window (steps 2,3,4 of this run),
+                # draining pending async work at both edges so earlier steps
+                # don't bleed into the capture
+                if i == start_step + 2 and not profiling:
+                    jax.block_until_ready(self.state.params)
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    profiling = True
+                elif profiling and i == start_step + 5:
+                    jax.block_until_ready(self.state.params)
+                    jax.profiler.stop_trace()
+                    profiling = False
             img = next(batches)
             img = jax.device_put(img, self._batch_sh)
             self.state, metrics = self._step(self.state, img)
@@ -170,6 +192,8 @@ class Trainer:
                 self.save(cfg.checkpoint_dir)
                 last_saved = i + 1
         jax.block_until_ready(self.state.params)
+        if profiling:
+            jax.profiler.stop_trace()
         if cfg.checkpoint_dir and cfg.checkpoint_every and last_saved != steps and start_step < steps:
             self.save(cfg.checkpoint_dir)
         return last_metrics
