@@ -1,0 +1,95 @@
+"""Stateful / video processing.
+
+Reference analogue: the README's stateful recipe (`README.md:92-112`) —
+call the model on consecutive frames, passing each call's output ``levels``
+back in.  The reference leaves the frame loop on the host; here it is a
+second ``lax.scan`` *over frames* wrapped around the per-frame iteration
+scan, so an entire clip rolls out as one XLA graph (BASELINE.json config 5:
+batched video on TPU).
+
+Two variants:
+  * ``rollout``       — same ``iters`` per frame (single compiled graph for
+                        any clip length; frames is the scan dimension).
+  * ``rollout_varied`` — per-frame iteration counts (README's 12/10/6
+                        pattern); unrolled, one scan per distinct count.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from glom_tpu.config import GlomConfig
+from glom_tpu.models import glom as glom_model
+
+
+def rollout(
+    params: dict,
+    frames: jax.Array,
+    *,
+    config: GlomConfig,
+    iters: Optional[int] = None,
+    levels: Optional[jax.Array] = None,
+    return_states: bool = False,
+    consensus_fn=None,
+):
+    """Process ``frames`` of shape ``(t, b, c, H, W)`` sequentially with
+    carried level state, as one scan-of-scans graph.
+
+    Returns the final state ``(b, n, L, d)``, or with ``return_states`` the
+    per-frame final states ``(t, b, n, L, d)`` as well.
+    """
+    if frames.ndim != 5:
+        raise ValueError(f"frames must be (t, b, c, H, W), got {frames.shape}")
+    if iters is None:
+        iters = config.default_iters
+
+    t, b = frames.shape[:2]
+    compute_dtype = config.compute_dtype or config.param_dtype
+    if levels is None:
+        levels = jnp.broadcast_to(
+            jnp.asarray(params["init_levels"], compute_dtype)[None, None],
+            (b, config.num_patches, config.levels, config.dim),
+        )
+    else:
+        # scan carry dtype must match what apply() returns (compute dtype)
+        levels = jnp.asarray(levels, compute_dtype)
+
+    def frame_step(carry, frame):
+        new = glom_model.apply(
+            params, frame, config=config, iters=iters, levels=carry,
+            consensus_fn=consensus_fn,
+        )
+        return new, (new if return_states else None)
+
+    final, states = jax.lax.scan(frame_step, levels, frames)
+    if return_states:
+        return final, states
+    return final
+
+
+def rollout_varied(
+    params: dict,
+    frames: Sequence[jax.Array],
+    iters_schedule: Sequence[int],
+    *,
+    config: GlomConfig,
+    levels: Optional[jax.Array] = None,
+    consensus_fn=None,
+):
+    """README's exact pattern — per-frame iteration counts (e.g. [12, 10, 6])
+    with carried state.  Each distinct count compiles once.  ``frames`` is a
+    sequence of ``(b, c, H, W)`` arrays; returns the final state."""
+    if len(frames) != len(iters_schedule):
+        raise ValueError(
+            f"{len(frames)} frames but {len(iters_schedule)} iteration counts"
+        )
+    state = levels
+    for frame, it in zip(frames, iters_schedule):
+        state = glom_model.apply(
+            params, frame, config=config, iters=int(it), levels=state,
+            consensus_fn=consensus_fn,
+        )
+    return state

@@ -1,0 +1,115 @@
+"""ctypes bindings for the native batch-assembly core (``batcher.cpp``).
+
+Built on demand with ``g++ -O3 -shared`` into the package directory (cached
+by source mtime); every entry point degrades gracefully — callers get
+``None`` from :func:`load` when no compiler is available and fall back to
+the NumPy path in ``glom_tpu.training.data``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "batcher.cpp")
+_LIB = os.path.join(_DIR, "_batcher.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    # compile to a temp path and move into place so a killed/timed-out g++
+    # can never leave a truncated .so that poisons the mtime cache
+    tmp = _LIB + ".build"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Compile (if stale/missing) and dlopen the native core; None on any
+    failure (no compiler, read-only install, ...)."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_failed:
+            return None
+        stale = not os.path.exists(_LIB) or (
+            os.path.exists(_SRC) and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+        )
+        if stale and not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            # a bad artifact must not survive to poison future loads
+            try:
+                os.remove(_LIB)
+            except OSError:
+                pass
+            _load_failed = True
+            return None
+        lp = ctypes.POINTER(ctypes.c_long)
+        fp = ctypes.POINTER(ctypes.c_float)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.glom_batch_f32.argtypes = [fp] + [ctypes.c_long] * 4 + [lp, ctypes.c_long, ctypes.c_long, fp]
+        lib.glom_batch_f32.restype = None
+        lib.glom_batch_u8_nhwc.argtypes = [u8p] + [ctypes.c_long] * 4 + [lp, ctypes.c_long, ctypes.c_long, fp]
+        lib.glom_batch_u8_nhwc.restype = None
+        _lib = lib
+        return _lib
+
+
+def assemble_batch(data: np.ndarray, idx: np.ndarray, size: int) -> Optional[np.ndarray]:
+    """Native gather+convert+resize.  ``data`` is float32 NCHW or uint8 NHWC;
+    returns a float32 ``(len(idx), c, size, size)`` batch, or None when the
+    native core is unavailable (caller falls back to NumPy)."""
+    lib = load()
+    if lib is None:
+        return None
+    data = np.ascontiguousarray(data)
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    idx_p = idx.ctypes.data_as(ctypes.POINTER(ctypes.c_long))
+    bs = len(idx)
+
+    # channels-last data would be silently misread by the NCHW f32 kernel
+    is_nhwc = data.ndim == 4 and data.shape[-1] in (1, 3) and data.shape[1] not in (1, 3)
+
+    if data.dtype == np.float32 and data.ndim == 4 and not is_nhwc:
+        n, c, h, w = data.shape
+        out = np.empty((bs, c, size, size), np.float32)
+        lib.glom_batch_f32(
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n, c, h, w, idx_p, bs, size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        return out
+    if data.dtype == np.uint8 and data.ndim == 4 and is_nhwc:
+        n, h, w, c = data.shape
+        out = np.empty((bs, c, size, size), np.float32)
+        lib.glom_batch_u8_nhwc(
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            n, h, w, c, idx_p, bs, size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        return out
+    return None

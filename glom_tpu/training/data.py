@@ -42,10 +42,17 @@ def folder_batches(
     image_size: int,
     channels: int = 3,
     seed: int = 0,
+    use_native: bool = True,
 ) -> Iterator[np.ndarray]:
     """Stream batches from ``.npy``/``.npz`` files holding ``(N, C, H, W)`` or
     ``(N, H, W, C)`` uint8/float arrays; normalized to zero-mean/unit-ish
-    range and resized by nearest-neighbor to the model's image size."""
+    range and resized by nearest-neighbor to the model's image size.
+
+    When the native core (``glom_tpu.native``) is available and the dataset
+    is uint8-NHWC or float32-NCHW, batches are assembled per draw by the
+    multithreaded C++ path from the raw resident buffer (no upfront
+    whole-dataset conversion); otherwise the dataset is preprocessed once in
+    NumPy.  Both paths produce bit-identical batches."""
     files = sorted(
         os.path.join(directory, f)
         for f in os.listdir(directory)
@@ -64,18 +71,41 @@ def folder_batches(
         else:
             arrays.append(np.load(f))
     data = np.concatenate(arrays, axis=0)
-    if data.shape[-1] in (1, 3) and data.shape[1] not in (1, 3):
+
+    is_nhwc = data.shape[-1] in (1, 3) and data.shape[1] not in (1, 3)
+    native_ok = use_native and (
+        (data.dtype == np.uint8 and is_nhwc)
+        or (data.dtype == np.float32 and not is_nhwc)
+    )
+    got_channels = data.shape[-1] if is_nhwc else data.shape[1]
+    if got_channels != channels:
+        raise ValueError(f"dataset has {got_channels} channels, model expects {channels}")
+
+    rng = np.random.default_rng(seed)
+    n = data.shape[0]
+
+    if native_ok:
+        from glom_tpu import native
+
+        # probe before any RNG draw so the fallback stream is identical
+        if native.load() is None:
+            native_ok = False
+
+    if native_ok:
+        from glom_tpu import native
+
+        data = np.ascontiguousarray(data)
+        while True:
+            idx = rng.integers(0, n, size=batch_size)
+            yield native.assemble_batch(data, idx, image_size)
+
+    if is_nhwc:
         data = data.transpose(0, 3, 1, 2)  # NHWC -> NCHW
     if data.dtype == np.uint8:
         data = data.astype(np.float32) / 127.5 - 1.0
     else:
         data = data.astype(np.float32)
     data = _resize_nchw(data, image_size)
-    if data.shape[1] != channels:
-        raise ValueError(f"dataset has {data.shape[1]} channels, model expects {channels}")
-
-    rng = np.random.default_rng(seed)
-    n = data.shape[0]
     while True:
         idx = rng.integers(0, n, size=batch_size)
         yield data[idx]

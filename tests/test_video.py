@@ -1,0 +1,70 @@
+"""Video/stateful rollout tests (BASELINE config 5; README.md:92-112)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from glom_tpu.config import GlomConfig
+from glom_tpu.models import glom as glom_model
+from glom_tpu.models.video import rollout, rollout_varied
+
+TINY = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4)
+
+
+def test_rollout_matches_sequential_calls():
+    params = glom_model.init(jax.random.PRNGKey(0), TINY)
+    frames = jax.random.normal(jax.random.PRNGKey(1), (3, 2, 3, 16, 16))
+
+    got = rollout(params, frames, config=TINY, iters=2)
+
+    state = None
+    for i in range(3):
+        state = glom_model.apply(params, frames[i], config=TINY, iters=2, levels=state)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(state), atol=1e-5)
+
+
+def test_rollout_return_states_shapes():
+    params = glom_model.init(jax.random.PRNGKey(0), TINY)
+    frames = jax.random.normal(jax.random.PRNGKey(1), (4, 1, 3, 16, 16))
+    final, states = rollout(params, frames, config=TINY, iters=2, return_states=True)
+    assert states.shape == (4, 1, TINY.num_patches, 3, 16)
+    np.testing.assert_allclose(np.asarray(states[-1]), np.asarray(final), rtol=1e-6)
+
+
+def test_rollout_varied_matches_readme_pattern():
+    """README 12/10/6 pattern (scaled down) equals explicit chained calls."""
+    params = glom_model.init(jax.random.PRNGKey(0), TINY)
+    f = [jax.random.normal(jax.random.PRNGKey(i), (1, 3, 16, 16)) for i in range(3)]
+
+    got = rollout_varied(params, f, [4, 3, 2], config=TINY)
+
+    s = glom_model.apply(params, f[0], config=TINY, iters=4)
+    s = glom_model.apply(params, f[1], config=TINY, iters=3, levels=s)
+    s = glom_model.apply(params, f[2], config=TINY, iters=2, levels=s)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(s), rtol=1e-6)
+
+
+def test_rollout_is_one_graph():
+    """The whole clip traces into a single jit without retracing per frame."""
+    params = glom_model.init(jax.random.PRNGKey(0), TINY)
+    traces = []
+
+    @jax.jit
+    def run(params, frames):
+        traces.append(1)
+        return rollout(params, frames, config=TINY, iters=2)
+
+    f1 = jax.random.normal(jax.random.PRNGKey(1), (5, 2, 3, 16, 16))
+    f2 = jax.random.normal(jax.random.PRNGKey(2), (5, 2, 3, 16, 16))
+    run(params, f1)
+    run(params, f2)
+    assert len(traces) == 1
+
+
+def test_rollout_validates_shapes():
+    params = glom_model.init(jax.random.PRNGKey(0), TINY)
+    with pytest.raises(ValueError, match="t, b, c, H, W"):
+        rollout(params, jnp.zeros((2, 3, 16, 16)), config=TINY)
+    with pytest.raises(ValueError, match="iteration counts"):
+        rollout_varied(params, [jnp.zeros((1, 3, 16, 16))], [2, 3], config=TINY)
