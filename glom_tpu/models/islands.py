@@ -1,0 +1,113 @@
+"""Island analysis — inspecting emergent part-whole structure.
+
+Reference analogue: the README points at using ``return_all`` level states
+"for clustering, from which one can inspect for the theorized islands in the
+paper" (`/root/reference/README.md:34-36`) but ships no tooling.  These are
+the framework-owned utilities: per-level neighbor-agreement maps (how
+strongly each patch column agrees with its grid neighbors — islands appear
+as high-agreement regions) and a threshold-based island labeling.
+
+Agreement math runs in JAX (jit-friendly, batched); labeling is a host-side
+NumPy connected-components pass (it is inherently data-dependent and tiny).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from glom_tpu.ops.consensus import l2_normalize
+
+
+def neighbor_agreement(levels: jax.Array, num_patches_side: int) -> jax.Array:
+    """Mean cosine similarity of each column to its 4-neighbors, per level.
+
+    ``levels``: ``(b, n, L, d)`` state (one timestep of ``return_all`` or the
+    final state).  Returns ``(b, L, side, side)`` agreement maps in [-1, 1]
+    (edge cells average over their in-grid neighbors only).
+    """
+    b, n, L, d = levels.shape
+    side = num_patches_side
+    if side * side != n:
+        raise ValueError(f"n={n} is not {side}x{side}")
+
+    x = l2_normalize(levels, axis=-1)
+    grid = x.reshape(b, side, side, L, d)
+
+    sims = []
+    counts = jnp.zeros((side, side))
+    total = jnp.zeros((b, side, side, L))
+    for dy, dx in ((0, 1), (0, -1), (1, 0), (-1, 0)):
+        shifted = jnp.roll(grid, (dy, dx), axis=(1, 2))
+        sim = jnp.einsum("bijld,bijld->bijl", grid, shifted)
+        # mask wrapped-around edges
+        valid = jnp.ones((side, side), bool)
+        if dy == 1:
+            valid = valid.at[0, :].set(False)
+        elif dy == -1:
+            valid = valid.at[-1, :].set(False)
+        if dx == 1:
+            valid = valid.at[:, 0].set(False)
+        elif dx == -1:
+            valid = valid.at[:, -1].set(False)
+        total = total + sim * valid[None, :, :, None]
+        counts = counts + valid
+    agreement = total / counts[None, :, :, None]
+    return jnp.einsum("bijl->blij", agreement)
+
+
+def label_islands(
+    agreement: np.ndarray, threshold: float = 0.9
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Connected-component labeling of high-agreement regions.
+
+    ``agreement``: one ``(side, side)`` map (slice of
+    :func:`neighbor_agreement`).  Returns ``(labels, sizes)`` where labels is
+    ``(side, side)`` int32 (0 = below threshold, islands numbered from 1) and
+    ``sizes[k]`` is the cell count of island ``k+1``.
+    """
+    agreement = np.asarray(agreement)
+    side = agreement.shape[0]
+    mask = agreement >= threshold
+    labels = np.zeros((side, side), np.int32)
+    sizes = []
+    current = 0
+    for y in range(side):
+        for x in range(side):
+            if not mask[y, x] or labels[y, x]:
+                continue
+            current += 1
+            stack = [(y, x)]
+            labels[y, x] = current
+            count = 0
+            while stack:
+                cy, cx = stack.pop()
+                count += 1
+                for ny, nx in ((cy + 1, cx), (cy - 1, cx), (cy, cx + 1), (cy, cx - 1)):
+                    if 0 <= ny < side and 0 <= nx < side and mask[ny, nx] and not labels[ny, nx]:
+                        labels[ny, nx] = current
+                        stack.append((ny, nx))
+            sizes.append(count)
+    return labels, np.asarray(sizes, np.int64)
+
+
+def island_summary(
+    all_levels: jax.Array, num_patches_side: int, threshold: float = 0.9
+) -> dict:
+    """Per-(timestep, level) island statistics over a ``return_all`` stack
+    ``(T, b, n, L, d)`` — mean agreement and island count for batch item 0.
+    Returns ``{"mean_agreement": (T, L), "num_islands": (T, L)}``."""
+    T = all_levels.shape[0]
+    L = all_levels.shape[3]
+    mean_agreement = np.zeros((T, L))
+    num_islands = np.zeros((T, L), np.int64)
+    for t in range(T):
+        maps = np.asarray(neighbor_agreement(all_levels[t], num_patches_side))
+        for level in range(L):
+            mean_agreement[t, level] = maps[0, level].mean()
+            labels, sizes = label_islands(maps[0, level], threshold)
+            num_islands[t, level] = len(sizes)
+    return {"mean_agreement": mean_agreement, "num_islands": num_islands}

@@ -96,3 +96,25 @@ class Glom:
     @property
     def num_params(self) -> int:
         return glom_model.param_count(self.params)
+
+    # -- persistence (reference analogue: nn.Module state_dict inheritance) --
+    def save(self, directory: str, step: int = 0) -> str:
+        """Write params as a framework checkpoint (atomic npz + manifest)."""
+        from glom_tpu import checkpoint as ckpt_lib
+
+        return ckpt_lib.save(directory, step, {"params": jax.device_get(self.params)})
+
+    def load(self, directory: str, step: Optional[int] = None) -> int:
+        """Restore params from a framework checkpoint; returns the step."""
+        from glom_tpu import checkpoint as ckpt_lib
+
+        step, trees = ckpt_lib.restore(directory, {"params": self.params}, step=step)
+        self.params = trees["params"]
+        return step
+
+    def state_dict(self) -> dict:
+        """Reference-layout torch-style state_dict (numpy values) — the
+        export direction of ``glom_tpu.convert``."""
+        from glom_tpu.convert import jax_to_torch
+
+        return jax_to_torch(jax.device_get(self.params), self.config)

@@ -26,9 +26,9 @@ _load_failed = False
 
 
 def _build() -> bool:
-    # compile to a temp path and move into place so a killed/timed-out g++
-    # can never leave a truncated .so that poisons the mtime cache
-    tmp = _LIB + ".build"
+    # compile to a per-process temp path and move into place so a killed g++
+    # can't leave a truncated .so, and concurrent builders can't interleave
+    tmp = f"{_LIB}.build.{os.getpid()}"
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread", _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
@@ -68,12 +68,12 @@ def load() -> Optional[ctypes.CDLL]:
                 pass
             _load_failed = True
             return None
-        lp = ctypes.POINTER(ctypes.c_long)
+        lp = ctypes.POINTER(ctypes.c_int64)
         fp = ctypes.POINTER(ctypes.c_float)
         u8p = ctypes.POINTER(ctypes.c_uint8)
-        lib.glom_batch_f32.argtypes = [fp] + [ctypes.c_long] * 4 + [lp, ctypes.c_long, ctypes.c_long, fp]
+        lib.glom_batch_f32.argtypes = [fp] + [ctypes.c_int64] * 4 + [lp, ctypes.c_int64, ctypes.c_int64, fp]
         lib.glom_batch_f32.restype = None
-        lib.glom_batch_u8_nhwc.argtypes = [u8p] + [ctypes.c_long] * 4 + [lp, ctypes.c_long, ctypes.c_long, fp]
+        lib.glom_batch_u8_nhwc.argtypes = [u8p] + [ctypes.c_int64] * 4 + [lp, ctypes.c_int64, ctypes.c_int64, fp]
         lib.glom_batch_u8_nhwc.restype = None
         _lib = lib
         return _lib
@@ -88,7 +88,12 @@ def assemble_batch(data: np.ndarray, idx: np.ndarray, size: int) -> Optional[np.
         return None
     data = np.ascontiguousarray(data)
     idx = np.ascontiguousarray(idx, dtype=np.int64)
-    idx_p = idx.ctypes.data_as(ctypes.POINTER(ctypes.c_long))
+    if len(idx) and (idx.min() < 0 or idx.max() >= data.shape[0]):
+        raise IndexError(
+            f"batch indices out of range [0, {data.shape[0]}): "
+            f"min {idx.min()}, max {idx.max()}"
+        )
+    idx_p = idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
     bs = len(idx)
 
     # channels-last data would be silently misread by the NCHW f32 kernel

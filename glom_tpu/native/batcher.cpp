@@ -21,35 +21,35 @@
 namespace {
 
 // Number of worker threads: hardware concurrency capped at 16, min 1.
-int worker_count(long batch) {
+int worker_count(int64_t batch) {
   unsigned hc = std::thread::hardware_concurrency();
-  long n = hc == 0 ? 1 : static_cast<long>(hc);
+  int64_t n = hc == 0 ? 1 : static_cast<int64_t>(hc);
   if (n > 16) n = 16;
   if (n > batch) n = batch;
   return static_cast<int>(n);
 }
 
 template <typename Fn>
-void parallel_for(long count, Fn fn) {
+void parallel_for(int64_t count, Fn fn) {
   int workers = worker_count(count);
   if (workers <= 1) {
-    for (long i = 0; i < count; ++i) fn(i);
+    for (int64_t i = 0; i < count; ++i) fn(i);
     return;
   }
-  std::atomic<long> next(0);
+  std::atomic<int64_t> next(0);
   std::vector<std::thread> pool;
   pool.reserve(workers);
   for (int t = 0; t < workers; ++t) {
     pool.emplace_back([&]() {
-      for (long i = next.fetch_add(1); i < count; i = next.fetch_add(1)) fn(i);
+      for (int64_t i = next.fetch_add(1); i < count; i = next.fetch_add(1)) fn(i);
     });
   }
   for (auto& th : pool) th.join();
 }
 
-inline void resize_indices(long dst, long src, std::vector<long>& out) {
+inline void resize_indices(int64_t dst, int64_t src, std::vector<int64_t>& out) {
   out.resize(dst);
-  for (long i = 0; i < dst; ++i) out[i] = i * src / dst;
+  for (int64_t i = 0; i < dst; ++i) out[i] = i * src / dst;
 }
 
 }  // namespace
@@ -58,23 +58,23 @@ extern "C" {
 
 // Gather float32 NCHW samples `data[idx[b]]` into `out` (bs, c, size, size)
 // with nearest-neighbor resize from (h, w).
-void glom_batch_f32(const float* data, long n, long c, long h, long w,
-                    const long* idx, long bs, long size, float* out) {
-  std::vector<long> ri, ci;
+void glom_batch_f32(const float* data, int64_t n, int64_t c, int64_t h, int64_t w,
+                    const int64_t* idx, int64_t bs, int64_t size, float* out) {
+  std::vector<int64_t> ri, ci;
   resize_indices(size, h, ri);
   resize_indices(size, w, ci);
-  const long src_img = c * h * w;
-  const long dst_img = c * size * size;
-  parallel_for(bs, [&](long b) {
+  const int64_t src_img = c * h * w;
+  const int64_t dst_img = c * size * size;
+  parallel_for(bs, [&](int64_t b) {
     const float* src = data + idx[b] * src_img;
     float* dst = out + b * dst_img;
-    for (long ch = 0; ch < c; ++ch) {
+    for (int64_t ch = 0; ch < c; ++ch) {
       const float* sc = src + ch * h * w;
       float* dc = dst + ch * size * size;
-      for (long y = 0; y < size; ++y) {
+      for (int64_t y = 0; y < size; ++y) {
         const float* srow = sc + ri[y] * w;
         float* drow = dc + y * size;
-        for (long x = 0; x < size; ++x) drow[x] = srow[ci[x]];
+        for (int64_t x = 0; x < size; ++x) drow[x] = srow[ci[x]];
       }
     }
   });
@@ -82,21 +82,21 @@ void glom_batch_f32(const float* data, long n, long c, long h, long w,
 
 // Gather uint8 NHWC samples, normalize to [-1, 1], emit float32 NCHW with
 // nearest-neighbor resize.
-void glom_batch_u8_nhwc(const uint8_t* data, long n, long h, long w, long c,
-                        const long* idx, long bs, long size, float* out) {
-  std::vector<long> ri, ci;
+void glom_batch_u8_nhwc(const uint8_t* data, int64_t n, int64_t h, int64_t w, int64_t c,
+                        const int64_t* idx, int64_t bs, int64_t size, float* out) {
+  std::vector<int64_t> ri, ci;
   resize_indices(size, h, ri);
   resize_indices(size, w, ci);
-  const long src_img = h * w * c;
-  const long dst_img = c * size * size;
-  parallel_for(bs, [&](long b) {
+  const int64_t src_img = h * w * c;
+  const int64_t dst_img = c * size * size;
+  parallel_for(bs, [&](int64_t b) {
     const uint8_t* src = data + idx[b] * src_img;
     float* dst = out + b * dst_img;
-    for (long y = 0; y < size; ++y) {
+    for (int64_t y = 0; y < size; ++y) {
       const uint8_t* srow = src + ri[y] * w * c;
-      for (long x = 0; x < size; ++x) {
+      for (int64_t x = 0; x < size; ++x) {
         const uint8_t* spx = srow + ci[x] * c;
-        for (long ch = 0; ch < c; ++ch) {
+        for (int64_t ch = 0; ch < c; ++ch) {
           dst[ch * size * size + y * size + x] =
               static_cast<float>(spx[ch]) / 127.5f - 1.0f;
         }
