@@ -32,7 +32,7 @@ def main():
     p.add_argument("--fp32", action="store_true", help="disable bf16 compute")
     p.add_argument("--no-remat", action="store_true",
                    help="disable scan-body rematerialization (needs small batch)")
-    p.add_argument("--attention-impl", default="dense", choices=["dense", "pallas", "ring"])
+    p.add_argument("--attention-impl", default="dense", choices=["dense", "pallas", "ring", "ulysses"])
     args = p.parse_args()
 
     import jax

@@ -36,7 +36,7 @@ class GlomConfig:
     param_dtype: jnp.dtype = jnp.float32
     compute_dtype: Optional[jnp.dtype] = None   # None => use param dtype
     remat: bool = False                         # jax.checkpoint the scan body
-    attention_impl: str = "dense"               # "dense" | "pallas" | "ring"
+    attention_impl: str = "dense"   # "dense" | "pallas" | "ring" | "ulysses"
 
     def __post_init__(self):
         if self.image_size % self.patch_size != 0:
@@ -45,7 +45,7 @@ class GlomConfig:
             )
         if self.levels < 2:
             raise ValueError("levels must be >= 2 (top_down uses levels-1 groups)")
-        if self.attention_impl not in ("dense", "pallas", "ring"):
+        if self.attention_impl not in ("dense", "pallas", "ring", "ulysses"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
 
     # -- derived quantities (glom_pytorch.py:90-91,112) --

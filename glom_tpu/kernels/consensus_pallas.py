@@ -68,6 +68,112 @@ def _kernel(q_ref, kv_ref, *refs, scale, attend_self, block_i, n, has_mask):
     o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
+def _kernel_blocked(q_ref, kv_ref, *refs, scale, attend_self, block_i, block_j,
+                    has_mask):
+    """Flash-style variant for large n: grid (b, L, ni, nj); K/V arrive in
+    ``block_j`` chunks and an online softmax accumulates in VMEM scratch, so
+    VMEM holds O(block_i * block_j + block_i * d) instead of O(n * d + n²).
+    Scratch layout: acc (Bi, d) f32, m/den (Bi, 128) f32 (lane-padded)."""
+    if has_mask:
+        mask_ref, o_ref, acc_ref, m_ref, den_ref = refs
+    else:
+        (o_ref, acc_ref, m_ref, den_ref) = refs
+
+    jj = pl.program_id(3)
+    nj = pl.num_programs(3)
+
+    @pl.when(jj == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        den_ref[:] = jnp.zeros_like(den_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32)          # (Bi, d)
+    kv = kv_ref[0, 0].astype(jnp.float32)        # (Bj, d)
+    k = l2_normalize(kv, axis=-1)
+
+    sim = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale                                     # (Bi, Bj)
+
+    if not attend_self:
+        i_ids = jax.lax.broadcasted_iota(jnp.int32, (block_i, block_j), 0)
+        i_ids = i_ids + pl.program_id(2) * block_i
+        j_ids = jax.lax.broadcasted_iota(jnp.int32, (block_i, block_j), 1)
+        j_ids = j_ids + jj * block_j
+        sim = jnp.where(i_ids == j_ids, jnp.float32(TOKEN_ATTEND_SELF_VALUE), sim)
+
+    if has_mask:
+        sim = jnp.where(mask_ref[:] != 0, -jnp.finfo(jnp.float32).max, sim)
+
+    m_prev = m_ref[:, 0]                          # (Bi,)
+    m_new = jnp.maximum(m_prev, sim.max(axis=-1))
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(sim - m_new[:, None])
+    acc_ref[:] = acc_ref[:] * corr[:, None] + jnp.dot(
+        p, kv, preferred_element_type=jnp.float32
+    )
+    den_ref[:, 0] = den_ref[:, 0] * corr + p.sum(axis=-1)
+    m_ref[:, 0] = m_new
+
+    @pl.when(jj == nj - 1)
+    def _():
+        o_ref[0, 0] = (acc_ref[:] / den_ref[:, 0][:, None]).astype(o_ref.dtype)
+
+
+def _forward_blocked(levels, mask_i8, *, attend_self, interpret, block_j):
+    b, n, L, d = levels.shape
+    x = jnp.transpose(levels, (0, 2, 1, 3))       # (b, L, n, d)
+    block_i = _pick_block(n)
+    bj = _pick_block(n, cap=block_j)
+    if bj >= n:
+        # no usable K/V divisor: "blocked" would degenerate to one full-n
+        # block, re-materializing the n x n sim the path exists to avoid
+        raise ValueError(
+            f"pallas blocked kernel needs n ({n}) to have a multiple-of-8 "
+            f"divisor <= {block_j}; use attention_impl='dense' or the "
+            "ring/ulysses paths for this patch count"
+        )
+    grid = (b, L, n // block_i, n // bj)
+    scale = d ** -0.5
+
+    q_spec = pl.BlockSpec(
+        (1, 1, block_i, d), lambda ib, il, ii, ij: (ib, il, ii, 0), memory_space=pltpu.VMEM
+    )
+    kv_spec = pl.BlockSpec(
+        (1, 1, bj, d), lambda ib, il, ii, ij: (ib, il, ij, 0), memory_space=pltpu.VMEM
+    )
+    out_spec = pl.BlockSpec(
+        (1, 1, block_i, d), lambda ib, il, ii, ij: (ib, il, ii, 0), memory_space=pltpu.VMEM
+    )
+    has_mask = mask_i8 is not None
+    kern = functools.partial(
+        _kernel_blocked, scale=scale, attend_self=attend_self,
+        block_i=block_i, block_j=bj, has_mask=has_mask,
+    )
+    in_specs = [q_spec, kv_spec]
+    operands = [x, x]
+    if has_mask:
+        in_specs.append(
+            pl.BlockSpec((block_i, bj), lambda ib, il, ii, ij: (ii, ij), memory_space=pltpu.VMEM)
+        )
+        operands.append(mask_i8)
+    y = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_spec,
+        out_shape=jax.ShapeDtypeStruct((b, L, n, d), levels.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_i, d), jnp.float32),
+            pltpu.VMEM((block_i, 128), jnp.float32),
+            pltpu.VMEM((block_i, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*operands)
+    return jnp.transpose(y, (0, 2, 1, 3))
+
+
 def _forward(levels, mask_i8, *, attend_self, interpret):
     b, n, L, d = levels.shape
     x = jnp.transpose(levels, (0, 2, 1, 3))       # (b, L, n, d)
@@ -110,17 +216,32 @@ def _forward(levels, mask_i8, *, attend_self, interpret):
     return jnp.transpose(y, (0, 2, 1, 3))         # (b, n, L, d)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
-def _consensus_pallas(levels, mask_i8, attend_self, interpret):
+# K/V lengths above this use the flash-style blocked kernel (the one-shot
+# kernel would otherwise hold the whole n x d K/V slab per (b, l) in VMEM)
+_ONE_SHOT_MAX_N = 1024
+
+
+def _dispatch(levels, mask_i8, attend_self, interpret, kv_block):
+    n = levels.shape[1]
+    if kv_block or n > _ONE_SHOT_MAX_N:
+        return _forward_blocked(
+            levels, mask_i8, attend_self=attend_self, interpret=interpret,
+            block_j=kv_block or 512,
+        )
     return _forward(levels, mask_i8, attend_self=attend_self, interpret=interpret)
 
 
-def _fwd(levels, mask_i8, attend_self, interpret):
-    out = _forward(levels, mask_i8, attend_self=attend_self, interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _consensus_pallas(levels, mask_i8, attend_self, interpret, kv_block):
+    return _dispatch(levels, mask_i8, attend_self, interpret, kv_block)
+
+
+def _fwd(levels, mask_i8, attend_self, interpret, kv_block):
+    out = _dispatch(levels, mask_i8, attend_self, interpret, kv_block)
     return out, (levels, mask_i8)
 
 
-def _bwd(attend_self, interpret, res, g):
+def _bwd(attend_self, interpret, kv_block, res, g):
     levels, mask_i8 = res
     mask = mask_i8.astype(bool) if mask_i8 is not None else None
     _, vjp = jax.vjp(
@@ -140,14 +261,16 @@ def consensus_attention_pallas(
     attend_self: bool = False,
     non_local_mask: Optional[jax.Array] = None,
     interpret: Optional[bool] = None,
+    kv_block: Optional[int] = None,
 ) -> jax.Array:
     """Drop-in for :func:`glom_tpu.ops.consensus.consensus_attention`.
 
-    ``interpret=None`` auto-selects interpreter mode off-TPU (CPU tests);
-    pass ``False``/``True`` to force."""
+    ``interpret=None`` auto-selects interpreter mode off-TPU (CPU tests).
+    ``kv_block``: force the flash-style blocked kernel with this K/V chunk
+    length; default picks one-shot for n <= 1024 and 512-chunks beyond."""
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     mask_i8 = None
     if non_local_mask is not None:
         mask_i8 = non_local_mask.astype(jnp.int8)
-    return _consensus_pallas(levels, mask_i8, attend_self, interpret)
+    return _consensus_pallas(levels, mask_i8, attend_self, interpret, kv_block)

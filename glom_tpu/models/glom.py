@@ -111,12 +111,12 @@ def make_consensus_fn(config: GlomConfig):
         return functools.partial(
             consensus_attention_pallas, attend_self=config.consensus_self, non_local_mask=mask
         )
-    if config.attention_impl == "ring":
+    if config.attention_impl in ("ring", "ulysses"):
         raise ValueError(
-            "attention_impl='ring' needs a device mesh binding the seq axis; "
-            "use the Trainer (which injects it), or pass "
-            "consensus_fn=glom_tpu.parallel.ring.make_ring_consensus(mesh, ...) "
-            "to apply() yourself"
+            f"attention_impl={config.attention_impl!r} needs a device mesh "
+            "binding the seq axis; use the Trainer (which injects it), or pass "
+            "consensus_fn=glom_tpu.parallel.{ring.make_ring_consensus | "
+            "ulysses.make_ulysses_consensus}(mesh, ...) to apply() yourself"
         )
     raise ValueError(config.attention_impl)
 

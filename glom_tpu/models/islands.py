@@ -37,7 +37,6 @@ def neighbor_agreement(levels: jax.Array, num_patches_side: int) -> jax.Array:
     x = l2_normalize(levels, axis=-1)
     grid = x.reshape(b, side, side, L, d)
 
-    sims = []
     counts = jnp.zeros((side, side))
     total = jnp.zeros((b, side, side, L))
     for dy, dx in ((0, 1), (0, -1), (1, 0), (-1, 0)):
@@ -105,7 +104,8 @@ def island_summary(
     mean_agreement = np.zeros((T, L))
     num_islands = np.zeros((T, L), np.int64)
     for t in range(T):
-        maps = np.asarray(neighbor_agreement(all_levels[t], num_patches_side))
+        # only batch item 0 is summarized — slice before computing agreement
+        maps = np.asarray(neighbor_agreement(all_levels[t, :1], num_patches_side))
         for level in range(L):
             mean_agreement[t, level] = maps[0, level].mean()
             labels, sizes = label_islands(maps[0, level], threshold)

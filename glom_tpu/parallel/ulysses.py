@@ -1,0 +1,81 @@
+"""Ulysses-style (all-to-all) sequence-parallel consensus attention.
+
+The alternative to the ring path named in SURVEY.md §5: GLOM's ``levels``
+axis plays the role Ulysses gives to attention heads.  State enters sharded
+over columns ``(b, n/S, L, d)``; one ``all_to_all`` re-shards it to
+``(b, n, L/S, d)`` — full column axis, subset of levels — each device runs
+the *dense* per-level consensus on its levels, and a second ``all_to_all``
+restores column sharding.
+
+Trade-off vs ring (``glom_tpu.parallel.ring``): two all-to-alls of the
+state per call instead of S-1 ppermutes of K/V, and the n×n similarity IS
+materialized (per local level) — better when L ≥ S and ICI all-to-all is
+cheap; ring wins when n² memory is the binding constraint.  Requires
+``levels % S == 0``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from glom_tpu.ops.consensus import consensus_attention
+
+
+def _ulysses_local(
+    levels: jax.Array,
+    *,
+    axis_name: str,
+    attend_self: bool,
+    non_local_mask: Optional[jax.Array],
+) -> jax.Array:
+    """shard_map body.  ``levels``: (b, n_local, L, d); returns same shape."""
+    # tiled all_to_all trades the level axis for the column axis:
+    # (b, n/S, L, d) -> (b, n, L/S, d) — full columns, local levels
+    x = jax.lax.all_to_all(levels, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    out = consensus_attention(
+        x, attend_self=attend_self, non_local_mask=non_local_mask
+    )
+
+    # inverse exchange: (b, n, L/S, d) -> (b, n/S, L, d)
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def make_ulysses_consensus(
+    mesh: Mesh,
+    *,
+    attend_self: bool = False,
+    non_local_mask: Optional[jax.Array] = None,
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+):
+    """Drop-in consensus fn ``(b, n, L, d) -> (b, n, L, d)`` with columns
+    sharded over ``seq_axis``, exchanged via all_to_all so each device runs
+    dense attention on ``levels / S`` levels."""
+    spec = P(data_axis, seq_axis, None, None)
+    body = functools.partial(
+        _ulysses_local,
+        axis_name=seq_axis,
+        attend_self=attend_self,
+        non_local_mask=non_local_mask,
+    )
+    sharded = jax.shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec, check_vma=False)
+
+    def consensus_fn(levels: jax.Array) -> jax.Array:
+        n, L = levels.shape[1], levels.shape[2]
+        s = mesh.shape[seq_axis]
+        if n % s != 0:
+            raise ValueError(f"n={n} columns not divisible by seq-axis size {s}")
+        if L % s != 0:
+            raise ValueError(
+                f"ulysses needs levels ({L}) divisible by seq-axis size {s}; "
+                "use the ring path otherwise"
+            )
+        return sharded(levels)
+
+    return consensus_fn

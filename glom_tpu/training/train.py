@@ -28,7 +28,7 @@ def parse_args(argv=None):
     p.add_argument("--local-consensus-radius", type=int, default=0)
     p.add_argument("--bf16", action="store_true", help="bf16 compute (params stay fp32)")
     p.add_argument("--remat", action="store_true")
-    p.add_argument("--attention-impl", default="dense", choices=["dense", "pallas", "ring"])
+    p.add_argument("--attention-impl", default="dense", choices=["dense", "pallas", "ring", "ulysses"])
     # training
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--lr", type=float, default=3e-4)

@@ -90,22 +90,24 @@ class Trainer:
         self.state = init_fn()
 
         consensus_fn = None
-        if config.attention_impl == "ring":
+        if config.attention_impl in ("ring", "ulysses"):
             from glom_tpu.models.glom import resolve_locality_mask
-            from glom_tpu.parallel.ring import make_ring_consensus
 
             if len(train.mesh_axes) < 3:
                 raise ValueError(
-                    "attention_impl='ring' needs a third (seq) mesh axis; "
-                    f"got mesh_axes={train.mesh_axes}"
+                    f"attention_impl={config.attention_impl!r} needs a third "
+                    f"(seq) mesh axis; got mesh_axes={train.mesh_axes}"
                 )
-            seq_axis = train.mesh_axes[2]
-            consensus_fn = make_ring_consensus(
+            if config.attention_impl == "ring":
+                from glom_tpu.parallel.ring import make_ring_consensus as make_sp
+            else:
+                from glom_tpu.parallel.ulysses import make_ulysses_consensus as make_sp
+            consensus_fn = make_sp(
                 self.mesh,
                 attend_self=config.consensus_self,
                 non_local_mask=resolve_locality_mask(config),
                 data_axis=data_axis,
-                seq_axis=seq_axis,
+                seq_axis=train.mesh_axes[2],
             )
 
         self._step = jax.jit(

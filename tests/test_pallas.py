@@ -35,6 +35,33 @@ def test_pallas_matches_dense(attend_self, use_mask):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
+@pytest.mark.parametrize("attend_self", [False, True])
+@pytest.mark.parametrize("use_mask", [False, True])
+def test_blocked_kernel_matches_dense(attend_self, use_mask):
+    """Force the flash-style j-blocked kernel (kv_block=8 on n=16) and check
+    parity — the large-n path exercised at small scale."""
+    rng = np.random.default_rng(5)
+    levels = jnp.asarray(rng.standard_normal((2, 16, 3, 32)).astype(np.float32))
+    mask = jnp.asarray(local_consensus_mask(4, 1.5)) if use_mask else None
+    want = consensus_attention(levels, attend_self=attend_self, non_local_mask=mask)
+    got = consensus_attention_pallas(
+        levels, attend_self=attend_self, non_local_mask=mask, kv_block=8
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_blocked_kernel_uneven_softmax_stability():
+    """Large logit spread across j-blocks exercises the running-max path."""
+    rng = np.random.default_rng(6)
+    levels = rng.standard_normal((1, 32, 2, 16)).astype(np.float32)
+    levels[0, 20:] *= 50.0  # huge-norm columns land in a later block
+    levels = jnp.asarray(levels)
+    want = consensus_attention(levels)
+    got = consensus_attention_pallas(levels, kv_block=8)
+    assert np.isfinite(np.asarray(got)).all()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
+
+
 def test_pallas_grad_matches_dense():
     rng = np.random.default_rng(1)
     levels = jnp.asarray(rng.standard_normal((1, 16, 2, 16)).astype(np.float32))
