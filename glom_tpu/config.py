@@ -87,6 +87,12 @@ class TrainConfig:
     loss_timestep: Optional[int] = None  # None => iters // 2 + 1
     loss_level: int = -1                 # top level
     noise_std: float = 1.0               # img + randn_like(img)  (README.md:74)
+    # contrastive/consistency regularization of top-ish levels — the
+    # reference's own roadmap item (README.md:118-120), framework-owned here
+    consistency: str = "none"            # "none" | "mse" | "infonce"
+    consistency_weight: float = 0.1
+    consistency_temperature: float = 0.1
+    consistency_level: int = -1          # which level to regularize
     steps: int = 100
     log_every: int = 10
     checkpoint_every: int = 0            # 0 => disabled
@@ -105,3 +111,9 @@ class TrainConfig:
     def __post_init__(self):
         if self.param_sharding not in ("tp", "ep", "replicated"):
             raise ValueError(f"unknown param_sharding {self.param_sharding!r}")
+        if self.consistency not in ("none", "mse", "infonce"):
+            raise ValueError(f"unknown consistency kind {self.consistency!r}")
+        if self.consistency_temperature <= 0:
+            raise ValueError(
+                f"consistency_temperature must be > 0, got {self.consistency_temperature}"
+            )

@@ -1,0 +1,68 @@
+"""Contrastive / consistency regularization of top-ish levels.
+
+This is the reference's OWN unfinished roadmap item
+(`/root/reference/README.md:118-120`: "Todo: contrastive / consistency
+regularization of top-ish levels") — implemented here as a framework
+feature.  Two independently-noised views of each image run through the
+model (batched together so it is still one scan); their level states at a
+chosen (timestep, level) are pooled per image and pulled together:
+
+  * ``mse``     — plain consistency: mean-squared distance between the two
+                  views' pooled embeddings (BYOL-style without a predictor).
+  * ``infonce`` — contrastive: symmetric InfoNCE over the batch with the
+                  other view's embedding as the positive and the rest of the
+                  batch as negatives (temperature-scaled cosine logits).
+
+Combined objective: ``denoise_mse + weight * consistency``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from glom_tpu.ops.consensus import l2_normalize
+
+
+def pooled_level_embedding(all_levels: jax.Array, timestep: int, level: int) -> jax.Array:
+    """``(T+1, b, n, L, d)`` return_all stack -> ``(b, d)`` mean-pooled
+    embedding of ``level`` at ``timestep``."""
+    return jnp.mean(all_levels[timestep, :, :, level], axis=1)
+
+
+def consistency_loss(z1: jax.Array, z2: jax.Array) -> jax.Array:
+    """MSE consistency between two views' pooled embeddings (``(b, d)``)."""
+    return jnp.mean((z1.astype(jnp.float32) - z2.astype(jnp.float32)) ** 2)
+
+
+def infonce_loss(z1: jax.Array, z2: jax.Array, temperature: float = 0.1) -> jax.Array:
+    """Symmetric InfoNCE: for each image, the other view is the positive,
+    other images (both views' logits rows) are negatives."""
+    z1 = l2_normalize(z1.astype(jnp.float32))
+    z2 = l2_normalize(z2.astype(jnp.float32))
+    logits = z1 @ z2.T / temperature                    # (b, b)
+    labels = jnp.arange(z1.shape[0])
+    l12 = -jnp.mean(jax.nn.log_softmax(logits, axis=-1)[labels, labels])
+    l21 = -jnp.mean(jax.nn.log_softmax(logits.T, axis=-1)[labels, labels])
+    return 0.5 * (l12 + l21)
+
+
+def regularizer(
+    kind: str,
+    all_levels_v1: jax.Array,
+    all_levels_v2: jax.Array,
+    *,
+    timestep: int,
+    level: int = -1,
+    temperature: float = 0.1,
+) -> jax.Array:
+    """Dispatch on ``kind`` ('mse' | 'infonce')."""
+    z1 = pooled_level_embedding(all_levels_v1, timestep, level)
+    z2 = pooled_level_embedding(all_levels_v2, timestep, level)
+    if kind == "mse":
+        return consistency_loss(z1, z2)
+    if kind == "infonce":
+        return infonce_loss(z1, z2, temperature)
+    raise ValueError(f"unknown consistency kind {kind!r}")

@@ -53,16 +53,39 @@ def make_loss_fn(config: GlomConfig, train: TrainConfig, *, consensus_fn=None):
     if not 0 <= timestep <= iters:
         raise ValueError(f"loss_timestep {timestep} outside [0, {iters}]")
 
+    two_views = train.consistency != "none"
+
     def loss_fn(params, img, rng):
-        noise = jax.random.normal(rng, img.shape, img.dtype) * train.noise_std
-        noised = img + noise
+        b = img.shape[0]
+        if two_views:
+            # two independently-noised views, batched into ONE scan forward;
+            # the reconstruction target stays view 1, consistency couples the
+            # two views' pooled level embeddings (reference roadmap item,
+            # README.md:118-120)
+            noise = jax.random.normal(rng, (2 * b,) + img.shape[1:], img.dtype)
+            noised = jnp.concatenate([img, img]) + noise * train.noise_std
+        else:
+            noise = jax.random.normal(rng, img.shape, img.dtype) * train.noise_std
+            noised = img + noise
         all_levels = glom_model.apply(
             params["glom"], noised, config=config, iters=iters, return_all=True,
             consensus_fn=consensus_fn,
         )
-        tokens = all_levels[timestep, :, :, train.loss_level]   # (b, n, d)
+        tokens = all_levels[timestep, :b, :, train.loss_level]  # (b, n, d)
         recon = patches_to_images_apply(params["decoder"], tokens, config)
         loss = jnp.mean((recon.astype(jnp.float32) - img.astype(jnp.float32)) ** 2)
+        if two_views:
+            from glom_tpu.training.consistency import regularizer
+
+            reg = regularizer(
+                train.consistency,
+                all_levels[:, :b],
+                all_levels[:, b:],
+                timestep=timestep,
+                level=train.consistency_level,
+                temperature=train.consistency_temperature,
+            )
+            loss = loss + train.consistency_weight * reg
         return loss, recon
 
     return loss_fn

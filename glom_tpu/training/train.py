@@ -38,6 +38,11 @@ def parse_args(argv=None):
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--noise-std", type=float, default=1.0)
+    p.add_argument("--consistency", default="none", choices=["none", "mse", "infonce"],
+                   help="two-view consistency regularization of top-ish levels")
+    p.add_argument("--consistency-weight", type=float, default=0.1)
+    p.add_argument("--consistency-temperature", type=float, default=0.1)
+    p.add_argument("--consistency-level", type=int, default=-1)
     # data
     p.add_argument("--data", default="synthetic", choices=["synthetic", "folder"])
     p.add_argument("--data-dir", default=None)
@@ -82,6 +87,10 @@ def main(argv=None):
         weight_decay=args.weight_decay,
         iters=args.iters,
         noise_std=args.noise_std,
+        consistency=args.consistency,
+        consistency_weight=args.consistency_weight,
+        consistency_temperature=args.consistency_temperature,
+        consistency_level=args.consistency_level,
         steps=args.steps,
         log_every=args.log_every,
         checkpoint_every=args.checkpoint_every,

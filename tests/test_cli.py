@@ -18,6 +18,8 @@ def test_every_train_config_field_has_a_cli_path():
         "batch_size", "learning_rate", "weight_decay", "iters", "noise_std",
         "steps", "log_every", "checkpoint_every", "checkpoint_dir",
         "profile_dir", "seed", "mesh_shape", "param_sharding",
+        "consistency", "consistency_weight", "consistency_temperature",
+        "consistency_level",
     }
     # fields intentionally config-only (documented, no flag yet)
     config_only = {"loss_timestep", "loss_level", "mesh_axes", "donate"}

@@ -1,0 +1,89 @@
+"""Consistency/contrastive regularization tests (the reference's roadmap
+item, README.md:118-120, implemented as framework code)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from glom_tpu.config import GlomConfig, TrainConfig
+from glom_tpu.training import denoise
+from glom_tpu.training.consistency import consistency_loss, infonce_loss, regularizer
+
+TINY = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4)
+
+
+def test_consistency_loss_zero_for_identical_views():
+    z = jnp.asarray(np.random.default_rng(0).standard_normal((4, 8)))
+    assert float(consistency_loss(z, z)) == 0.0
+
+
+def test_infonce_perfect_alignment_beats_misalignment():
+    rng = np.random.default_rng(1)
+    z = jnp.asarray(rng.standard_normal((6, 16)).astype(np.float32))
+    aligned = float(infonce_loss(z, z, temperature=0.1))
+    shuffled = jnp.asarray(np.roll(np.asarray(z), 1, axis=0))
+    misaligned = float(infonce_loss(z, shuffled, temperature=0.1))
+    assert aligned < misaligned
+
+
+def test_nonpositive_temperature_rejected():
+    with pytest.raises(ValueError, match="temperature"):
+        TrainConfig(consistency="infonce", consistency_temperature=0.0)
+    with pytest.raises(ValueError, match="temperature"):
+        TrainConfig(consistency_temperature=-1.0)
+
+
+def test_regularizer_rejects_unknown_kind():
+    x = jnp.zeros((3, 2, 4, 2, 8))
+    with pytest.raises(ValueError, match="unknown consistency"):
+        regularizer("byol", x, x, timestep=1)
+
+
+def test_mse_consistency_vanishes_without_noise():
+    """noise_std=0 makes both views identical => the regularizer term is 0,
+    so the loss equals the plain denoising loss exactly."""
+    t_plain = TrainConfig(iters=2, noise_std=0.0)
+    t_cons = TrainConfig(iters=2, noise_std=0.0, consistency="mse", consistency_weight=5.0)
+    tx = optax.sgd(0.0)
+    state = denoise.init_state(jax.random.PRNGKey(0), TINY, tx)
+    img = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 16, 16))
+    l_plain, _ = denoise.make_loss_fn(TINY, t_plain)(state.params, img, jax.random.PRNGKey(2))
+    l_cons, _ = denoise.make_loss_fn(TINY, t_cons)(state.params, img, jax.random.PRNGKey(2))
+    np.testing.assert_allclose(float(l_plain), float(l_cons), rtol=1e-6)
+
+
+@pytest.mark.parametrize("kind", ["mse", "infonce"])
+def test_training_with_consistency_decreases_loss(kind):
+    c = TINY
+    t = TrainConfig(batch_size=4, learning_rate=1e-3, iters=2, noise_std=0.3,
+                    consistency=kind, consistency_weight=0.5)
+    tx = optax.adam(t.learning_rate)
+    state = denoise.init_state(jax.random.PRNGKey(0), c, tx)
+    step = denoise.make_train_step(c, t, tx, donate=False)
+    img = jax.random.normal(jax.random.PRNGKey(1), (4, 3, 16, 16))
+    losses = []
+    for _ in range(25):
+        state, metrics = step(state, img)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_consistency_gradient_couples_views():
+    """With a large weight, the regularizer must contribute gradient:
+    grads differ from the plain-denoise grads."""
+    t_plain = TrainConfig(iters=2, noise_std=0.5)
+    t_cons = TrainConfig(iters=2, noise_std=0.5, consistency="infonce", consistency_weight=10.0)
+    tx = optax.sgd(0.0)
+    state = denoise.init_state(jax.random.PRNGKey(0), TINY, tx)
+    img = jax.random.normal(jax.random.PRNGKey(1), (4, 3, 16, 16))
+    g_plain = jax.grad(lambda p: denoise.make_loss_fn(TINY, t_plain)(p, img, jax.random.PRNGKey(2))[0])(state.params)
+    g_cons = jax.grad(lambda p: denoise.make_loss_fn(TINY, t_cons)(p, img, jax.random.PRNGKey(2))[0])(state.params)
+    diff = jax.tree_util.tree_reduce(
+        lambda a, b: a + float(jnp.abs(b[0] - b[1]).max()),
+        jax.tree_util.tree_map(lambda a, b: (a, b), g_plain, g_cons),
+        0.0,
+    )
+    assert diff > 0.0
