@@ -54,10 +54,22 @@ def _atomic_write(directory: str, name: str, write_fn) -> str:
         raise
 
 
-def save(directory: str, step: int, trees: Dict[str, Any], *, keep: int = 3) -> str:
+def save(
+    directory: str, step: int, trees: Dict[str, Any], *, keep: int = 3,
+    backend: str = "npz",
+) -> str:
     """Write ``<dir>/ckpt_<step>.npz`` holding every named pytree in
     ``trees`` (e.g. ``{"params": ..., "opt": ..., "rng": ...}``) plus an
-    atomic manifest; prune to ``keep`` newest.  Returns the path."""
+    atomic manifest; prune to ``keep`` newest.  Returns the path.
+
+    ``backend="orbax"`` delegates the tree serialization to orbax
+    (``ocp.StandardCheckpointer``) under ``<dir>/ckpt_<step>.orbax`` —
+    useful for interop with orbax-centric stacks; the npz backend stays the
+    default (single file, loadable from NumPy alone)."""
+    if backend == "orbax":
+        return _save_orbax(directory, step, trees, keep=keep)
+    if backend != "npz":
+        raise ValueError(f"unknown checkpoint backend {backend!r}")
     if jax.process_index() != 0:
         return ""
     os.makedirs(directory, exist_ok=True)
@@ -78,18 +90,32 @@ def save(directory: str, step: int, trees: Dict[str, Any], *, keep: int = 3) -> 
     return path
 
 
+def _step_of(name: str) -> Optional[int]:
+    for suffix in (".npz", ".orbax"):
+        if name.startswith("ckpt_") and name.endswith(suffix):
+            return int(name[len("ckpt_"):-len(suffix)])
+    return None
+
+
 def _prune(directory: str, keep: int, *, protect: Optional[int] = None) -> None:
-    """Keep the ``keep`` newest checkpoints, never deleting ``protect`` (the
-    step the manifest points at — matters when saving a step lower than
-    stale higher-numbered checkpoints after a rollback)."""
+    """Keep the ``keep`` newest checkpoints ACROSS BOTH BACKENDS, never
+    deleting step ``protect`` (the step the manifest points at — matters
+    when saving a step lower than stale higher-numbered checkpoints after a
+    rollback)."""
+    import shutil
+
     ckpts = sorted(
-        (f for f in os.listdir(directory) if f.startswith("ckpt_") and f.endswith(".npz")),
-        key=lambda f: int(f[len("ckpt_"):-len(".npz")]),
+        (f for f in os.listdir(directory) if _step_of(f) is not None),
+        key=_step_of,
     )
     for f in ckpts[:-keep] if keep > 0 else []:
-        if protect is not None and f == f"ckpt_{protect}.npz":
+        if protect is not None and _step_of(f) == protect:
             continue
-        os.remove(os.path.join(directory, f))
+        path = os.path.join(directory, f)
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        else:
+            os.remove(path)
     # sweep tmp files orphaned by crashed writers
     for f in os.listdir(directory):
         if f.endswith(".tmp"):
@@ -104,6 +130,36 @@ def latest_step(directory: str) -> Optional[int]:
         return json.load(f)["latest_step"]
 
 
+def _save_orbax(directory: str, step: int, trees: Dict[str, Any], *, keep: int) -> str:
+    import orbax.checkpoint as ocp
+
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.abspath(os.path.join(directory, f"ckpt_{step}.orbax"))
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, {k: v for k, v in trees.items() if v is not None}, force=True)
+    ckptr.wait_until_finished()  # StandardCheckpointer finalizes async
+    if jax.process_index() != 0:
+        return ""  # leader-only return contract, matching the npz backend
+    _atomic_write(
+        directory,
+        "manifest.json",
+        lambda f: f.write(
+            json.dumps({"latest_step": step, "path": path, "backend": "orbax"}).encode()
+        ),
+    )
+    _prune(directory, keep, protect=step)
+    return path
+
+
+def _restore_orbax(directory: str, templates: Dict[str, Any], step: int):
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(os.path.join(directory, f"ckpt_{step}.orbax"))
+    target = {k: v for k, v in templates.items() if v is not None}
+    restored = ocp.StandardCheckpointer().restore(path, target)
+    return step, {k: restored.get(k) for k in templates}
+
+
 def restore(
     directory: str,
     templates: Dict[str, Any],
@@ -111,11 +167,15 @@ def restore(
     step: Optional[int] = None,
 ) -> Tuple[int, Dict[str, Any]]:
     """Restore ``(step, {name: pytree})``; templates supply structure and
-    (for jax.Array leaves) target shardings."""
+    (for jax.Array leaves) target shardings.  The backend is detected
+    per-step from which artifact exists, so npz and orbax checkpoints (even
+    mixed in one directory) restore through the same call."""
     if step is None:
         step = latest_step(directory)
         if step is None:
             raise FileNotFoundError(f"no checkpoint manifest in {directory}")
+    if os.path.isdir(os.path.join(directory, f"ckpt_{step}.orbax")):
+        return _restore_orbax(directory, templates, step)
     with np.load(os.path.join(directory, f"ckpt_{step}.npz")) as data:
         arrays = dict(data)
 

@@ -1,0 +1,55 @@
+"""Flax Linen wrapper.
+
+The functional core (``glom_tpu.models.glom``) is framework-agnostic; this
+module packages it as a ``flax.linen.Module`` for users whose training
+stacks (TrainState, optax wiring, orbax integrations) speak Linen.  The
+whole param pytree registers under one collection entry (``params/glom``),
+so ``module.init`` / ``module.apply`` interoperate with the functional
+``init``/``apply`` via :func:`to_functional` / :func:`from_functional`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+
+from glom_tpu.config import GlomConfig
+from glom_tpu.models import glom as glom_model
+
+
+class GlomFlax(nn.Module):
+    """Linen module with the reference forward signature
+    (`glom_pytorch.py:110`): ``module.apply(variables, img, iters=...,
+    levels=..., return_all=...)``."""
+
+    config: GlomConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        img: jax.Array,
+        iters: Optional[int] = None,
+        levels: Optional[jax.Array] = None,
+        return_all: bool = False,
+    ):
+        params = self.param("glom", lambda rng: glom_model.init(rng, self.config))
+        return glom_model.apply(
+            params,
+            img,
+            config=self.config,
+            iters=iters,
+            levels=levels,
+            return_all=return_all,
+        )
+
+
+def to_functional(variables: dict) -> dict:
+    """Linen variables -> functional param pytree."""
+    return variables["params"]["glom"]
+
+
+def from_functional(params: dict) -> dict:
+    """Functional param pytree -> Linen variables."""
+    return {"params": {"glom": params}}

@@ -72,18 +72,19 @@ def test_training_with_consistency_decreases_loss(kind):
 
 
 def test_consistency_gradient_couples_views():
-    """With a large weight, the regularizer must contribute gradient:
-    grads differ from the plain-denoise grads."""
-    t_plain = TrainConfig(iters=2, noise_std=0.5)
-    t_cons = TrainConfig(iters=2, noise_std=0.5, consistency="infonce", consistency_weight=10.0)
+    """The regularizer must contribute gradient: compare two two-view
+    configs differing ONLY in consistency_weight (identical noise draws), so
+    any difference is attributable to the regularizer term."""
+    t_w0 = TrainConfig(iters=2, noise_std=0.5, consistency="infonce", consistency_weight=0.0)
+    t_w10 = TrainConfig(iters=2, noise_std=0.5, consistency="infonce", consistency_weight=10.0)
     tx = optax.sgd(0.0)
     state = denoise.init_state(jax.random.PRNGKey(0), TINY, tx)
     img = jax.random.normal(jax.random.PRNGKey(1), (4, 3, 16, 16))
-    g_plain = jax.grad(lambda p: denoise.make_loss_fn(TINY, t_plain)(p, img, jax.random.PRNGKey(2))[0])(state.params)
-    g_cons = jax.grad(lambda p: denoise.make_loss_fn(TINY, t_cons)(p, img, jax.random.PRNGKey(2))[0])(state.params)
+    g_w0 = jax.grad(lambda p: denoise.make_loss_fn(TINY, t_w0)(p, img, jax.random.PRNGKey(2))[0])(state.params)
+    g_w10 = jax.grad(lambda p: denoise.make_loss_fn(TINY, t_w10)(p, img, jax.random.PRNGKey(2))[0])(state.params)
     diff = jax.tree_util.tree_reduce(
         lambda a, b: a + float(jnp.abs(b[0] - b[1]).max()),
-        jax.tree_util.tree_map(lambda a, b: (a, b), g_plain, g_cons),
+        jax.tree_util.tree_map(lambda a, b: (a, b), g_w0, g_w10),
         0.0,
     )
-    assert diff > 0.0
+    assert diff > 1e-6

@@ -173,6 +173,53 @@ def test_checkpoint_roundtrip(tmp_path):
     assert int(jax.device_get(trainer2.state.step)) == 4
 
 
+def test_checkpoint_orbax_backend_roundtrip(tmp_path):
+    """backend='orbax' writes via StandardCheckpointer; restore() reads the
+    backend from the manifest transparently."""
+    c = TINY
+    tx = optax.adam(1e-3)
+    state = denoise.init_state(jax.random.PRNGKey(0), c, tx)
+    host = jax.device_get(state)
+    ckpt_lib.save(str(tmp_path), 7, {"params": host.params, "rng": host.rng}, backend="orbax")
+    assert ckpt_lib.latest_step(str(tmp_path)) == 7
+    step, trees = ckpt_lib.restore(str(tmp_path), {"params": state.params, "rng": state.rng})
+    assert step == 7
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        jax.device_get(trees["params"]),
+        host.params,
+    )
+
+
+def test_checkpoint_mixed_backends_one_directory(tmp_path):
+    """Backend is detected per step: an npz step restores even after a later
+    orbax save (and unified pruning spans both)."""
+    c = TINY
+    tx = optax.adam(1e-3)
+    state = denoise.init_state(jax.random.PRNGKey(0), c, tx)
+    host = jax.device_get(state)
+    ckpt_lib.save(str(tmp_path), 5, {"params": host.params})
+    ckpt_lib.save(str(tmp_path), 10, {"params": host.params}, backend="orbax")
+    step5, trees5 = ckpt_lib.restore(str(tmp_path), {"params": state.params}, step=5)
+    step10, trees10 = ckpt_lib.restore(str(tmp_path), {"params": state.params})
+    assert (step5, step10) == (5, 10)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        jax.device_get(trees5["params"]),
+        jax.device_get(trees10["params"]),
+    )
+    # unified pruning: 4 more saves with keep=3 must delete the oldest of BOTH kinds
+    for s in (11, 12, 13):
+        ckpt_lib.save(str(tmp_path), s, {"params": host.params})
+    names = sorted(f for f in __import__("os").listdir(str(tmp_path)) if f.startswith("ckpt_"))
+    assert names == ["ckpt_11.npz", "ckpt_12.npz", "ckpt_13.npz"], names
+
+
+def test_checkpoint_unknown_backend_rejected(tmp_path):
+    with pytest.raises(ValueError, match="unknown checkpoint backend"):
+        ckpt_lib.save(str(tmp_path), 1, {"params": {}}, backend="msgpack")
+
+
 def test_checkpoint_shape_mismatch_rejected(tmp_path):
     c = TINY
     tx = optax.adam(1e-3)
