@@ -7,14 +7,23 @@ counter, written as a single ``.npz`` (flattened by '/'-joined key paths)
 with a JSON manifest.  No framework dependency, deterministic layout,
 loadable from NumPy alone.  All writes are atomic (tmp + rename) so a crash
 never leaves a torn checkpoint or manifest; stale tmp files from crashed
-writers are swept on the next save.  Multi-host: only process 0 writes;
-restore places leaves onto the template's shardings via device_put.
+writers are swept on the next save.  Multi-host: only process 0 writes the
+npz/manifest; restore places leaves onto the template's shardings via
+device_put.
+
+``backend="orbax"`` swaps the artifact serialization for orbax's
+``StandardCheckpointer`` (interop with orbax-centric stacks).  Everything
+else — manifest, pruning, the restore contract (shape validation, dtype
+cast, sharding placement) — is shared, and a step holds exactly ONE
+artifact regardless of backend (saving a step replaces the other backend's
+artifact for that step).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
 import tempfile
 from typing import Any, Dict, Optional, Tuple
 
@@ -40,6 +49,17 @@ def _flatten(tree: Any) -> dict:
     return flat
 
 
+def _flatten_named(trees: Dict[str, Any]) -> dict:
+    arrays = {}
+    for name, tree in trees.items():
+        if tree is None:
+            continue
+        arrays.update(
+            {(f"{name}{_SEP}{k}" if k else name): v for k, v in _flatten(tree).items()}
+        )
+    return arrays
+
+
 def _atomic_write(directory: str, name: str, write_fn) -> str:
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
     try:
@@ -54,33 +74,49 @@ def _atomic_write(directory: str, name: str, write_fn) -> str:
         raise
 
 
+def _npz_path(directory: str, step: int) -> str:
+    return os.path.join(directory, f"ckpt_{step}.npz")
+
+
+def _orbax_path(directory: str, step: int) -> str:
+    return os.path.abspath(os.path.join(directory, f"ckpt_{step}.orbax"))
+
+
 def save(
     directory: str, step: int, trees: Dict[str, Any], *, keep: int = 3,
     backend: str = "npz",
 ) -> str:
-    """Write ``<dir>/ckpt_<step>.npz`` holding every named pytree in
-    ``trees`` (e.g. ``{"params": ..., "opt": ..., "rng": ...}``) plus an
-    atomic manifest; prune to ``keep`` newest.  Returns the path.
-
-    ``backend="orbax"`` delegates the tree serialization to orbax
-    (``ocp.StandardCheckpointer``) under ``<dir>/ckpt_<step>.orbax`` —
-    useful for interop with orbax-centric stacks; the npz backend stays the
-    default (single file, loadable from NumPy alone)."""
-    if backend == "orbax":
-        return _save_orbax(directory, step, trees, keep=keep)
-    if backend != "npz":
+    """Write step ``step`` holding every named pytree in ``trees`` (e.g.
+    ``{"params": ..., "opt": ..., "rng": ...}``) plus an atomic manifest;
+    prune to ``keep`` newest steps.  Returns the artifact path (process 0)
+    or ``""`` (other processes)."""
+    if backend not in ("npz", "orbax"):
         raise ValueError(f"unknown checkpoint backend {backend!r}")
+    os.makedirs(directory, exist_ok=True)
+
+    if backend == "orbax":
+        # collective: every process participates in the orbax save
+        import orbax.checkpoint as ocp
+
+        path = _orbax_path(directory, step)
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(path, {k: v for k, v in trees.items() if v is not None}, force=True)
+        ckptr.wait_until_finished()  # StandardCheckpointer finalizes async
+
     if jax.process_index() != 0:
         return ""
-    os.makedirs(directory, exist_ok=True)
-    arrays = {}
-    for name, tree in trees.items():
-        if tree is None:
-            continue
-        arrays.update(
-            {(f"{name}{_SEP}{k}" if k else name): v for k, v in _flatten(tree).items()}
-        )
-    path = _atomic_write(directory, f"ckpt_{step}.npz", lambda f: np.savez(f, **arrays))
+
+    if backend == "npz":
+        arrays = _flatten_named(trees)
+        path = _atomic_write(directory, f"ckpt_{step}.npz", lambda f: np.savez(f, **arrays))
+
+    # one artifact per step: replace the other backend's same-step artifact
+    other = _orbax_path(directory, step) if backend == "npz" else _npz_path(directory, step)
+    if os.path.isdir(other):
+        shutil.rmtree(other, ignore_errors=True)
+    elif os.path.exists(other):
+        os.remove(other)
+
     _atomic_write(
         directory,
         "manifest.json",
@@ -98,12 +134,10 @@ def _step_of(name: str) -> Optional[int]:
 
 
 def _prune(directory: str, keep: int, *, protect: Optional[int] = None) -> None:
-    """Keep the ``keep`` newest checkpoints ACROSS BOTH BACKENDS, never
+    """Keep the ``keep`` newest checkpoint steps ACROSS BOTH BACKENDS, never
     deleting step ``protect`` (the step the manifest points at — matters
     when saving a step lower than stale higher-numbered checkpoints after a
     rollback)."""
-    import shutil
-
     ckpts = sorted(
         (f for f in os.listdir(directory) if _step_of(f) is not None),
         key=_step_of,
@@ -130,34 +164,24 @@ def latest_step(directory: str) -> Optional[int]:
         return json.load(f)["latest_step"]
 
 
-def _save_orbax(directory: str, step: int, trees: Dict[str, Any], *, keep: int) -> str:
-    import orbax.checkpoint as ocp
+def _load_arrays(directory: str, step: int) -> dict:
+    """Read step ``step``'s artifact (whichever backend wrote it) into the
+    flat ``{"name/leaf/path": ndarray}`` form."""
+    npz = _npz_path(directory, step)
+    orbax_dir = _orbax_path(directory, step)
+    has_npz, has_orbax = os.path.exists(npz), os.path.isdir(orbax_dir)
+    if has_npz and has_orbax:  # legacy double-artifact dirs: newest wins
+        has_orbax = os.path.getmtime(orbax_dir) > os.path.getmtime(npz)
+        has_npz = not has_orbax
+    if has_npz:
+        with np.load(npz) as data:
+            return dict(data)
+    if has_orbax:
+        import orbax.checkpoint as ocp
 
-    os.makedirs(directory, exist_ok=True)
-    path = os.path.abspath(os.path.join(directory, f"ckpt_{step}.orbax"))
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path, {k: v for k, v in trees.items() if v is not None}, force=True)
-    ckptr.wait_until_finished()  # StandardCheckpointer finalizes async
-    if jax.process_index() != 0:
-        return ""  # leader-only return contract, matching the npz backend
-    _atomic_write(
-        directory,
-        "manifest.json",
-        lambda f: f.write(
-            json.dumps({"latest_step": step, "path": path, "backend": "orbax"}).encode()
-        ),
-    )
-    _prune(directory, keep, protect=step)
-    return path
-
-
-def _restore_orbax(directory: str, templates: Dict[str, Any], step: int):
-    import orbax.checkpoint as ocp
-
-    path = os.path.abspath(os.path.join(directory, f"ckpt_{step}.orbax"))
-    target = {k: v for k, v in templates.items() if v is not None}
-    restored = ocp.StandardCheckpointer().restore(path, target)
-    return step, {k: restored.get(k) for k in templates}
+        raw = ocp.StandardCheckpointer().restore(orbax_dir)
+        return _flatten_named(raw)
+    raise FileNotFoundError(f"no checkpoint artifact for step {step} in {directory}")
 
 
 def restore(
@@ -167,24 +191,22 @@ def restore(
     step: Optional[int] = None,
 ) -> Tuple[int, Dict[str, Any]]:
     """Restore ``(step, {name: pytree})``; templates supply structure and
-    (for jax.Array leaves) target shardings.  The backend is detected
-    per-step from which artifact exists, so npz and orbax checkpoints (even
-    mixed in one directory) restore through the same call."""
+    (for jax.Array leaves) target dtype + shardings.  Backend is detected
+    per step from the on-disk artifact; validation (shape mismatch =>
+    ValueError), dtype cast, and device placement are uniform across
+    backends."""
     if step is None:
         step = latest_step(directory)
         if step is None:
             raise FileNotFoundError(f"no checkpoint manifest in {directory}")
-    if os.path.isdir(os.path.join(directory, f"ckpt_{step}.orbax")):
-        return _restore_orbax(directory, templates, step)
-    with np.load(os.path.join(directory, f"ckpt_{step}.npz")) as data:
-        arrays = dict(data)
+    arrays = _load_arrays(directory, step)
 
     def unflatten(template, prefix):
         flat_paths = jax.tree_util.tree_flatten_with_path(template)
         leaves = []
         for path, leaf in flat_paths[0]:
             key = prefix + _SEP + _SEP.join(_entry_str(p) for p in path) if path else prefix
-            arr = arrays[key]
+            arr = np.asarray(arrays[key])
             if arr.shape != np.shape(leaf):
                 raise ValueError(
                     f"shape mismatch for {key}: ckpt {arr.shape} vs template {np.shape(leaf)}"

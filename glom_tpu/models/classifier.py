@@ -1,0 +1,83 @@
+"""Supervised classification head on GLOM — a second model family.
+
+The reference ships only the bare SSL backbone; the paper's intended
+downstream use is recognition from the top-level part-whole representation.
+``GlomClassifier`` = GLOM backbone + mean-pooled level embedding + linear
+head, trained with cross-entropy (optionally on frozen backbone features —
+the fine-tune vs probe switch).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from glom_tpu.config import GlomConfig
+from glom_tpu.models import glom as glom_model
+
+
+def init(rng: jax.Array, config: GlomConfig, num_classes: int) -> dict:
+    k_glom, k_head = jax.random.split(rng)
+    bound = config.dim ** -0.5
+    return {
+        "glom": glom_model.init(k_glom, config),
+        "head": {
+            "w": jax.random.uniform(k_head, (config.dim, num_classes), config.param_dtype, -bound, bound),
+            "b": jnp.zeros((num_classes,), config.param_dtype),
+        },
+    }
+
+
+def apply(
+    params: dict,
+    imgs: jax.Array,
+    *,
+    config: GlomConfig,
+    iters: Optional[int] = None,
+    level: int = -1,
+    consensus_fn=None,
+) -> jax.Array:
+    """``(b, c, H, W) -> (b, num_classes)`` logits."""
+    out = glom_model.apply(
+        params["glom"], imgs, config=config, iters=iters, consensus_fn=consensus_fn
+    )
+    pooled = jnp.mean(out[:, :, level], axis=1)
+    return pooled @ params["head"]["w"] + params["head"]["b"]
+
+
+def make_train_step(
+    config: GlomConfig,
+    tx: optax.GradientTransformation,
+    *,
+    iters: Optional[int] = None,
+    level: int = -1,
+    freeze_backbone: bool = False,
+    donate: bool = False,
+):
+    """Jitted supervised step ``(params, opt_state, imgs, labels) ->
+    (params, opt_state, metrics)``.  ``freeze_backbone=True`` stops gradients
+    into the GLOM params (linear-probe fine-tuning)."""
+
+    def loss_fn(params, imgs, labels):
+        p = params
+        if freeze_backbone:
+            p = {**params, "glom": jax.lax.stop_gradient(params["glom"])}
+        logits = apply(p, imgs, config=config, iters=iters, level=level)
+        loss = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), labels
+            )
+        )
+        acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        return loss, acc
+
+    def step(params, opt_state, imgs, labels):
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, imgs, labels)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": loss, "accuracy": acc}
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())

@@ -73,7 +73,10 @@ def make_loss_fn(config: GlomConfig, train: TrainConfig, *, consensus_fn=None):
         )
         tokens = all_levels[timestep, :b, :, train.loss_level]  # (b, n, d)
         recon = patches_to_images_apply(params["decoder"], tokens, config)
-        loss = jnp.mean((recon.astype(jnp.float32) - img.astype(jnp.float32)) ** 2)
+        # accumulate the loss in AT LEAST fp32 (bf16 compute upcasts; f64
+        # params keep f64 — matters for finite-difference grad checks)
+        acc_dt = jnp.promote_types(recon.dtype, jnp.float32)
+        loss = jnp.mean((recon.astype(acc_dt) - img.astype(acc_dt)) ** 2)
         if two_views:
             from glom_tpu.training.consistency import regularizer
 

@@ -1,0 +1,97 @@
+"""Evaluation utilities for denoising-SSL representations.
+
+The reference ships no evaluation story; these are the framework-owned
+standard probes for "did the SSL objective learn anything":
+
+  * :func:`embed` — pooled level embeddings from the scan forward (the
+    representation the README's island/clustering discussion points at).
+  * :func:`linear_probe` — closed-form ridge classifier on frozen
+    embeddings + accuracy (the standard SSL probe, deterministic, no
+    iterative fitting).
+  * :func:`reconstruction_psnr` — denoising fidelity of the decoder head.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from glom_tpu.config import GlomConfig
+from glom_tpu.models import glom as glom_model
+from glom_tpu.models.heads import patches_to_images_apply
+
+
+def embed(
+    params: dict,
+    imgs: jax.Array,
+    *,
+    config: GlomConfig,
+    iters: Optional[int] = None,
+    level: int = -1,
+    consensus_fn=None,
+) -> jax.Array:
+    """``(b, c, H, W) -> (b, d)`` mean-pooled final-state embeddings at
+    ``level``."""
+    out = glom_model.apply(
+        params, imgs, config=config, iters=iters, consensus_fn=consensus_fn
+    )
+    return jnp.mean(out[:, :, level], axis=1)
+
+
+def linear_probe(
+    train_x: jax.Array,
+    train_y: jax.Array,
+    test_x: jax.Array,
+    test_y: jax.Array,
+    *,
+    num_classes: int,
+    l2: float = 1e-3,
+) -> Tuple[float, float]:
+    """Closed-form ridge regression to one-hot targets on frozen embeddings;
+    returns ``(train_accuracy, test_accuracy)``."""
+    x = train_x.astype(jnp.float32)
+    mean, std = x.mean(0), x.std(0) + 1e-6
+    x = (x - mean) / std
+    xt = (test_x.astype(jnp.float32) - mean) / std
+
+    onehot = jax.nn.one_hot(train_y, num_classes)
+    d = x.shape[1]
+    w = jnp.linalg.solve(x.T @ x + l2 * jnp.eye(d), x.T @ onehot)
+
+    def acc(feats, labels):
+        pred = jnp.argmax(feats @ w, axis=-1)
+        return float(jnp.mean((pred == labels).astype(jnp.float32)))
+
+    return acc(x, train_y), acc(xt, test_y)
+
+
+def reconstruction_psnr(
+    params: dict,
+    imgs: jax.Array,
+    rng: jax.Array,
+    *,
+    config: GlomConfig,
+    noise_std: float = 1.0,
+    iters: Optional[int] = None,
+    timestep: Optional[int] = None,
+    level: int = -1,
+    data_range: float = 2.0,
+) -> float:
+    """PSNR (dB) of decoder reconstructions from noised inputs — the eval
+    twin of the denoising training objective.  ``params`` is the trainer's
+    ``{"glom": ..., "decoder": ...}`` tree."""
+    if iters is None:
+        iters = config.default_iters
+    if timestep is None:
+        timestep = iters // 2 + 1
+    noised = imgs + jax.random.normal(rng, imgs.shape, imgs.dtype) * noise_std
+    all_levels = glom_model.apply(
+        params["glom"], noised, config=config, iters=iters, return_all=True
+    )
+    recon = patches_to_images_apply(
+        params["decoder"], all_levels[timestep, :, :, level], config
+    )
+    mse = jnp.mean((recon.astype(jnp.float32) - imgs.astype(jnp.float32)) ** 2)
+    return float(20.0 * jnp.log10(data_range) - 10.0 * jnp.log10(mse))

@@ -123,6 +123,21 @@ def test_top_level_divisor_and_zero_pad():
     np.testing.assert_allclose(out[1][..., -1, :], top_manual, atol=1e-4)
 
 
+def test_information_propagates_one_level_per_iteration():
+    """Bottom-up moves input one level per iteration (glom_pytorch.py:131-134):
+    with L levels, the top level is input-INDEPENDENT until iteration L
+    (motivating the reference's iters=2*levels default, `:112`)."""
+    c = TINY  # levels=3
+    params = glom_model.init(jax.random.PRNGKey(0), c)
+    img1 = jax.random.normal(jax.random.PRNGKey(1), (1, 3, c.image_size, c.image_size))
+    img2 = jax.random.normal(jax.random.PRNGKey(2), (1, 3, c.image_size, c.image_size))
+    top = lambda img, it: np.asarray(
+        glom_model.apply(params, img, config=c, iters=it)[..., -1, :]
+    )
+    np.testing.assert_array_equal(top(img1, 2), top(img2, 2))   # not yet reached
+    assert not np.allclose(top(img1, 3), top(img2, 3))          # reached at L
+
+
 def test_grad_flows_and_finite():
     """Autodiff through the scan: MSE on final top level; grads finite and
     nonzero for every param leaf (SURVEY.md §4.3)."""

@@ -215,6 +215,27 @@ def test_checkpoint_mixed_backends_one_directory(tmp_path):
     assert names == ["ckpt_11.npz", "ckpt_12.npz", "ckpt_13.npz"], names
 
 
+def test_checkpoint_same_step_resave_replaces_other_backend(tmp_path):
+    """Re-saving a step with the other backend leaves exactly ONE artifact
+    for that step, and restore reads the fresh payload."""
+    import os
+    tree_a = {"params": {"w": np.arange(4.0)}}
+    tree_b = {"params": {"w": np.arange(4.0) + 100.0}}
+    ckpt_lib.save(str(tmp_path), 5, tree_a, backend="orbax")
+    ckpt_lib.save(str(tmp_path), 5, tree_b)  # npz re-save of the same step
+    names = [f for f in os.listdir(str(tmp_path)) if f.startswith("ckpt_5")]
+    assert names == ["ckpt_5.npz"], names
+    _, trees = ckpt_lib.restore(str(tmp_path), {"params": tree_a["params"]}, step=5)
+    np.testing.assert_array_equal(np.asarray(trees["params"]["w"]), tree_b["params"]["w"])
+
+
+def test_checkpoint_orbax_shape_mismatch_uniform_contract(tmp_path):
+    """The orbax path honors the same shape-mismatch ValueError as npz."""
+    ckpt_lib.save(str(tmp_path), 1, {"params": {"w": np.ones((2, 3))}}, backend="orbax")
+    with pytest.raises(ValueError, match="shape mismatch"):
+        ckpt_lib.restore(str(tmp_path), {"params": {"w": np.ones((4, 4))}})
+
+
 def test_checkpoint_unknown_backend_rejected(tmp_path):
     with pytest.raises(ValueError, match="unknown checkpoint backend"):
         ckpt_lib.save(str(tmp_path), 1, {"params": {}}, backend="msgpack")
