@@ -1,0 +1,33 @@
+"""The reference README's three recipes, verbatim semantics, on TPU.
+
+Run: python examples/basic_usage.py
+"""
+
+import numpy as np
+
+from glom_tpu import Glom
+
+model = Glom(dim=512, levels=6, image_size=224, patch_size=14)
+rng = np.random.default_rng(0)
+
+# 1. plain forward (README usage)
+img = rng.standard_normal((1, 3, 224, 224)).astype(np.float32)
+levels = model(img, iters=12)
+print("forward:", levels.shape)                      # (1, 256, 6, 512)
+
+# 2. all-states inspection (islands / losses at any timestep+level)
+all_levels = model(img, iters=12, return_all=True)
+print("return_all:", all_levels.shape)               # (13, 1, 256, 6, 512)
+top_after_6 = all_levels[7, :, :, -1]
+print("top level after iteration 7:", top_after_6.shape)
+
+from glom_tpu.models.islands import island_summary
+
+summary = island_summary(all_levels, model.config.num_patches_side, threshold=0.9)
+print("islands per (timestep, level):\n", summary["num_islands"])
+
+# 3. stateful video continuation
+img2 = rng.standard_normal((1, 3, 224, 224)).astype(np.float32)
+levels2 = model(img2, levels=levels, iters=10)
+levels3 = model(img2, levels=levels2, iters=6)
+print("carried state:", levels3.shape)

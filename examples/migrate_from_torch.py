@@ -1,0 +1,34 @@
+"""Migrating reference-trained weights to the TPU framework (and back).
+
+Requires torch + the reference package importable (pip install glom-pytorch,
+or a checkout on sys.path).
+
+Run: python examples/migrate_from_torch.py
+"""
+
+import numpy as np
+
+try:
+    import torch
+    from glom_pytorch import Glom as TorchGlom
+except ImportError as e:
+    raise SystemExit(f"needs torch + glom-pytorch installed: {e}")
+
+from glom_tpu import Glom
+
+KW = dict(dim=512, levels=6, image_size=224, patch_size=14)
+
+# torch -> jax: one line
+tmodel = TorchGlom(**KW).eval()
+model = Glom.from_torch_state_dict(tmodel.state_dict(), **KW)
+
+img = np.random.default_rng(0).standard_normal((1, 3, 224, 224)).astype(np.float32)
+with torch.no_grad():
+    want = tmodel(torch.from_numpy(img), iters=12).numpy()
+got = np.asarray(model(img, iters=12))
+print("max |torch - jax|:", float(np.abs(got - want).max()))
+
+# jax -> torch: state_dict() emits the reference layout
+back = TorchGlom(**KW)
+back.load_state_dict({k: torch.from_numpy(np.array(v)) for k, v in model.state_dict().items()})
+print("round-trip into the reference module: OK")

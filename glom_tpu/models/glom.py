@@ -146,6 +146,18 @@ def apply(
     (``glom_tpu.parallel.ring.make_ring_consensus``).
     """
     c = config
+    if img.ndim != 4 or img.shape[1:] != (c.channels, c.image_size, c.image_size):
+        raise ValueError(
+            f"img must be (batch, {c.channels}, {c.image_size}, {c.image_size}) "
+            f"for this config, got {tuple(img.shape)}"
+        )
+    if levels is not None and tuple(levels.shape) != (
+        img.shape[0], c.num_patches, c.levels, c.dim
+    ):
+        raise ValueError(
+            f"carried levels must be ({img.shape[0]}, {c.num_patches}, "
+            f"{c.levels}, {c.dim}), got {tuple(levels.shape)}"
+        )
     if iters is None:
         iters = c.default_iters
     compute_dtype = c.compute_dtype or c.param_dtype

@@ -122,6 +122,47 @@ def _resize_nchw(data: np.ndarray, size: int) -> np.ndarray:
     return data
 
 
+def augment_batch(batch: np.ndarray, rng: np.random.Generator, kind: str) -> np.ndarray:
+    """Host-side augmentation of an NCHW batch.
+
+    ``"flip"``: random horizontal flip per image.
+    ``"flip_crop"``: flip + random resized crop (scale 0.7-1.0, re-resized
+    to the original size by nearest neighbor)."""
+    if kind not in ("none", "flip", "flip_crop"):
+        raise ValueError(f"unknown augmentation {kind!r}")
+    if kind == "none":
+        return batch
+    b, c, h, w = batch.shape
+    flips = rng.random(b) < 0.5
+    # np.where allocates a fresh writable array, safe for in-place crops below
+    out = np.where(flips[:, None, None, None], batch[:, :, :, ::-1], batch)
+    if kind == "flip_crop":
+        for i in range(b):
+            scale = rng.uniform(0.7, 1.0)
+            ch, cw = max(1, int(h * scale)), max(1, int(w * scale))
+            y0 = rng.integers(0, h - ch + 1)
+            x0 = rng.integers(0, w - cw + 1)
+            crop = out[i, :, y0:y0 + ch, x0:x0 + cw]
+            out[i] = _resize_nchw(np.ascontiguousarray(crop)[None], h)[0]
+    return np.ascontiguousarray(out)
+
+
+def augmented(it, kind: str, seed: int = 0):
+    """Wrap a batch iterator with :func:`augment_batch` (own RNG stream).
+    The kind is validated eagerly, at wrap time."""
+    if kind not in ("none", "flip", "flip_crop"):
+        raise ValueError(f"unknown augmentation {kind!r}")
+    if kind == "none":
+        return it
+    rng = np.random.default_rng(seed + 0x5EED)
+
+    def gen():
+        for batch in it:
+            yield augment_batch(batch, rng, kind)
+
+    return gen()
+
+
 class Prefetcher:
     """Bounded background-thread prefetch of host batches (the data-loader
     overlap role; device transfer happens at dispatch inside jit).  Producer
@@ -165,6 +206,7 @@ def make_batches(
     seed: int = 0,
     data_dir: Optional[str] = None,
     prefetch: int = 2,
+    augment: str = "none",
 ) -> Iterator[np.ndarray]:
     if kind == "synthetic":
         it = synthetic_batches(batch_size, image_size, channels, seed)
@@ -174,4 +216,5 @@ def make_batches(
         it = folder_batches(data_dir, batch_size, image_size, channels, seed)
     else:
         raise ValueError(f"unknown data source {kind!r}")
+    it = augmented(it, augment, seed)
     return Prefetcher(it, prefetch) if prefetch > 0 else it

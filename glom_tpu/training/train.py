@@ -46,6 +46,7 @@ def parse_args(argv=None):
     # data
     p.add_argument("--data", default="synthetic", choices=["synthetic", "folder"])
     p.add_argument("--data-dir", default=None)
+    p.add_argument("--augment", default="none", choices=["none", "flip", "flip_crop"])
     # parallelism
     p.add_argument("--mesh", type=int, nargs="+", default=None,
                    help="mesh shape over (data, model, seq); default: all-data")
@@ -105,6 +106,7 @@ def main(argv=None):
     batches = make_batches(
         args.data, args.batch_size, args.image_size,
         config.channels, args.seed, args.data_dir,
+        augment=args.augment,
     )
     final = trainer.fit(batches)
     if jax.process_index() == 0:

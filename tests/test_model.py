@@ -123,6 +123,21 @@ def test_top_level_divisor_and_zero_pad():
     np.testing.assert_allclose(out[1][..., -1, :], top_manual, atol=1e-4)
 
 
+def test_apply_validates_input_shapes():
+    """Wrong-shaped inputs get a clear ValueError, not a raw XLA error."""
+    c = TINY
+    params = glom_model.init(jax.random.PRNGKey(0), c)
+    with pytest.raises(ValueError, match="img must be"):
+        glom_model.apply(params, jnp.zeros((1, 1, 16, 16)), config=c)
+    with pytest.raises(ValueError, match="img must be"):
+        glom_model.apply(params, jnp.zeros((1, 3, 32, 32)), config=c)
+    with pytest.raises(ValueError, match="carried levels must be"):
+        glom_model.apply(
+            params, jnp.zeros((1, 3, 16, 16)), config=c,
+            levels=jnp.zeros((1, 16, 5, 16)),
+        )
+
+
 def test_information_propagates_one_level_per_iteration():
     """Bottom-up moves input one level per iteration (glom_pytorch.py:131-134):
     with L levels, the top level is input-INDEPENDENT until iteration L

@@ -296,6 +296,44 @@ def test_data_pipeline_folder(tmp_path):
     assert -1.0 <= batch.min() and batch.max() <= 1.0
 
 
+def test_augment_flip_only_mirrors():
+    from glom_tpu.training.data import augment_batch
+    rng = np.random.default_rng(0)
+    batch = rng.standard_normal((8, 3, 4, 4)).astype(np.float32)
+    out = augment_batch(batch, np.random.default_rng(1), "flip")
+    for i in range(8):
+        same = np.array_equal(out[i], batch[i])
+        flipped = np.array_equal(out[i], batch[i, :, :, ::-1])
+        assert same or flipped
+    assert not np.array_equal(out, batch)  # at least one flip at this seed
+
+
+def test_augment_crop_preserves_shape_and_determinism():
+    from glom_tpu.training.data import augment_batch
+    rng = np.random.default_rng(2)
+    batch = rng.standard_normal((4, 3, 16, 16)).astype(np.float32)
+    a = augment_batch(batch, np.random.default_rng(3), "flip_crop")
+    b = augment_batch(batch, np.random.default_rng(3), "flip_crop")
+    assert a.shape == batch.shape
+    np.testing.assert_array_equal(a, b)
+    with pytest.raises(ValueError, match="unknown augmentation"):
+        augment_batch(batch, rng, "cutmix")
+
+
+def test_augment_kind_validated_eagerly():
+    with pytest.raises(ValueError, match="unknown augmentation"):
+        make_batches("synthetic", 2, 8, augment="fliip")
+
+
+def test_make_batches_augmented_stream():
+    it_plain = make_batches("synthetic", 2, 8, seed=5, prefetch=0)
+    it_aug = make_batches("synthetic", 2, 8, seed=5, prefetch=0, augment="flip")
+    plain = np.stack([next(it_plain) for _ in range(4)])
+    aug = np.stack([next(it_aug) for _ in range(4)])
+    assert plain.shape == aug.shape
+    assert not np.array_equal(plain, aug)
+
+
 def test_data_prefetcher_matches_plain():
     plain = synthetic_batches(2, 8, seed=3)
     pref = make_batches("synthetic", 2, 8, seed=3, prefetch=2)
