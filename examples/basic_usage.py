@@ -18,8 +18,9 @@ print("forward:", levels.shape)                      # (1, 256, 6, 512)
 # 2. all-states inspection (islands / losses at any timestep+level)
 all_levels = model(img, iters=12, return_all=True)
 print("return_all:", all_levels.shape)               # (13, 1, 256, 6, 512)
-top_after_6 = all_levels[7, :, :, -1]
-print("top level after iteration 7:", top_after_6.shape)
+# index 0 is the t=0 initial state, so index 7 = state after iteration 7
+top_level = all_levels[7, :, :, -1]
+print("top level at time index 7:", top_level.shape)
 
 from glom_tpu.models.islands import island_summary
 

@@ -59,6 +59,31 @@ def make_mesh(
     return Mesh(dev_array, tuple(axis_names))
 
 
+def make_hybrid_mesh(
+    ici_shape: Sequence[int],
+    dcn_data_parallelism: int = 1,
+    axis_names: Sequence[str] = DEFAULT_AXES,
+) -> Mesh:
+    """Multi-slice mesh: ``dcn_data_parallelism`` slices over DCN on the
+    leading (data) axis, ``ici_shape`` within each slice over ICI.  Uses
+    ``mesh_utils.create_hybrid_device_mesh`` so collectives on the data axis
+    ride DCN and everything else stays intra-slice.  On topologies without
+    slice metadata (single slice, CPU/test meshes) it falls back to a flat
+    :func:`make_mesh` of the same total shape — same logical axes, no DCN
+    placement to optimize."""
+    from jax.experimental import mesh_utils
+
+    dcn_shape = (dcn_data_parallelism,) + (1,) * (len(ici_shape) - 1)
+    try:
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            tuple(ici_shape), dcn_mesh_shape=dcn_shape
+        )
+    except (ValueError, KeyError, AttributeError):
+        total = (ici_shape[0] * dcn_data_parallelism,) + tuple(ici_shape[1:])
+        return make_mesh(total, axis_names)
+    return Mesh(dev_array, tuple(axis_names))
+
+
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,

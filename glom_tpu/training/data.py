@@ -122,13 +122,18 @@ def _resize_nchw(data: np.ndarray, size: int) -> np.ndarray:
     return data
 
 
+# single source of truth for the augmentation whitelist (augment_batch,
+# augmented, and the --augment CLI choices all reference this)
+AUGMENT_KINDS = ("none", "flip", "flip_crop")
+
+
 def augment_batch(batch: np.ndarray, rng: np.random.Generator, kind: str) -> np.ndarray:
     """Host-side augmentation of an NCHW batch.
 
     ``"flip"``: random horizontal flip per image.
     ``"flip_crop"``: flip + random resized crop (scale 0.7-1.0, re-resized
     to the original size by nearest neighbor)."""
-    if kind not in ("none", "flip", "flip_crop"):
+    if kind not in AUGMENT_KINDS:
         raise ValueError(f"unknown augmentation {kind!r}")
     if kind == "none":
         return batch
@@ -150,7 +155,7 @@ def augment_batch(batch: np.ndarray, rng: np.random.Generator, kind: str) -> np.
 def augmented(it, kind: str, seed: int = 0):
     """Wrap a batch iterator with :func:`augment_batch` (own RNG stream).
     The kind is validated eagerly, at wrap time."""
-    if kind not in ("none", "flip", "flip_crop"):
+    if kind not in AUGMENT_KINDS:
         raise ValueError(f"unknown augmentation {kind!r}")
     if kind == "none":
         return it

@@ -12,7 +12,7 @@ import jax
 
 from glom_tpu.config import GlomConfig, TrainConfig
 from glom_tpu.parallel.mesh import initialize_distributed
-from glom_tpu.training.data import make_batches
+from glom_tpu.training.data import AUGMENT_KINDS, make_batches
 from glom_tpu.training.metrics import MetricLogger
 from glom_tpu.training.trainer import Trainer
 
@@ -46,7 +46,7 @@ def parse_args(argv=None):
     # data
     p.add_argument("--data", default="synthetic", choices=["synthetic", "folder"])
     p.add_argument("--data-dir", default=None)
-    p.add_argument("--augment", default="none", choices=["none", "flip", "flip_crop"])
+    p.add_argument("--augment", default="none", choices=list(AUGMENT_KINDS))
     # parallelism
     p.add_argument("--mesh", type=int, nargs="+", default=None,
                    help="mesh shape over (data, model, seq); default: all-data")

@@ -1,0 +1,15 @@
+"""Mesh helper tests."""
+
+from glom_tpu.parallel.mesh import make_hybrid_mesh, make_mesh
+
+
+def test_hybrid_mesh_falls_back_without_slice_metadata():
+    """On CPU/test topologies (no slice_index), make_hybrid_mesh degrades to
+    a flat mesh of the same total shape."""
+    m = make_hybrid_mesh((4, 1, 1), dcn_data_parallelism=2)
+    assert dict(m.shape) == {"data": 8, "model": 1, "seq": 1}
+
+
+def test_make_mesh_infers_negative_one():
+    m = make_mesh((-1, 2, 1))
+    assert dict(m.shape) == {"data": 4, "model": 2, "seq": 1}
