@@ -1,0 +1,114 @@
+"""dm-haiku wrapper.
+
+Companion to the Flax wrapper (``flax_module.py``): packages the functional
+core for haiku-based stacks.  Haiku parameters are flat per-module arrays,
+so each leaf of the functional param tree registers as one
+``hk.get_parameter`` whose initializer reproduces the exact distribution of
+``glom_tpu.models.glom.init`` (torch-matching uniform/normal families —
+SURVEY.md §2.1 init semantics).  ``to_functional``/``from_functional``
+convert between the haiku params mapping and the functional pytree.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import haiku as hk
+import jax
+import jax.numpy as jnp
+
+from glom_tpu.config import GlomConfig
+from glom_tpu.models import glom as glom_model
+
+_MODULE = "glom"
+
+
+_NORMAL_LEAVES = ("pos_emb", "init_levels")
+
+
+def _leaf_specs(config: GlomConfig):
+    """name -> (shape, init_kind, bound).  Shapes come from
+    ``jax.eval_shape(glom_model.init)`` so the wrapper can never drift from
+    the functional layout; only the distribution families are local
+    knowledge: pos_emb/init_levels are unit-normal, everything else is
+    torch-style U(-1/sqrt(fan_in), 1/sqrt(fan_in)) where a weight's fan_in
+    is its second-to-last dim and a bias shares its sibling weight's."""
+    abstract = jax.eval_shape(
+        lambda: glom_model.init(jax.random.PRNGKey(0), config)
+    )
+    flat = _flatten(jax.tree_util.tree_map(lambda leaf: leaf.shape, abstract))
+    specs = {}
+    for name, shape in flat.items():
+        leaf = name.split("/")[-1]
+        if name in _NORMAL_LEAVES:
+            specs[name] = (shape, "normal", 1.0)
+            continue
+        if leaf.startswith("w"):
+            fan_in = shape[-2]
+        else:  # bias: fan_in of the sibling weight (b -> w, b1 -> w1, ...)
+            sibling = name[: -len(leaf)] + "w" + leaf[1:]
+            fan_in = flat[sibling][-2]
+        specs[name] = (shape, "uniform", fan_in ** -0.5)
+    return specs
+
+
+def _unflatten(flat: dict) -> dict:
+    params = {}
+    for key, leaf in flat.items():
+        parts = key.split("/")
+        node = params
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = leaf
+    return params
+
+
+def _flatten(params: dict, prefix: str = "") -> dict:
+    flat = {}
+    for k, v in params.items():
+        key = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict):
+            flat.update(_flatten(v, key))
+        else:
+            flat[key] = v
+    return flat
+
+
+def make_glom(config: GlomConfig):
+    """Build ``hk.transform``-able forward with the reference signature."""
+
+    def forward(
+        img: jax.Array,
+        iters: Optional[int] = None,
+        levels: Optional[jax.Array] = None,
+        return_all: bool = False,
+    ):
+        flat = {}
+        for name, (shape, kind, bound) in _leaf_specs(config).items():
+            if kind == "normal":
+                init = hk.initializers.RandomNormal(stddev=bound)
+            else:
+                init = hk.initializers.RandomUniform(-bound, bound)
+            flat[name] = hk.get_parameter(
+                name.replace("/", "__"), shape, config.param_dtype, init
+            )
+        params = _unflatten({k: v for k, v in flat.items()})
+        return glom_model.apply(
+            params, img, config=config, iters=iters, levels=levels,
+            return_all=return_all,
+        )
+
+    return hk.transform(forward)
+
+
+def to_functional(hk_params: hk.Params) -> dict:
+    """Haiku params mapping -> functional param pytree.  The transform has
+    exactly one module scope (named '~' at top level)."""
+    (module_params,) = hk_params.values()
+    return _unflatten({k.replace("__", "/"): v for k, v in module_params.items()})
+
+
+def from_functional(params: dict) -> hk.Params:
+    """Functional param pytree -> haiku params mapping (module name '~')."""
+    flat = _flatten(params)
+    return {"~": {k.replace("/", "__"): v for k, v in flat.items()}}

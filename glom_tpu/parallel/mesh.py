@@ -73,14 +73,18 @@ def make_hybrid_mesh(
     placement to optimize."""
     from jax.experimental import mesh_utils
 
-    dcn_shape = (dcn_data_parallelism,) + (1,) * (len(ici_shape) - 1)
-    try:
-        dev_array = mesh_utils.create_hybrid_device_mesh(
-            tuple(ici_shape), dcn_mesh_shape=dcn_shape
-        )
-    except (ValueError, KeyError, AttributeError):
+    devices = jax.devices()
+    # fall back ONLY when the topology carries no slice metadata (CPU/test
+    # meshes, single-process sims); on real multi-slice TPUs any error from
+    # create_hybrid_device_mesh is a genuine misconfiguration and must
+    # propagate — a silent flat mesh would put model/seq collectives on DCN
+    if getattr(devices[0], "slice_index", None) is None:
         total = (ici_shape[0] * dcn_data_parallelism,) + tuple(ici_shape[1:])
         return make_mesh(total, axis_names)
+    dcn_shape = (dcn_data_parallelism,) + (1,) * (len(ici_shape) - 1)
+    dev_array = mesh_utils.create_hybrid_device_mesh(
+        tuple(ici_shape), dcn_mesh_shape=dcn_shape
+    )
     return Mesh(dev_array, tuple(axis_names))
 
 
