@@ -23,4 +23,13 @@ from glom_tpu.models.shim import Glom
 
 __version__ = "0.1.0"
 
-__all__ = ["Glom", "GlomConfig", "TrainConfig", "__version__"]
+__all__ = ["Glom", "GlomConfig", "TrainConfig", "Trainer", "__version__"]
+
+
+def __getattr__(name):
+    # lazy: keep `import glom_tpu` light; Trainer pulls optax/mesh machinery
+    if name == "Trainer":
+        from glom_tpu.training.trainer import Trainer
+
+        return Trainer
+    raise AttributeError(f"module 'glom_tpu' has no attribute {name!r}")

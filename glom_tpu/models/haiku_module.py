@@ -76,6 +76,7 @@ def _flatten(params: dict, prefix: str = "") -> dict:
 
 def make_glom(config: GlomConfig):
     """Build ``hk.transform``-able forward with the reference signature."""
+    specs = _leaf_specs(config)  # static per config; hoisted out of forward
 
     def forward(
         img: jax.Array,
@@ -84,7 +85,7 @@ def make_glom(config: GlomConfig):
         return_all: bool = False,
     ):
         flat = {}
-        for name, (shape, kind, bound) in _leaf_specs(config).items():
+        for name, (shape, kind, bound) in specs.items():
             if kind == "normal":
                 init = hk.initializers.RandomNormal(stddev=bound)
             else:
@@ -92,7 +93,7 @@ def make_glom(config: GlomConfig):
             flat[name] = hk.get_parameter(
                 name.replace("/", "__"), shape, config.param_dtype, init
             )
-        params = _unflatten({k: v for k, v in flat.items()})
+        params = _unflatten(flat)
         return glom_model.apply(
             params, img, config=config, iters=iters, levels=levels,
             return_all=return_all,
