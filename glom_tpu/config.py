@@ -95,6 +95,7 @@ class TrainConfig:
     consistency_level: int = -1          # which level to regularize
     steps: int = 100
     log_every: int = 10
+    eval_every: int = 0              # 0 => disabled; logs denoise PSNR
     checkpoint_every: int = 0            # 0 => disabled
     checkpoint_dir: Optional[str] = None
     profile_dir: Optional[str] = None    # jax.profiler trace of a 3-step window

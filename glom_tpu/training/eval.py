@@ -67,6 +67,39 @@ def linear_probe(
     return acc(x, train_y), acc(xt, test_y)
 
 
+def make_psnr_fn(
+    config: GlomConfig,
+    *,
+    noise_std: float = 1.0,
+    iters: Optional[int] = None,
+    timestep: Optional[int] = None,
+    level: int = -1,
+    data_range: float = 2.0,
+    consensus_fn=None,
+):
+    """Build the pure, jittable eval twin of the denoising objective:
+    ``(params, imgs, rng) -> psnr_db`` scalar.  ``consensus_fn`` threads the
+    mesh-bound ring/ulysses consensus exactly as the train step does."""
+    if iters is None:
+        iters = config.default_iters
+    if timestep is None:
+        timestep = iters // 2 + 1
+
+    def psnr_fn(params: dict, imgs: jax.Array, rng: jax.Array) -> jax.Array:
+        noised = imgs + jax.random.normal(rng, imgs.shape, imgs.dtype) * noise_std
+        all_levels = glom_model.apply(
+            params["glom"], noised, config=config, iters=iters, return_all=True,
+            consensus_fn=consensus_fn,
+        )
+        recon = patches_to_images_apply(
+            params["decoder"], all_levels[timestep, :, :, level], config
+        )
+        mse = jnp.mean((recon.astype(jnp.float32) - imgs.astype(jnp.float32)) ** 2)
+        return 20.0 * jnp.log10(data_range) - 10.0 * jnp.log10(mse)
+
+    return psnr_fn
+
+
 def reconstruction_psnr(
     params: dict,
     imgs: jax.Array,
@@ -78,20 +111,12 @@ def reconstruction_psnr(
     timestep: Optional[int] = None,
     level: int = -1,
     data_range: float = 2.0,
+    consensus_fn=None,
 ) -> float:
-    """PSNR (dB) of decoder reconstructions from noised inputs — the eval
-    twin of the denoising training objective.  ``params`` is the trainer's
-    ``{"glom": ..., "decoder": ...}`` tree."""
-    if iters is None:
-        iters = config.default_iters
-    if timestep is None:
-        timestep = iters // 2 + 1
-    noised = imgs + jax.random.normal(rng, imgs.shape, imgs.dtype) * noise_std
-    all_levels = glom_model.apply(
-        params["glom"], noised, config=config, iters=iters, return_all=True
+    """One-shot convenience over :func:`make_psnr_fn` (PSNR in dB as a
+    Python float); loops should build+jit the fn once instead."""
+    fn = make_psnr_fn(
+        config, noise_std=noise_std, iters=iters, timestep=timestep,
+        level=level, data_range=data_range, consensus_fn=consensus_fn,
     )
-    recon = patches_to_images_apply(
-        params["decoder"], all_levels[timestep, :, :, level], config
-    )
-    mse = jnp.mean((recon.astype(jnp.float32) - imgs.astype(jnp.float32)) ** 2)
-    return float(20.0 * jnp.log10(data_range) - 10.0 * jnp.log10(mse))
+    return float(fn(params, imgs, rng))

@@ -36,6 +36,8 @@ def parse_args(argv=None):
     p.add_argument("--iters", type=int, default=None)
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--eval-every", type=int, default=0,
+                   help="log denoising PSNR every N steps (0 = off)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--noise-std", type=float, default=1.0)
     p.add_argument("--consistency", default="none", choices=["none", "mse", "infonce"],
@@ -94,6 +96,7 @@ def main(argv=None):
         consistency_level=args.consistency_level,
         steps=args.steps,
         log_every=args.log_every,
+        eval_every=args.eval_every,
         checkpoint_every=args.checkpoint_every,
         checkpoint_dir=args.checkpoint_dir,
         profile_dir=args.profile_dir,

@@ -110,6 +110,17 @@ class Trainer:
                 seq_axis=train.mesh_axes[2],
             )
 
+        self._eval = None
+        if train.eval_every:
+            from glom_tpu.training.eval import make_psnr_fn
+
+            self._eval = jax.jit(
+                make_psnr_fn(
+                    config, noise_std=train.noise_std, iters=train.iters,
+                    consensus_fn=consensus_fn,
+                )
+            )
+
         self._step = jax.jit(
             denoise.make_step_fn(config, train, tx, consensus_fn=consensus_fn),
             in_shardings=(self._state_sh, self._batch_sh),
@@ -186,6 +197,12 @@ class Trainer:
                 )
                 last_metrics = metrics
                 window_t0, window_imgs = time.time(), 0
+            if self._eval is not None and (i + 1) % cfg.eval_every == 0:
+                # img is already placed with the batch sharding (line above)
+                psnr = self._eval(
+                    self.state.params, img, jax.random.PRNGKey(cfg.seed + i)
+                )
+                self.logger.log(i + 1, psnr_db=float(jax.device_get(psnr)))
             if (
                 cfg.checkpoint_every
                 and cfg.checkpoint_dir

@@ -1,0 +1,52 @@
+"""bf16 training fidelity (SURVEY.md §7 hard part d): the bf16-compute loss
+curve must track the fp32 reference mode, and params stay fp32."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+from glom_tpu.config import GlomConfig, TrainConfig
+from glom_tpu.training import denoise
+
+
+def _run(compute_dtype, steps=12):
+    c = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4,
+                   compute_dtype=compute_dtype)
+    t = TrainConfig(batch_size=4, learning_rate=1e-3, iters=3, noise_std=0.2)
+    tx = optax.adam(t.learning_rate)
+    state = denoise.init_state(jax.random.PRNGKey(0), c, tx)
+    step = denoise.make_train_step(c, t, tx, donate=False)
+    img = jax.random.normal(jax.random.PRNGKey(1), (4, 3, 16, 16))
+    losses = []
+    for _ in range(steps):
+        state, m = step(state, img)
+        losses.append(float(m["loss"]))
+    return np.asarray(losses), state
+
+
+def test_bf16_loss_curve_tracks_fp32():
+    fp32_losses, _ = _run(None)
+    bf16_losses, state = _run(jnp.bfloat16)
+    assert np.isfinite(bf16_losses).all()
+    # same trajectory within bf16 resolution (~3 decimal digits), and the
+    # same overall descent
+    np.testing.assert_allclose(bf16_losses, fp32_losses, rtol=2e-2)
+    assert bf16_losses[-1] < bf16_losses[0]
+    # master params remain fp32 regardless of compute dtype
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        assert leaf.dtype == jnp.float32
+
+
+def test_bf16_forward_error_bounded():
+    from glom_tpu.models import glom as gm
+
+    c32 = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4)
+    cbf = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4,
+                     compute_dtype=jnp.bfloat16)
+    params = gm.init(jax.random.PRNGKey(0), c32)
+    img = jax.random.normal(jax.random.PRNGKey(1), (1, 3, 16, 16))
+    out32 = np.asarray(gm.apply(params, img, config=c32, iters=4), np.float32)
+    outbf = np.asarray(gm.apply(params, img, config=cbf, iters=4), np.float32)
+    rel = np.abs(outbf - out32).max() / (np.abs(out32).max() + 1e-9)
+    assert rel < 0.05, rel  # bf16 has ~2-3 significant digits

@@ -65,6 +65,30 @@ def test_train_step_decreases_loss():
     assert np.isfinite(losses).all()
 
 
+def test_trainer_eval_every_logs_psnr(capsys):
+    c = TINY
+    t = TrainConfig(batch_size=8, iters=2, steps=4, log_every=0, eval_every=2)
+    trainer = Trainer(c, t)
+    trainer.fit(synthetic_batches(8, 16), steps=4)
+    out = capsys.readouterr().out
+    assert "psnr_db" in out
+    import json as _json
+    psnrs = [_json.loads(l)["psnr_db"] for l in out.splitlines() if "psnr_db" in l]
+    assert len(psnrs) == 2 and all(np.isfinite(psnrs))
+
+
+def test_trainer_eval_every_works_with_ring_attention(capsys):
+    """Regression: eval must thread the mesh-bound consensus_fn — with
+    attention_impl='ring' the un-threaded path raises at the first eval."""
+    c = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4, attention_impl="ring")
+    t = TrainConfig(batch_size=8, iters=2, steps=2, log_every=0, eval_every=1,
+                    mesh_shape=(2, 1, 4))
+    trainer = Trainer(c, t)
+    trainer.fit(synthetic_batches(8, 16), steps=2)
+    out = capsys.readouterr().out
+    assert out.count("psnr_db") == 2
+
+
 def test_trainer_on_fake_mesh_dp():
     """Trainer over the faked 8-device mesh, pure DP: runs, logs, loss
     finite; batch is sharded over the data axis."""
