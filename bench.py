@@ -33,6 +33,7 @@ def main():
     p.add_argument("--no-remat", action="store_true",
                    help="disable scan-body rematerialization (needs small batch)")
     p.add_argument("--attention-impl", default="dense", choices=["dense", "pallas", "ring", "ulysses"])
+    p.add_argument("--ff-impl", default="dense", choices=["dense", "pallas"])
     args = p.parse_args()
 
     import jax
@@ -55,6 +56,7 @@ def main():
         compute_dtype=jnp.float32 if args.fp32 else jnp.bfloat16,
         remat=not args.no_remat,
         attention_impl=args.attention_impl,
+        ff_impl=args.ff_impl,
         **model_kwargs,
     )
     train = TrainConfig(batch_size=batch, iters=iters, log_every=0)

@@ -37,6 +37,7 @@ class GlomConfig:
     compute_dtype: Optional[jnp.dtype] = None   # None => use param dtype
     remat: bool = False                         # jax.checkpoint the scan body
     attention_impl: str = "dense"   # "dense" | "pallas" | "ring" | "ulysses"
+    ff_impl: str = "dense"          # "dense" | "pallas" (fused, hidden stays in VMEM)
 
     def __post_init__(self):
         if self.image_size % self.patch_size != 0:
@@ -47,6 +48,8 @@ class GlomConfig:
             raise ValueError("levels must be >= 2 (top_down uses levels-1 groups)")
         if self.attention_impl not in ("dense", "pallas", "ring", "ulysses"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+        if self.ff_impl not in ("dense", "pallas"):
+            raise ValueError(f"unknown ff_impl {self.ff_impl!r}")
 
     # -- derived quantities (glom_pytorch.py:90-91,112) --
     @property

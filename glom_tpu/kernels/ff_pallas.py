@@ -1,0 +1,118 @@
+"""Fused grouped feed-forward as a Pallas TPU kernel.
+
+Reference analogue: ``GroupedFeedForward`` (`glom_pytorch.py:23-36`).  The
+XLA path (``ops/feedforward.py``) lowers to two batched matmuls with the
+``(b, n, g, 4d)`` hidden activation written to and re-read from HBM between
+them — XLA does not fuse across matmuls.  This kernel computes
+
+    out = gelu(x @ w1 + b1) @ w2 + b2
+
+per (batch, group, n-block) entirely in VMEM: the hidden tile lives only
+on-chip.  At flagship scale that removes ~400 MB of HBM traffic per
+iteration (two nets, forward).  Backward is a custom VJP that recomputes via
+the XLA einsum formulation (correctness-first, same pattern as the
+consensus kernel).
+
+GELU is the exact erf form to match torch ``nn.GELU()`` and the XLA path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from glom_tpu.ops.feedforward import grouped_ff_apply
+
+
+def _pick_block(n: int, cap: int = 512) -> int:
+    for bi in range(min(cap, n), 7, -1):
+        if n % bi == 0 and bi % 8 == 0:
+            return bi
+    return n
+
+
+def _kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, o_ref, acc_ref):
+    """Grid (b, g, ni, nh): the hidden dim is tiled so only an (d, hc) /
+    (hc, d) weight chunk pair is VMEM-resident at once; per-chunk partial
+    products accumulate in scratch (GELU is elementwise over h, so chunking
+    h is exact).  b2 is added once, at the final chunk."""
+    ih = pl.program_id(3)
+    nh = pl.num_programs(3)
+
+    @pl.when(ih == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[0, 0].astype(jnp.float32)           # (Bn, d)
+    w1 = w1_ref[0].astype(jnp.float32)            # (d, hc)
+    b1 = b1_ref[0].astype(jnp.float32)            # (hc,)
+    w2 = w2_ref[0].astype(jnp.float32)            # (hc, d)
+
+    h = jnp.dot(x, w1, preferred_element_type=jnp.float32) + b1
+    h = jax.nn.gelu(h, approximate=False)
+    acc_ref[:] = acc_ref[:] + jnp.dot(h, w2, preferred_element_type=jnp.float32)
+
+    @pl.when(ih == nh - 1)
+    def _():
+        o_ref[0, 0] = (acc_ref[:] + b2_ref[0].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _forward(x, params, *, interpret, h_block=2048):
+    b, n, g, d = x.shape
+    h = params["w1"].shape[-1]
+    xt = jnp.transpose(x, (0, 2, 1, 3))           # (b, g, n, d)
+    bn = _pick_block(n)
+    hc = _pick_block(h, cap=h_block) if h > h_block else h
+    grid = (b, g, n // bn, h // hc)
+
+    y = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bn, d), lambda ib, ig, ii, ih: (ib, ig, ii, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d, hc), lambda ib, ig, ii, ih: (ig, 0, ih), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hc), lambda ib, ig, ii, ih: (ig, ih), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hc, d), lambda ib, ig, ii, ih: (ig, ih, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), lambda ib, ig, ii, ih: (ig, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, bn, d), lambda ib, ig, ii, ih: (ib, ig, ii, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, g, n, d), x.dtype),
+        scratch_shapes=[pltpu.VMEM((bn, d), jnp.float32)],
+        interpret=interpret,
+    )(xt, params["w1"], params["b1"], params["w2"], params["b2"])
+    return jnp.transpose(y, (0, 2, 1, 3))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _ff_pallas(x, params, interpret):
+    return _forward(x, params, interpret=interpret)
+
+
+def _fwd(x, params, interpret):
+    return _forward(x, params, interpret=interpret), (x, params)
+
+
+def _bwd(interpret, res, g):
+    x, params = res
+    _, vjp = jax.vjp(lambda x_, p_: grouped_ff_apply(p_, x_), x, params)
+    return vjp(g)
+
+
+_ff_pallas.defvjp(_fwd, _bwd)
+
+
+def grouped_ff_pallas(
+    params: dict, x: jax.Array, *, interpret: Optional[bool] = None
+) -> jax.Array:
+    """Drop-in for :func:`glom_tpu.ops.feedforward.grouped_ff_apply` with the
+    hidden activation kept in VMEM."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    return _ff_pallas(x, params, interpret)

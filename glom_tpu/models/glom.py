@@ -63,16 +63,26 @@ def param_count(params) -> int:
     return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
 
 
-def _update_step(params, bottom_level, pos_embs, divisors, consensus_fn, levels):
+def make_ff_fn(config: GlomConfig):
+    """Resolve the grouped-FF implementation: XLA batched matmuls or the
+    fused Pallas kernel (hidden activation VMEM-resident)."""
+    if config.ff_impl == "pallas":
+        from glom_tpu.kernels.ff_pallas import grouped_ff_pallas
+
+        return grouped_ff_pallas
+    return grouped_ff_apply
+
+
+def _update_step(params, bottom_level, pos_embs, divisors, consensus_fn, ff_fn, levels):
     """One GLOM iteration (`glom_pytorch.py:131-145`), as a pure function of
     the carried ``levels`` state."""
     # (b, n, L+1, d): tokens re-attached at the bottom each iteration (`:132`)
     levels_with_input = jnp.concatenate([bottom_level, levels], axis=-2)
 
-    bottom_up_out = grouped_ff_apply(params["bottom_up"], levels_with_input[..., :-1, :])
+    bottom_up_out = ff_fn(params["bottom_up"], levels_with_input[..., :-1, :])
 
     top_down_in = levels_with_input[..., 2:, :] + pos_embs
-    top_down_out = grouped_ff_apply(params["top_down"], top_down_in)
+    top_down_out = ff_fn(params["top_down"], top_down_in)
     # zero contribution at the top level (`:137`)
     top_down_out = jnp.pad(top_down_out, ((0, 0), (0, 0), (0, 1), (0, 0)))
 
@@ -187,7 +197,8 @@ def apply(
     if consensus_fn is None:
         consensus_fn = make_consensus_fn(c)
     step = functools.partial(
-        _update_step, params, bottom_level, pos_embs, divisors, consensus_fn
+        _update_step, params, bottom_level, pos_embs, divisors, consensus_fn,
+        make_ff_fn(c),
     )
     if c.remat:
         step = jax.checkpoint(step)

@@ -1,0 +1,56 @@
+"""Sharded batch inference.
+
+The Trainer owns the training-side sharding; this is the inference
+equivalent: replicate params, shard the image batch over the mesh's data
+axis, jit once per (iters, return_all) signature.  Collectives (if the
+config selects ring/ulysses consensus via ``consensus_fn``) ride the same
+mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from glom_tpu.config import GlomConfig
+from glom_tpu.models import glom as glom_model
+
+
+def make_data_parallel_forward(
+    mesh: Mesh,
+    config: GlomConfig,
+    *,
+    iters: Optional[int] = None,
+    return_all: bool = False,
+    data_axis: str = "data",
+    consensus_fn=None,
+):
+    """Build ``fn(params, imgs) -> states`` with params replicated and the
+    batch sharded over ``data_axis``.  Batch size must divide the data-axis
+    extent."""
+    batch_sh = NamedSharding(mesh, P(data_axis))
+    replicated = NamedSharding(mesh, P())
+    # output batch axis position depends on return_all (time axis leads)
+    out_sh = NamedSharding(mesh, P(None, data_axis) if return_all else P(data_axis))
+
+    @functools.partial(
+        jax.jit, in_shardings=(replicated, batch_sh), out_shardings=out_sh
+    )
+    def fn(params, imgs):
+        return glom_model.apply(
+            params, imgs, config=config, iters=iters, return_all=return_all,
+            consensus_fn=consensus_fn,
+        )
+
+    def wrapped(params, imgs):
+        n_data = mesh.shape[data_axis]
+        if imgs.shape[0] % n_data != 0:
+            raise ValueError(
+                f"batch {imgs.shape[0]} not divisible by data-axis size {n_data}"
+            )
+        return fn(params, imgs)
+
+    return wrapped

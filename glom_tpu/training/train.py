@@ -29,6 +29,7 @@ def parse_args(argv=None):
     p.add_argument("--bf16", action="store_true", help="bf16 compute (params stay fp32)")
     p.add_argument("--remat", action="store_true")
     p.add_argument("--attention-impl", default="dense", choices=["dense", "pallas", "ring", "ulysses"])
+    p.add_argument("--ff-impl", default="dense", choices=["dense", "pallas"])
     # training
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--lr", type=float, default=3e-4)
@@ -83,6 +84,7 @@ def main(argv=None):
         compute_dtype=jnp.bfloat16 if args.bf16 else None,
         remat=args.remat,
         attention_impl=args.attention_impl,
+        ff_impl=args.ff_impl,
     )
     train_cfg = TrainConfig(
         batch_size=args.batch_size,

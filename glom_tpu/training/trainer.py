@@ -117,6 +117,7 @@ class Trainer:
             self._eval = jax.jit(
                 make_psnr_fn(
                     config, noise_std=train.noise_std, iters=train.iters,
+                    timestep=train.loss_timestep, level=train.loss_level,
                     consensus_fn=consensus_fn,
                 )
             )
@@ -184,6 +185,13 @@ class Trainer:
                     profiling = False
             img = next(batches)
             img = jax.device_put(img, self._batch_sh)
+            if self._eval is not None and (i + 1) % cfg.eval_every == 0:
+                # evaluate BEFORE the step consumes this batch, so the PSNR
+                # reflects params that have not trained on these images
+                psnr = self._eval(
+                    self.state.params, img, jax.random.PRNGKey(cfg.seed + i)
+                )
+                self.logger.log(i + 1, psnr_db=float(jax.device_get(psnr)))
             self.state, metrics = self._step(self.state, img)
             window_imgs += img.shape[0]
             if cfg.log_every and (i + 1) % cfg.log_every == 0:
@@ -197,12 +205,6 @@ class Trainer:
                 )
                 last_metrics = metrics
                 window_t0, window_imgs = time.time(), 0
-            if self._eval is not None and (i + 1) % cfg.eval_every == 0:
-                # img is already placed with the batch sharding (line above)
-                psnr = self._eval(
-                    self.state.params, img, jax.random.PRNGKey(cfg.seed + i)
-                )
-                self.logger.log(i + 1, psnr_db=float(jax.device_get(psnr)))
             if (
                 cfg.checkpoint_every
                 and cfg.checkpoint_dir

@@ -13,3 +13,25 @@ def test_hybrid_mesh_falls_back_without_slice_metadata():
 def test_make_mesh_infers_negative_one():
     m = make_mesh((-1, 2, 1))
     assert dict(m.shape) == {"data": 4, "model": 2, "seq": 1}
+
+
+def test_data_parallel_forward_matches_single_device():
+    import numpy as np
+    import jax
+    from glom_tpu.config import GlomConfig
+    from glom_tpu.models import glom as glom_model
+    from glom_tpu.parallel.inference import make_data_parallel_forward
+
+    c = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4)
+    params = glom_model.init(jax.random.PRNGKey(0), c)
+    imgs = jax.random.normal(jax.random.PRNGKey(1), (8, 3, 16, 16))
+    mesh = make_mesh((8, 1, 1))
+
+    fwd = make_data_parallel_forward(mesh, c, iters=3, return_all=True)
+    got = np.asarray(fwd(params, imgs))
+    want = np.asarray(glom_model.apply(params, imgs, config=c, iters=3, return_all=True))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+    import pytest
+    with pytest.raises(ValueError, match="not divisible"):
+        fwd(params, imgs[:3])
