@@ -101,6 +101,7 @@ class TrainConfig:
     eval_every: int = 0              # 0 => disabled; logs denoise PSNR
     checkpoint_every: int = 0            # 0 => disabled
     checkpoint_dir: Optional[str] = None
+    checkpoint_backend: str = "npz"      # "npz" | "orbax"
     profile_dir: Optional[str] = None    # jax.profiler trace of a 3-step window
     seed: int = 0
     # mesh axes: data-parallel x model(tensor)-parallel x sequence(column)-parallel
@@ -121,3 +122,5 @@ class TrainConfig:
             raise ValueError(
                 f"consistency_temperature must be > 0, got {self.consistency_temperature}"
             )
+        if self.checkpoint_backend not in ("npz", "orbax"):
+            raise ValueError(f"unknown checkpoint backend {self.checkpoint_backend!r}")

@@ -28,16 +28,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from glom_tpu.kernels.tiling import pick_block as _pick_block
 from glom_tpu.ops.consensus import TOKEN_ATTEND_SELF_VALUE, consensus_attention, l2_normalize
-
-
-def _pick_block(n: int, cap: int = 256) -> int:
-    """Largest divisor of n that is a multiple of 8 (fp32 sublane tile) and
-    <= cap; falls back to n itself (single block)."""
-    for bi in range(min(cap, n), 7, -1):
-        if n % bi == 0 and bi % 8 == 0:
-            return bi
-    return n
 
 
 def _kernel(q_ref, kv_ref, *refs, scale, attend_self, block_i, n, has_mask):

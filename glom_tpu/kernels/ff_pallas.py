@@ -26,18 +26,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from glom_tpu.kernels.tiling import pick_block as _pick_block
 from glom_tpu.ops.feedforward import grouped_ff_apply
 
 
-def _pick_block(n: int, cap: int = 512) -> int:
-    for bi in range(min(cap, n), 7, -1):
-        if n % bi == 0 and bi % 8 == 0:
-            return bi
-    return n
-
-
 def _kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, o_ref, acc_ref):
-    """Grid (b, g, ni, nh): the hidden dim is tiled so only an (d, hc) /
+    """Grid (g, b, ni, nh): the hidden dim is tiled so only an (d, hc) /
     (hc, d) weight chunk pair is VMEM-resident at once; per-chunk partial
     products accumulate in scratch (GELU is elementwise over h, so chunking
     h is exact).  b2 is added once, at the final chunk."""
@@ -66,22 +60,25 @@ def _forward(x, params, *, interpret, h_block=2048):
     b, n, g, d = x.shape
     h = params["w1"].shape[-1]
     xt = jnp.transpose(x, (0, 2, 1, 3))           # (b, g, n, d)
-    bn = _pick_block(n)
-    hc = _pick_block(h, cap=h_block) if h > h_block else h
-    grid = (b, g, n // bn, h // hc)
+    bn = _pick_block(n, cap=512)
+    hc = _pick_block(h, cap=h_block)
+    # group is the OUTERMOST grid dim: the weight blocks' index maps depend
+    # only on (ig, ih), so Pallas keeps them VMEM-resident across all (b, ni)
+    # steps instead of re-streaming them from HBM once per batch row
+    grid = (g, b, n // bn, h // hc)
 
     y = pl.pallas_call(
         _kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, bn, d), lambda ib, ig, ii, ih: (ib, ig, ii, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, d, hc), lambda ib, ig, ii, ih: (ig, 0, ih), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, hc), lambda ib, ig, ii, ih: (ig, ih), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, hc, d), lambda ib, ig, ii, ih: (ig, ih, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, d), lambda ib, ig, ii, ih: (ig, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bn, d), lambda ig, ib, ii, ih: (ib, ig, ii, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d, hc), lambda ig, ib, ii, ih: (ig, 0, ih), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hc), lambda ig, ib, ii, ih: (ig, ih), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hc, d), lambda ig, ib, ii, ih: (ig, ih, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), lambda ig, ib, ii, ih: (ig, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, bn, d), lambda ib, ig, ii, ih: (ib, ig, ii, 0), memory_space=pltpu.VMEM
+            (1, 1, bn, d), lambda ig, ib, ii, ih: (ib, ig, ii, 0), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((b, g, n, d), x.dtype),
         scratch_shapes=[pltpu.VMEM((bn, d), jnp.float32)],

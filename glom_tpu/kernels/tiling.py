@@ -1,0 +1,12 @@
+"""Shared tiling helpers for the Pallas kernels."""
+
+from __future__ import annotations
+
+
+def pick_block(n: int, cap: int = 256) -> int:
+    """Largest divisor of n that is a multiple of 8 (fp32 sublane tile) and
+    <= cap; falls back to n itself (single block)."""
+    for bi in range(min(cap, n), 7, -1):
+        if n % bi == 0 and bi % 8 == 0:
+            return bi
+    return n

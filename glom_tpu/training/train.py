@@ -58,6 +58,7 @@ def parse_args(argv=None):
     # checkpointing / logging
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--checkpoint-backend", default="npz", choices=["npz", "orbax"])
     p.add_argument("--profile-dir", default=None,
                    help="emit a jax.profiler trace of a 3-step window here")
     p.add_argument("--log-file", default=None)
@@ -101,6 +102,7 @@ def main(argv=None):
         eval_every=args.eval_every,
         checkpoint_every=args.checkpoint_every,
         checkpoint_dir=args.checkpoint_dir,
+        checkpoint_backend=args.checkpoint_backend,
         profile_dir=args.profile_dir,
         seed=args.seed,
         mesh_shape=tuple(args.mesh) if args.mesh else None,

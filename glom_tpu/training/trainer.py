@@ -66,6 +66,15 @@ class Trainer:
                 f"{self.mesh.shape[data_axis]}"
             )
 
+        if config.ff_impl == "pallas" and self.mesh.shape[model_axis] > 1 \
+                and train.param_sharding in ("tp", "ep"):
+            # pallas_call is opaque to GSPMD: model-axis-sharded FF weights
+            # would be silently all-gathered onto every device each step
+            raise ValueError(
+                "ff_impl='pallas' is incompatible with model-axis param "
+                "sharding (tp/ep) — use param_sharding='replicated' or "
+                "ff_impl='dense' when the model axis is > 1"
+            )
         if train.param_sharding == "tp":
             glom_specs = param_pspecs(config, model_axis=model_axis)
         elif train.param_sharding == "ep":
@@ -142,6 +151,7 @@ class Trainer:
             directory,
             int(host_state.step),
             {"params": host_state.params, "opt": host_state.opt_state, "rng": host_state.rng},
+            backend=self.train_cfg.checkpoint_backend,
         )
 
     def restore(self, directory: str) -> int:

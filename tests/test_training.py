@@ -239,6 +239,25 @@ def test_checkpoint_mixed_backends_one_directory(tmp_path):
     assert names == ["ckpt_11.npz", "ckpt_12.npz", "ckpt_13.npz"], names
 
 
+def test_trainer_orbax_backend_roundtrip(tmp_path):
+    """Trainer with checkpoint_backend='orbax' saves and auto-resumes."""
+    c = TINY
+    t = TrainConfig(batch_size=8, iters=2, checkpoint_dir=str(tmp_path),
+                    checkpoint_every=2, steps=2, log_every=0,
+                    checkpoint_backend="orbax")
+    trainer = Trainer(c, t)
+    trainer.fit(synthetic_batches(8, 16), steps=2)
+    import os
+    assert any(f.endswith(".orbax") for f in os.listdir(str(tmp_path)))
+    trainer2 = Trainer(c, t)
+    assert trainer2.restore(str(tmp_path)) == 2
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        jax.device_get(trainer.state.params),
+        jax.device_get(trainer2.state.params),
+    )
+
+
 def test_checkpoint_same_step_resave_replaces_other_backend(tmp_path):
     """Re-saving a step with the other backend leaves exactly ONE artifact
     for that step, and restore reads the fresh payload."""
