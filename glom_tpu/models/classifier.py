@@ -9,7 +9,7 @@ the fine-tune vs probe switch).
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -15,7 +15,6 @@ from typing import Optional
 
 import haiku as hk
 import jax
-import jax.numpy as jnp
 
 from glom_tpu.config import GlomConfig
 from glom_tpu.models import glom as glom_model

@@ -20,7 +20,6 @@ import functools
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from glom_tpu.ops.consensus import consensus_attention

@@ -18,7 +18,6 @@ Combined objective: ``denoise_mse + weight * consistency``.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -14,8 +14,7 @@ and XLA emits the grad psum over ICI; there is no separate DDP wrapper.
 
 from __future__ import annotations
 
-import functools
-from typing import Any, NamedTuple, Optional, Tuple
+from typing import Any, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
