@@ -36,7 +36,7 @@ def main():
     p.add_argument("--ff-impl", default="dense", choices=["dense", "pallas"])
     p.add_argument("--device-probe-timeout", type=int, default=180,
                    help="seconds allowed for device init before emitting an "
-                        "error JSON line and exiting; 0 disables the watchdog")
+                        "error JSON line and exiting; <= 0 disables the watchdog")
     args = p.parse_args()
 
     metric = "denoise_ssl_train_imgs_per_sec_per_chip"
@@ -46,7 +46,7 @@ def main():
     # A wedged accelerator tunnel makes jax.devices() hang forever (even a
     # probe subprocess can become unreapable in D-state); an in-process timer
     # guarantees the JSON line gets emitted, with a single device init.
-    if args.device_probe_timeout:
+    if args.device_probe_timeout > 0:
         import os
         import threading
 
@@ -73,7 +73,7 @@ def main():
     from glom_tpu.training.trainer import Trainer
 
     on_tpu = jax.devices()[0].platform != "cpu"
-    if args.device_probe_timeout:
+    if args.device_probe_timeout > 0:
         timer.cancel()  # device init completed; the guarded window is over
     # CPU fallback exists so the bench cannot wedge a driver run; the metric
     # stays honest (it just reports the low CPU rate)

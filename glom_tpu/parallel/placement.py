@@ -11,10 +11,27 @@ state without per-optimizer code.
 
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@functools.lru_cache(maxsize=8)
+def _replicator(mesh: Mesh):
+    return jax.jit(lambda s: s, out_shardings=NamedSharding(mesh, P()))
+
+
+def gather_to_host(tree: Any, mesh: Mesh) -> Any:
+    """Bring a (possibly non-fully-addressable, multi-host-sharded) pytree
+    fully onto this host: replicate every leaf across the mesh, then read
+    the local copy.  The jitted replicate program is cached per mesh."""
+    replicated = _replicator(mesh)(tree)
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x.addressable_data(0)), replicated
+    )
 
 
 def _flatten_specs(spec_tree: Any) -> dict:

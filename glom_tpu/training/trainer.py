@@ -141,10 +141,11 @@ class Trainer:
     # -- checkpointing ----------------------------------------------------
     def save(self, directory: str) -> str:
         if jax.process_count() > 1:
-            # sharded leaves may span non-addressable devices; gather first
-            from jax.experimental import multihost_utils
+            # sharded leaves may span non-addressable devices: replicate
+            # across the mesh, then read locally (cached jit per mesh)
+            from glom_tpu.parallel.placement import gather_to_host
 
-            host_state = multihost_utils.process_allgather(self.state)
+            host_state = denoise.DenoiseState(*gather_to_host(tuple(self.state), self.mesh))
         else:
             host_state = jax.device_get(self.state)
         return ckpt_lib.save(

@@ -1,0 +1,61 @@
+"""Worker process for the multi-host (2-process) distributed test.
+
+Each process owns 2 faked CPU devices; jax.distributed joins them into a
+4-device cluster over gloo.  The worker trains the tiny GLOM config with
+the framework Trainer over the GLOBAL mesh, saves a leader-only checkpoint
+(exercising the multi-host gather_to_host path), and prints a digest of the
+final params for cross-process/single-process comparison.
+
+Invoked by tests/test_multihost.py — not a test module itself.
+"""
+
+import os
+import sys
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+port = sys.argv[3]
+ckpt_dir = sys.argv[4]
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from glom_tpu.parallel.mesh import initialize_distributed
+
+initialize_distributed(f"localhost:{port}", nproc, pid)
+
+import numpy as np
+
+from glom_tpu.config import GlomConfig, TrainConfig
+from glom_tpu.training.data import synthetic_batches
+from glom_tpu.training.trainer import Trainer
+
+STEPS = 3
+BATCH = 8
+
+config = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4)
+train = TrainConfig(
+    batch_size=BATCH, learning_rate=1e-3, iters=2, steps=STEPS, log_every=0,
+    donate=False, checkpoint_dir=ckpt_dir, checkpoint_every=STEPS,
+)
+trainer = Trainer(config, train)
+assert trainer.mesh.devices.size == 2 * nproc, trainer.mesh
+
+# identical global batches on every host (deterministic synthetic stream);
+# Trainer.fit device_puts them onto the global batch sharding
+trainer.fit(synthetic_batches(BATCH, config.image_size, seed=0), steps=STEPS)
+
+from glom_tpu.parallel.placement import gather_to_host
+
+host_params = gather_to_host(trainer.state.params, trainer.mesh)
+digest = float(
+    sum(
+        np.abs(np.asarray(l, np.float64)).sum()
+        for l in jax.tree_util.tree_leaves(host_params)
+    )
+)
+print(f"DIGEST {pid} {digest:.10f}", flush=True)
