@@ -171,6 +171,9 @@ class Trainer:
 
     # -- loop -------------------------------------------------------------
     def fit(self, batches: Iterator[np.ndarray], steps: Optional[int] = None) -> dict:
+        """Run the step loop to ``steps`` total steps.  With a checkpoint dir
+        the loop auto-resumes from the latest step — so a ``steps`` at or
+        below the checkpointed step is a no-op by design."""
         cfg = self.train_cfg
         steps = steps if steps is not None else cfg.steps
         if cfg.checkpoint_dir and ckpt_lib.latest_step(cfg.checkpoint_dir) is not None:
