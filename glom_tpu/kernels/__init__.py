@@ -8,5 +8,6 @@ round-trip through HBM.
 """
 
 from glom_tpu.kernels.consensus_pallas import consensus_attention_pallas
+from glom_tpu.kernels.ff_pallas import grouped_ff_pallas
 
-__all__ = ["consensus_attention_pallas"]
+__all__ = ["consensus_attention_pallas", "grouped_ff_pallas"]

@@ -60,17 +60,25 @@ def folder_batches(
     )
     if not files:
         raise FileNotFoundError(f"no .npy/.npz files in {directory}")
-    arrays = []
-    for f in files:
-        if f.endswith(".npz"):
-            with np.load(f) as z:
-                for k in z.files:
-                    arr = z[k]  # decompress once
-                    if arr.ndim == 4:
-                        arrays.append(arr)
-        else:
-            arrays.append(np.load(f))
-    data = np.concatenate(arrays, axis=0)
+    if len(files) == 1 and files[0].endswith(".npy"):
+        # single .npy: memory-map it so ImageNet-scale dumps never load into
+        # RAM — both the native gather and NumPy fancy-indexing read straight
+        # through the mapping (pages fault in on demand)
+        data = np.load(files[0], mmap_mode="r")
+        if data.ndim != 4:
+            raise ValueError(f"{files[0]} must hold a 4-D array, got {data.shape}")
+    else:
+        arrays = []
+        for f in files:
+            if f.endswith(".npz"):
+                with np.load(f) as z:
+                    for k in z.files:
+                        arr = z[k]  # decompress once
+                        if arr.ndim == 4:
+                            arrays.append(arr)
+            else:
+                arrays.append(np.load(f))
+        data = np.concatenate(arrays, axis=0)
 
     is_nhwc = data.shape[-1] in (1, 3) and data.shape[1] not in (1, 3)
     native_ok = use_native and (
@@ -99,13 +107,22 @@ def folder_batches(
             idx = rng.integers(0, n, size=batch_size)
             yield native.assemble_batch(data, idx, image_size)
 
-    if is_nhwc:
-        data = data.transpose(0, 3, 1, 2)  # NHWC -> NCHW
-    if data.dtype == np.uint8:
-        data = data.astype(np.float32) / 127.5 - 1.0
-    else:
-        data = data.astype(np.float32)
-    data = _resize_nchw(data, image_size)
+    def _process(batch: np.ndarray) -> np.ndarray:
+        if is_nhwc:
+            batch = batch.transpose(0, 3, 1, 2)  # NHWC -> NCHW
+        if batch.dtype == np.uint8:
+            batch = batch.astype(np.float32) / 127.5 - 1.0
+        else:
+            batch = batch.astype(np.float32)
+        return _resize_nchw(batch, image_size)
+
+    if isinstance(data, np.memmap):
+        # keep the mapping lazy: gather + convert per batch, never the whole set
+        while True:
+            idx = rng.integers(0, n, size=batch_size)
+            yield _process(np.asarray(data[idx]))
+
+    data = _process(data)  # small in-RAM datasets: preprocess once
     while True:
         idx = rng.integers(0, n, size=batch_size)
         yield data[idx]

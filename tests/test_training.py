@@ -377,6 +377,26 @@ def test_make_batches_augmented_stream():
     assert not np.array_equal(plain, aug)
 
 
+def test_folder_single_npy_is_memory_mapped(tmp_path):
+    """One .npy file => mmap-backed streaming; batches identical to the
+    in-RAM multi-file path on both native and numpy routes."""
+    rng = np.random.default_rng(4)
+    imgs = (rng.random((20, 8, 8, 3)) * 255).astype(np.uint8)
+    np.save(tmp_path / "all.npy", imgs)
+    two = tmp_path / "two"
+    two.mkdir()
+    np.save(two / "a.npy", imgs[:10])
+    np.save(two / "b.npy", imgs[10:])
+
+    from glom_tpu.training.data import folder_batches
+
+    for use_native in (True, False):
+        it_one = folder_batches(str(tmp_path), 4, 16, seed=0, use_native=use_native)
+        it_two = folder_batches(str(two), 4, 16, seed=0, use_native=use_native)
+        for _ in range(3):
+            np.testing.assert_array_equal(next(it_one), next(it_two))
+
+
 def test_data_prefetcher_matches_plain():
     plain = synthetic_batches(2, 8, seed=3)
     pref = make_batches("synthetic", 2, 8, seed=3, prefetch=2)
