@@ -83,6 +83,10 @@ class TrainConfig:
     code) plus the distributed-execution fields the reference lacks."""
 
     batch_size: int = 8
+    # split each batch into this many sequential microbatches, accumulating
+    # grads before the single optimizer update (large effective batches on
+    # small-HBM chips); batch_size must be divisible by it
+    grad_accum_steps: int = 1
     learning_rate: float = 3e-4
     weight_decay: float = 0.0
     iters: Optional[int] = None          # None => model default (2*levels)
@@ -124,3 +128,10 @@ class TrainConfig:
             )
         if self.checkpoint_backend not in ("npz", "orbax"):
             raise ValueError(f"unknown checkpoint backend {self.checkpoint_backend!r}")
+        if self.grad_accum_steps < 1:
+            raise ValueError(f"grad_accum_steps must be >= 1, got {self.grad_accum_steps}")
+        if self.batch_size % self.grad_accum_steps != 0:
+            raise ValueError(
+                f"batch_size {self.batch_size} not divisible by "
+                f"grad_accum_steps {self.grad_accum_steps}"
+            )

@@ -99,16 +99,66 @@ def make_step_fn(
     tx: optax.GradientTransformation,
     *,
     consensus_fn=None,
+    microbatch_sharding=None,
 ):
     """Un-jitted train step ``state, img -> state, metrics`` — the body the
-    Trainer jits with explicit shardings/donation."""
+    Trainer jits with explicit shardings/donation.
+
+    With ``train.grad_accum_steps > 1`` the batch splits into that many
+    sequential microbatches under a ``lax.scan``; gradients average before
+    the single optimizer update.  For the plain denoising loss (a mean over
+    the batch) this is numerically the full-batch step; batch-coupled terms
+    (InfoNCE consistency) see per-microbatch negatives instead — documented
+    semantics, not drift."""
     loss_fn = make_loss_fn(config, train, consensus_fn=consensus_fn)
+    accum = train.grad_accum_steps
 
     def step_fn(state: DenoiseState, img: jax.Array) -> Tuple[DenoiseState, dict]:
         rng, rng_noise = jax.random.split(state.rng)
-        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, img, rng_noise
-        )
+        if accum == 1:
+            (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, img, rng_noise
+            )
+        else:
+            mb = img.shape[0] // accum
+            micro = img.reshape(accum, mb, *img.shape[1:])
+            if microbatch_sharding is not None:
+                # keep each microbatch split across the data axis — without
+                # this, contiguous row-chunks of a data-sharded batch land
+                # on device subsets and GSPMD reshards every scan step
+                micro = jax.lax.with_sharding_constraint(micro, microbatch_sharding)
+            noise_keys = jax.random.split(rng_noise, accum)
+
+            # accumulate in at-least-fp32 regardless of param dtype — bf16
+            # sums would absorb small gradient components microbatch by
+            # microbatch, breaking equivalence with the full-batch step
+            acc_dt = lambda d: jnp.promote_types(d, jnp.float32)
+            loss_dt = acc_dt(config.compute_dtype or config.param_dtype)
+
+            def accum_body(carry, xs):
+                loss_sum, grads_sum = carry
+                chunk, key = xs
+                (l, _), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                    state.params, chunk, key
+                )
+                return (
+                    loss_sum + l.astype(loss_dt),
+                    jax.tree_util.tree_map(
+                        lambda a, b: a + b.astype(a.dtype), grads_sum, g
+                    ),
+                ), None
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, acc_dt(p.dtype)), state.params
+            )
+            (loss_sum, grads_sum), _ = jax.lax.scan(
+                accum_body, (jnp.zeros((), loss_dt), zeros), (micro, noise_keys)
+            )
+            loss = loss_sum / accum
+            grads = jax.tree_util.tree_map(
+                lambda g, p: (g / accum).astype(p.dtype), grads_sum, state.params
+            )
+
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         new_state = DenoiseState(params, opt_state, state.step + 1, rng)

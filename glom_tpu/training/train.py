@@ -32,6 +32,7 @@ def parse_args(argv=None):
     p.add_argument("--ff-impl", default="dense", choices=["dense", "pallas"])
     # training
     p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--grad-accum-steps", type=int, default=1)
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--weight-decay", type=float, default=0.0)
     p.add_argument("--iters", type=int, default=None)
@@ -89,6 +90,7 @@ def main(argv=None):
     )
     train_cfg = TrainConfig(
         batch_size=args.batch_size,
+        grad_accum_steps=args.grad_accum_steps,
         learning_rate=args.lr,
         weight_decay=args.weight_decay,
         iters=args.iters,

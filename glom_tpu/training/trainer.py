@@ -65,6 +65,13 @@ class Trainer:
                 f"batch_size {train.batch_size} not divisible by data-axis size "
                 f"{self.mesh.shape[data_axis]}"
             )
+        microbatch = train.batch_size // train.grad_accum_steps
+        if microbatch % self.mesh.shape[data_axis] != 0:
+            raise ValueError(
+                f"microbatch {microbatch} (batch {train.batch_size} / "
+                f"grad_accum_steps {train.grad_accum_steps}) not divisible by "
+                f"data-axis size {self.mesh.shape[data_axis]}"
+            )
 
         if config.ff_impl == "pallas" and self.mesh.shape[model_axis] > 1 \
                 and train.param_sharding in ("tp", "ep"):
@@ -131,8 +138,15 @@ class Trainer:
                 )
             )
 
+        micro_sh = None
+        if train.grad_accum_steps > 1:
+            micro_sh = NamedSharding(self.mesh, P(None, data_axis))
+
         self._step = jax.jit(
-            denoise.make_step_fn(config, train, tx, consensus_fn=consensus_fn),
+            denoise.make_step_fn(
+                config, train, tx, consensus_fn=consensus_fn,
+                microbatch_sharding=micro_sh,
+            ),
             in_shardings=(self._state_sh, self._batch_sh),
             out_shardings=(self._state_sh, NamedSharding(self.mesh, P())),
             donate_argnums=(0,) if train.donate else (),
