@@ -88,6 +88,8 @@ class TrainConfig:
     # small-HBM chips); batch_size must be divisible by it
     grad_accum_steps: int = 1
     learning_rate: float = 3e-4
+    lr_schedule: str = "constant"        # "constant" | "cosine" (linear warmup + cosine decay)
+    warmup_steps: int = 0                # linear warmup from 0 (cosine schedule)
     weight_decay: float = 0.0
     iters: Optional[int] = None          # None => model default (2*levels)
     # README.md:83 reads the state at time index 7 of 13 and the top level.
@@ -128,6 +130,12 @@ class TrainConfig:
             )
         if self.checkpoint_backend not in ("npz", "orbax"):
             raise ValueError(f"unknown checkpoint backend {self.checkpoint_backend!r}")
+        if self.lr_schedule not in ("constant", "cosine"):
+            raise ValueError(f"unknown lr_schedule {self.lr_schedule!r}")
+        if self.warmup_steps and self.lr_schedule == "constant":
+            raise ValueError(
+                "warmup_steps is only meaningful with lr_schedule='cosine'"
+            )
         if self.grad_accum_steps < 1:
             raise ValueError(f"grad_accum_steps must be >= 1, got {self.grad_accum_steps}")
         if self.batch_size % self.grad_accum_steps != 0:

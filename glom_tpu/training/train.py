@@ -34,6 +34,8 @@ def parse_args(argv=None):
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--grad-accum-steps", type=int, default=1)
     p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--lr-schedule", default="constant", choices=["constant", "cosine"])
+    p.add_argument("--warmup-steps", type=int, default=0)
     p.add_argument("--weight-decay", type=float, default=0.0)
     p.add_argument("--iters", type=int, default=None)
     p.add_argument("--steps", type=int, default=100)
@@ -92,6 +94,8 @@ def main(argv=None):
         batch_size=args.batch_size,
         grad_accum_steps=args.grad_accum_steps,
         learning_rate=args.lr,
+        lr_schedule=args.lr_schedule,
+        warmup_steps=args.warmup_steps,
         weight_decay=args.weight_decay,
         iters=args.iters,
         noise_std=args.noise_std,

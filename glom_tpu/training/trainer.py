@@ -32,6 +32,19 @@ def _decoder_specs() -> dict:
     return {"w": P(None, None), "b": P(None)}
 
 
+def make_lr_schedule(train: TrainConfig):
+    """Resolve TrainConfig's learning-rate schedule into an optax schedule
+    (or a constant float)."""
+    if train.lr_schedule == "cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=train.learning_rate,
+            warmup_steps=max(train.warmup_steps, 1),
+            decay_steps=max(train.steps, train.warmup_steps + 1),
+        )
+    return train.learning_rate
+
+
 class Trainer:
     def __init__(
         self,
@@ -46,10 +59,11 @@ class Trainer:
         self.train_cfg = train
         self.mesh = mesh if mesh is not None else make_mesh(train.mesh_shape, train.mesh_axes)
         if tx is None:
+            lr = make_lr_schedule(train)
             tx = (
-                optax.adamw(train.learning_rate, weight_decay=train.weight_decay)
+                optax.adamw(lr, weight_decay=train.weight_decay)
                 if train.weight_decay
-                else optax.adam(train.learning_rate)
+                else optax.adam(lr)
             )
         self.tx = tx
         self.logger = logger or MetricLogger()
@@ -190,6 +204,15 @@ class Trainer:
         below the checkpointed step is a no-op by design."""
         cfg = self.train_cfg
         steps = steps if steps is not None else cfg.steps
+        if cfg.lr_schedule == "cosine" and steps > cfg.steps:
+            import warnings
+
+            warnings.warn(
+                f"fit(steps={steps}) exceeds TrainConfig.steps={cfg.steps}, "
+                "which set the cosine decay horizon — steps past it run at "
+                "lr=0; set TrainConfig.steps to the full run length",
+                stacklevel=2,
+            )
         if cfg.checkpoint_dir and ckpt_lib.latest_step(cfg.checkpoint_dir) is not None:
             resumed = self.restore(cfg.checkpoint_dir)
             self.logger.log(resumed, event=1.0)  # resume marker

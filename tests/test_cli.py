@@ -15,7 +15,7 @@ def test_every_train_config_field_has_a_cli_path():
     such drifts were caught by hand in verification; this automates it)."""
     args = parse_args([])
     covered_by_flag = {
-        "batch_size", "grad_accum_steps", "learning_rate", "weight_decay", "iters", "noise_std",
+        "batch_size", "grad_accum_steps", "learning_rate", "lr_schedule", "warmup_steps", "weight_decay", "iters", "noise_std",
         "steps", "log_every", "eval_every", "checkpoint_every", "checkpoint_dir",
         "checkpoint_backend",
         "profile_dir", "seed", "mesh_shape", "param_sharding",
