@@ -1,0 +1,75 @@
+"""Video denoising-SSL training.
+
+BASELINE.json config 5 is "consecutive frames with carried ``levels`` state,
+batched on TPU".  ``models/video.py`` gives the one-graph rollout; this adds
+the training objective on top: every frame of a noised clip rolls through
+the scan-of-scans with carried state, each frame's final top level decodes
+through ``patches_to_images``, and the loss is the mean frame-reconstruction
+MSE.  Gradients flow through the carried state across frames (full BPTT
+over the clip — the clip length is the scan dimension, so memory is
+O(frames) activations unless ``config.remat`` is set).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from glom_tpu.config import GlomConfig, TrainConfig
+from glom_tpu.models.heads import patches_to_images_apply
+from glom_tpu.models.video import rollout
+from glom_tpu.training.denoise import DenoiseState
+
+
+def make_video_loss_fn(config: GlomConfig, train: TrainConfig, *, consensus_fn=None):
+    """loss(params, frames, rng) -> (loss, recon_frames).
+
+    ``frames``: clean clip ``(t, b, c, H, W)``; each frame is independently
+    noised, rolled through with carried state, and reconstructed."""
+    iters = train.iters if train.iters is not None else config.default_iters
+
+    def loss_fn(params, frames, rng):
+        noise = jax.random.normal(rng, frames.shape, frames.dtype) * train.noise_std
+        _, states = rollout(
+            params["glom"], frames + noise, config=config, iters=iters,
+            return_states=True, consensus_fn=consensus_fn,
+        )  # (t, b, n, L, d)
+        tokens = states[:, :, :, train.loss_level]              # (t, b, n, d)
+        t, b = tokens.shape[:2]
+        recon = patches_to_images_apply(
+            params["decoder"], tokens.reshape(t * b, *tokens.shape[2:]), config
+        ).reshape(t, b, config.channels, config.image_size, config.image_size)
+        acc_dt = jnp.promote_types(recon.dtype, jnp.float32)
+        loss = jnp.mean((recon.astype(acc_dt) - frames.astype(acc_dt)) ** 2)
+        return loss, recon
+
+    return loss_fn
+
+
+def make_video_train_step(
+    config: GlomConfig,
+    train: TrainConfig,
+    tx: optax.GradientTransformation,
+    *,
+    consensus_fn=None,
+    donate: bool = True,
+):
+    """Jitted ``state, frames -> state, metrics`` over clips."""
+    loss_fn = make_video_loss_fn(config, train, consensus_fn=consensus_fn)
+
+    def step_fn(state: DenoiseState, frames: jax.Array) -> Tuple[DenoiseState, dict]:
+        rng, rng_noise = jax.random.split(state.rng)
+        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, frames, rng_noise
+        )
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            DenoiseState(params, opt_state, state.step + 1, rng),
+            {"loss": loss, "grad_norm": optax.global_norm(grads)},
+        )
+
+    return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
