@@ -505,3 +505,26 @@ def test_cosine_fit_past_horizon_warns():
     trainer = Trainer(TINY, t)
     with pytest.warns(UserWarning, match="decay horizon"):
         trainer.fit(synthetic_batches(8, 16), steps=3)
+
+
+def test_donation_correctness():
+    """SURVEY.md §5: donated-buffer steps must equal non-donated steps (and
+    the donated state must actually be consumed, not silently copied)."""
+    c = TINY
+    t_d = TrainConfig(batch_size=8, learning_rate=1e-3, iters=2, donate=True)
+    t_n = TrainConfig(batch_size=8, learning_rate=1e-3, iters=2, donate=False)
+    tr_d, tr_n = Trainer(c, t_d), Trainer(c, t_n)
+    rng = np.random.default_rng(0)
+    s_d, s_n = tr_d.state, tr_n.state
+    for _ in range(3):
+        img = rng.standard_normal((8, 3, 16, 16)).astype(np.float32)
+        prev = s_d
+        s_d, m_d = tr_d._step(s_d, jax.device_put(img, tr_d._batch_sh))
+        s_n, m_n = tr_n._step(s_n, jax.device_put(img, tr_n._batch_sh))
+    np.testing.assert_allclose(float(m_d["loss"]), float(m_n["loss"]), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6),
+        jax.device_get(s_d.params), jax.device_get(s_n.params),
+    )
+    # the donated input state's buffers were really consumed
+    assert all(l.is_deleted() for l in jax.tree_util.tree_leaves(prev.params))
