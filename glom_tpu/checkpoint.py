@@ -129,7 +129,10 @@ def save(
 def _step_of(name: str) -> Optional[int]:
     for suffix in (".npz", ".orbax"):
         if name.startswith("ckpt_") and name.endswith(suffix):
-            return int(name[len("ckpt_"):-len(suffix)])
+            try:
+                return int(name[len("ckpt_"):-len(suffix)])
+            except ValueError:  # stray non-numeric ckpt_*.npz: not ours, skip
+                return None
     return None
 
 

@@ -59,7 +59,9 @@ def make_train_step(
 ):
     """Jitted supervised step ``(params, opt_state, imgs, labels) ->
     (params, opt_state, metrics)``.  ``freeze_backbone=True`` stops gradients
-    into the GLOM params (linear-probe fine-tuning)."""
+    into the GLOM params AND zeroes their optimizer updates, so decoupled
+    weight decay (e.g. ``optax.adamw``) cannot drift frozen weights
+    (linear-probe fine-tuning)."""
 
     def loss_fn(params, imgs, labels):
         p = params
@@ -77,6 +79,8 @@ def make_train_step(
     def step(params, opt_state, imgs, labels):
         (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, imgs, labels)
         updates, opt_state = tx.update(grads, opt_state, params)
+        if freeze_backbone:
+            updates = {**updates, "glom": jax.tree.map(jnp.zeros_like, updates["glom"])}
         params = optax.apply_updates(params, updates)
         return params, opt_state, {"loss": loss, "accuracy": acc}
 

@@ -77,7 +77,10 @@ def folder_batches(
                         if arr.ndim == 4:
                             arrays.append(arr)
             else:
-                arrays.append(np.load(f))
+                arr = np.load(f)
+                if arr.ndim != 4:
+                    raise ValueError(f"{f} must hold a 4-D array, got {arr.shape}")
+                arrays.append(arr)
         data = np.concatenate(arrays, axis=0)
 
     is_nhwc = data.shape[-1] in (1, 3) and data.shape[1] not in (1, 3)

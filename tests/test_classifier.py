@@ -46,13 +46,23 @@ def test_classifier_learns_synthetic_task():
 
 
 def test_freeze_backbone_keeps_glom_params():
+    _check_frozen(optax.adam(1e-2))
+
+
+def test_freeze_backbone_survives_decoupled_weight_decay():
+    # adamw decays weights regardless of zero grads; the frozen subtree's
+    # UPDATES must be masked, not just its gradients (ADVICE round 1)
+    _check_frozen(optax.adamw(1e-2, weight_decay=0.1))
+
+
+def _check_frozen(tx):
     rng = np.random.default_rng(1)
     imgs, labels = _synthetic_task(8, rng)
     params = classifier.init(jax.random.PRNGKey(0), TINY, num_classes=2)
-    tx = optax.adam(1e-2)
     opt_state = tx.init(params)
     step = classifier.make_train_step(TINY, tx, iters=2, freeze_backbone=True)
     before = jax.device_get(params["glom"])
+    head_before = np.asarray(params["head"]["w"]).copy()
     for _ in range(3):
         params, opt_state, _ = step(params, opt_state, imgs, labels)
     jax.tree_util.tree_map(
@@ -61,4 +71,4 @@ def test_freeze_backbone_keeps_glom_params():
         jax.device_get(params["glom"]),
     )
     # head must still have moved
-    assert not np.allclose(np.asarray(params["head"]["w"]), 0.0)
+    assert not np.allclose(np.asarray(params["head"]["w"]), head_before)

@@ -310,6 +310,21 @@ def test_checkpoint_mixed_backends_one_directory(tmp_path):
     assert names == ["ckpt_11.npz", "ckpt_12.npz", "ckpt_13.npz"], names
 
 
+def test_checkpoint_ignores_stray_nonnumeric_files(tmp_path):
+    """A stray ckpt_*.npz with a non-numeric step (ADVICE round 1) must not
+    crash save/prune/restore — it is simply not treated as a checkpoint."""
+    (tmp_path / "ckpt_backup.npz").write_bytes(b"not a checkpoint")
+    c = TINY
+    tx = optax.adam(1e-3)
+    state = denoise.init_state(jax.random.PRNGKey(0), c, tx)
+    host = jax.device_get(state)
+    for s in (1, 2, 3, 4):
+        ckpt_lib.save(str(tmp_path), s, {"params": host.params}, keep=2)
+    step, _ = ckpt_lib.restore(str(tmp_path), {"params": state.params})
+    assert step == 4
+    assert (tmp_path / "ckpt_backup.npz").exists()  # never pruned
+
+
 def test_trainer_orbax_backend_roundtrip(tmp_path):
     """Trainer with checkpoint_backend='orbax' saves and auto-resumes."""
     c = TINY
