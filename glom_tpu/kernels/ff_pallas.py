@@ -30,6 +30,26 @@ from glom_tpu.kernels.tiling import pick_block as _pick_block
 from glom_tpu.ops.feedforward import grouped_ff_apply
 
 
+def _erf_f32(x):
+    """f32 erf as the rational polynomial XLA itself lowers erf to (input
+    clamped to [-4, 4], where f32 erf saturates).  Mosaic has no TPU lowering
+    for the erf/erfc primitives, so the kernel carries its own — numerically
+    identical to the XLA path's ``jax.nn.gelu(approximate=False)`` to ~1 ulp."""
+    alpha = (0.00022905065861350646, 0.0034082910107109506, 0.050955695062380861,
+             0.18520832239976145, 1.128379143519084)
+    beta = (-1.1791602954361697e-7, 2.3547966471313185e-5, 0.0010179625278914885,
+            0.014070470171167667, 0.11098505178285362, 0.49746925110067538, 1.0)
+    x = jnp.clip(x, -4.0, 4.0)
+    x2 = x * x
+    p = jnp.float32(alpha[0])
+    for a in alpha[1:]:
+        p = p * x2 + a
+    q = jnp.float32(beta[0])
+    for b in beta[1:]:
+        q = q * x2 + b
+    return x * p / q
+
+
 def _kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, o_ref, acc_ref):
     """Grid (g, b, ni, nh): the hidden dim is tiled so only an (d, hc) /
     (hc, d) weight chunk pair is VMEM-resident at once; per-chunk partial
@@ -44,16 +64,26 @@ def _kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, o_ref, acc_ref):
 
     x = x_ref[0, 0].astype(jnp.float32)           # (Bn, d)
     w1 = w1_ref[0].astype(jnp.float32)            # (d, hc)
-    b1 = b1_ref[0].astype(jnp.float32)            # (hc,)
+    b1 = b1_ref[0, 0].astype(jnp.float32)         # (hc,)
     w2 = w2_ref[0].astype(jnp.float32)            # (hc, d)
 
     h = jnp.dot(x, w1, preferred_element_type=jnp.float32) + b1
-    h = jax.nn.gelu(h, approximate=False)
+    h = 0.5 * h * (1.0 + _erf_f32(h * (2.0 ** -0.5)))
     acc_ref[:] = acc_ref[:] + jnp.dot(h, w2, preferred_element_type=jnp.float32)
 
     @pl.when(ih == nh - 1)
     def _():
-        o_ref[0, 0] = (acc_ref[:] + b2_ref[0].astype(jnp.float32)).astype(o_ref.dtype)
+        o_ref[0, 0] = (acc_ref[:] + b2_ref[0, 0].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+_VMEM_BUDGET = 13 * 2 ** 20  # scoped VMEM is 16 MB; leave headroom for Mosaic
+
+
+def _vmem_bytes(bn, hc, d, itemsize):
+    """Working-set estimate for one grid step: Pallas double-buffers every
+    pipelined block (x, w1, b1, w2, b2, out), plus the f32 accumulator."""
+    blocks = bn * d + d * hc + hc + hc * d + d + bn * d
+    return 2 * itemsize * blocks + 4 * bn * d
 
 
 def _forward(x, params, *, interpret, h_block=2048):
@@ -62,6 +92,20 @@ def _forward(x, params, *, interpret, h_block=2048):
     xt = jnp.transpose(x, (0, 2, 1, 3))           # (b, g, n, d)
     bn = _pick_block(n, cap=512)
     hc = _pick_block(h, cap=h_block)
+    itemsize = max(x.dtype.itemsize, params["w1"].dtype.itemsize)
+    # shrink the hidden chunk (then the n block) until the double-buffered
+    # working set fits scoped VMEM — at dim=1024 a (1024, 2048) weight pair
+    # alone is 16 MB of bf16 once double-buffered
+    while _vmem_bytes(bn, hc, d, itemsize) > _VMEM_BUDGET and hc >= 256:
+        smaller = _pick_block(h, cap=hc // 2)
+        if smaller >= hc:  # no smaller aligned divisor exists; stop shrinking
+            break
+        hc = smaller
+    while _vmem_bytes(bn, hc, d, itemsize) > _VMEM_BUDGET and bn >= 16:
+        smaller = _pick_block(n, cap=bn // 2)
+        if smaller >= bn:
+            break
+        bn = smaller
     # group is the OUTERMOST grid dim: the weight blocks' index maps depend
     # only on (ig, ih), so Pallas keeps them VMEM-resident across all (b, ni)
     # steps instead of re-streaming them from HBM once per batch row
@@ -73,9 +117,12 @@ def _forward(x, params, *, interpret, h_block=2048):
         in_specs=[
             pl.BlockSpec((1, 1, bn, d), lambda ig, ib, ii, ih: (ib, ig, ii, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, d, hc), lambda ig, ib, ii, ih: (ig, 0, ih), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, hc), lambda ig, ib, ii, ih: (ig, ih), memory_space=pltpu.VMEM),
+            # biases carried as (g, 1, h): Mosaic requires the block's
+            # second-to-last dim to be 8-aligned OR equal to the array dim, so
+            # a (1, hc) block over (g, h) is unloadable on hardware
+            pl.BlockSpec((1, 1, hc), lambda ig, ib, ii, ih: (ig, 0, ih), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, hc, d), lambda ig, ib, ii, ih: (ig, ih, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, d), lambda ig, ib, ii, ih: (ig, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, d), lambda ig, ib, ii, ih: (ig, 0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
             (1, 1, bn, d), lambda ig, ib, ii, ih: (ib, ig, ii, 0), memory_space=pltpu.VMEM
@@ -83,7 +130,7 @@ def _forward(x, params, *, interpret, h_block=2048):
         out_shape=jax.ShapeDtypeStruct((b, g, n, d), x.dtype),
         scratch_shapes=[pltpu.VMEM((bn, d), jnp.float32)],
         interpret=interpret,
-    )(xt, params["w1"], params["b1"], params["w2"], params["b2"])
+    )(xt, params["w1"], params["b1"][:, None, :], params["w2"], params["b2"][:, None, :])
     return jnp.transpose(y, (0, 2, 1, 3))
 
 
