@@ -13,9 +13,22 @@ the n=1024/d=512 scale), queries are blocked.  For column counts beyond
 VMEM, use the ring path (``glom_tpu.parallel.ring``), which is the sharded
 analogue of the same online-softmax math.
 
-Backward: ``jax.custom_vjp`` whose cotangent rule is the plain-XLA dense
-formulation — numerically identical, and the forward memory win (no n²
-materialization on the hot inference/rollout path) is kept.
+Backward is flash-style too: the forward kernels emit the per-row
+logsumexp, and two blocked kernels recompute the attention probabilities
+per (query-block, key-block) tile from it —
+
+    dV_j  = sum_i  P_ij^T dO_i
+    dS_ij = P_ij * (dO_i V_j^T - delta_i),  delta_i = dO_i . O_i
+    dK_j  = sum_i  dS_ij^T Q_i * scale   (then through the normalize VJP)
+    dQ_i  = sum_j  dS_ij K_j * scale
+
+so training never materializes the n x n similarity either.  The GLOM
+quirks are handled per tile: the soft self-mask (`glom_pytorch.py:11,65`)
+replaces the diagonal LOGIT by a constant, so dS is zeroed on the diagonal
+(the dense ``jnp.where`` has zero cotangent there); hard-masked pairs have
+P = 0 and vanish on their own; and because keys are the L2-normalized
+values (`:58,72`), dK flows through the normalize VJP and is summed with
+dV and dQ into one dLevels.
 """
 
 from __future__ import annotations
@@ -31,12 +44,15 @@ from jax.experimental.pallas import tpu as pltpu
 from glom_tpu.kernels.tiling import pick_block as _pick_block
 from glom_tpu.ops.consensus import TOKEN_ATTEND_SELF_VALUE, consensus_attention, l2_normalize
 
+_MAX_NEG = float(-jnp.finfo(jnp.float32).max)
+
 
 def _kernel(q_ref, kv_ref, *refs, scale, attend_self, block_i, n, has_mask):
-    """One fused consensus block.  ``refs`` is (mask_ref, o_ref) when
-    ``has_mask`` (selected statically in ``_forward``), else (o_ref,)."""
+    """One fused consensus block.  ``refs`` is (mask_ref, o_ref, lse_ref)
+    when ``has_mask`` (selected statically in ``_forward``), else
+    (o_ref, lse_ref)."""
     mask_ref = refs[0] if has_mask else None
-    o_ref = refs[-1]
+    o_ref, lse_ref = refs[-2], refs[-1]
 
     q = q_ref[0, 0].astype(jnp.float32)          # (Bi, d)
     kv = kv_ref[0, 0].astype(jnp.float32)        # (n, d)
@@ -53,11 +69,14 @@ def _kernel(q_ref, kv_ref, *refs, scale, attend_self, block_i, n, has_mask):
         sim = jnp.where(i_ids == j_ids, jnp.float32(TOKEN_ATTEND_SELF_VALUE), sim)
 
     if mask_ref is not None:
-        sim = jnp.where(mask_ref[:] != 0, -jnp.finfo(jnp.float32).max, sim)
+        sim = jnp.where(mask_ref[:] != 0, _MAX_NEG, sim)
 
-    attn = jax.nn.softmax(sim, axis=-1)
+    m = sim.max(axis=-1)
+    lse = m + jnp.log(jnp.exp(sim - m[:, None]).sum(axis=-1))
+    attn = jnp.exp(sim - lse[:, None])
     out = jnp.dot(attn, kv, preferred_element_type=jnp.float32)
     o_ref[0, 0] = out.astype(o_ref.dtype)
+    lse_ref[0, 0] = lse[:, None]
 
 
 def _kernel_blocked(q_ref, kv_ref, *refs, scale, attend_self, block_i, block_j,
@@ -67,9 +86,9 @@ def _kernel_blocked(q_ref, kv_ref, *refs, scale, attend_self, block_i, block_j,
     VMEM holds O(block_i * block_j + block_i * d) instead of O(n * d + n²).
     Scratch layout: acc (Bi, d) f32, m/den (Bi, 128) f32 (lane-padded)."""
     if has_mask:
-        mask_ref, o_ref, acc_ref, m_ref, den_ref = refs
+        mask_ref, o_ref, lse_ref, acc_ref, m_ref, den_ref = refs
     else:
-        (o_ref, acc_ref, m_ref, den_ref) = refs
+        (o_ref, lse_ref, acc_ref, m_ref, den_ref) = refs
 
     jj = pl.program_id(3)
     nj = pl.num_programs(3)
@@ -96,7 +115,7 @@ def _kernel_blocked(q_ref, kv_ref, *refs, scale, attend_self, block_i, block_j,
         sim = jnp.where(i_ids == j_ids, jnp.float32(TOKEN_ATTEND_SELF_VALUE), sim)
 
     if has_mask:
-        sim = jnp.where(mask_ref[:] != 0, -jnp.finfo(jnp.float32).max, sim)
+        sim = jnp.where(mask_ref[:] != 0, _MAX_NEG, sim)
 
     m_prev = m_ref[:, 0]                          # (Bi,)
     m_new = jnp.maximum(m_prev, sim.max(axis=-1))
@@ -111,6 +130,7 @@ def _kernel_blocked(q_ref, kv_ref, *refs, scale, attend_self, block_i, block_j,
     @pl.when(jj == nj - 1)
     def _():
         o_ref[0, 0] = (acc_ref[:] / den_ref[:, 0][:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_ref[:, 0] + jnp.log(den_ref[:, 0]))[:, None]
 
 
 def _forward_blocked(levels, mask_i8, *, attend_self, interpret, block_j):
@@ -120,11 +140,16 @@ def _forward_blocked(levels, mask_i8, *, attend_self, interpret, block_j):
     bj = _pick_block(n, cap=block_j)
     if bj >= n:
         # no usable K/V divisor: "blocked" would degenerate to one full-n
-        # block, re-materializing the n x n sim the path exists to avoid
+        # block; fall back to the one-shot kernel (still no n x n in HBM)
+        # while n fits its VMEM envelope, else fail with an actionable error
+        # rather than a Mosaic VMEM-exhaustion crash deep in compilation
+        if n <= _ONE_SHOT_MAX_N:
+            return _forward(levels, mask_i8, attend_self=attend_self, interpret=interpret)
         raise ValueError(
-            f"pallas blocked kernel needs n ({n}) to have a multiple-of-8 "
-            f"divisor <= {block_j}; use attention_impl='dense' or the "
-            "ring/ulysses paths for this patch count"
+            f"pallas consensus needs n ({n}) <= {_ONE_SHOT_MAX_N} or a "
+            f"multiple-of-8 divisor of n <= {block_j} for K/V blocking; use "
+            "attention_impl='dense' or the ring/ulysses paths for this patch "
+            "count"
         )
     grid = (b, L, n // block_i, n // bj)
     scale = d ** -0.5
@@ -138,6 +163,9 @@ def _forward_blocked(levels, mask_i8, *, attend_self, interpret, block_j):
     out_spec = pl.BlockSpec(
         (1, 1, block_i, d), lambda ib, il, ii, ij: (ib, il, ii, 0), memory_space=pltpu.VMEM
     )
+    lse_spec = pl.BlockSpec(
+        (1, 1, block_i, 1), lambda ib, il, ii, ij: (ib, il, ii, 0), memory_space=pltpu.VMEM
+    )
     has_mask = mask_i8 is not None
     kern = functools.partial(
         _kernel_blocked, scale=scale, attend_self=attend_self,
@@ -150,12 +178,15 @@ def _forward_blocked(levels, mask_i8, *, attend_self, interpret, block_j):
             pl.BlockSpec((block_i, bj), lambda ib, il, ii, ij: (ii, ij), memory_space=pltpu.VMEM)
         )
         operands.append(mask_i8)
-    y = pl.pallas_call(
+    y, lse = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=in_specs,
-        out_specs=out_spec,
-        out_shape=jax.ShapeDtypeStruct((b, L, n, d), levels.dtype),
+        out_specs=[out_spec, lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, L, n, d), levels.dtype),
+            jax.ShapeDtypeStruct((b, L, n, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_i, d), jnp.float32),
             pltpu.VMEM((block_i, 128), jnp.float32),
@@ -163,7 +194,7 @@ def _forward_blocked(levels, mask_i8, *, attend_self, interpret, block_j):
         ],
         interpret=interpret,
     )(*operands)
-    return jnp.transpose(y, (0, 2, 1, 3))
+    return jnp.transpose(y, (0, 2, 1, 3)), lse
 
 
 def _forward(levels, mask_i8, *, attend_self, interpret):
@@ -182,7 +213,9 @@ def _forward(levels, mask_i8, *, attend_self, interpret):
     out_spec = pl.BlockSpec(
         (1, 1, block_i, d), lambda ib, il, ii: (ib, il, ii, 0), memory_space=pltpu.VMEM
     )
-    out_shape = jax.ShapeDtypeStruct((b, L, n, d), levels.dtype)
+    lse_spec = pl.BlockSpec(
+        (1, 1, block_i, 1), lambda ib, il, ii: (ib, il, ii, 0), memory_space=pltpu.VMEM
+    )
 
     has_mask = mask_i8 is not None
     kern = functools.partial(
@@ -196,16 +229,204 @@ def _forward(levels, mask_i8, *, attend_self, interpret):
             pl.BlockSpec((block_i, n), lambda ib, il, ii: (ii, 0), memory_space=pltpu.VMEM)
         )
         operands.append(mask_i8)
-    y = pl.pallas_call(
+    y, lse = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=in_specs,
-        out_specs=out_spec,
-        out_shape=out_shape,
+        out_specs=[out_spec, lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, L, n, d), levels.dtype),
+            jax.ShapeDtypeStruct((b, L, n, 1), jnp.float32),
+        ],
         interpret=interpret,
     )(*operands)
 
-    return jnp.transpose(y, (0, 2, 1, 3))         # (b, n, L, d)
+    return jnp.transpose(y, (0, 2, 1, 3)), lse    # (b, n, L, d), (b, L, n, 1)
+
+
+# ---------------------------------------------------------------------------
+# Flash-style backward
+# ---------------------------------------------------------------------------
+
+
+def _sim_block(q, kv, scale, attend_self, mask_ref, has_mask, i0, j0, bi, bj):
+    """Recompute one (Bi, Bj) masked logit tile + the normalized keys."""
+    k = l2_normalize(kv, axis=-1)
+    sim = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    i_ids = jax.lax.broadcasted_iota(jnp.int32, (bi, bj), 0) + i0
+    j_ids = jax.lax.broadcasted_iota(jnp.int32, (bi, bj), 1) + j0
+    diag = i_ids == j_ids
+    if not attend_self:
+        sim = jnp.where(diag, jnp.float32(TOKEN_ATTEND_SELF_VALUE), sim)
+    if has_mask:
+        sim = jnp.where(mask_ref[:] != 0, _MAX_NEG, sim)
+    return sim, k, diag
+
+
+def _bwd_dkv_kernel(q_ref, kv_ref, do_ref, lse_ref, dl_ref, *refs, scale,
+                    attend_self, block_i, block_j, has_mask):
+    """Grid (b, L, nj, ni): for a fixed key/value block j, accumulate
+    dK_j/dV_j over all query blocks i, then push dK through the normalize
+    VJP and emit dKV_j = d(normalize)(dK_j) + dV_j."""
+    if has_mask:
+        mask_ref, o_ref, dk_ref, dv_ref = refs
+    else:
+        o_ref, dk_ref, dv_ref = refs
+    ii = pl.program_id(3)
+    ni = pl.num_programs(3)
+
+    @pl.when(ii == 0)
+    def _():
+        dk_ref[:] = jnp.zeros_like(dk_ref)
+        dv_ref[:] = jnp.zeros_like(dv_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32)           # (Bi, d)
+    kv = kv_ref[0, 0].astype(jnp.float32)         # (Bj, d)
+    do = do_ref[0, 0].astype(jnp.float32)         # (Bi, d)
+    lse = lse_ref[0, 0][:, 0]                     # (Bi,)
+    delta = dl_ref[0, 0][:, 0]                    # (Bi,)
+
+    sim, _, diag = _sim_block(
+        q, kv, scale, attend_self, mask_ref if has_mask else None, has_mask,
+        ii * block_i, pl.program_id(2) * block_j, block_i, block_j,
+    )
+    p = jnp.exp(sim - lse[:, None])               # (Bi, Bj)
+    dv_ref[:] = dv_ref[:] + jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    dattn = jax.lax.dot_general(
+        do, kv, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )                                             # (Bi, Bj)
+    ds = p * (dattn - delta[:, None])
+    if not attend_self:
+        # the diagonal logit was overwritten by a constant -> zero cotangent
+        ds = jnp.where(diag, 0.0, ds)
+    dk_ref[:] = dk_ref[:] + jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+
+    @pl.when(ii == ni - 1)
+    def _():
+        _, nvjp = jax.vjp(lambda t: l2_normalize(t, axis=-1), kv)
+        (dkv_k,) = nvjp(dk_ref[:])
+        o_ref[0, 0] = (dkv_k + dv_ref[:]).astype(o_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, kv_ref, do_ref, lse_ref, dl_ref, *refs, scale,
+                   attend_self, block_i, block_j, has_mask):
+    """Grid (b, L, ni, nj): for a fixed query block i, accumulate dQ_i over
+    all key blocks j."""
+    if has_mask:
+        mask_ref, o_ref, dq_ref = refs
+    else:
+        o_ref, dq_ref = refs
+    jj = pl.program_id(3)
+    nj = pl.num_programs(3)
+
+    @pl.when(jj == 0)
+    def _():
+        dq_ref[:] = jnp.zeros_like(dq_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32)
+    kv = kv_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, 0]
+    delta = dl_ref[0, 0][:, 0]
+
+    sim, k, diag = _sim_block(
+        q, kv, scale, attend_self, mask_ref if has_mask else None, has_mask,
+        pl.program_id(2) * block_i, jj * block_j, block_i, block_j,
+    )
+    p = jnp.exp(sim - lse[:, None])
+    dattn = jax.lax.dot_general(
+        do, kv, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dattn - delta[:, None])
+    if not attend_self:
+        ds = jnp.where(diag, 0.0, ds)
+    dq_ref[:] = dq_ref[:] + jnp.dot(ds, k, preferred_element_type=jnp.float32) * scale
+
+    @pl.when(jj == nj - 1)
+    def _():
+        o_ref[0, 0] = dq_ref[:].astype(o_ref.dtype)
+
+
+def _backward_flash(levels, mask_i8, out, lse, g, *, attend_self, interpret,
+                    block_cap=256):
+    """dLevels for the fused consensus, never materializing (n, n)."""
+    b, n, L, d = levels.shape
+    x = jnp.transpose(levels, (0, 2, 1, 3))       # (b, L, n, d)
+    do = jnp.transpose(g, (0, 2, 1, 3)).astype(levels.dtype)
+    out_t = jnp.transpose(out, (0, 2, 1, 3))
+    # delta_i = dO_i . O_i  (the flash rowsum(P * dAttn) identity), f32
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out_t.astype(jnp.float32), axis=-1, keepdims=True
+    )                                             # (b, L, n, 1)
+
+    bi = _pick_block(n, cap=block_cap)
+    bj = _pick_block(n, cap=block_cap)
+    scale = d ** -0.5
+    has_mask = mask_i8 is not None
+
+    def xspec(block, which):
+        # which: 0 -> indexed by the i grid slot, 1 -> by the j grid slot
+        if which == 0:
+            return pl.BlockSpec((1, 1, block, d), lambda ib, il, io, ia: (ib, il, ia, 0),
+                                memory_space=pltpu.VMEM)
+        return pl.BlockSpec((1, 1, block, d), lambda ib, il, io, ia: (ib, il, io, 0),
+                            memory_space=pltpu.VMEM)
+
+    def sspec(block, which):
+        if which == 0:
+            return pl.BlockSpec((1, 1, block, 1), lambda ib, il, io, ia: (ib, il, ia, 0),
+                                memory_space=pltpu.VMEM)
+        return pl.BlockSpec((1, 1, block, 1), lambda ib, il, io, ia: (ib, il, io, 0),
+                            memory_space=pltpu.VMEM)
+
+    # --- dKV: grid (b, L, nj, ni); q/do/lse/delta stream over the inner i
+    # axis, kv and the output block are pinned to the outer j slot
+    in_specs = [xspec(bi, 0), xspec(bj, 1), xspec(bi, 0), sspec(bi, 0), sspec(bi, 0)]
+    operands = [x, x, do, lse, delta]
+    if has_mask:
+        in_specs.append(
+            pl.BlockSpec((bi, bj), lambda ib, il, io, ia: (ia, io), memory_space=pltpu.VMEM)
+        )
+        operands.append(mask_i8)
+    dkv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, attend_self=attend_self,
+                          block_i=bi, block_j=bj, has_mask=has_mask),
+        grid=(b, L, n // bj, n // bi),
+        in_specs=in_specs,
+        out_specs=xspec(bj, 1),
+        out_shape=jax.ShapeDtypeStruct((b, L, n, d), levels.dtype),
+        scratch_shapes=[pltpu.VMEM((bj, d), jnp.float32),
+                        pltpu.VMEM((bj, d), jnp.float32)],
+        interpret=interpret,
+    )(*operands)
+
+    # --- dQ: grid (b, L, ni, nj); kv streams over the inner j axis
+    in_specs = [xspec(bi, 1), xspec(bj, 0), xspec(bi, 1), sspec(bi, 1), sspec(bi, 1)]
+    operands = [x, x, do, lse, delta]
+    if has_mask:
+        in_specs.append(
+            pl.BlockSpec((bi, bj), lambda ib, il, io, ia: (io, ia), memory_space=pltpu.VMEM)
+        )
+        operands.append(mask_i8)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, attend_self=attend_self,
+                          block_i=bi, block_j=bj, has_mask=has_mask),
+        grid=(b, L, n // bi, n // bj),
+        in_specs=in_specs,
+        out_specs=xspec(bi, 1),
+        out_shape=jax.ShapeDtypeStruct((b, L, n, d), levels.dtype),
+        scratch_shapes=[pltpu.VMEM((bi, d), jnp.float32)],
+        interpret=interpret,
+    )(*operands)
+
+    dlevels = jnp.transpose(dq, (0, 2, 1, 3)) + jnp.transpose(dkv, (0, 2, 1, 3))
+    return dlevels.astype(levels.dtype)
 
 
 # K/V lengths above this use the flash-style blocked kernel (the one-shot
@@ -223,18 +444,27 @@ def _dispatch(levels, mask_i8, attend_self, interpret, kv_block):
     return _forward(levels, mask_i8, attend_self=attend_self, interpret=interpret)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
-def _consensus_pallas(levels, mask_i8, attend_self, interpret, kv_block):
-    return _dispatch(levels, mask_i8, attend_self, interpret, kv_block)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _consensus_pallas(levels, mask_i8, attend_self, interpret, kv_block, flash_bwd):
+    out, _ = _dispatch(levels, mask_i8, attend_self, interpret, kv_block)
+    return out
 
 
-def _fwd(levels, mask_i8, attend_self, interpret, kv_block):
-    out = _dispatch(levels, mask_i8, attend_self, interpret, kv_block)
-    return out, (levels, mask_i8)
+def _fwd(levels, mask_i8, attend_self, interpret, kv_block, flash_bwd):
+    out, lse = _dispatch(levels, mask_i8, attend_self, interpret, kv_block)
+    return out, (levels, mask_i8, out, lse)
 
 
-def _bwd(attend_self, interpret, kv_block, res, g):
-    levels, mask_i8 = res
+def _bwd(attend_self, interpret, kv_block, flash_bwd, res, g):
+    levels, mask_i8, out, lse = res
+    if flash_bwd:
+        dlevels = _backward_flash(
+            levels, mask_i8, out, lse, g, attend_self=attend_self,
+            interpret=interpret,
+        )
+        return (dlevels, None)
+    # debug fallback: cotangents via the dense XLA formulation (materializes
+    # the (n, n) similarity in HBM — kept only for A/B verification)
     mask = mask_i8.astype(bool) if mask_i8 is not None else None
     _, vjp = jax.vjp(
         lambda x: consensus_attention(x, attend_self=attend_self, non_local_mask=mask),
@@ -254,15 +484,19 @@ def consensus_attention_pallas(
     non_local_mask: Optional[jax.Array] = None,
     interpret: Optional[bool] = None,
     kv_block: Optional[int] = None,
+    flash_bwd: bool = True,
 ) -> jax.Array:
     """Drop-in for :func:`glom_tpu.ops.consensus.consensus_attention`.
 
     ``interpret=None`` auto-selects interpreter mode off-TPU (CPU tests).
     ``kv_block``: force the flash-style blocked kernel with this K/V chunk
-    length; default picks one-shot for n <= 1024 and 512-chunks beyond."""
+    length; default picks one-shot for n <= 1024 and 512-chunks beyond.
+    ``flash_bwd=False`` routes gradients through the dense XLA formulation
+    instead of the blocked backward kernels (debug/verification only)."""
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     mask_i8 = None
     if non_local_mask is not None:
         mask_i8 = non_local_mask.astype(jnp.int8)
-    return _consensus_pallas(levels, mask_i8, attend_self, interpret, kv_block)
+    return _consensus_pallas(levels, mask_i8, attend_self, interpret, kv_block,
+                             flash_bwd)

@@ -140,6 +140,7 @@ def apply(
     levels: Optional[jax.Array] = None,
     return_all: bool = False,
     consensus_fn=None,
+    ff_fn=None,
 ) -> jax.Array:
     """Forward pass.
 
@@ -153,7 +154,10 @@ def apply(
 
     ``consensus_fn`` overrides the config-resolved attention implementation —
     used by the Trainer to inject a mesh-bound ring consensus
-    (``glom_tpu.parallel.ring.make_ring_consensus``).
+    (``glom_tpu.parallel.ring.make_ring_consensus``).  ``ff_fn`` likewise
+    overrides the grouped-FF implementation — used to inject the
+    shard_map-wrapped Pallas FF
+    (``glom_tpu.parallel.ff_shard.make_sharded_ff_pallas``).
     """
     c = config
     if img.ndim != 4 or img.shape[1:] != (c.channels, c.image_size, c.image_size):
@@ -196,9 +200,11 @@ def apply(
 
     if consensus_fn is None:
         consensus_fn = make_consensus_fn(c)
+    if ff_fn is None:
+        ff_fn = make_ff_fn(c)
     step = functools.partial(
         _update_step, params, bottom_level, pos_embs, divisors, consensus_fn,
-        make_ff_fn(c),
+        ff_fn,
     )
     if c.remat:
         step = jax.checkpoint(step)

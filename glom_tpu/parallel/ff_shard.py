@@ -1,0 +1,117 @@
+"""Pallas grouped-FF composed with the device mesh via shard_map.
+
+``pallas_call`` is opaque to GSPMD: under a >1-device mesh, jitting the fused
+FF kernel directly would silently all-gather its sharded operands onto every
+device.  This module closes that hole (VERDICT r1 item 4): the kernel runs
+*inside* ``jax.shard_map``, so each device executes it on exactly its local
+shard and the only cross-device traffic is the one collective the math
+requires.
+
+Per ``TrainConfig.param_sharding`` (specs from ``glom_tpu.parallel.sharding``):
+
+  * **replicated / pure DP** — params replicated, activations sharded over
+    ``data`` (and ``seq`` when bound): kernel runs per-shard, no collectives.
+  * **tp** — the hidden dim is sharded (w1 column-, w2 row-wise).  Each
+    device computes its partial second matmul with b2 = 0 inside the kernel;
+    a single ``psum`` over the model axis completes the row-parallel matmul
+    and b2 is added once, outside the shard_map (exact — no b2/S rounding).
+  * **ep** — whole level-MLPs are sharded over the model axis together with
+    the activations' group axis; no collective at all.  A net whose group
+    count does not divide the axis (top_down with L-1 groups, say) is
+    replicated, mirroring ``level_sharded_pspecs``.
+
+The reference has no analogue (no parallelism code at all — SURVEY.md §2.3);
+this is the TPU-native composition of its ``GroupedFeedForward``
+(`glom_pytorch.py:23-36`) with tensor/expert parallelism.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from glom_tpu.kernels.ff_pallas import grouped_ff_pallas
+
+
+def make_sharded_ff_pallas(
+    mesh: Mesh,
+    *,
+    param_sharding: str = "replicated",
+    data_axis: str = "data",
+    model_axis: str = "model",
+    seq_axis: Optional[str] = None,
+    interpret: Optional[bool] = None,
+):
+    """Returns ``ff_fn(params, x)`` — drop-in for
+    :func:`glom_tpu.ops.feedforward.grouped_ff_apply` that runs the Pallas
+    kernel per mesh shard.  ``x`` is ``(b, n, g, d)``; specs must mirror the
+    Trainer's actual placements (``param_pspecs`` / ``level_sharded_pspecs``
+    + batch over ``data_axis``)."""
+    model_size = mesh.shape[model_axis]
+    use_seq = seq_axis is not None and mesh.shape.get(seq_axis, 1) > 1
+    nspec = seq_axis if use_seq else None
+
+    def kernel(p, x):
+        return grouped_ff_pallas(p, x, interpret=interpret)
+
+    def x_spec(group_axis=None):
+        return P(data_axis, nspec, group_axis, None)
+
+    rep_pspec = {"w1": P(None, None, None), "b1": P(None, None),
+                 "w2": P(None, None, None), "b2": P(None, None)}
+
+    # -- replicated params (pure DP, or the EP fallback for awkward groups)
+    run_replicated = jax.shard_map(
+        kernel, mesh=mesh, in_specs=(rep_pspec, x_spec()), out_specs=x_spec(),
+        check_vma=False,
+    )
+
+    if param_sharding == "tp":
+        tp_pspec = {"w1": P(None, None, model_axis), "b1": P(None, model_axis),
+                    "w2": P(None, model_axis, None)}
+
+        def tp_body(p, x):
+            # local partial: gelu(x @ w1_s + b1_s) @ w2_s with zero b2 —
+            # the psum over the model axis completes the row-parallel matmul
+            local = dict(p, b2=jnp.zeros(
+                (p["w1"].shape[0], p["w2"].shape[-1]), p["w2"].dtype
+            ))
+            part = kernel(local, x)
+            return jax.lax.psum(part, model_axis)
+
+        run_tp = jax.shard_map(
+            tp_body, mesh=mesh, in_specs=(tp_pspec, x_spec()),
+            out_specs=x_spec(), check_vma=False,
+        )
+
+        def ff_fn(params, x):
+            part = run_tp(
+                {k: params[k] for k in ("w1", "b1", "w2")}, x
+            )
+            return part + params["b2"]  # b2 added exactly once, replicated
+
+        return ff_fn
+
+    if param_sharding == "ep":
+        ep_pspec = {"w1": P(model_axis, None, None), "b1": P(model_axis, None),
+                    "w2": P(model_axis, None, None), "b2": P(model_axis, None)}
+        run_ep = jax.shard_map(
+            kernel, mesh=mesh, in_specs=(ep_pspec, x_spec(model_axis)),
+            out_specs=x_spec(model_axis), check_vma=False,
+        )
+
+        def ff_fn(params, x):
+            groups = params["w1"].shape[0]
+            if model_size > 1 and groups % model_size == 0:
+                return run_ep(params, x)
+            # group count not divisible (e.g. top_down's L-1): params are
+            # replicated by level_sharded_pspecs — run the DP form
+            return run_replicated(params, x)
+
+        return ff_fn
+
+    return run_replicated

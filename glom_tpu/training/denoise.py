@@ -45,7 +45,7 @@ def init_state(
     return DenoiseState(params, tx.init(params), jnp.zeros((), jnp.int32), k_train)
 
 
-def make_loss_fn(config: GlomConfig, train: TrainConfig, *, consensus_fn=None):
+def make_loss_fn(config: GlomConfig, train: TrainConfig, *, consensus_fn=None, ff_fn=None):
     """loss(params, img, rng) -> (loss, recon).  Mirrors README.md:74-88."""
     iters = train.iters if train.iters is not None else config.default_iters
     timestep = train.loss_timestep if train.loss_timestep is not None else iters // 2 + 1
@@ -68,7 +68,7 @@ def make_loss_fn(config: GlomConfig, train: TrainConfig, *, consensus_fn=None):
             noised = img + noise
         all_levels = glom_model.apply(
             params["glom"], noised, config=config, iters=iters, return_all=True,
-            consensus_fn=consensus_fn,
+            consensus_fn=consensus_fn, ff_fn=ff_fn,
         )
         tokens = all_levels[timestep, :b, :, train.loss_level]  # (b, n, d)
         recon = patches_to_images_apply(params["decoder"], tokens, config)
@@ -99,6 +99,7 @@ def make_step_fn(
     tx: optax.GradientTransformation,
     *,
     consensus_fn=None,
+    ff_fn=None,
     microbatch_sharding=None,
 ):
     """Un-jitted train step ``state, img -> state, metrics`` — the body the
@@ -110,7 +111,7 @@ def make_step_fn(
     the batch) this is numerically the full-batch step; batch-coupled terms
     (InfoNCE consistency) see per-microbatch negatives instead — documented
     semantics, not drift."""
-    loss_fn = make_loss_fn(config, train, consensus_fn=consensus_fn)
+    loss_fn = make_loss_fn(config, train, consensus_fn=consensus_fn, ff_fn=ff_fn)
     accum = train.grad_accum_steps
 
     def step_fn(state: DenoiseState, img: jax.Array) -> Tuple[DenoiseState, dict]:

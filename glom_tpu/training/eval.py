@@ -76,6 +76,7 @@ def make_psnr_fn(
     level: int = -1,
     data_range: float = 2.0,
     consensus_fn=None,
+    ff_fn=None,
 ):
     """Build the pure, jittable eval twin of the denoising objective:
     ``(params, imgs, rng) -> psnr_db`` scalar.  ``consensus_fn`` threads the
@@ -89,7 +90,7 @@ def make_psnr_fn(
         noised = imgs + jax.random.normal(rng, imgs.shape, imgs.dtype) * noise_std
         all_levels = glom_model.apply(
             params["glom"], noised, config=config, iters=iters, return_all=True,
-            consensus_fn=consensus_fn,
+            consensus_fn=consensus_fn, ff_fn=ff_fn,
         )
         recon = patches_to_images_apply(
             params["decoder"], all_levels[timestep, :, :, level], config

@@ -87,15 +87,6 @@ class Trainer:
                 f"data-axis size {self.mesh.shape[data_axis]}"
             )
 
-        if config.ff_impl == "pallas" and self.mesh.shape[model_axis] > 1 \
-                and train.param_sharding in ("tp", "ep"):
-            # pallas_call is opaque to GSPMD: model-axis-sharded FF weights
-            # would be silently all-gathered onto every device each step
-            raise ValueError(
-                "ff_impl='pallas' is incompatible with model-axis param "
-                "sharding (tp/ep) — use param_sharding='replicated' or "
-                "ff_impl='dense' when the model axis is > 1"
-            )
         if train.param_sharding == "tp":
             glom_specs = param_pspecs(config, model_axis=model_axis)
         elif train.param_sharding == "ep":
@@ -118,6 +109,22 @@ class Trainer:
             lambda: denoise.init_state(rng, config, tx), out_shardings=self._state_sh
         )
         self.state = init_fn()
+
+        ff_fn = None
+        if config.ff_impl == "pallas" and self.mesh.devices.size > 1:
+            # pallas_call is opaque to GSPMD — run the kernel inside a
+            # shard_map matching the actual param/batch placements so each
+            # device sees only its shard (TP gets the row-parallel psum)
+            from glom_tpu.parallel.ff_shard import make_sharded_ff_pallas
+
+            ff_fn = make_sharded_ff_pallas(
+                self.mesh,
+                param_sharding=train.param_sharding,
+                data_axis=data_axis,
+                model_axis=model_axis,
+                seq_axis=train.mesh_axes[2] if len(train.mesh_axes) > 2 else None,
+            )
+        self._ff_fn = ff_fn
 
         consensus_fn = None
         if config.attention_impl in ("ring", "ulysses"):
@@ -148,7 +155,7 @@ class Trainer:
                 make_psnr_fn(
                     config, noise_std=train.noise_std, iters=train.iters,
                     timestep=train.loss_timestep, level=train.loss_level,
-                    consensus_fn=consensus_fn,
+                    consensus_fn=consensus_fn, ff_fn=ff_fn,
                 )
             )
 
@@ -158,7 +165,7 @@ class Trainer:
 
         self._step = jax.jit(
             denoise.make_step_fn(
-                config, train, tx, consensus_fn=consensus_fn,
+                config, train, tx, consensus_fn=consensus_fn, ff_fn=ff_fn,
                 microbatch_sharding=micro_sh,
             ),
             in_shardings=(self._state_sh, self._batch_sh),

@@ -48,22 +48,6 @@ def test_ff_pallas_grad_matches_dense():
     )
 
 
-def test_ff_pallas_rejected_with_model_sharding():
-    """pallas_call is GSPMD-opaque: TP/EP + ff_impl='pallas' must be refused
-    instead of silently all-gathering the sharded weights."""
-    import pytest
-    from glom_tpu.config import TrainConfig
-    from glom_tpu.training.trainer import Trainer
-
-    c = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4, ff_impl="pallas")
-    t = TrainConfig(batch_size=8, iters=2, mesh_shape=(4, 2, 1))
-    with pytest.raises(ValueError, match="incompatible with model-axis"):
-        Trainer(c, t)
-    # replicated params on the same mesh are fine
-    t_ok = TrainConfig(batch_size=8, iters=2, mesh_shape=(4, 2, 1), param_sharding="replicated")
-    Trainer(c, t_ok)
-
-
 def test_model_with_pallas_ff_matches_dense():
     c_dense = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4)
     c_ff = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4, ff_impl="pallas")

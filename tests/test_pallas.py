@@ -62,13 +62,89 @@ def test_blocked_kernel_uneven_softmax_stability():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
 
 
-def test_pallas_grad_matches_dense():
+@pytest.mark.parametrize("attend_self", [False, True])
+@pytest.mark.parametrize("use_mask", [False, True])
+@pytest.mark.parametrize("kv_block", [None, 8])
+def test_pallas_flash_grad_matches_dense(attend_self, use_mask, kv_block):
+    """The blocked flash backward (dQ/dK/dV kernels) must match the dense
+    XLA cotangents for every mask configuration, on both the one-shot and
+    the j-blocked forward."""
     rng = np.random.default_rng(1)
-    levels = jnp.asarray(rng.standard_normal((1, 16, 2, 16)).astype(np.float32))
+    levels = jnp.asarray(rng.standard_normal((2, 16, 3, 32)).astype(np.float32))
+    mask = jnp.asarray(local_consensus_mask(4, 1.5)) if use_mask else None
 
+    def loss_dense(x):
+        out = consensus_attention(x, attend_self=attend_self, non_local_mask=mask)
+        return jnp.sum(out * jnp.cos(out))  # non-symmetric cotangent
+
+    def loss_pallas(x):
+        out = consensus_attention_pallas(
+            x, attend_self=attend_self, non_local_mask=mask, kv_block=kv_block
+        )
+        return jnp.sum(out * jnp.cos(out))
+
+    g_dense = jax.grad(loss_dense)(levels)
+    g_pallas = jax.grad(loss_pallas)(levels)
+    np.testing.assert_allclose(np.asarray(g_pallas), np.asarray(g_dense),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_pallas_flash_grad_odd_n():
+    """n with no multiple-of-8 divisor -> single full-n blocks everywhere;
+    the backward must still be exact."""
+    rng = np.random.default_rng(7)
+    levels = jnp.asarray(rng.standard_normal((1, 9, 2, 16)).astype(np.float32))
     g_dense = jax.grad(lambda x: jnp.sum(consensus_attention(x) ** 2))(levels)
-    g_pallas = jax.grad(lambda x: jnp.sum(consensus_attention_pallas(x) ** 2))(levels)
+    g_pallas = jax.grad(
+        lambda x: jnp.sum(consensus_attention_pallas(x) ** 2)
+    )(levels)
     np.testing.assert_allclose(np.asarray(g_pallas), np.asarray(g_dense), atol=1e-5)
+
+
+def test_flash_bwd_flag_dense_fallback_matches():
+    """flash_bwd=False (debug path) and the default flash backward agree."""
+    rng = np.random.default_rng(2)
+    levels = jnp.asarray(rng.standard_normal((1, 16, 2, 16)).astype(np.float32))
+    g_flash = jax.grad(lambda x: jnp.sum(consensus_attention_pallas(x) ** 2))(levels)
+    g_dense = jax.grad(
+        lambda x: jnp.sum(consensus_attention_pallas(x, flash_bwd=False) ** 2)
+    )(levels)
+    np.testing.assert_allclose(np.asarray(g_flash), np.asarray(g_dense), atol=1e-5)
+
+
+def test_no_nxn_tensor_in_train_hlo():
+    """VERDICT r1 item 3 'done' check: a jitted value_and_grad over the
+    pallas consensus must contain NO (n, n)-shaped tensor — forward OR
+    backward — while the dense path provably does (sanity leg)."""
+    n = 576  # large-config patch count; appears nowhere else in the shapes
+    rng = np.random.default_rng(3)
+    levels = jnp.asarray(rng.standard_normal((1, n, 1, 8)).astype(np.float32))
+
+    def make_loss(fn):
+        return lambda x: jnp.sum(fn(x) ** 2)
+
+    hlo_pallas = (
+        jax.jit(jax.value_and_grad(make_loss(
+            lambda x: consensus_attention_pallas(x, kv_block=192)
+        )))
+        .lower(levels).compile().as_text()
+    )
+    hlo_dense = (
+        jax.jit(jax.value_and_grad(make_loss(consensus_attention)))
+        .lower(levels).compile().as_text()
+    )
+    assert f"{n},{n}" in hlo_dense          # the einsum path materializes n^2
+    assert f"{n},{n}" not in hlo_pallas     # flash fwd+bwd never does
+
+
+def test_blocked_awkward_n_degrades_to_one_shot():
+    """kv_block on an n with no usable divisor must fall back to the
+    one-shot kernel instead of raising (VERDICT r1 item 7)."""
+    rng = np.random.default_rng(8)
+    levels = jnp.asarray(rng.standard_normal((1, 9, 2, 16)).astype(np.float32))
+    want = consensus_attention(levels)
+    got = consensus_attention_pallas(levels, kv_block=8)  # 9 has no 8-divisor
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
 def test_model_with_pallas_attention_matches_dense():

@@ -220,6 +220,40 @@ def test_grad_accum_validation():
         TrainConfig(grad_accum_steps=0)
 
 
+@pytest.mark.parametrize(
+    "sharding,mesh_shape",
+    [("replicated", (8, 1, 1)), ("tp", (2, 4, 1)), ("ep", (4, 2, 1))],
+)
+def test_pallas_ff_composes_with_mesh_sharding(sharding, mesh_shape):
+    """VERDICT r1 item 4: ff_impl='pallas' must compose with DP/TP/EP param
+    sharding (kernel wrapped in shard_map; TP adds the row-parallel psum) and
+    match the dense single-mesh step numerically."""
+    c_dense = GlomConfig(dim=16, levels=4, image_size=16, patch_size=4)
+    c_pallas = GlomConfig(dim=16, levels=4, image_size=16, patch_size=4,
+                          ff_impl="pallas")
+    t_dense = TrainConfig(batch_size=8, learning_rate=1e-3, iters=2,
+                          donate=False, mesh_shape=(8, 1, 1))
+    t_pallas = TrainConfig(batch_size=8, learning_rate=1e-3, iters=2,
+                           donate=False, mesh_shape=mesh_shape,
+                           param_sharding=sharding)
+    tr_d, tr_p = Trainer(c_dense, t_dense), Trainer(c_pallas, t_pallas)
+    rng = np.random.default_rng(4)
+    s_d, s_p = tr_d.state, tr_p.state
+    for _ in range(2):
+        img = rng.standard_normal((8, 3, 16, 16)).astype(np.float32)
+        s_d, m_d = tr_d._step(s_d, jax.device_put(img, tr_d._batch_sh))
+        s_p, m_p = tr_p._step(s_p, jax.device_put(img, tr_p._batch_sh))
+    np.testing.assert_allclose(float(m_p["loss"]), float(m_d["loss"]), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5),
+        jax.device_get(s_p.params),
+        jax.device_get(s_d.params),
+    )
+    if sharding == "tp":
+        # FF hidden really is model-sharded under the pallas kernel
+        assert s_p.params["glom"]["bottom_up"]["w1"].sharding.spec[2] == "model"
+
+
 def test_ep_sharding_matches_dp():
     """Expert/level-sharded params (L=4 bottom_up over model=2, coprime L-1=3
     top_down replicated) match the pure-DP step numerically."""
