@@ -223,6 +223,28 @@ class Prefetcher:
         return item
 
 
+class _StatefulAugmented:
+    """Augmentation wrapper that forwards the inner stream's resume cursor.
+    Only the iteration position is exact across resume; the augmentation RNG
+    restarts (stochastic augmentation needs no exact replay)."""
+
+    def __init__(self, inner, kind: str, seed: int):
+        self._inner = inner
+        self._it = augmented(inner, kind, seed)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._it)
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def load_state_dict(self, state):
+        self._inner.load_state_dict(state)
+
+
 def make_batches(
     kind: str,
     batch_size: int,
@@ -239,6 +261,20 @@ def make_batches(
         if data_dir is None:
             raise ValueError("folder data source needs data_dir")
         it = folder_batches(data_dir, batch_size, image_size, channels, seed)
+    elif kind == "images":
+        from glom_tpu.training.image_stream import ImageFolderStream
+
+        if data_dir is None:
+            raise ValueError("images data source needs data_dir")
+        stream = ImageFolderStream(
+            data_dir, batch_size, image_size, channels=channels, seed=seed,
+            prefetch=max(prefetch, 1),
+        )
+        # internal per-file prefetch + a resumable cursor: no Prefetcher wrap
+        # (its read-ahead would desynchronize state_dict from the consumer)
+        if augment == "none":
+            return stream
+        return _StatefulAugmented(stream, augment, seed)
     else:
         raise ValueError(f"unknown data source {kind!r}")
     it = augmented(it, augment, seed)

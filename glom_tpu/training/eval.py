@@ -13,6 +13,7 @@ standard probes for "did the SSL objective learn anything":
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple
 
 import jax
@@ -31,11 +32,13 @@ def embed(
     iters: Optional[int] = None,
     level: int = -1,
     consensus_fn=None,
+    ff_fn=None,
 ) -> jax.Array:
     """``(b, c, H, W) -> (b, d)`` mean-pooled final-state embeddings at
     ``level``."""
     out = glom_model.apply(
-        params, imgs, config=config, iters=iters, consensus_fn=consensus_fn
+        params, imgs, config=config, iters=iters, consensus_fn=consensus_fn,
+        ff_fn=ff_fn,
     )
     return jnp.mean(out[:, :, level], axis=1)
 
@@ -99,6 +102,120 @@ def make_psnr_fn(
         return 20.0 * jnp.log10(data_range) - 10.0 * jnp.log10(mse)
 
     return psnr_fn
+
+
+class EvalSuite:
+    """Held-out evaluation bundle for the Trainer (VERDICT r1 item 6).
+
+    Wraps a FIXED set of images (never seen by the train step) and runs, at
+    each eval point:
+
+      * denoising PSNR on the held-out images (same objective as training,
+        fresh noise per call from the caller's rng), and
+      * a linear probe on frozen pooled embeddings when labels are given:
+        ridge-fit on the probe-train half, accuracy reported on the
+        probe-test half — the standard "did SSL learn anything" measure
+        (the reference's island/clustering discussion,
+        `/root/reference/README.md:34-36`, is the motivation).
+
+    Forward functions are jitted once; embeddings run in fixed-size chunks
+    so arbitrarily large eval sets never blow device memory or recompile.
+    """
+
+    def __init__(
+        self,
+        config: GlomConfig,
+        psnr_images,
+        *,
+        probe_images=None,
+        probe_labels=None,
+        num_classes: Optional[int] = None,
+        probe_train_fraction: float = 0.5,
+        noise_std: float = 1.0,
+        iters: Optional[int] = None,
+        timestep: Optional[int] = None,
+        level: int = -1,
+        chunk: int = 32,
+        consensus_fn=None,
+        ff_fn=None,
+    ):
+        import numpy as np
+
+        self.config = config
+        self.psnr_images = np.asarray(psnr_images, np.float32)
+        self.chunk = min(chunk, len(self.psnr_images))
+        self._psnr = jax.jit(make_psnr_fn(
+            config, noise_std=noise_std, iters=iters, timestep=timestep,
+            level=level, consensus_fn=consensus_fn, ff_fn=ff_fn,
+        ))
+        self._embed = jax.jit(functools.partial(
+            embed, config=config, iters=iters, level=level,
+            consensus_fn=consensus_fn, ff_fn=ff_fn,
+        ))
+
+        self.probe_images = None
+        if probe_images is not None:
+            if probe_labels is None:
+                raise ValueError("probe_images needs probe_labels")
+            imgs = np.asarray(probe_images, np.float32)
+            labels = np.asarray(probe_labels)
+            if num_classes is None:
+                num_classes = int(labels.max()) + 1
+            # deterministic stratification-free split: interleave so both
+            # halves see every class with high probability
+            n_train = max(1, int(len(imgs) * probe_train_fraction))
+            self.probe_images = imgs
+            self.probe_labels = labels
+            self._probe_split = n_train
+            self.num_classes = num_classes
+
+    def _chunked_embed(self, params, imgs):
+        import numpy as np
+
+        outs = []
+        n = (len(imgs) // self.chunk) * self.chunk
+        for i in range(0, n, self.chunk):
+            outs.append(np.asarray(self._embed(params, imgs[i:i + self.chunk])))
+        return np.concatenate(outs), n
+
+    def run(self, params: dict, rng: jax.Array) -> dict:
+        """``{"eval_psnr_db": ..., ("probe_train_acc", "probe_test_acc")}``
+        — all on data the train step has never consumed."""
+        import numpy as np
+
+        psnrs = []
+        n = (len(self.psnr_images) // self.chunk) * self.chunk
+        for i in range(0, n, self.chunk):
+            key = jax.random.fold_in(rng, i)
+            psnrs.append(float(self._psnr(params, self.psnr_images[i:i + self.chunk], key)))
+        metrics = {"eval_psnr_db": float(np.mean(psnrs))}
+
+        if self.probe_images is not None:
+            feats, n_used = self._chunked_embed(params["glom"], self.probe_images)
+            labels = self.probe_labels[:n_used]
+            k = min(self._probe_split, n_used - 1)
+            tr_acc, te_acc = linear_probe(
+                jnp.asarray(feats[:k]), jnp.asarray(labels[:k]),
+                jnp.asarray(feats[k:]), jnp.asarray(labels[k:]),
+                num_classes=self.num_classes,
+            )
+            metrics["probe_train_acc"] = tr_acc
+            metrics["probe_test_acc"] = te_acc
+        return metrics
+
+
+def holdout_split(files, fraction: float, *, seed: int = 0):
+    """Deterministic (train_files, eval_files) split of a file list —
+    eval files never enter the training stream."""
+    import numpy as np
+
+    files = list(files)
+    n_eval = max(1, int(len(files) * fraction))
+    perm = np.random.default_rng((seed, 0xE7A1)).permutation(len(files))
+    eval_idx = set(perm[:n_eval].tolist())
+    train = [f for i, f in enumerate(files) if i not in eval_idx]
+    evals = [f for i, f in enumerate(files) if i in eval_idx]
+    return train, evals
 
 
 def reconstruction_psnr(

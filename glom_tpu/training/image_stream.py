@@ -1,0 +1,208 @@
+"""ImageNet-scale streaming loader: a folder tree of JPEG/PNG files.
+
+The reference trains on ``torch.randn`` images and ships no data pipeline at
+all; this is the framework-owned loader for real image datasets (SURVEY.md
+§7 step 3, BASELINE config 3).  Design:
+
+  * **Sharded reads** — each process sees ``files[process_index::count]``;
+    no coordination, no overlap, works for any process count.
+  * **Deterministic + exactly resumable** — iteration order is a pure
+    function of ``(seed, epoch)`` (one permutation per epoch) and a position
+    cursor.  ``state_dict()`` is two integers; restoring them resumes the
+    stream on the exact next batch, including across process restarts.  The
+    cursor snapshots account for in-flight prefetched batches, so what you
+    checkpoint is the next batch the *consumer* would have seen, not the
+    producer's read-ahead.
+  * **Overlapped decode** — a thread pool decodes each batch's files in
+    parallel (cv2 if present, else PIL; both release the GIL in the codec)
+    and ``prefetch`` whole batches are kept in flight ahead of the consumer,
+    so host decode overlaps device compute without a separate DataLoader
+    process tree.
+  * **Static shapes** — shorter-side resize + center crop to
+    ``image_size``²; partial trailing batches are dropped (epoch boundary),
+    keeping every batch ``(B, C, S, S)`` so jit never recompiles.
+
+Batches are NCHW float32 in [-1, 1], matching the rest of the pipeline
+(``glom_tpu.training.data``).
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional, Sequence
+
+import numpy as np
+
+IMAGE_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".webp")
+
+
+def list_image_files(root: str) -> list:
+    """Recursive, sorted scan — the sort makes the file index stable across
+    processes and restarts (the shard + shuffle math depends on it)."""
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for f in sorted(filenames):
+            if f.lower().endswith(IMAGE_EXTENSIONS):
+                out.append(os.path.join(dirpath, f))
+    return out
+
+
+def _decode(path: str, image_size: int, channels: int) -> np.ndarray:
+    """Decode + shorter-side resize + center crop -> (C, S, S) float32 in
+    [-1, 1]."""
+    try:
+        import cv2
+
+        img = cv2.imread(path, cv2.IMREAD_COLOR)  # BGR uint8, HWC
+        if img is None:
+            raise ValueError(f"undecodable image: {path}")
+        h, w = img.shape[:2]
+        scale = image_size / min(h, w)
+        if scale != 1.0:
+            img = cv2.resize(
+                img, (max(image_size, round(w * scale)),
+                      max(image_size, round(h * scale))),
+                interpolation=cv2.INTER_AREA if scale < 1.0 else cv2.INTER_LINEAR,
+            )
+        img = img[:, :, ::-1]  # BGR -> RGB
+    except ImportError:
+        from PIL import Image
+
+        with Image.open(path) as im:
+            im = im.convert("RGB")
+            w, h = im.size
+            scale = image_size / min(h, w)
+            if scale != 1.0:
+                im = im.resize(
+                    (max(image_size, round(w * scale)), max(image_size, round(h * scale)))
+                )
+            img = np.asarray(im)
+    h, w = img.shape[:2]
+    y0, x0 = (h - image_size) // 2, (w - image_size) // 2
+    img = img[y0:y0 + image_size, x0:x0 + image_size]
+    arr = np.ascontiguousarray(img.transpose(2, 0, 1), dtype=np.float32)
+    arr = arr / 127.5 - 1.0
+    if channels != 3:
+        raise ValueError(f"image stream decodes RGB (3 channels), model wants {channels}")
+    return arr
+
+
+def labels_from_paths(files) -> "tuple[np.ndarray, list]":
+    """Class labels from the standard ImageFolder layout (label = immediate
+    parent directory name).  Returns ``(labels int64, class_names)``."""
+    parents = [os.path.basename(os.path.dirname(f)) for f in files]
+    names = sorted(set(parents))
+    index = {n: i for i, n in enumerate(names)}
+    return np.asarray([index[p] for p in parents], np.int64), names
+
+
+def load_images(files, image_size: int, *, channels: int = 3, workers: int = 8) -> np.ndarray:
+    """Decode a fixed file list into one ``(N, C, S, S)`` float32 array
+    (eval sets — bounded, held in host RAM)."""
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        parts = list(pool.map(lambda p: _decode(p, image_size, channels), files))
+    return np.stack(parts)
+
+
+class ImageFolderStream:
+    """Endless batch iterator over a folder tree of images.
+
+    ``state_dict()``/``load_state_dict()`` capture/restore the iteration
+    cursor; the Trainer checkpoints them alongside the training state so a
+    resumed run continues mid-epoch on the exact next batch.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        batch_size: int,
+        image_size: int,
+        *,
+        channels: int = 3,
+        seed: int = 0,
+        shuffle: bool = True,
+        process_index: Optional[int] = None,
+        process_count: Optional[int] = None,
+        workers: int = 8,
+        prefetch: int = 4,
+        files: Optional[Sequence[str]] = None,
+    ):
+        if process_index is None or process_count is None:
+            import jax
+
+            process_index = jax.process_index()
+            process_count = jax.process_count()
+        all_files = list(files) if files is not None else list_image_files(root)
+        if not all_files:
+            raise FileNotFoundError(f"no image files under {root}")
+        self.files = all_files[process_index::process_count]
+        if len(self.files) < batch_size:
+            raise ValueError(
+                f"process shard has {len(self.files)} images < batch_size "
+                f"{batch_size} (dataset {len(all_files)} files over "
+                f"{process_count} processes)"
+            )
+        self.batch_size = batch_size
+        self.image_size = image_size
+        self.channels = channels
+        self.seed = seed
+        self.shuffle = shuffle
+        self._epoch = 0
+        self._pos = 0
+        self._perm = self._epoch_perm(0)
+        self._pool = ThreadPoolExecutor(max_workers=workers)
+        self._prefetch = max(1, prefetch)
+        self._pending: deque = deque()  # (state_before, future)
+
+    # -- determinism / resume --------------------------------------------
+    def _epoch_perm(self, epoch: int) -> np.ndarray:
+        if not self.shuffle:
+            return np.arange(len(self.files))
+        return np.random.default_rng((self.seed, epoch)).permutation(len(self.files))
+
+    def state_dict(self) -> dict:
+        """Cursor of the next batch the CONSUMER will receive (in-flight
+        prefetched batches belong to the future, so the first pending
+        entry's pre-state is the resume point)."""
+        if self._pending:
+            epoch, pos = self._pending[0][0]
+        else:
+            epoch, pos = self._epoch, self._pos
+        return {"epoch": epoch, "pos": pos}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._epoch = int(state["epoch"])
+        self._pos = int(state["pos"])
+        self._perm = self._epoch_perm(self._epoch)
+        self._pending.clear()  # drop read-ahead from the pre-restore cursor
+
+    # -- iteration --------------------------------------------------------
+    def _advance(self):
+        """Claim the next batch's paths at the producer cursor."""
+        if self._pos + self.batch_size > len(self.files):
+            self._epoch += 1
+            self._pos = 0
+            self._perm = self._epoch_perm(self._epoch)
+        state = (self._epoch, self._pos)
+        idx = self._perm[self._pos:self._pos + self.batch_size]
+        self._pos += self.batch_size
+        return state, [self.files[i] for i in idx]
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> np.ndarray:
+        while len(self._pending) < self._prefetch:
+            state, paths = self._advance()
+            # per-file futures (not a nested batch task): a batch-level task
+            # blocking on decodes in the same pool could deadlock it
+            futs = [
+                self._pool.submit(_decode, p, self.image_size, self.channels)
+                for p in paths
+            ]
+            self._pending.append((state, futs))
+        _, futs = self._pending.popleft()
+        return np.stack([f.result() for f in futs])

@@ -50,9 +50,18 @@ def parse_args(argv=None):
     p.add_argument("--consistency-temperature", type=float, default=0.1)
     p.add_argument("--consistency-level", type=int, default=-1)
     # data
-    p.add_argument("--data", default="synthetic", choices=["synthetic", "folder"])
+    p.add_argument("--data", default="synthetic",
+                   choices=["synthetic", "folder", "images"],
+                   help="synthetic randn stream, .npy/.npz folder, or a "
+                        "JPEG/PNG folder tree (sharded, resumable stream)")
     p.add_argument("--data-dir", default=None)
     p.add_argument("--augment", default="none", choices=list(AUGMENT_KINDS))
+    p.add_argument("--eval-holdout", type=float, default=0.02,
+                   help="(images + --eval-every) fraction of files held out "
+                        "of training for the eval suite")
+    p.add_argument("--probe-examples", type=int, default=256,
+                   help="held-out labeled examples for the linear probe "
+                        "(0 disables the probe)")
     # parallelism
     p.add_argument("--mesh", type=int, nargs="+", default=None,
                    help="mesh shape over (data, model, seq); default: all-data")
@@ -115,12 +124,53 @@ def main(argv=None):
         param_sharding=args.param_sharding,
     )
 
+    if args.data == "images" and args.eval_every:
+        # carve a held-out split BEFORE the training stream exists, so eval
+        # images never enter the step function (VERDICT r1 item 6)
+        from glom_tpu.training.data import _StatefulAugmented
+        from glom_tpu.training.eval import EvalSuite, holdout_split
+        from glom_tpu.training.image_stream import (
+            ImageFolderStream, labels_from_paths, list_image_files, load_images,
+        )
+
+        train_files, eval_files = holdout_split(
+            list_image_files(args.data_dir), args.eval_holdout, seed=args.seed
+        )
+        eval_imgs = load_images(eval_files, args.image_size)
+        probe_kwargs = {}
+        if args.probe_examples:
+            probe_files = eval_files[:args.probe_examples]
+            labels, names = labels_from_paths(probe_files)
+            if len(names) > 1:
+                probe_kwargs = dict(
+                    probe_images=eval_imgs[:args.probe_examples],
+                    probe_labels=labels, num_classes=len(names),
+                )
+        eval_data = (eval_imgs, probe_kwargs)
+        batches = ImageFolderStream(
+            args.data_dir, args.batch_size, args.image_size,
+            channels=config.channels, seed=args.seed, files=train_files,
+        )
+        if args.augment != "none":
+            batches = _StatefulAugmented(batches, args.augment, args.seed)
+    else:
+        eval_data = None
+        batches = make_batches(
+            args.data, args.batch_size, args.image_size,
+            config.channels, args.seed, args.data_dir,
+            augment=args.augment,
+        )
     trainer = Trainer(config, train_cfg, logger=MetricLogger(path=args.log_file))
-    batches = make_batches(
-        args.data, args.batch_size, args.image_size,
-        config.channels, args.seed, args.data_dir,
-        augment=args.augment,
-    )
+    if eval_data is not None:
+        # built after the Trainer so the suite shares its mesh-bound
+        # consensus/FF implementations (ring/ulysses/sharded-pallas)
+        eval_imgs, probe_kwargs = eval_data
+        trainer.set_eval_suite(EvalSuite(
+            config, eval_imgs, noise_std=args.noise_std, iters=args.iters,
+            chunk=min(args.batch_size, len(eval_imgs)),
+            consensus_fn=trainer._consensus_fn, ff_fn=trainer._ff_fn,
+            **probe_kwargs,
+        ))
     final = trainer.fit(batches)
     if jax.process_index() == 0:
         print({"final": final})

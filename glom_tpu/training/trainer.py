@@ -54,6 +54,7 @@ class Trainer:
         mesh: Optional[Mesh] = None,
         tx: Optional[optax.GradientTransformation] = None,
         logger: Optional[MetricLogger] = None,
+        eval_suite=None,
     ):
         self.config = config
         self.train_cfg = train
@@ -146,9 +147,11 @@ class Trainer:
                 data_axis=data_axis,
                 seq_axis=train.mesh_axes[2],
             )
+        self._consensus_fn = consensus_fn
 
+        self._eval_suite = eval_suite
         self._eval = None
-        if train.eval_every:
+        if train.eval_every and eval_suite is None:
             from glom_tpu.training.eval import make_psnr_fn
 
             self._eval = jax.jit(
@@ -173,8 +176,14 @@ class Trainer:
             donate_argnums=(0,) if train.donate else (),
         )
 
+    def set_eval_suite(self, suite) -> None:
+        """Attach/replace the held-out eval suite after construction (the
+        CLI builds the suite with this trainer's mesh-bound consensus/FF
+        fns, which only exist once the trainer does)."""
+        self._eval_suite = suite
+
     # -- checkpointing ----------------------------------------------------
-    def save(self, directory: str) -> str:
+    def save(self, directory: str, *, data_state: Optional[dict] = None) -> str:
         if jax.process_count() > 1:
             # sharded leaves may span non-addressable devices: replicate
             # across the mesh, then read locally (cached jit per mesh)
@@ -183,18 +192,23 @@ class Trainer:
             host_state = denoise.DenoiseState(*gather_to_host(tuple(self.state), self.mesh))
         else:
             host_state = jax.device_get(self.state)
+        trees = {"params": host_state.params, "opt": host_state.opt_state, "rng": host_state.rng}
+        if data_state is not None:
+            trees["data"] = data_state
         return ckpt_lib.save(
             directory,
             int(host_state.step),
-            {"params": host_state.params, "opt": host_state.opt_state, "rng": host_state.rng},
+            trees,
             backend=self.train_cfg.checkpoint_backend,
         )
 
-    def restore(self, directory: str) -> int:
+    def restore(self, directory: str, *, batches=None) -> int:
         """Restore params, optimizer state AND the training RNG, so a resumed
-        run continues the noise-key sequence instead of replaying it.  (The
-        data iterator position is the caller's concern — synthetic streams
-        are stateless; folder streams reshuffle.)"""
+        run continues the noise-key sequence instead of replaying it.  When
+        ``batches`` exposes ``state_dict``/``load_state_dict`` (the
+        ``ImageFolderStream`` contract) its cursor is restored too, so the
+        stream resumes on the exact next batch; stateless synthetic/folder
+        streams are unaffected."""
         step, trees = ckpt_lib.restore(
             directory,
             {"params": self.state.params, "opt": self.state.opt_state, "rng": self.state.rng},
@@ -202,6 +216,22 @@ class Trainer:
         self.state = denoise.DenoiseState(
             trees["params"], trees["opt"], jnp.asarray(step, jnp.int32), trees["rng"]
         )
+        if batches is not None and hasattr(batches, "load_state_dict"):
+            try:
+                _, data_trees = ckpt_lib.restore(
+                    directory, {"data": batches.state_dict()}, step=step
+                )
+                batches.load_state_dict(
+                    {k: int(v) for k, v in data_trees["data"].items()}
+                )
+            except KeyError:
+                import warnings
+
+                warnings.warn(
+                    f"checkpoint step {step} carries no data-iterator state; "
+                    "the stream restarts from its initial cursor",
+                    stacklevel=2,
+                )
         return step
 
     # -- loop -------------------------------------------------------------
@@ -220,8 +250,9 @@ class Trainer:
                 "lr=0; set TrainConfig.steps to the full run length",
                 stacklevel=2,
             )
+        stateful_stream = hasattr(batches, "state_dict")
         if cfg.checkpoint_dir and ckpt_lib.latest_step(cfg.checkpoint_dir) is not None:
-            resumed = self.restore(cfg.checkpoint_dir)
+            resumed = self.restore(cfg.checkpoint_dir, batches=batches)
             self.logger.log(resumed, event=1.0)  # resume marker
         last_metrics = {}
         last_saved = -1
@@ -243,13 +274,22 @@ class Trainer:
                     profiling = False
             img = next(batches)
             img = jax.device_put(img, self._batch_sh)
-            if self._eval is not None and (i + 1) % cfg.eval_every == 0:
-                # evaluate BEFORE the step consumes this batch, so the PSNR
-                # reflects params that have not trained on these images
-                psnr = self._eval(
-                    self.state.params, img, jax.random.PRNGKey(cfg.seed + i)
-                )
-                self.logger.log(i + 1, psnr_db=float(jax.device_get(psnr)))
+            if cfg.eval_every and (i + 1) % cfg.eval_every == 0:
+                if self._eval_suite is not None:
+                    # held-out evaluation: PSNR + linear probe on data the
+                    # step function NEVER consumes
+                    ev = self._eval_suite.run(
+                        self.state.params, jax.random.PRNGKey(cfg.seed + i)
+                    )
+                    self.logger.log(i + 1, **ev)
+                elif self._eval is not None:
+                    # legacy fallback (no suite given): evaluate BEFORE the
+                    # step consumes this batch, so the PSNR reflects params
+                    # that have not trained on these images
+                    psnr = self._eval(
+                        self.state.params, img, jax.random.PRNGKey(cfg.seed + i)
+                    )
+                    self.logger.log(i + 1, psnr_db=float(jax.device_get(psnr)))
             self.state, metrics = self._step(self.state, img)
             window_imgs += img.shape[0]
             if cfg.log_every and (i + 1) % cfg.log_every == 0:
@@ -268,11 +308,17 @@ class Trainer:
                 and cfg.checkpoint_dir
                 and (i + 1) % cfg.checkpoint_every == 0
             ):
-                self.save(cfg.checkpoint_dir)
+                self.save(
+                    cfg.checkpoint_dir,
+                    data_state=batches.state_dict() if stateful_stream else None,
+                )
                 last_saved = i + 1
         jax.block_until_ready(self.state.params)
         if profiling:
             jax.profiler.stop_trace()
         if cfg.checkpoint_dir and cfg.checkpoint_every and last_saved != steps and start_step < steps:
-            self.save(cfg.checkpoint_dir)
+            self.save(
+                cfg.checkpoint_dir,
+                data_state=batches.state_dict() if stateful_stream else None,
+            )
         return last_metrics

@@ -64,3 +64,42 @@ def test_shim_state_dict_reference_layout():
     sd = m.state_dict()
     assert "image_to_tokens.1.weight" in sd
     assert sd["bottom_up.net.1.weight"].shape == (3 * 64, 16, 1)
+
+
+def test_cli_images_with_heldout_eval(tmp_path, capsys):
+    """End-to-end CLI: JPEG-folder stream + held-out eval suite (PSNR +
+    linear probe) + stream-cursor checkpointing."""
+    import json
+
+    from glom_tpu.training.train import main
+
+    rng = np.random.default_rng(0)
+    for i in range(40):
+        sub = tmp_path / "data" / f"class_{i % 2}"
+        sub.mkdir(parents=True, exist_ok=True)
+        arr = rng.integers(0, 256, (20, 20, 3), dtype=np.uint8)
+        arr[:, :, 0] = (i % 2) * 255  # class-coded red channel
+        try:
+            import cv2
+            cv2.imwrite(str(sub / f"i{i:03d}.png"), arr[:, :, ::-1])
+        except ImportError:
+            from PIL import Image
+            Image.fromarray(arr).save(str(sub / f"i{i:03d}.png"))
+
+    log = tmp_path / "log.jsonl"
+    main([
+        "--dim", "16", "--levels", "3", "--image-size", "16", "--patch-size", "4",
+        "--data", "images", "--data-dir", str(tmp_path / "data"),
+        "--batch-size", "8", "--steps", "2", "--iters", "2",
+        "--eval-every", "1", "--eval-holdout", "0.25", "--probe-examples", "8",
+        "--log-every", "1", "--log-file", str(log),
+        "--checkpoint-dir", str(tmp_path / "ck"), "--checkpoint-every", "2",
+    ])
+    rows = [json.loads(l) for l in open(log)]
+    assert any("probe_test_acc" in r for r in rows)
+    assert any("eval_psnr_db" in r for r in rows)
+    # stream cursor landed in the checkpoint
+    import numpy as _np
+    ck = [f for f in (tmp_path / "ck").iterdir() if f.suffix == ".npz"]
+    keys = _np.load(str(ck[0])).files
+    assert "data/epoch" in keys and "data/pos" in keys
