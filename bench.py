@@ -32,6 +32,7 @@ def main():
     p.add_argument("--fp32", action="store_true", help="disable bf16 compute")
     p.add_argument("--no-remat", action="store_true",
                    help="disable scan-body rematerialization (needs small batch)")
+    p.add_argument("--remat-policy", default="full", choices=["full", "dots"])
     p.add_argument("--attention-impl", default="dense", choices=["dense", "pallas", "ring", "ulysses"])
     p.add_argument("--ff-impl", default="dense", choices=["dense", "pallas"])
     p.add_argument("--device-probe-timeout", type=int, default=180,
@@ -92,6 +93,7 @@ def main():
     config = GlomConfig(
         compute_dtype=jnp.float32 if args.fp32 else jnp.bfloat16,
         remat=not args.no_remat,
+        remat_policy=args.remat_policy,
         attention_impl=args.attention_impl,
         ff_impl=args.ff_impl,
         **model_kwargs,

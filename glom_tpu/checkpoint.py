@@ -110,12 +110,14 @@ def save(
         arrays = _flatten_named(trees)
         path = _atomic_write(directory, f"ckpt_{step}.npz", lambda f: np.savez(f, **arrays))
 
-    # one artifact per step: replace the other backend's same-step artifact
+    # one artifact per step: replace the other backends' same-step artifacts
     other = _orbax_path(directory, step) if backend == "npz" else _npz_path(directory, step)
     if os.path.isdir(other):
         shutil.rmtree(other, ignore_errors=True)
     elif os.path.exists(other):
         os.remove(other)
+    for stale_shard in _shard_paths(directory, step):
+        os.remove(stale_shard)
 
     _atomic_write(
         directory,
@@ -126,11 +128,152 @@ def save(
     return path
 
 
+_INDEX_KEY = "__shard_index__"
+
+
+def _shard_paths(directory: str, step: int) -> list:
+    import glob as _glob
+
+    return sorted(_glob.glob(os.path.join(directory, f"ckpt_{step}.shard*of*.npz")))
+
+
+def save_sharded(
+    directory: str, step: int, trees: Dict[str, Any], *, keep: int = 3,
+    per_process: Tuple[str, ...] = (),
+) -> str:
+    """Per-process shard writes (VERDICT r1 item 8): every process writes
+    ONLY the replica-0 addressable shards of each leaf — no host gather, no
+    cross-host traffic, O(local bytes) per process.  Slice indices + global
+    shapes travel inside each artifact under ``__shard_index__``; the
+    replica-0 shards across all processes tile every array exactly once.
+    Process 0 writes the atomic manifest after a cross-process barrier, so a
+    manifest never points at a half-written step.  Requires the checkpoint
+    directory to be on a filesystem all hosts can read at restore time (the
+    standard arrangement).
+
+    Tree names in ``per_process`` hold host-side state that differs PER
+    PROCESS (e.g. each process's data-stream cursor): every process writes
+    its own copy under ``<name>@p<i>`` and restores its own at load time."""
+    os.makedirs(directory, exist_ok=True)
+    pi, pc = jax.process_index(), jax.process_count()
+
+    arrays: dict = {}
+    index: dict = {}
+    for name, tree in trees.items():
+        if tree is None:
+            continue
+        store_name = f"{name}@p{pi}" if name in per_process else name
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            key = _SEP.join(_entry_str(p) for p in path)
+            key = f"{store_name}{_SEP}{key}" if key else store_name
+            if name in per_process:
+                arrays[key] = np.asarray(leaf)
+                index[key] = {"key": key, "shape": None, "start": None}
+            elif isinstance(leaf, jax.Array):
+                for i, s in enumerate(leaf.addressable_shards):
+                    if s.replica_id != 0:
+                        continue  # exactly one global copy of each tile
+                    k = f"{key}#{i}"
+                    arrays[k] = np.asarray(s.data)
+                    index[k] = {
+                        "key": key,
+                        "shape": list(leaf.shape),
+                        "start": [sl.start or 0 for sl in s.index],
+                    }
+            elif pi == 0:  # host-side leaves (ints, np arrays): leader only
+                arrays[key] = np.asarray(leaf)
+                index[key] = {"key": key, "shape": None, "start": None}
+    arrays[_INDEX_KEY] = np.frombuffer(json.dumps(index).encode(), np.uint8)
+
+    path = _atomic_write(
+        directory, f"ckpt_{step}.shard{pi}of{pc}.npz", lambda f: np.savez(f, **arrays)
+    )
+    if pc > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"glom_tpu_ckpt_{step}")
+    if pi != 0:
+        return ""
+
+    # one artifact set per step: drop other backends' same-step artifacts
+    # AND shard files from a previous run with a different process count (a
+    # crash between shard writes and manifest can strand them; mixing two
+    # tilings at restore would silently blend two training states)
+    for stale in (_npz_path(directory, step), _orbax_path(directory, step)):
+        if os.path.isdir(stale):
+            shutil.rmtree(stale, ignore_errors=True)
+        elif os.path.exists(stale):
+            os.remove(stale)
+    for shard in _shard_paths(directory, step):
+        if not shard.endswith(f"of{pc}.npz"):
+            os.remove(shard)
+
+    _atomic_write(
+        directory,
+        "manifest.json",
+        lambda f: f.write(json.dumps(
+            {"latest_step": step, "path": path, "shard_count": pc}
+        ).encode()),
+    )
+    _prune(directory, keep, protect=step)
+    return path
+
+
+def _load_sharded_arrays(paths) -> dict:
+    """Reassemble the flat array dict from every per-process shard file."""
+    # refuse mixed tilings: all files must come from ONE save (same "ofN"
+    # suffix, all N present) — a crashed run with a different process count
+    # could otherwise contribute stale tiles that silently blend states
+    counts = {p.rsplit("of", 1)[1].split(".")[0] for p in paths}
+    if len(counts) != 1 or len(paths) != int(next(iter(counts))):
+        raise ValueError(
+            f"inconsistent shard set {sorted(os.path.basename(p) for p in paths)}: "
+            "expected exactly one ckpt_<step>.shard<i>of<N>.npz per process of "
+            "a single save; delete stale shard files from crashed runs"
+        )
+    pieces: dict = {}
+    out: dict = {}
+    for p in paths:
+        with np.load(p) as z:
+            idx = json.loads(bytes(z[_INDEX_KEY].tobytes()).decode())
+            for k in z.files:
+                if k == _INDEX_KEY:
+                    continue
+                meta = idx[k]
+                if meta["shape"] is None:  # host-side leaf, stored whole
+                    out[meta["key"]] = z[k]
+                    continue
+                buf = pieces.get(meta["key"])
+                if buf is None:
+                    buf = pieces[meta["key"]] = (
+                        np.empty(meta["shape"], z[k].dtype),
+                        np.zeros(meta["shape"], bool),
+                    )
+                data = z[k]
+                sl = tuple(
+                    slice(st, st + dim) for st, dim in zip(meta["start"], data.shape)
+                )
+                buf[0][sl] = data
+                buf[1][sl] = True
+    for key, (arr, seen) in pieces.items():
+        if not seen.all():
+            raise ValueError(
+                f"sharded checkpoint is missing tiles of {key!r} — shard "
+                "files absent or written by a different process topology"
+            )
+        out[key] = arr
+    return out
+
+
 def _step_of(name: str) -> Optional[int]:
     for suffix in (".npz", ".orbax"):
         if name.startswith("ckpt_") and name.endswith(suffix):
+            stem = name[len("ckpt_"):-len(suffix)]
+            # per-process shard artifact: ckpt_<step>.shard<i>of<n>.npz
+            if ".shard" in stem:
+                stem = stem.split(".shard", 1)[0]
             try:
-                return int(name[len("ckpt_"):-len(suffix)])
+                return int(stem)
             except ValueError:  # stray non-numeric ckpt_*.npz: not ours, skip
                 return None
     return None
@@ -170,6 +313,9 @@ def latest_step(directory: str) -> Optional[int]:
 def _load_arrays(directory: str, step: int) -> dict:
     """Read step ``step``'s artifact (whichever backend wrote it) into the
     flat ``{"name/leaf/path": ndarray}`` form."""
+    shards = _shard_paths(directory, step)
+    if shards:
+        return _load_sharded_arrays(shards)
     npz = _npz_path(directory, step)
     orbax_dir = _orbax_path(directory, step)
     has_npz, has_orbax = os.path.exists(npz), os.path.isdir(orbax_dir)
@@ -192,12 +338,14 @@ def restore(
     templates: Dict[str, Any],
     *,
     step: Optional[int] = None,
+    per_process: Tuple[str, ...] = (),
 ) -> Tuple[int, Dict[str, Any]]:
     """Restore ``(step, {name: pytree})``; templates supply structure and
     (for jax.Array leaves) target dtype + shardings.  Backend is detected
     per step from the on-disk artifact; validation (shape mismatch =>
     ValueError), dtype cast, and device placement are uniform across
-    backends."""
+    backends.  Names in ``per_process`` load this process's own copy
+    (written by ``save_sharded(..., per_process=...)``)."""
     if step is None:
         step = latest_step(directory)
         if step is None:
@@ -220,7 +368,13 @@ def restore(
         return jax.tree_util.tree_unflatten(flat_paths[1], leaves)
 
     restored = {
-        name: (unflatten(tpl, name) if tpl is not None else None)
+        name: (
+            unflatten(
+                tpl,
+                f"{name}@p{jax.process_index()}" if name in per_process else name,
+            )
+            if tpl is not None else None
+        )
         for name, tpl in templates.items()
     }
     return step, restored

@@ -36,6 +36,10 @@ class GlomConfig:
     param_dtype: jnp.dtype = jnp.float32
     compute_dtype: Optional[jnp.dtype] = None   # None => use param dtype
     remat: bool = False                         # jax.checkpoint the scan body
+    # what the scan-body checkpoint SAVES: "full" saves nothing (recompute
+    # everything in backward — min memory, max recompute) vs "dots" saves
+    # matmul outputs (recompute only elementwise — more memory, less FLOPs)
+    remat_policy: str = "full"      # "full" | "dots"
     attention_impl: str = "dense"   # "dense" | "pallas" | "ring" | "ulysses"
     ff_impl: str = "dense"          # "dense" | "pallas" (fused, hidden stays in VMEM)
 
@@ -50,6 +54,8 @@ class GlomConfig:
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
         if self.ff_impl not in ("dense", "pallas"):
             raise ValueError(f"unknown ff_impl {self.ff_impl!r}")
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(f"unknown remat_policy {self.remat_policy!r}")
 
     # -- derived quantities (glom_pytorch.py:90-91,112) --
     @property
@@ -107,7 +113,7 @@ class TrainConfig:
     eval_every: int = 0              # 0 => disabled; logs denoise PSNR
     checkpoint_every: int = 0            # 0 => disabled
     checkpoint_dir: Optional[str] = None
-    checkpoint_backend: str = "npz"      # "npz" | "orbax"
+    checkpoint_backend: str = "npz"      # "npz" | "orbax" | "sharded"
     profile_dir: Optional[str] = None    # jax.profiler trace of a 3-step window
     seed: int = 0
     # mesh axes: data-parallel x model(tensor)-parallel x sequence(column)-parallel
@@ -128,7 +134,7 @@ class TrainConfig:
             raise ValueError(
                 f"consistency_temperature must be > 0, got {self.consistency_temperature}"
             )
-        if self.checkpoint_backend not in ("npz", "orbax"):
+        if self.checkpoint_backend not in ("npz", "orbax", "sharded"):
             raise ValueError(f"unknown checkpoint backend {self.checkpoint_backend!r}")
         if self.lr_schedule not in ("constant", "cosine"):
             raise ValueError(f"unknown lr_schedule {self.lr_schedule!r}")

@@ -207,7 +207,14 @@ def apply(
         ff_fn,
     )
     if c.remat:
-        step = jax.checkpoint(step)
+        # "dots" keeps matmul outputs resident and recomputes only the cheap
+        # elementwise ops in the backward pass; "full" recomputes the whole
+        # body (minimum memory — the flagship batch-32 default)
+        policy = (
+            jax.checkpoint_policies.checkpoint_dots
+            if c.remat_policy == "dots" else None
+        )
+        step = jax.checkpoint(step, policy=policy)
 
     def body(carry, _):
         new = step(carry)

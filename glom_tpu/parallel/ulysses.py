@@ -10,8 +10,14 @@ restores column sharding.
 Trade-off vs ring (``glom_tpu.parallel.ring``): two all-to-alls of the
 state per call instead of S-1 ppermutes of K/V, and the n×n similarity IS
 materialized (per local level) — better when L ≥ S and ICI all-to-all is
-cheap; ring wins when n² memory is the binding constraint.  Requires
-``levels % S == 0``.
+cheap; ring wins when n² memory is the binding constraint.
+
+``levels % S != 0`` is handled by zero-padding the level axis up to the next
+multiple of S and slicing the padding back off: consensus is strictly
+per-level (no cross-level term anywhere in `glom_pytorch.py:56-73`), so the
+padded levels compute throwaway rows that interact with nothing.  The cost
+is the padded levels' attention FLOPs on one device — at L=6, S=4 that is
+2/8 wasted, still far cheaper than falling back to dense.
 """
 
 from __future__ import annotations
@@ -20,6 +26,7 @@ import functools
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from glom_tpu.ops.consensus import consensus_attention
@@ -70,11 +77,12 @@ def make_ulysses_consensus(
         s = mesh.shape[seq_axis]
         if n % s != 0:
             raise ValueError(f"n={n} columns not divisible by seq-axis size {s}")
-        if L % s != 0:
-            raise ValueError(
-                f"ulysses needs levels ({L}) divisible by seq-axis size {s}; "
-                "use the ring path otherwise"
-            )
-        return sharded(levels)
+        pad = (-L) % s
+        if pad:
+            # zero-pad the level axis to a multiple of S; consensus has no
+            # cross-level term, so the padded rows are inert and sliced off
+            levels = jnp.pad(levels, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        out = sharded(levels)
+        return out[:, :, :L] if pad else out
 
     return consensus_fn

@@ -161,8 +161,11 @@ class EvalSuite:
             labels = np.asarray(probe_labels)
             if num_classes is None:
                 num_classes = int(labels.max()) + 1
-            # deterministic stratification-free split: interleave so both
-            # halves see every class with high probability
+            # ImageFolder eval sets arrive class-grouped (sorted paths): a
+            # first-k/rest split would put disjoint classes in the two
+            # halves.  Shuffle deterministically so both halves mix classes.
+            perm = np.random.default_rng(0xB0BE).permutation(len(imgs))
+            imgs, labels = imgs[perm], labels[perm]
             n_train = max(1, int(len(imgs) * probe_train_fraction))
             self.probe_images = imgs
             self.probe_labels = labels
@@ -173,9 +176,10 @@ class EvalSuite:
         import numpy as np
 
         outs = []
-        n = (len(imgs) // self.chunk) * self.chunk
-        for i in range(0, n, self.chunk):
-            outs.append(np.asarray(self._embed(params, imgs[i:i + self.chunk])))
+        chunk = min(self.chunk, len(imgs))  # probe set may be < PSNR chunk
+        n = (len(imgs) // chunk) * chunk
+        for i in range(0, n, chunk):
+            outs.append(np.asarray(self._embed(params, imgs[i:i + chunk])))
         return np.concatenate(outs), n
 
     def run(self, params: dict, rng: jax.Array) -> dict:

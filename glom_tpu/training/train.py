@@ -28,6 +28,9 @@ def parse_args(argv=None):
     p.add_argument("--local-consensus-radius", type=int, default=0)
     p.add_argument("--bf16", action="store_true", help="bf16 compute (params stay fp32)")
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--remat-policy", default="full", choices=["full", "dots"],
+                   help="what the scan-body checkpoint saves (dots = keep "
+                        "matmul outputs, recompute only elementwise)")
     p.add_argument("--attention-impl", default="dense", choices=["dense", "pallas", "ring", "ulysses"])
     p.add_argument("--ff-impl", default="dense", choices=["dense", "pallas"])
     # training
@@ -62,6 +65,10 @@ def parse_args(argv=None):
     p.add_argument("--probe-examples", type=int, default=256,
                    help="held-out labeled examples for the linear probe "
                         "(0 disables the probe)")
+    p.add_argument("--eval-max-images", type=int, default=1024,
+                   help="cap on held-out images decoded into host RAM and "
+                        "scored per eval point (ImageNet-scale holdouts "
+                        "would otherwise decode GBs per process)")
     # parallelism
     p.add_argument("--mesh", type=int, nargs="+", default=None,
                    help="mesh shape over (data, model, seq); default: all-data")
@@ -70,7 +77,7 @@ def parse_args(argv=None):
     # checkpointing / logging
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
-    p.add_argument("--checkpoint-backend", default="npz", choices=["npz", "orbax"])
+    p.add_argument("--checkpoint-backend", default="npz", choices=["npz", "orbax", "sharded"])
     p.add_argument("--profile-dir", default=None,
                    help="emit a jax.profiler trace of a 3-step window here")
     p.add_argument("--log-file", default=None)
@@ -96,6 +103,7 @@ def main(argv=None):
         local_consensus_radius=args.local_consensus_radius,
         compute_dtype=jnp.bfloat16 if args.bf16 else None,
         remat=args.remat,
+        remat_policy=args.remat_policy,
         attention_impl=args.attention_impl,
         ff_impl=args.ff_impl,
     )
@@ -133,9 +141,16 @@ def main(argv=None):
             ImageFolderStream, labels_from_paths, list_image_files, load_images,
         )
 
+        import numpy as np
+
         train_files, eval_files = holdout_split(
             list_image_files(args.data_dir), args.eval_holdout, seed=args.seed
         )
+        # eval_files arrive class-grouped (sorted paths); permute before the
+        # RAM/probe caps so the decoded subset spans classes, and bound the
+        # decode cost (an uncapped 2% ImageNet holdout is ~15 GB fp32/host)
+        perm = np.random.default_rng(args.seed).permutation(len(eval_files))
+        eval_files = [eval_files[i] for i in perm[:args.eval_max_images]]
         eval_imgs = load_images(eval_files, args.image_size)
         probe_kwargs = {}
         if args.probe_examples:

@@ -184,6 +184,32 @@ class Trainer:
 
     # -- checkpointing ----------------------------------------------------
     def save(self, directory: str, *, data_state: Optional[dict] = None) -> str:
+        if self.train_cfg.checkpoint_backend == "sharded":
+            # per-process shard writes: every process persists only its own
+            # replica-0 tiles — no host gather, no cross-host traffic; each
+            # process's data cursor is saved per-process (shards differ in
+            # size, so cursors legitimately diverge across processes)
+            trees = {"params": self.state.params, "opt": self.state.opt_state,
+                     "rng": self.state.rng}
+            if data_state is not None:
+                trees["data"] = data_state
+            return ckpt_lib.save_sharded(
+                directory, int(jax.device_get(self.state.step)), trees,
+                per_process=("data",),
+            )
+        if data_state is not None and jax.process_count() > 1:
+            # gathered npz/orbax artifacts are leader-written: they can only
+            # carry ONE cursor, which would be wrong for every other process
+            import warnings
+
+            warnings.warn(
+                "data-iterator cursor is not checkpointed with "
+                f"backend={self.train_cfg.checkpoint_backend!r} under "
+                "multiple processes (per-process cursors diverge); use "
+                "checkpoint_backend='sharded' for exact stream resume",
+                stacklevel=2,
+            )
+            data_state = None
         if jax.process_count() > 1:
             # sharded leaves may span non-addressable devices: replicate
             # across the mesh, then read locally (cached jit per mesh)
@@ -217,10 +243,14 @@ class Trainer:
             trees["params"], trees["opt"], jnp.asarray(step, jnp.int32), trees["rng"]
         )
         if batches is not None and hasattr(batches, "load_state_dict"):
+            template = {"data": batches.state_dict()}
             try:
-                _, data_trees = ckpt_lib.restore(
-                    directory, {"data": batches.state_dict()}, step=step
-                )
+                try:  # sharded artifacts store the cursor per-process
+                    _, data_trees = ckpt_lib.restore(
+                        directory, template, step=step, per_process=("data",)
+                    )
+                except KeyError:
+                    _, data_trees = ckpt_lib.restore(directory, template, step=step)
                 batches.load_state_dict(
                     {k: int(v) for k, v in data_trees["data"].items()}
                 )

@@ -59,3 +59,40 @@ digest = float(
     )
 )
 print(f"DIGEST {pid} {digest:.10f}", flush=True)
+
+# --- sharded checkpoint round-trip (VERDICT r1 item 8): every process
+# writes only its replica-0 tiles; restore into a differently-seeded fresh
+# trainer must be bit-identical ---
+import glob
+
+import glom_tpu.checkpoint as ckpt_lib
+
+shard_dir = os.path.join(ckpt_dir, "sharded")
+ckpt_lib.save_sharded(
+    shard_dir, STEPS,
+    {"params": trainer.state.params, "opt": trainer.state.opt_state,
+     "rng": trainer.state.rng},
+)
+shards = sorted(glob.glob(os.path.join(shard_dir, f"ckpt_{STEPS}.shard*of*.npz")))
+assert len(shards) == nproc, shards
+
+train2 = TrainConfig(
+    batch_size=BATCH, learning_rate=1e-3, iters=2, steps=STEPS, log_every=0,
+    donate=False, checkpoint_backend="sharded", seed=123,
+)
+trainer2 = Trainer(config, train2)
+step, trees2 = ckpt_lib.restore(
+    shard_dir,
+    {"params": trainer2.state.params, "opt": trainer2.state.opt_state,
+     "rng": trainer2.state.rng},
+)
+assert step == STEPS
+host2 = gather_to_host(trees2["params"], trainer2.mesh)
+digest2 = float(
+    sum(
+        np.abs(np.asarray(l, np.float64)).sum()
+        for l in jax.tree_util.tree_leaves(host2)
+    )
+)
+assert digest2 == digest, (digest2, digest)  # bit-identical resume
+print(f"SHARDOK {pid}", flush=True)

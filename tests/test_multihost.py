@@ -60,6 +60,7 @@ def test_two_process_training_agrees_and_checkpoints(tmp_path):
                 _, pid, val = line.split()
                 digests[pid] = float(val)
     assert set(digests) == {"0", "1"}, outs
+    assert all("SHARDOK" in out for out in outs), outs  # sharded ckpt round-trip
     # both processes hold identical global params after DP training
     assert digests["0"] == digests["1"], digests
 
