@@ -609,9 +609,12 @@ def test_sharded_checkpoint_roundtrip_tp_mesh(tmp_path):
     )
     # restored leaves keep the TP sharding
     assert tr2.state.params["glom"]["bottom_up"]["w1"].sharding.spec[2] == "model"
-    # data cursor travels through the sharded artifact too
+    # data cursor travels through the sharded artifact too (stored
+    # per-process: each process restores its own copy)
     import glom_tpu.checkpoint as ckpt_lib
-    _, d = ckpt_lib.restore(str(tmp_path), {"data": {"epoch": 0, "pos": 0}})
+    _, d = ckpt_lib.restore(
+        str(tmp_path), {"data": {"epoch": 0, "pos": 0}}, per_process=("data",)
+    )
     assert {k: int(v) for k, v in d["data"].items()} == {"epoch": 1, "pos": 16}
 
 
