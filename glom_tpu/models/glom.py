@@ -139,6 +139,7 @@ def apply(
     iters: Optional[int] = None,
     levels: Optional[jax.Array] = None,
     return_all: bool = False,
+    capture_timestep: Optional[int] = None,
     consensus_fn=None,
     ff_fn=None,
 ) -> jax.Array:
@@ -150,7 +151,9 @@ def apply(
     design (SURVEY.md §7 hard part b).
 
     Returns ``(b, n, L, d)`` or, with ``return_all``, ``(iters+1, b, n, L, d)``
-    including the t=0 state.
+    including the t=0 state.  ``capture_timestep=t`` returns
+    ``(final, state_after_t_iterations)`` WITHOUT materializing the full
+    trajectory — the training fast path (t=0 is the initial state).
 
     ``consensus_fn`` overrides the config-resolved attention implementation —
     used by the Trainer to inject a mesh-bound ring consensus
@@ -220,7 +223,23 @@ def apply(
         new = step(carry)
         return new, (new if return_all else None)
 
+    if capture_timestep is not None and not return_all:
+        # training fast path: the denoising loss reads ONE timestep of the
+        # trajectory (README.md:83), so stacking all iters+1 states — the
+        # (13, b, n, L, d) HBM write+read return_all pays — is pure waste.
+        # Split the scan at the capture point instead: zero extra work.
+        t = capture_timestep
+        if not 0 <= t <= iters:
+            raise ValueError(f"capture_timestep {t} outside [0, {iters}]")
+        captured, _ = jax.lax.scan(body, levels, None, length=t)
+        final, _ = jax.lax.scan(body, captured, None, length=iters - t)
+        return final, captured
+
     final, ys = jax.lax.scan(body, levels, None, length=iters)
+
+    if capture_timestep is not None:
+        all_states = jnp.concatenate([levels[None], ys], axis=0)
+        return all_states[-1], all_states[capture_timestep]
 
     if return_all:
         # prepend the t=0 state to match (iters+1, ...) (`:126,148`)

@@ -31,6 +31,12 @@ def pooled_level_embedding(all_levels: jax.Array, timestep: int, level: int) -> 
     return jnp.mean(all_levels[timestep, :, :, level], axis=1)
 
 
+def pooled_state_embedding(state: jax.Array, level: int) -> jax.Array:
+    """``(b, n, L, d)`` single-timestep state -> ``(b, d)`` mean-pooled
+    embedding of ``level`` (the capture_timestep fast path's form)."""
+    return jnp.mean(state[:, :, level], axis=1)
+
+
 def consistency_loss(z1: jax.Array, z2: jax.Array) -> jax.Array:
     """MSE consistency between two views' pooled embeddings (``(b, d)``)."""
     return jnp.mean((z1.astype(jnp.float32) - z2.astype(jnp.float32)) ** 2)
@@ -57,9 +63,25 @@ def regularizer(
     level: int = -1,
     temperature: float = 0.1,
 ) -> jax.Array:
-    """Dispatch on ``kind`` ('mse' | 'infonce')."""
-    z1 = pooled_level_embedding(all_levels_v1, timestep, level)
-    z2 = pooled_level_embedding(all_levels_v2, timestep, level)
+    """Dispatch on ``kind`` ('mse' | 'infonce') over return_all stacks."""
+    return regularizer_from_state(
+        kind, all_levels_v1[timestep], all_levels_v2[timestep],
+        level=level, temperature=temperature,
+    )
+
+
+def regularizer_from_state(
+    kind: str,
+    state_v1: jax.Array,
+    state_v2: jax.Array,
+    *,
+    level: int = -1,
+    temperature: float = 0.1,
+) -> jax.Array:
+    """Same dispatch over single-timestep ``(b, n, L, d)`` states (the
+    training fast path — no full-trajectory stack exists)."""
+    z1 = pooled_state_embedding(state_v1, level)
+    z2 = pooled_state_embedding(state_v2, level)
     if kind == "mse":
         return consistency_loss(z1, z2)
     if kind == "infonce":

@@ -66,24 +66,25 @@ def make_loss_fn(config: GlomConfig, train: TrainConfig, *, consensus_fn=None, f
         else:
             noise = jax.random.normal(rng, img.shape, img.dtype) * train.noise_std
             noised = img + noise
-        all_levels = glom_model.apply(
-            params["glom"], noised, config=config, iters=iters, return_all=True,
-            consensus_fn=consensus_fn, ff_fn=ff_fn,
+        # capture_timestep: only the loss timestep's state is kept — the
+        # (iters+1, b, n, L, d) return_all stack never exists on this path
+        _, captured = glom_model.apply(
+            params["glom"], noised, config=config, iters=iters,
+            capture_timestep=timestep, consensus_fn=consensus_fn, ff_fn=ff_fn,
         )
-        tokens = all_levels[timestep, :b, :, train.loss_level]  # (b, n, d)
+        tokens = captured[:b, :, train.loss_level]  # (b, n, d)
         recon = patches_to_images_apply(params["decoder"], tokens, config)
         # accumulate the loss in AT LEAST fp32 (bf16 compute upcasts; f64
         # params keep f64 — matters for finite-difference grad checks)
         acc_dt = jnp.promote_types(recon.dtype, jnp.float32)
         loss = jnp.mean((recon.astype(acc_dt) - img.astype(acc_dt)) ** 2)
         if two_views:
-            from glom_tpu.training.consistency import regularizer
+            from glom_tpu.training.consistency import regularizer_from_state
 
-            reg = regularizer(
+            reg = regularizer_from_state(
                 train.consistency,
-                all_levels[:, :b],
-                all_levels[:, b:],
-                timestep=timestep,
+                captured[:b],
+                captured[b:],
                 level=train.consistency_level,
                 temperature=train.consistency_temperature,
             )

@@ -91,12 +91,12 @@ def make_psnr_fn(
 
     def psnr_fn(params: dict, imgs: jax.Array, rng: jax.Array) -> jax.Array:
         noised = imgs + jax.random.normal(rng, imgs.shape, imgs.dtype) * noise_std
-        all_levels = glom_model.apply(
-            params["glom"], noised, config=config, iters=iters, return_all=True,
-            consensus_fn=consensus_fn, ff_fn=ff_fn,
+        _, captured = glom_model.apply(
+            params["glom"], noised, config=config, iters=iters,
+            capture_timestep=timestep, consensus_fn=consensus_fn, ff_fn=ff_fn,
         )
         recon = patches_to_images_apply(
-            params["decoder"], all_levels[timestep, :, :, level], config
+            params["decoder"], captured[:, :, level], config
         )
         mse = jnp.mean((recon.astype(jnp.float32) - imgs.astype(jnp.float32)) ** 2)
         return 20.0 * jnp.log10(data_range) - 10.0 * jnp.log10(mse)

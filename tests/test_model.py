@@ -218,3 +218,33 @@ def test_shim_api():
     # default iters = 2*levels
     assert model(img).shape == (1, 16, 3, 16)
     assert model.num_params == glom_model.param_count(model.params)
+
+
+def test_capture_timestep_matches_return_all():
+    """capture_timestep=t must equal return_all's [t] (and [-1]) without ever
+    materializing the (iters+1, ...) stack."""
+    import jax.numpy as jnp
+
+    c = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4)
+    params = glom_model.init(jax.random.PRNGKey(0), c)
+    img = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 16, 16))
+    all_states = glom_model.apply(params, img, config=c, iters=4, return_all=True)
+    for t in (0, 2, 4):
+        final, cap = glom_model.apply(
+            params, img, config=c, iters=4, capture_timestep=t
+        )
+        np.testing.assert_allclose(np.asarray(cap), np.asarray(all_states[t]), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(final), np.asarray(all_states[-1]), atol=1e-6)
+    # the stacked trajectory must be absent from the compiled fast path:
+    # no tensor carries the (iters+1)=5 leading axis
+    hlo = (
+        jax.jit(lambda p, x: glom_model.apply(
+            p, x, config=c, iters=4, capture_timestep=2
+        ))
+        .lower(params, img).compile().as_text()
+    )
+    assert "f32[5,2" not in hlo
+
+    import pytest
+    with pytest.raises(ValueError, match="capture_timestep"):
+        glom_model.apply(params, img, config=c, iters=4, capture_timestep=9)
