@@ -33,6 +33,8 @@ def main():
     p.add_argument("--no-remat", action="store_true",
                    help="disable scan-body rematerialization (needs small batch)")
     p.add_argument("--remat-policy", default="full", choices=["full", "dots"])
+    p.add_argument("--fuse-ff", action="store_true",
+                   help="run bottom_up+top_down as one 2L-1-group call")
     p.add_argument("--attention-impl", default="dense", choices=["dense", "pallas", "ring", "ulysses"])
     p.add_argument("--ff-impl", default="dense", choices=["dense", "pallas"])
     p.add_argument("--device-probe-timeout", type=int, default=180,
@@ -94,6 +96,7 @@ def main():
         compute_dtype=jnp.float32 if args.fp32 else jnp.bfloat16,
         remat=not args.no_remat,
         remat_policy=args.remat_policy,
+        fuse_ff=args.fuse_ff,
         attention_impl=args.attention_impl,
         ff_impl=args.ff_impl,
         **model_kwargs,

@@ -42,6 +42,10 @@ class GlomConfig:
     remat_policy: str = "full"      # "full" | "dots"
     attention_impl: str = "dense"   # "dense" | "pallas" | "ring" | "ulysses"
     ff_impl: str = "dense"          # "dense" | "pallas" (fused, hidden stays in VMEM)
+    # run bottom_up and top_down as ONE grouped call of 2L-1 groups per
+    # iteration (weights concatenated once per step, outside the scan):
+    # halves the batched-GEMM / pallas dispatches on the FF hot path
+    fuse_ff: bool = False
 
     def __post_init__(self):
         if self.image_size % self.patch_size != 0:

@@ -92,6 +92,30 @@ def _update_step(params, bottom_level, pos_embs, divisors, consensus_fn, ff_fn, 
     return new_levels
 
 
+def _update_step_fused(cat_params, levels_count, bottom_level, pos_embs, divisors,
+                       consensus_fn, ff_fn, levels):
+    """Identical math to :func:`_update_step`, but both nets run as ONE
+    grouped call of ``2L-1`` groups (``cat_params`` holds the two nets'
+    weights concatenated along the group axis, built once per step outside
+    the scan).  The per-group MLPs are independent, so concatenating groups
+    is exact — it only changes how many batched GEMMs / pallas launches the
+    hot loop issues."""
+    L = levels_count
+    levels_with_input = jnp.concatenate([bottom_level, levels], axis=-2)
+
+    bu_in = levels_with_input[..., :-1, :]                 # (b, n, L, d)
+    td_in = levels_with_input[..., 2:, :] + pos_embs       # (b, n, L-1, d)
+    fused_out = ff_fn(cat_params, jnp.concatenate([bu_in, td_in], axis=-2))
+
+    bottom_up_out = fused_out[..., :L, :]
+    top_down_out = jnp.pad(
+        fused_out[..., L:, :], ((0, 0), (0, 0), (0, 1), (0, 0))
+    )
+
+    consensus = consensus_fn(levels)
+    return (levels + bottom_up_out + top_down_out + consensus) / divisors
+
+
 def resolve_locality_mask(config: GlomConfig) -> Optional[jax.Array]:
     """Boolean (n, n) blocked-pair mask when ``local_consensus_radius > 0``
     (`glom_pytorch.py:44-54`), else None."""
@@ -205,10 +229,21 @@ def apply(
         consensus_fn = make_consensus_fn(c)
     if ff_fn is None:
         ff_fn = make_ff_fn(c)
-    step = functools.partial(
-        _update_step, params, bottom_level, pos_embs, divisors, consensus_fn,
-        ff_fn,
-    )
+    if c.fuse_ff:
+        # one weight concat per step (hoisted out of the scan), 2L-1 groups
+        cat_params = jax.tree_util.tree_map(
+            lambda a, b_: jnp.concatenate([a, b_], axis=0),
+            params["bottom_up"], params["top_down"],
+        )
+        step = functools.partial(
+            _update_step_fused, cat_params, c.levels, bottom_level, pos_embs,
+            divisors, consensus_fn, ff_fn,
+        )
+    else:
+        step = functools.partial(
+            _update_step, params, bottom_level, pos_embs, divisors, consensus_fn,
+            ff_fn,
+        )
     if c.remat:
         # "dots" keeps matmul outputs resident and recomputes only the cheap
         # elementwise ops in the backward pass; "full" recomputes the whole

@@ -33,6 +33,8 @@ def parse_args(argv=None):
                         "matmul outputs, recompute only elementwise)")
     p.add_argument("--attention-impl", default="dense", choices=["dense", "pallas", "ring", "ulysses"])
     p.add_argument("--ff-impl", default="dense", choices=["dense", "pallas"])
+    p.add_argument("--fuse-ff", action="store_true",
+                   help="bottom_up+top_down as one grouped call per iteration")
     # training
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--grad-accum-steps", type=int, default=1)
@@ -106,6 +108,7 @@ def main(argv=None):
         remat_policy=args.remat_policy,
         attention_impl=args.attention_impl,
         ff_impl=args.ff_impl,
+        fuse_ff=args.fuse_ff,
     )
     train_cfg = TrainConfig(
         batch_size=args.batch_size,

@@ -248,3 +248,31 @@ def test_capture_timestep_matches_return_all():
     import pytest
     with pytest.raises(ValueError, match="capture_timestep"):
         glom_model.apply(params, img, config=c, iters=4, capture_timestep=9)
+
+
+def test_fuse_ff_matches_unfused():
+    """fuse_ff=True (one 2L-1-group call per iteration) is numerically
+    identical forward and backward, for dense and pallas FF impls."""
+    import jax.numpy as jnp
+
+    img = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 16, 16))
+    base = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4)
+    params = glom_model.init(jax.random.PRNGKey(0), base)
+    want = glom_model.apply(params, img, config=base, iters=3, return_all=True)
+    g_want = jax.grad(
+        lambda p: jnp.sum(glom_model.apply(p, img, config=base, iters=3) ** 2)
+    )(params)
+    for ff_impl in ("dense", "pallas"):
+        c = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4,
+                       fuse_ff=True, ff_impl=ff_impl)
+        got = glom_model.apply(params, img, config=c, iters=3, return_all=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+        g_got = jax.grad(
+            lambda p: jnp.sum(glom_model.apply(p, img, config=c, iters=3) ** 2)
+        )(params)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-4
+            ),
+            g_got, g_want,
+        )
