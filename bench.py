@@ -37,6 +37,9 @@ def main():
                    help="run bottom_up+top_down as one 2L-1-group call")
     p.add_argument("--attention-impl", default="dense", choices=["dense", "pallas", "ring", "ulysses"])
     p.add_argument("--ff-impl", default="dense", choices=["dense", "pallas"])
+    p.add_argument("--fused-ff-bwd", action="store_true",
+                   help="with --ff-impl pallas: fused Pallas backward kernels "
+                        "instead of the default XLA einsum VJP")
     p.add_argument("--device-probe-timeout", type=int, default=180,
                    help="seconds allowed for device init before emitting an "
                         "error JSON line and exiting; <= 0 disables the watchdog")
@@ -99,6 +102,7 @@ def main():
         fuse_ff=args.fuse_ff,
         attention_impl=args.attention_impl,
         ff_impl=args.ff_impl,
+        ff_fused_bwd=args.fused_ff_bwd,
         **model_kwargs,
     )
     train = TrainConfig(batch_size=batch, iters=iters, log_every=0)

@@ -42,6 +42,12 @@ class GlomConfig:
     remat_policy: str = "full"      # "full" | "dots"
     attention_impl: str = "dense"   # "dense" | "pallas" | "ring" | "ulysses"
     ff_impl: str = "dense"          # "dense" | "pallas" (fused, hidden stays in VMEM)
+    # with ff_impl="pallas": fused Pallas backward kernels (hidden recomputed
+    # per tile, never in HBM) vs the XLA einsum VJP.  Default stays False
+    # until the fused backward has a hardware A/B check on record (it is
+    # interpret-mode-verified; Mosaic lowering is the open risk — BASELINE.md
+    # round-2 notes)
+    ff_fused_bwd: bool = False
     # run bottom_up and top_down as ONE grouped call of 2L-1 groups per
     # iteration (weights concatenated once per step, outside the scan):
     # halves the batched-GEMM / pallas dispatches on the FF hot path

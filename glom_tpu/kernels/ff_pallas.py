@@ -9,9 +9,19 @@ them — XLA does not fuse across matmuls.  This kernel computes
 
 per (batch, group, n-block) entirely in VMEM: the hidden tile lives only
 on-chip.  At flagship scale that removes ~400 MB of HBM traffic per
-iteration (two nets, forward).  Backward is a custom VJP that recomputes via
-the XLA einsum formulation (correctness-first, same pattern as the
-consensus kernel).
+iteration (two nets, forward).
+
+Backward is fused too: the ``(b, n, g, h)`` hidden is recomputed per tile
+from the residual ``x`` instead of being materialized, in two blocked
+kernels —
+
+    dH_i   = (dO_i W2^T) * gelu'(X_i W1 + b1)       (per tile, VMEM-only)
+    dX_i   = sum_h  dH_ih W1_h^T                     (grid g,b,ni,nh)
+    dW1_h  = sum_i  X_i^T dH_ih ;  db1_h = sum_i 1^T dH_ih
+    dW2_h  = sum_i  gelu(pre)_ih^T dO_i              (grid g,nh,b,ni)
+
+with ``db2`` left to one cheap XLA reduction of ``dO``.  The XLA-einsum
+VJP is kept behind ``fused_bwd=False`` for A/B verification.
 
 GELU is the exact erf form to match torch ``nn.GELU()`` and the XLA path.
 """
@@ -86,26 +96,33 @@ def _vmem_bytes(bn, hc, d, itemsize):
     return 2 * itemsize * blocks + 4 * bn * d
 
 
-def _forward(x, params, *, interpret, h_block=2048):
-    b, n, g, d = x.shape
-    h = params["w1"].shape[-1]
-    xt = jnp.transpose(x, (0, 2, 1, 3))           # (b, g, n, d)
-    bn = _pick_block(n, cap=512)
-    hc = _pick_block(h, cap=h_block)
-    itemsize = max(x.dtype.itemsize, params["w1"].dtype.itemsize)
-    # shrink the hidden chunk (then the n block) until the double-buffered
-    # working set fits scoped VMEM — at dim=1024 a (1024, 2048) weight pair
-    # alone is 16 MB of bf16 once double-buffered
-    while _vmem_bytes(bn, hc, d, itemsize) > _VMEM_BUDGET and hc >= 256:
+def _shrink(n, h, budget_fn, d, itemsize, bn_cap=512, hc_cap=2048):
+    """Pick (n-block, hidden-chunk) sizes: start at the caps, shrink the
+    hidden chunk (then the n block) until ``budget_fn`` fits scoped VMEM."""
+    bn = _pick_block(n, cap=bn_cap)
+    hc = _pick_block(h, cap=hc_cap)
+    while budget_fn(bn, hc, d, itemsize) > _VMEM_BUDGET and hc >= 256:
         smaller = _pick_block(h, cap=hc // 2)
         if smaller >= hc:  # no smaller aligned divisor exists; stop shrinking
             break
         hc = smaller
-    while _vmem_bytes(bn, hc, d, itemsize) > _VMEM_BUDGET and bn >= 16:
+    while budget_fn(bn, hc, d, itemsize) > _VMEM_BUDGET and bn >= 16:
         smaller = _pick_block(n, cap=bn // 2)
         if smaller >= bn:
             break
         bn = smaller
+    return bn, hc
+
+
+def _forward(x, params, *, interpret, h_block=2048):
+    b, n, g, d = x.shape
+    h = params["w1"].shape[-1]
+    xt = jnp.transpose(x, (0, 2, 1, 3))           # (b, g, n, d)
+    itemsize = max(x.dtype.itemsize, params["w1"].dtype.itemsize)
+    # shrink the hidden chunk (then the n block) until the double-buffered
+    # working set fits scoped VMEM — at dim=1024 a (1024, 2048) weight pair
+    # alone is 16 MB of bf16 once double-buffered
+    bn, hc = _shrink(n, h, _vmem_bytes, d, itemsize, hc_cap=h_block)
     # group is the OUTERMOST grid dim: the weight blocks' index maps depend
     # only on (ig, ih), so Pallas keeps them VMEM-resident across all (b, ni)
     # steps instead of re-streaming them from HBM once per batch row
@@ -134,17 +151,180 @@ def _forward(x, params, *, interpret, h_block=2048):
     return jnp.transpose(y, (0, 2, 1, 3))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
-def _ff_pallas(x, params, interpret):
+def _gelu_and_grad(pre):
+    """Exact-erf GELU and its derivative, f32:
+    gelu(z) = 0.5 z (1 + erf(z/sqrt2));
+    gelu'(z) = 0.5 (1 + erf(z/sqrt2)) + z exp(-z^2/2) / sqrt(2 pi)."""
+    cdf = 0.5 * (1.0 + _erf_f32(pre * (2.0 ** -0.5)))
+    pdf = jnp.exp(-0.5 * pre * pre) * (1.0 / jnp.sqrt(2.0 * jnp.pi)).astype(jnp.float32)
+    return pre * cdf, cdf + pre * pdf
+
+
+def _recompute_dh(x_ref, w1_ref, b1_ref, w2_ref, go_ref):
+    """Load one (Bn, d) x/dO tile + (d, hc)/(hc, d) weight chunks and
+    recompute the hidden tile's forward + cotangent entirely in VMEM:
+    returns (x, w1, go, h, dh) with h = gelu(x W1 + b1) and
+    dh = (dO W2^T) * gelu'(x W1 + b1), all f32."""
+    x = x_ref[0, 0].astype(jnp.float32)           # (Bn, d)
+    w1 = w1_ref[0].astype(jnp.float32)            # (d, hc)
+    b1 = b1_ref[0, 0].astype(jnp.float32)         # (hc,)
+    w2 = w2_ref[0].astype(jnp.float32)            # (hc, d)
+    go = go_ref[0, 0].astype(jnp.float32)         # (Bn, d)
+
+    pre = jnp.dot(x, w1, preferred_element_type=jnp.float32) + b1
+    h, dgelu = _gelu_and_grad(pre)
+    dh = jax.lax.dot_general(
+        go, w2, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * dgelu                                     # (Bn, hc)
+    return x, w1, go, h, dh
+
+
+def _bwd_dx_kernel(x_ref, w1_ref, b1_ref, w2_ref, go_ref, o_ref, acc_ref):
+    """Grid (g, b, ni, nh): accumulate dX_i over hidden chunks.  Mirrors the
+    forward kernel's layout; the hidden tile (Bn, hc) is recomputed and
+    consumed in VMEM."""
+    ih = pl.program_id(3)
+    nh = pl.num_programs(3)
+
+    @pl.when(ih == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    _, w1, _, _, dh = _recompute_dh(x_ref, w1_ref, b1_ref, w2_ref, go_ref)
+    # dx += dh @ W1^T
+    acc_ref[:] = acc_ref[:] + jax.lax.dot_general(
+        dh, w1, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(ih == nh - 1)
+    def _():
+        o_ref[0, 0] = acc_ref[:].astype(o_ref.dtype)
+
+
+def _bwd_dw_kernel(x_ref, w1_ref, b1_ref, w2_ref, go_ref,
+                   dw1_ref, db1_ref, dw2_ref, dw1_acc, db1_acc, dw2_acc):
+    """Grid (g, nh, b, ni): for a fixed (group, hidden-chunk), accumulate
+    dW1/db1/dW2 over every (batch, n-block) tile.  The weight chunks and the
+    output blocks depend only on the two OUTER grid dims, so they stay
+    VMEM-resident across the whole inner sweep."""
+    ib, ii = pl.program_id(2), pl.program_id(3)
+    last = (ib == pl.num_programs(2) - 1) & (ii == pl.num_programs(3) - 1)
+
+    @pl.when((ib == 0) & (ii == 0))
+    def _():
+        dw1_acc[:] = jnp.zeros_like(dw1_acc)
+        db1_acc[:] = jnp.zeros_like(db1_acc)
+        dw2_acc[:] = jnp.zeros_like(dw2_acc)
+
+    x, _, go, h, dh = _recompute_dh(x_ref, w1_ref, b1_ref, w2_ref, go_ref)
+
+    # dW1 += X^T dH ; db1 += rowsum(dH) ; dW2 += gelu(pre)^T dO
+    dw1_acc[:] = dw1_acc[:] + jax.lax.dot_general(
+        x, dh, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    db1_acc[0, :] = db1_acc[0, :] + dh.sum(axis=0)
+    dw2_acc[:] = dw2_acc[:] + jax.lax.dot_general(
+        h, go, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(last)
+    def _():
+        dw1_ref[0] = dw1_acc[:].astype(dw1_ref.dtype)
+        db1_ref[0, 0] = db1_acc[0, :].astype(db1_ref.dtype)
+        dw2_ref[0] = dw2_acc[:].astype(dw2_ref.dtype)
+
+
+def _vmem_bytes_bwd_dx(bn, hc, d, itemsize):
+    blocks = 3 * bn * d + d * hc + hc + hc * d
+    return 2 * itemsize * blocks + 4 * bn * d
+
+
+def _vmem_bytes_bwd_dw(bn, hc, d, itemsize):
+    blocks = 2 * bn * d + 2 * d * hc + 2 * hc + 2 * hc * d
+    scratch = 4 * (2 * d * hc + 8 * hc)
+    return 2 * itemsize * blocks + scratch
+
+
+def _backward_fused(x, params, g, *, interpret):
+    b, n, gr, d = x.shape
+    h = params["w1"].shape[-1]
+    xt = jnp.transpose(x, (0, 2, 1, 3))           # (b, g, n, d)
+    gt = jnp.transpose(g, (0, 2, 1, 3)).astype(x.dtype)
+    itemsize = max(x.dtype.itemsize, params["w1"].dtype.itemsize)
+    b1_in = params["b1"][:, None, :]
+
+    # --- dX: grid (g, b, ni, nh), hidden chunks stream innermost
+    bn, hc = _shrink(n, h, _vmem_bytes_bwd_dx, d, itemsize)
+    dx = pl.pallas_call(
+        _bwd_dx_kernel,
+        grid=(gr, b, n // bn, h // hc),
+        in_specs=[
+            pl.BlockSpec((1, 1, bn, d), lambda ig, ib, ii, ih: (ib, ig, ii, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d, hc), lambda ig, ib, ii, ih: (ig, 0, ih), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, hc), lambda ig, ib, ii, ih: (ig, 0, ih), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hc, d), lambda ig, ib, ii, ih: (ig, ih, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bn, d), lambda ig, ib, ii, ih: (ib, ig, ii, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, bn, d), lambda ig, ib, ii, ih: (ib, ig, ii, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, gr, n, d), x.dtype),
+        scratch_shapes=[pltpu.VMEM((bn, d), jnp.float32)],
+        interpret=interpret,
+    )(xt, params["w1"], b1_in, params["w2"], gt)
+
+    # --- dW1/db1/dW2: grid (g, nh, b, ni), row tiles stream innermost
+    bn, hc = _shrink(n, h, _vmem_bytes_bwd_dw, d, itemsize)
+    wdt = params["w1"].dtype
+    dw1, db1, dw2 = pl.pallas_call(
+        _bwd_dw_kernel,
+        grid=(gr, h // hc, b, n // bn),
+        in_specs=[
+            pl.BlockSpec((1, 1, bn, d), lambda ig, ih, ib, ii: (ib, ig, ii, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d, hc), lambda ig, ih, ib, ii: (ig, 0, ih), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, hc), lambda ig, ih, ib, ii: (ig, 0, ih), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hc, d), lambda ig, ih, ib, ii: (ig, ih, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bn, d), lambda ig, ih, ib, ii: (ib, ig, ii, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, d, hc), lambda ig, ih, ib, ii: (ig, 0, ih), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, hc), lambda ig, ih, ib, ii: (ig, 0, ih), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hc, d), lambda ig, ih, ib, ii: (ig, ih, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((gr, d, h), wdt),
+            jax.ShapeDtypeStruct((gr, 1, h), wdt),
+            jax.ShapeDtypeStruct((gr, h, d), wdt),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((d, hc), jnp.float32),
+            pltpu.VMEM((8, hc), jnp.float32),
+            pltpu.VMEM((hc, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xt, params["w1"], b1_in, params["w2"], gt)
+
+    # db2 = sum of dO over (b, n) — one cheap XLA reduction, f32 accumulation
+    db2 = jnp.sum(g.astype(jnp.float32), axis=(0, 1)).astype(params["b2"].dtype)
+    dparams = {"w1": dw1, "b1": db1[:, 0, :], "w2": dw2, "b2": db2}
+    return jnp.transpose(dx, (0, 2, 1, 3)).astype(x.dtype), dparams
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _ff_pallas(x, params, interpret, fused_bwd):
     return _forward(x, params, interpret=interpret)
 
 
-def _fwd(x, params, interpret):
+def _fwd(x, params, interpret, fused_bwd):
     return _forward(x, params, interpret=interpret), (x, params)
 
 
-def _bwd(interpret, res, g):
+def _bwd(interpret, fused_bwd, res, g):
     x, params = res
+    if fused_bwd:
+        return _backward_fused(x, params, g, interpret=interpret)
+    # debug fallback: cotangents via the dense XLA formulation (materializes
+    # the (b, n, g, h) hidden in HBM — kept only for A/B verification)
     _, vjp = jax.vjp(lambda x_, p_: grouped_ff_apply(p_, x_), x, params)
     return vjp(g)
 
@@ -153,10 +333,13 @@ _ff_pallas.defvjp(_fwd, _bwd)
 
 
 def grouped_ff_pallas(
-    params: dict, x: jax.Array, *, interpret: Optional[bool] = None
+    params: dict, x: jax.Array, *, interpret: Optional[bool] = None,
+    fused_bwd: bool = True,
 ) -> jax.Array:
     """Drop-in for :func:`glom_tpu.ops.feedforward.grouped_ff_apply` with the
-    hidden activation kept in VMEM."""
+    hidden activation kept in VMEM — in the backward pass too.
+    ``fused_bwd=False`` routes gradients through the dense XLA formulation
+    (debug/verification only)."""
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
-    return _ff_pallas(x, params, interpret)
+    return _ff_pallas(x, params, interpret, fused_bwd)

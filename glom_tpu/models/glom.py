@@ -69,7 +69,7 @@ def make_ff_fn(config: GlomConfig):
     if config.ff_impl == "pallas":
         from glom_tpu.kernels.ff_pallas import grouped_ff_pallas
 
-        return grouped_ff_pallas
+        return functools.partial(grouped_ff_pallas, fused_bwd=config.ff_fused_bwd)
     return grouped_ff_apply
 
 

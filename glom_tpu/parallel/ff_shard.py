@@ -45,6 +45,7 @@ def make_sharded_ff_pallas(
     model_axis: str = "model",
     seq_axis: Optional[str] = None,
     interpret: Optional[bool] = None,
+    fused_bwd: bool = True,
 ):
     """Returns ``ff_fn(params, x)`` — drop-in for
     :func:`glom_tpu.ops.feedforward.grouped_ff_apply` that runs the Pallas
@@ -56,7 +57,7 @@ def make_sharded_ff_pallas(
     nspec = seq_axis if use_seq else None
 
     def kernel(p, x):
-        return grouped_ff_pallas(p, x, interpret=interpret)
+        return grouped_ff_pallas(p, x, interpret=interpret, fused_bwd=fused_bwd)
 
     def x_spec(group_axis=None):
         return P(data_axis, nspec, group_axis, None)

@@ -33,6 +33,10 @@ def parse_args(argv=None):
                         "matmul outputs, recompute only elementwise)")
     p.add_argument("--attention-impl", default="dense", choices=["dense", "pallas", "ring", "ulysses"])
     p.add_argument("--ff-impl", default="dense", choices=["dense", "pallas"])
+    p.add_argument("--fused-ff-bwd", action="store_true",
+                   help="with --ff-impl pallas: gradients via the fused Pallas "
+                        "backward kernels (hidden recomputed in VMEM) instead "
+                        "of the default XLA einsum VJP")
     p.add_argument("--fuse-ff", action="store_true",
                    help="bottom_up+top_down as one grouped call per iteration")
     # training
@@ -108,6 +112,7 @@ def main(argv=None):
         remat_policy=args.remat_policy,
         attention_impl=args.attention_impl,
         ff_impl=args.ff_impl,
+        ff_fused_bwd=args.fused_ff_bwd,
         fuse_ff=args.fuse_ff,
     )
     train_cfg = TrainConfig(

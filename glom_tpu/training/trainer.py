@@ -124,6 +124,7 @@ class Trainer:
                 data_axis=data_axis,
                 model_axis=model_axis,
                 seq_axis=train.mesh_axes[2] if len(train.mesh_axes) > 2 else None,
+                fused_bwd=config.ff_fused_bwd,
             )
         self._ff_fn = ff_fn
 
