@@ -60,6 +60,13 @@ def _erf_f32(x):
     return x * p / q
 
 
+def _gelu_cdf(pre):
+    """Phi(z) = 0.5 (1 + erf(z / sqrt 2)), f32 — gelu(z) = z * Phi(z).  The
+    single definition both the forward kernel and the backward's recompute
+    use; they must stay bit-identical or recomputed activations diverge."""
+    return 0.5 * (1.0 + _erf_f32(pre * (2.0 ** -0.5)))
+
+
 def _kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, o_ref, acc_ref):
     """Grid (g, b, ni, nh): the hidden dim is tiled so only an (d, hc) /
     (hc, d) weight chunk pair is VMEM-resident at once; per-chunk partial
@@ -78,7 +85,7 @@ def _kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, o_ref, acc_ref):
     w2 = w2_ref[0].astype(jnp.float32)            # (hc, d)
 
     h = jnp.dot(x, w1, preferred_element_type=jnp.float32) + b1
-    h = 0.5 * h * (1.0 + _erf_f32(h * (2.0 ** -0.5)))
+    h = h * _gelu_cdf(h)
     acc_ref[:] = acc_ref[:] + jnp.dot(h, w2, preferred_element_type=jnp.float32)
 
     @pl.when(ih == nh - 1)
@@ -153,9 +160,10 @@ def _forward(x, params, *, interpret, h_block=2048):
 
 def _gelu_and_grad(pre):
     """Exact-erf GELU and its derivative, f32:
-    gelu(z) = 0.5 z (1 + erf(z/sqrt2));
-    gelu'(z) = 0.5 (1 + erf(z/sqrt2)) + z exp(-z^2/2) / sqrt(2 pi)."""
-    cdf = 0.5 * (1.0 + _erf_f32(pre * (2.0 ** -0.5)))
+    gelu(z) = z Phi(z);  gelu'(z) = Phi(z) + z phi(z),
+    phi(z) = exp(-z^2/2) / sqrt(2 pi).  Phi comes from the same _gelu_cdf
+    the forward kernel uses."""
+    cdf = _gelu_cdf(pre)
     pdf = jnp.exp(-0.5 * pre * pre) * (1.0 / jnp.sqrt(2.0 * jnp.pi)).astype(jnp.float32)
     return pre * cdf, cdf + pre * pdf
 
@@ -334,12 +342,13 @@ _ff_pallas.defvjp(_fwd, _bwd)
 
 def grouped_ff_pallas(
     params: dict, x: jax.Array, *, interpret: Optional[bool] = None,
-    fused_bwd: bool = True,
+    fused_bwd: bool = False,
 ) -> jax.Array:
     """Drop-in for :func:`glom_tpu.ops.feedforward.grouped_ff_apply` with the
-    hidden activation kept in VMEM — in the backward pass too.
-    ``fused_bwd=False`` routes gradients through the dense XLA formulation
-    (debug/verification only)."""
+    hidden activation kept in VMEM.  ``fused_bwd=True`` additionally runs the
+    backward through the fused Pallas kernels (hidden recomputed per tile,
+    never in HBM); the default is the XLA einsum VJP until the fused backward
+    has a hardware A/B check on record (tools/hw_check.py)."""
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     return _ff_pallas(x, params, interpret, fused_bwd)

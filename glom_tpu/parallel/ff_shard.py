@@ -45,7 +45,7 @@ def make_sharded_ff_pallas(
     model_axis: str = "model",
     seq_axis: Optional[str] = None,
     interpret: Optional[bool] = None,
-    fused_bwd: bool = True,
+    fused_bwd: bool = False,
 ):
     """Returns ``ff_fn(params, x)`` — drop-in for
     :func:`glom_tpu.ops.feedforward.grouped_ff_apply` that runs the Pallas

@@ -36,7 +36,7 @@ def test_ff_pallas_grad_matches_dense():
     x = jax.random.normal(jax.random.PRNGKey(3), (1, 8, 2, 8))
 
     def loss_p(p, x):
-        return jnp.sum(grouped_ff_pallas(p, x) ** 2)
+        return jnp.sum(grouped_ff_pallas(p, x, fused_bwd=True) ** 2)
 
     def loss_d(p, x):
         return jnp.sum(grouped_ff_apply(p, x) ** 2)

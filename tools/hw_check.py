@@ -1,0 +1,126 @@
+"""On-device validation checklist for the Pallas kernels.
+
+Run on a real TPU after any kernel change (serialized — this must be the
+only process touching the accelerator).  Exercises the paths that
+interpret-mode CPU tests cannot: Mosaic lowering, sublane/lane tiling,
+scoped-VMEM limits.  Exits non-zero on the first failure.
+
+  python tools/hw_check.py            # full checklist
+  python tools/hw_check.py --quick    # skip the large config
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def check(name, fn):
+    print(f"-- {name} ...", flush=True)
+    fn()
+    print(f"   ok", flush=True)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        print("refusing: no accelerator attached (this checklist is for hardware)")
+        sys.exit(1)
+    print("device:", dev, flush=True)
+
+    from glom_tpu.kernels.consensus_pallas import consensus_attention_pallas
+    from glom_tpu.kernels.ff_pallas import grouped_ff_pallas
+    from glom_tpu.ops.consensus import consensus_attention
+    from glom_tpu.ops.feedforward import grouped_ff_apply, grouped_ff_init
+
+    tol = dict(atol=2e-2, rtol=2e-2)  # bf16-pass matmuls on TPU fp32 defaults
+
+    # --- fused FF backward vs XLA VJP, flagship shapes ----------------------
+    def ff_bwd_ab():
+        params = grouped_ff_init(jax.random.PRNGKey(0), dim=512, groups=6, mult=4)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 256, 6, 512))
+        g = jax.random.normal(jax.random.PRNGKey(2), x.shape)
+
+        def grads(fused):
+            _, vjp = jax.vjp(
+                lambda x_, p_: grouped_ff_pallas(p_, x_, fused_bwd=fused), x, params
+            )
+            return vjp(g)
+
+        fused = jax.jit(lambda: grads(True))()
+        ref = jax.jit(lambda: grads(False))()
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), **tol),
+            fused, ref,
+        )
+
+    check("fused FF backward A/B (512/6, n=256)", ff_bwd_ab)
+
+    # --- consensus flash backward vs dense VJP ------------------------------
+    def cons_bwd_ab():
+        x = jax.random.normal(jax.random.PRNGKey(3), (2, 256, 6, 512))
+        g = jax.random.normal(jax.random.PRNGKey(4), x.shape)
+
+        def grad_of(fn):
+            _, vjp = jax.vjp(fn, x)
+            return vjp(g)[0]
+
+        got = jax.jit(lambda: grad_of(lambda t: consensus_attention_pallas(t)))()
+        want = jax.jit(lambda: grad_of(lambda t: consensus_attention(t)))()
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), **tol)
+
+    check("consensus flash backward A/B (n=256)", cons_bwd_ab)
+
+    # --- awkward n: no multiple-of-8 divisor (block == array dim path) ------
+    def awkward_n():
+        x = jax.random.normal(jax.random.PRNGKey(5), (2, 36, 3, 64))
+        got = jax.jit(lambda t: consensus_attention_pallas(t))(x)
+        want = consensus_attention(x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), **tol)
+
+        params = grouped_ff_init(jax.random.PRNGKey(6), dim=64, groups=3, mult=4)
+        got = jax.jit(lambda t: grouped_ff_pallas(params, t))(x)
+        want = grouped_ff_apply(params, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), **tol)
+
+    check("awkward n=36 (unaligned, single-block) fwd", awkward_n)
+
+    # --- fused FF backward at the large config (VMEM shrink path) -----------
+    if not args.quick:
+        def ff_bwd_large():
+            params = grouped_ff_init(jax.random.PRNGKey(7), dim=1024, groups=8, mult=4)
+            x = jax.random.normal(jax.random.PRNGKey(8), (1, 576, 8, 1024), jnp.bfloat16)
+            g = jax.random.normal(jax.random.PRNGKey(9), x.shape, jnp.bfloat16)
+
+            def grads(fused):
+                _, vjp = jax.vjp(
+                    lambda x_, p_: grouped_ff_pallas(p_, x_, fused_bwd=fused), x, params
+                )
+                return vjp(g)
+
+            fused = jax.jit(lambda: grads(True))()
+            ref = jax.jit(lambda: grads(False))()
+            jax.tree_util.tree_map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a, np.float32), np.asarray(b, np.float32),
+                    atol=1.0, rtol=8e-2,  # bf16 cotangents, 576-row reductions
+                ),
+                fused, ref,
+            )
+
+        check("fused FF backward A/B large (1024/8, n=576, bf16)", ff_bwd_large)
+
+    print("ALL HARDWARE CHECKS PASSED", flush=True)
+
+
+if __name__ == "__main__":
+    main()

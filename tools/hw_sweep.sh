@@ -1,0 +1,38 @@
+#!/bin/bash
+# Serialized hardware bench sweep (ONE process touches the accelerator at a
+# time — concurrent device clients wedge the tunnel; see BASELINE.md round-2
+# notes).  Results append to tools/hw_sweep.log with timestamps.
+set -u
+cd "$(dirname "$0")/.."
+LOG=tools/hw_sweep.log
+
+run() {
+  echo "=== $(date -u +%FT%TZ) bench $*" | tee -a "$LOG"
+  out=$(timeout 500 python bench.py "$@" 2>/tmp/hw_sweep_err.txt)
+  rc=$?
+  echo "$out" | tail -1 | tee -a "$LOG"
+  if [ $rc -ne 0 ]; then
+    # keep the failure signature: a Mosaic lowering error must be
+    # distinguishable from a dead tunnel in the log
+    { echo "!! rc=$rc"; tail -15 /tmp/hw_sweep_err.txt; } | tee -a "$LOG"
+  fi
+}
+
+echo "=== $(date -u +%FT%TZ) hw_check" | tee -a "$LOG"
+timeout 600 python tools/hw_check.py 2>&1 | tail -3 | tee -a "$LOG"
+
+run                                    # auto: pallas FF fwd on TPU
+run --ff-impl dense
+run --ff-impl pallas --fused-ff-bwd
+run --ff-impl pallas --attention-impl pallas
+run --fuse-ff --ff-impl pallas
+run --fuse-ff --ff-impl pallas --fused-ff-bwd
+run --remat-policy dots
+run --no-remat
+run --batch-size 64
+run --batch-size 64 --ff-impl pallas --fused-ff-bwd
+run --batch-size 128
+run --config large
+run --config large --ff-impl pallas --attention-impl pallas
+run --config large --ff-impl pallas --attention-impl pallas --fused-ff-bwd
+echo "=== $(date -u +%FT%TZ) sweep done" | tee -a "$LOG"
