@@ -86,11 +86,10 @@ def main():
         timer.cancel()  # device init completed; the guarded window is over
     if args.ff_impl == "auto":
         # pltpu kernels only lower on TPU; any other backend (cpu, gpu) takes
-        # the dense XLA path.  Match on device_kind, not platform: TPU plugin
-        # platforms carry nonstandard names (e.g. this environment's "axon")
-        d0 = jax.devices()[0]
-        is_tpu = d0.platform == "tpu" or "TPU" in (d0.device_kind or "").upper()
-        args.ff_impl = "pallas" if is_tpu else "dense"
+        # the dense XLA path
+        from glom_tpu.parallel.mesh import is_tpu_device
+
+        args.ff_impl = "pallas" if is_tpu_device(jax.devices()[0]) else "dense"
     # CPU fallback exists so the bench cannot wedge a driver run; the metric
     # stays honest (it just reports the low CPU rate)
     if args.steps == 0:

@@ -116,6 +116,74 @@ def _update_step_fused(cat_params, levels_count, bottom_level, pos_embs, divisor
     return (levels + bottom_up_out + top_down_out + consensus) / divisors
 
 
+def validate_img(img: jax.Array, config: GlomConfig) -> None:
+    """The ctor-derived input contract (`glom_pytorch.py:94-97` shapes)."""
+    c = config
+    if img.ndim != 4 or img.shape[1:] != (c.channels, c.image_size, c.image_size):
+        raise ValueError(
+            f"img must be (batch, {c.channels}, {c.image_size}, {c.image_size}) "
+            f"for this config, got {tuple(img.shape)}"
+        )
+
+
+def cast_for_compute(params: dict, img: jax.Array, config: GlomConfig):
+    """Apply the config's compute dtype to inputs and (if different from the
+    param dtype) the parameter tree; returns (params, img, compute_dtype)."""
+    compute_dtype = config.compute_dtype or config.param_dtype
+    if img.dtype != compute_dtype:
+        img = img.astype(compute_dtype)
+    if compute_dtype != config.param_dtype:
+        params = jax.tree_util.tree_map(lambda p: p.astype(compute_dtype), params)
+    return params, img, compute_dtype
+
+
+def update_divisors(config: GlomConfig, dtype) -> jax.Array:
+    """The equal-weight mean divisors [4,...,4,3]: the top level has no
+    top-down contribution (`glom_pytorch.py:128-129`)."""
+    divisors = np.full((config.levels, 1), 4.0, dtype=np.float32)
+    divisors[-1] = 3.0
+    return jnp.asarray(divisors, dtype)
+
+
+def make_step_builder(params, config: GlomConfig, pos_embs, divisors,
+                      consensus_fn, ff_fn):
+    """Returns ``build(bottom_level) -> step`` where ``step(levels)`` is one
+    GLOM iteration honoring the config's ``fuse_ff`` and ``remat`` knobs.
+    Shared by the sequential scan (:func:`apply`) and the pipelined schedule
+    (``glom_tpu.parallel.pipeline``) so the two paths cannot drift."""
+    c = config
+    if c.fuse_ff:
+        # one weight concat per step (hoisted out of the scan), 2L-1 groups
+        cat_params = jax.tree_util.tree_map(
+            lambda a, b_: jnp.concatenate([a, b_], axis=0),
+            params["bottom_up"], params["top_down"],
+        )
+
+    def build(bottom_level):
+        if c.fuse_ff:
+            step = functools.partial(
+                _update_step_fused, cat_params, c.levels, bottom_level, pos_embs,
+                divisors, consensus_fn, ff_fn,
+            )
+        else:
+            step = functools.partial(
+                _update_step, params, bottom_level, pos_embs, divisors,
+                consensus_fn, ff_fn,
+            )
+        if c.remat:
+            # "dots" keeps matmul outputs resident and recomputes only the
+            # cheap elementwise ops in the backward pass; "full" recomputes
+            # the whole body (minimum memory — the flagship batch-32 default)
+            policy = (
+                jax.checkpoint_policies.checkpoint_dots
+                if c.remat_policy == "dots" else None
+            )
+            step = jax.checkpoint(step, policy=policy)
+        return step
+
+    return build
+
+
 def resolve_locality_mask(config: GlomConfig) -> Optional[jax.Array]:
     """Boolean (n, n) blocked-pair mask when ``local_consensus_radius > 0``
     (`glom_pytorch.py:44-54`), else None."""
@@ -187,11 +255,7 @@ def apply(
     (``glom_tpu.parallel.ff_shard.make_sharded_ff_pallas``).
     """
     c = config
-    if img.ndim != 4 or img.shape[1:] != (c.channels, c.image_size, c.image_size):
-        raise ValueError(
-            f"img must be (batch, {c.channels}, {c.image_size}, {c.image_size}) "
-            f"for this config, got {tuple(img.shape)}"
-        )
+    validate_img(img, c)
     if levels is not None and tuple(levels.shape) != (
         img.shape[0], c.num_patches, c.levels, c.dim
     ):
@@ -201,11 +265,7 @@ def apply(
         )
     if iters is None:
         iters = c.default_iters
-    compute_dtype = c.compute_dtype or c.param_dtype
-    if img.dtype != compute_dtype:
-        img = img.astype(compute_dtype)
-    if compute_dtype != c.param_dtype:
-        params = jax.tree_util.tree_map(lambda p: p.astype(compute_dtype), params)
+    params, img, compute_dtype = cast_for_compute(params, img, c)
 
     tokens = patch_embed_apply(params["patch_embed"], img, c.patch_size)  # (b, n, d)
     b, n, _ = tokens.shape
@@ -220,39 +280,15 @@ def apply(
     else:
         levels = levels.astype(compute_dtype)
 
-    # divisors [4,...,4,3]: top level has no top-down contribution (`:128-129`)
-    divisors = np.full((c.levels, 1), 4.0, dtype=np.float32)
-    divisors[-1] = 3.0
-    divisors = jnp.asarray(divisors, compute_dtype)
+    divisors = update_divisors(c, compute_dtype)
 
     if consensus_fn is None:
         consensus_fn = make_consensus_fn(c)
     if ff_fn is None:
         ff_fn = make_ff_fn(c)
-    if c.fuse_ff:
-        # one weight concat per step (hoisted out of the scan), 2L-1 groups
-        cat_params = jax.tree_util.tree_map(
-            lambda a, b_: jnp.concatenate([a, b_], axis=0),
-            params["bottom_up"], params["top_down"],
-        )
-        step = functools.partial(
-            _update_step_fused, cat_params, c.levels, bottom_level, pos_embs,
-            divisors, consensus_fn, ff_fn,
-        )
-    else:
-        step = functools.partial(
-            _update_step, params, bottom_level, pos_embs, divisors, consensus_fn,
-            ff_fn,
-        )
-    if c.remat:
-        # "dots" keeps matmul outputs resident and recomputes only the cheap
-        # elementwise ops in the backward pass; "full" recomputes the whole
-        # body (minimum memory — the flagship batch-32 default)
-        policy = (
-            jax.checkpoint_policies.checkpoint_dots
-            if c.remat_policy == "dots" else None
-        )
-        step = jax.checkpoint(step, policy=policy)
+    step = make_step_builder(params, c, pos_embs, divisors, consensus_fn, ff_fn)(
+        bottom_level
+    )
 
     def body(carry, _):
         new = step(carry)

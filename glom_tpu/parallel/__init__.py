@@ -7,17 +7,21 @@ all of this subpackage is TPU-native framework machinery:
   * ``sharding.py`` — PartitionSpec rules for params/batch/state (DP + TP/EP + SP)
   * ``ring.py`` — ring (sequence-parallel) consensus attention via shard_map +
     ppermute with a running softmax — the ring-attention analogue for columns
+  * ``pipeline.py`` — GPipe pipeline parallelism over the weight-tied
+    iteration loop (stages own iteration chunks; state flows via ppermute)
 
 The communication backend is XLA collectives (psum/all_gather/ppermute) over
 ICI within a slice, DCN across slices — no NCCL/MPI anywhere.
 """
 
 from glom_tpu.parallel.mesh import make_mesh, initialize_distributed
+from glom_tpu.parallel.pipeline import make_pipelined_apply
 from glom_tpu.parallel.sharding import param_pspecs, batch_pspec, state_pspec
 
 __all__ = [
     "make_mesh",
     "initialize_distributed",
+    "make_pipelined_apply",
     "param_pspecs",
     "batch_pspec",
     "state_pspec",

@@ -20,6 +20,14 @@ from jax.sharding import Mesh
 DEFAULT_AXES = ("data", "model", "seq")
 
 
+def is_tpu_device(d: jax.Device) -> bool:
+    """True when ``d`` is a TPU.  Matches device_kind as well as platform:
+    TPU PJRT plugins can register under nonstandard platform names (this
+    build environment's tunnel reports platform 'axon', device_kind
+    'TPU v5 ...'), so ``platform == 'tpu'`` alone under-detects."""
+    return d.platform == "tpu" or "TPU" in (d.device_kind or "").upper()
+
+
 def make_mesh(
     mesh_shape: Optional[Sequence[int]] = None,
     axis_names: Sequence[str] = DEFAULT_AXES,

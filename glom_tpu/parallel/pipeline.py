@@ -1,0 +1,163 @@
+"""Pipeline parallelism over the GLOM iteration loop.
+
+Reference analogue: none — the reference has no parallelism code at all
+(`glom_pytorch.py:1-151`; SURVEY.md §2.3 lists PP as absent there, and as
+design-documented for this build).  This module turns that design note into
+a first-class component.
+
+TPU-native design.  GLOM's depth dimension is the *iteration* loop, and the
+loop is weight-tied — every iteration applies the same bottom-up/top-down/
+consensus weights (`glom_pytorch.py:131-145`).  That makes pipeline
+parallelism here structurally simpler than in a layered transformer:
+
+  * stage s owns a contiguous CHUNK of iterations, not a chunk of weights;
+  * params are fully replicated — no per-stage parameter partition, no
+    weight-gather traffic; only the ``(mb, n, L, d)`` level state flows
+    stage-to-stage over ICI via ``lax.ppermute``;
+  * the schedule is plain GPipe: microbatch m enters stage 0 at step m,
+    stage s processes microbatch ``t - s`` at step t, the last stage
+    retires one microbatch per step after the fill phase.  Bubble fraction
+    is ``(S-1) / (M + S-1)`` for S stages and M microbatches.
+
+Everything — the step loop, the stage compute, the boundary exchange — is
+ONE jitted ``shard_map`` + ``lax.scan`` graph: no host round-trips between
+microbatches or stages.  Gradients flow through the same graph
+(``ppermute`` transposes to the reverse permutation), so ``jax.grad`` of a
+loss on the pipelined forward is the pipelined backward, with the bubble
+schedule reversed — no hand-written backward schedule.
+
+At the reference's 23.5M params PP is never *required* (SURVEY.md §2.3
+scopes it as a design cut point); it exists so the framework scales the
+iteration loop across a mesh axis when iters × state no longer fits one
+device's step budget, and composes with the data axis (the batch dim of
+every microbatch can itself be data-sharded).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from glom_tpu.config import GlomConfig
+from glom_tpu.models import glom as glom_model
+from glom_tpu.ops.patch import patch_embed_apply
+
+
+def make_pipelined_apply(
+    mesh: Mesh,
+    config: GlomConfig,
+    *,
+    pipe_axis: str = "pipe",
+    num_microbatches: Optional[int] = None,
+    consensus_fn=None,
+    ff_fn=None,
+):
+    """Build ``apply(params, img, *, iters) -> (b, n, L, d)`` running the
+    iteration loop as an S-stage GPipe pipeline over ``pipe_axis``.
+
+    Constraints (checked at trace time): ``iters % S == 0`` (equal chunks)
+    and ``batch % num_microbatches == 0``.  ``num_microbatches`` defaults to
+    S (minimum that fills the pipe; more microbatches shrink the bubble).
+    Numerics are identical to :func:`glom_tpu.models.glom.apply` — asserted
+    by ``tests/test_pipeline.py`` against the sequential forward.
+    """
+    c = config
+    S = mesh.shape[pipe_axis]
+    M = num_microbatches or S
+    if consensus_fn is None:
+        consensus_fn = glom_model.make_consensus_fn(c)
+    if ff_fn is None:
+        ff_fn = glom_model.make_ff_fn(c)
+
+    def apply(params, img, *, iters: Optional[int] = None):
+        glom_model.validate_img(img, c)
+        if iters is None:
+            iters = c.default_iters
+        if iters % S != 0:
+            raise ValueError(f"iters {iters} not divisible by {S} pipeline stages")
+        k = iters // S
+        b = img.shape[0]
+        if b % M != 0:
+            raise ValueError(f"batch {b} not divisible by {M} microbatches")
+        mb = b // M
+
+        params_c, img_c, compute_dtype = glom_model.cast_for_compute(params, img, c)
+
+        tokens = patch_embed_apply(params_c["patch_embed"], img_c, c.patch_size)
+        n = tokens.shape[1]
+        tokens_mb = tokens.reshape(M, mb, n, c.dim)
+
+        pos_embs = params_c["pos_emb"][None, :, None, :]
+        init_state = jnp.broadcast_to(
+            params_c["init_levels"][None, None, :, :], (mb, n, c.levels, c.dim)
+        ).astype(compute_dtype)
+
+        divisors = glom_model.update_divisors(c, compute_dtype)
+        # the SAME step construction as the sequential scan — fuse_ff and the
+        # remat policy apply to pipeline stages identically
+        build_step = glom_model.make_step_builder(
+            params_c, c, pos_embs, divisors, consensus_fn, ff_fn
+        )
+
+        def stage_chunk(levels, toks):
+            """k sequential GLOM iterations on one microbatch (one stage)."""
+            step = build_step(toks[:, :, None, :])
+
+            def body(carry, _):
+                return step(carry), None
+            out, _ = jax.lax.scan(body, levels, None, length=k)
+            return out
+
+        def pipelined(tokens_mb):
+            """Runs identically on every device of the pipe axis; the stage
+            id comes from ``axis_index``."""
+            s = jax.lax.axis_index(pipe_axis)
+            T = M + S - 1
+            fwd_perm = [(i, i + 1) for i in range(S - 1)]
+
+            def step(carry, t):
+                cur, out_buf = carry
+                # boundary exchange: my just-finished state goes to stage
+                # s+1; stage 0 receives garbage (overwritten below)
+                recv = jax.lax.ppermute(cur, pipe_axis, fwd_perm) if S > 1 else cur
+                my_idx = t - s                       # microbatch this stage works on
+                idx = jnp.clip(my_idx, 0, M - 1)
+                toks = jax.lax.dynamic_index_in_dim(
+                    tokens_mb, idx, axis=0, keepdims=False
+                )
+                inp = jnp.where(s == 0, init_state, recv)
+                done = stage_chunk(inp, toks)
+                active = (my_idx >= 0) & (my_idx < M)
+                cur = jnp.where(active, done, cur)
+                # last stage retires one microbatch per step after the fill
+                write = active & (s == S - 1)
+                out_buf = jax.lax.dynamic_update_index_in_dim(
+                    out_buf,
+                    jnp.where(write, done, jax.lax.dynamic_index_in_dim(
+                        out_buf, idx, axis=0, keepdims=False)),
+                    idx, axis=0,
+                )
+                return (cur, out_buf), None
+
+            out0 = jnp.zeros((M,) + init_state.shape, init_state.dtype)
+            (_, out_buf), _ = jax.lax.scan(
+                step, (init_state, out0), jnp.arange(T)
+            )
+            # out_buf is populated only on the last stage; psum replicates the
+            # finished states across the pipe axis (all other stages hold 0)
+            mask = (s == S - 1).astype(out_buf.dtype)
+            return jax.lax.psum(out_buf * mask, pipe_axis)
+
+        out = jax.shard_map(
+            pipelined,
+            mesh=mesh,
+            in_specs=P(),      # tokens replicated over the pipe axis
+            out_specs=P(),     # finished states replicated (post-psum)
+            check_vma=False,
+        )(tokens_mb)
+        return out.reshape(b, n, c.levels, c.dim)
+
+    return apply

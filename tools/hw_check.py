@@ -31,9 +31,12 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    from glom_tpu.parallel.mesh import is_tpu_device
+
     dev = jax.devices()[0]
-    if dev.platform == "cpu":
-        print("refusing: no accelerator attached (this checklist is for hardware)")
+    if not is_tpu_device(dev):
+        print(f"refusing: {dev} is not a TPU (this checklist exercises Mosaic "
+              "lowering; pltpu kernels do not lower on cpu/gpu)")
         sys.exit(1)
     print("device:", dev, flush=True)
 

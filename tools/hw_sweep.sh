@@ -19,7 +19,15 @@ run() {
 }
 
 echo "=== $(date -u +%FT%TZ) hw_check" | tee -a "$LOG"
-timeout 600 python tools/hw_check.py 2>&1 | tail -3 | tee -a "$LOG"
+hc=$(timeout 600 python tools/hw_check.py 2>&1)
+rc=$?
+echo "$hc" | tail -3 | tee -a "$LOG"
+if [ $rc -ne 0 ]; then
+  # a kernel regression must stop the sweep, with its signature on record —
+  # benching broken kernels would put meaningless numbers in the log
+  { echo "!! hw_check rc=$rc — aborting sweep"; echo "$hc" | tail -30; } | tee -a "$LOG"
+  exit $rc
+fi
 
 run                                    # auto: pallas FF fwd on TPU
 run --ff-impl dense
