@@ -145,6 +145,26 @@ def update_divisors(config: GlomConfig, dtype) -> jax.Array:
     return jnp.asarray(divisors, dtype)
 
 
+def embed_inputs(params, img, config: GlomConfig):
+    """Shared input preamble: patch-embed the image and lay out the
+    positional embeddings for the top-down nets.  Returns
+    ``(tokens (b, n, d), pos_embs (1, n, 1, d))`` — the single definition of
+    these layouts for the sequential scan and the pipelined schedule
+    (`glom_pytorch.py:114,117-118`)."""
+    tokens = patch_embed_apply(params["patch_embed"], img, config.patch_size)
+    pos_embs = params["pos_emb"][None, :, None, :]
+    return tokens, pos_embs
+
+
+def initial_levels(params, b: int, config: GlomConfig, dtype) -> jax.Array:
+    """The learned per-level init state broadcast to ``(b, n, L, d)``
+    (`glom_pytorch.py:123-124`)."""
+    c = config
+    return jnp.broadcast_to(
+        params["init_levels"][None, None, :, :], (b, c.num_patches, c.levels, c.dim)
+    ).astype(dtype)
+
+
 def make_step_builder(params, config: GlomConfig, pos_embs, divisors,
                       consensus_fn, ff_fn):
     """Returns ``build(bottom_level) -> step`` where ``step(levels)`` is one
@@ -267,16 +287,12 @@ def apply(
         iters = c.default_iters
     params, img, compute_dtype = cast_for_compute(params, img, c)
 
-    tokens = patch_embed_apply(params["patch_embed"], img, c.patch_size)  # (b, n, d)
-    b, n, _ = tokens.shape
-
-    pos_embs = params["pos_emb"][None, :, None, :]        # (1, n, 1, d)  (`:117-118`)
+    tokens, pos_embs = embed_inputs(params, img, c)       # (`:114,117-118`)
+    b = tokens.shape[0]
     bottom_level = tokens[:, :, None, :]                  # (b, n, 1, d)  (`:120-121`)
 
     if levels is None:
-        levels = jnp.broadcast_to(
-            params["init_levels"][None, None, :, :], (b, n, c.levels, c.dim)
-        ).astype(compute_dtype)                           # (`:123-124`)
+        levels = initial_levels(params, b, c, compute_dtype)  # (`:123-124`)
     else:
         levels = levels.astype(compute_dtype)
 

@@ -43,7 +43,6 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from glom_tpu.config import GlomConfig
 from glom_tpu.models import glom as glom_model
-from glom_tpu.ops.patch import patch_embed_apply
 
 
 def make_pipelined_apply(
@@ -86,14 +85,11 @@ def make_pipelined_apply(
 
         params_c, img_c, compute_dtype = glom_model.cast_for_compute(params, img, c)
 
-        tokens = patch_embed_apply(params_c["patch_embed"], img_c, c.patch_size)
+        tokens, pos_embs = glom_model.embed_inputs(params_c, img_c, c)
         n = tokens.shape[1]
         tokens_mb = tokens.reshape(M, mb, n, c.dim)
 
-        pos_embs = params_c["pos_emb"][None, :, None, :]
-        init_state = jnp.broadcast_to(
-            params_c["init_levels"][None, None, :, :], (mb, n, c.levels, c.dim)
-        ).astype(compute_dtype)
+        init_state = glom_model.initial_levels(params_c, mb, c, compute_dtype)
 
         divisors = glom_model.update_divisors(c, compute_dtype)
         # the SAME step construction as the sequential scan — fuse_ff and the

@@ -1,0 +1,137 @@
+"""Model-FLOPs-utilization accounting for the flagship train step.
+
+Counts FLOPs two ways and converts a measured imgs/sec/chip rate to MFU:
+
+  * model FLOPs: the analytic per-image cost of the GLOM update loop
+    (matmul-dominated; the standard "useful FLOPs" numerator — excludes
+    remat recompute, which is overhead, not model work)
+  * compiled FLOPs: XLA's cost model on the actual jitted train step
+    (includes remat recompute and everything else the graph really does —
+    this is what the hardware physically executes)
+
+The FLOP counts are compile-time facts, so this runs anywhere (CPU
+included); pass the hardware-measured rate from bench.py to get MFU.
+
+  python tools/mfu.py --imgs-per-sec 282.4 --peak-tflops 197
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+# bf16 peak TFLOP/s per chip (one JAX device).  Sources: public TPU spec
+# sheets; extend as needed.
+PEAK_TFLOPS = {
+    "v4": 275.0,        # per chip (2 TensorCores)
+    "v5e": 197.0,
+    "v5p": 459.0,
+}
+
+
+def model_flops_per_image(c, iters: int) -> float:
+    """Analytic matmul FLOPs for one image's forward pass of ``iters``
+    EXECUTED iterations (2*m*n*k per matmul).  Mirrors the reference cost
+    structure (SURVEY.md §2.1 derived numbers: ~12.6 GFLOP/iter default).
+
+    NB: the denoising train step executes only ``loss_timestep`` iterations
+    — the post-capture scan's states feed nothing and XLA dead-code
+    eliminates them (the torch recipe eagerly runs all ``iters``; training
+    is identical because the loss never depended on the later states).  MFU
+    accounting must use the executed count, not the nominal ``iters``."""
+    n, d, h, L = c.num_patches, c.dim, c.dim * c.ff_mult, c.levels
+    patch = 2 * n * c.patch_dim * d
+    ff_bu = 2 * n * L * (d * h + h * d)              # L groups, two layers
+    ff_td = 2 * n * (L - 1) * (d * h + h * d)        # L-1 groups
+    attn = 2 * L * (n * n * d + n * n * d)           # QK^T + AV per level
+    return patch + iters * (ff_bu + ff_td + attn)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--imgs-per-sec", type=float, required=True,
+                   help="measured per-chip training rate (bench.py output)")
+    p.add_argument("--peak-tflops", type=float, default=None,
+                   help="bf16 peak TFLOP/s of the chip; default from --chip")
+    p.add_argument("--chip", default="v5e", choices=sorted(PEAK_TFLOPS))
+    p.add_argument("--config", default="flagship", choices=["flagship", "large"])
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--loss-timestep", type=int, default=0,
+                   help="executed iterations (0 = TrainConfig default, "
+                        "iters//2+1)")
+    p.add_argument("--skip-compiled", action="store_true",
+                   help="analytic numerator only (no jit / cost model)")
+    args = p.parse_args()
+
+    peak = args.peak_tflops or PEAK_TFLOPS[args.chip]
+
+    import jax
+
+    if jax.default_backend() not in ("cpu", "tpu"):
+        print(f"note: counting on backend {jax.default_backend()}", file=sys.stderr)
+
+    import jax.numpy as jnp
+
+    from glom_tpu.config import GlomConfig, TrainConfig
+
+    if args.config == "large":
+        config = GlomConfig(dim=1024, levels=8, image_size=384, patch_size=16,
+                            compute_dtype=jnp.bfloat16, remat=True)
+        iters = 16
+    else:
+        config = GlomConfig(compute_dtype=jnp.bfloat16, remat=True)
+        iters = 12
+
+    # numerator 1: analytic model FLOPs.  Train step = forward + backward;
+    # backward of a matmul graph is 2x the forward matmuls (dX and dW) =>
+    # 3x forward, the standard convention (remat recompute excluded).
+    # Executed iterations = the loss timestep (default iters//2 + 1, matching
+    # TrainConfig) — the later iterations are dead code under the loss.
+    executed = args.loss_timestep if args.loss_timestep else iters // 2 + 1
+    fwd = model_flops_per_image(config, executed)
+    train_flops = 3.0 * fwd
+
+    mfu = args.imgs_per_sec * train_flops / (peak * 1e12)
+    print(f"analytic model FLOPs/img: fwd {fwd/1e9:.1f} GF "
+          f"({executed} executed iterations of {iters}), "
+          f"train {train_flops/1e9:.1f} GF")
+    print(f"MFU (model FLOPs)       : {100*mfu:.1f}%  "
+          f"({args.imgs_per_sec} imgs/s x {train_flops/1e9:.1f} GF / {peak} TF/s)")
+
+    if args.skip_compiled:
+        return
+
+    # numerator 2: what the compiled step really executes (includes remat)
+    import optax
+
+    from glom_tpu.training import denoise
+
+    train = TrainConfig(batch_size=args.batch_size, iters=iters, log_every=0)
+    tx = optax.adam(1e-4)
+    step = denoise.make_step_fn(config, train, tx)
+    rng = jax.random.PRNGKey(0)
+    state = jax.eval_shape(lambda: denoise.init_state(rng, config, tx))
+    img = jax.ShapeDtypeStruct(
+        (args.batch_size, 3, config.image_size, config.image_size), jnp.float32
+    )
+    lowered = jax.jit(step).lower(state, img)
+    cost = lowered.compile().cost_analysis()
+    if not cost or "flops" not in cost:
+        print("compiled cost model unavailable on this backend", file=sys.stderr)
+        return
+    compiled_per_img = float(cost["flops"]) / args.batch_size
+    hw_util = args.imgs_per_sec * compiled_per_img / (peak * 1e12)
+    print(f"compiled FLOPs/img      : {compiled_per_img/1e9:.1f} GF "
+          f"(x{compiled_per_img/train_flops:.2f} of model FLOPs — remat etc.)")
+    print(f"hardware utilization    : {100*hw_util:.1f}% of {peak} TF/s")
+    if jax.default_backend() == "cpu":
+        # observed: CPU reports ~0.1x the analytic count on this very step —
+        # it does not see into fused dot bodies the way the TPU model does
+        print("warning: the CPU backend's cost model under-counts fused dots; "
+              "treat compiled FLOPs as authoritative only on TPU",
+              file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
