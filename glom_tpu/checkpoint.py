@@ -78,6 +78,11 @@ def _npz_path(directory: str, step: int) -> str:
     return os.path.join(directory, f"ckpt_{step}.npz")
 
 
+def npz_path(directory: str, step: int) -> str:
+    """Where :func:`save` (npz backend) puts step ``step``'s artifact."""
+    return _npz_path(directory, step)
+
+
 def _orbax_path(directory: str, step: int) -> str:
     return os.path.abspath(os.path.join(directory, f"ckpt_{step}.orbax"))
 

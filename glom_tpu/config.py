@@ -124,6 +124,10 @@ class TrainConfig:
     checkpoint_every: int = 0            # 0 => disabled
     checkpoint_dir: Optional[str] = None
     checkpoint_backend: str = "npz"      # "npz" | "orbax" | "sharded"
+    # npz backend only: snapshot to host synchronously (correct under buffer
+    # donation), then serialize+write on a background thread so the step
+    # loop never stalls on checkpoint IO; at most one write in flight
+    async_checkpoint: bool = False
     profile_dir: Optional[str] = None    # jax.profiler trace of a 3-step window
     seed: int = 0
     # mesh axes: data-parallel x model(tensor)-parallel x sequence(column)-parallel

@@ -84,6 +84,9 @@ def parse_args(argv=None):
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--checkpoint-backend", default="npz", choices=["npz", "orbax", "sharded"])
+    p.add_argument("--async-checkpoint", action="store_true",
+                   help="npz backend: write checkpoints on a background "
+                        "thread (host snapshot stays synchronous)")
     p.add_argument("--profile-dir", default=None,
                    help="emit a jax.profiler trace of a 3-step window here")
     p.add_argument("--log-file", default=None)
@@ -134,6 +137,7 @@ def main(argv=None):
         checkpoint_every=args.checkpoint_every,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_backend=args.checkpoint_backend,
+        async_checkpoint=args.async_checkpoint,
         profile_dir=args.profile_dir,
         seed=args.seed,
         mesh_shape=tuple(args.mesh) if args.mesh else None,

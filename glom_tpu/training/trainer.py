@@ -184,7 +184,31 @@ class Trainer:
         self._eval_suite = suite
 
     # -- checkpointing ----------------------------------------------------
+    def finish_saves(self) -> None:
+        """Block until any in-flight async checkpoint write is durable.
+        Re-raises the writer's exception (a swallowed ENOSPC would let fit()
+        claim durability for a checkpoint that does not exist)."""
+        t = getattr(self, "_ckpt_thread", None)
+        if t is not None:
+            t.join()
+            self._ckpt_thread = None
+            err = self._ckpt_error
+            self._ckpt_error = None
+            if err is not None:
+                raise err
+
     def save(self, directory: str, *, data_state: Optional[dict] = None) -> str:
+        self.finish_saves()  # order manifests; bound in-flight writes to one
+        async_requested = self.train_cfg.async_checkpoint
+        if async_requested and self.train_cfg.checkpoint_backend != "npz":
+            import warnings
+
+            warnings.warn(
+                "async_checkpoint only applies to the npz backend (orbax is "
+                "internally async; sharded writes are O(local bytes) already)"
+                " — saving synchronously",
+                stacklevel=2,
+            )
         if self.train_cfg.checkpoint_backend == "sharded":
             # per-process shard writes: every process persists only its own
             # replica-0 tiles — no host gather, no cross-host traffic; each
@@ -222,9 +246,29 @@ class Trainer:
         trees = {"params": host_state.params, "opt": host_state.opt_state, "rng": host_state.rng}
         if data_state is not None:
             trees["data"] = data_state
+        step = int(host_state.step)
+        if async_requested and self.train_cfg.checkpoint_backend == "npz":
+            # host_state above is a device_get/gather snapshot (real numpy —
+            # safe even though the live buffers get donated into the next
+            # step); only the serialize+write moves off-thread.  Non-daemon:
+            # interpreter exit must not kill a write mid-savez.
+            import threading
+
+            self._ckpt_error = None
+
+            def _write():
+                try:
+                    ckpt_lib.save(directory, step, trees, backend="npz")
+                except BaseException as e:  # surfaced by finish_saves()
+                    self._ckpt_error = e
+
+            self._ckpt_thread = threading.Thread(target=_write)
+            self._ckpt_thread.start()
+            # same contract as the sync save: only the leader names a path
+            return ckpt_lib.npz_path(directory, step) if jax.process_index() == 0 else ""
         return ckpt_lib.save(
             directory,
-            int(host_state.step),
+            step,
             trees,
             backend=self.train_cfg.checkpoint_backend,
         )
@@ -236,6 +280,7 @@ class Trainer:
         ``ImageFolderStream`` contract) its cursor is restored too, so the
         stream resumes on the exact next batch; stateless synthetic/folder
         streams are unaffected."""
+        self.finish_saves()  # never read past an in-flight write
         step, trees = ckpt_lib.restore(
             directory,
             {"params": self.state.params, "opt": self.state.opt_state, "rng": self.state.rng},
@@ -269,7 +314,20 @@ class Trainer:
     def fit(self, batches: Iterator[np.ndarray], steps: Optional[int] = None) -> dict:
         """Run the step loop to ``steps`` total steps.  With a checkpoint dir
         the loop auto-resumes from the latest step — so a ``steps`` at or
-        below the checkpointed step is a no-op by design."""
+        below the checkpointed step is a no-op by design.  Drains the async
+        checkpoint writer on every exit path, including exceptions — an
+        in-flight write must never be stranded by a failing data iterator."""
+        try:
+            return self._fit(batches, steps)
+        finally:
+            try:
+                self.finish_saves()
+            except Exception:
+                # on the normal path _fit already drained (and would have
+                # raised); here an original exception is the one to surface
+                pass
+
+    def _fit(self, batches: Iterator[np.ndarray], steps: Optional[int] = None) -> dict:
         cfg = self.train_cfg
         steps = steps if steps is not None else cfg.steps
         if cfg.lr_schedule == "cosine" and steps > cfg.steps:
@@ -352,4 +410,5 @@ class Trainer:
                 cfg.checkpoint_dir,
                 data_state=batches.state_dict() if stateful_stream else None,
             )
+        self.finish_saves()  # fit returns only once the checkpoint is durable
         return last_metrics

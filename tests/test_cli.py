@@ -17,7 +17,7 @@ def test_every_train_config_field_has_a_cli_path():
     covered_by_flag = {
         "batch_size", "grad_accum_steps", "learning_rate", "lr_schedule", "warmup_steps", "weight_decay", "iters", "noise_std",
         "steps", "log_every", "eval_every", "checkpoint_every", "checkpoint_dir",
-        "checkpoint_backend",
+        "checkpoint_backend", "async_checkpoint",
         "profile_dir", "seed", "mesh_shape", "param_sharding",
         "consistency", "consistency_weight", "consistency_temperature",
         "consistency_level",

@@ -105,9 +105,13 @@ def main():
     # numerator 2: what the compiled step really executes (includes remat)
     import optax
 
+    from glom_tpu.profiling import cost_analysis
     from glom_tpu.training import denoise
 
-    train = TrainConfig(batch_size=args.batch_size, iters=iters, log_every=0)
+    # the SAME executed-iteration count as the analytic numerator, so the
+    # compiled/model ratio isolates remat + non-matmul overhead
+    train = TrainConfig(batch_size=args.batch_size, iters=iters, log_every=0,
+                        loss_timestep=executed)
     tx = optax.adam(1e-4)
     step = denoise.make_step_fn(config, train, tx)
     rng = jax.random.PRNGKey(0)
@@ -115,10 +119,13 @@ def main():
     img = jax.ShapeDtypeStruct(
         (args.batch_size, 3, config.image_size, config.image_size), jnp.float32
     )
-    lowered = jax.jit(step).lower(state, img)
-    cost = lowered.compile().cost_analysis()
-    if not cost or "flops" not in cost:
-        print("compiled cost model unavailable on this backend", file=sys.stderr)
+    try:
+        cost = cost_analysis(jax.jit(step), state, img)
+    except Exception as e:
+        print(f"compiled cost model unavailable: {e}", file=sys.stderr)
+        return
+    if "flops" not in cost:
+        print("compiled cost model reports no flops on this backend", file=sys.stderr)
         return
     compiled_per_img = float(cost["flops"]) / args.batch_size
     hw_util = args.imgs_per_sec * compiled_per_img / (peak * 1e12)
