@@ -71,13 +71,18 @@ def make_pipelined_apply(
     if ff_fn is None:
         ff_fn = glom_model.make_ff_fn(c)
 
-    def apply(params, img, *, iters: Optional[int] = None):
+    def apply(params, img, *, iters: Optional[int] = None,
+              capture_timestep: Optional[int] = None):
         glom_model.validate_img(img, c)
         if iters is None:
             iters = c.default_iters
         if iters % S != 0:
             raise ValueError(f"iters {iters} not divisible by {S} pipeline stages")
         k = iters // S
+        if capture_timestep is not None and not 0 <= capture_timestep <= iters:
+            raise ValueError(
+                f"capture_timestep {capture_timestep} outside [0, {iters}]"
+            )
         b = img.shape[0]
         if b % M != 0:
             raise ValueError(f"batch {b} not divisible by {M} microbatches")
@@ -92,30 +97,56 @@ def make_pipelined_apply(
         init_state = glom_model.initial_levels(params_c, mb, c, compute_dtype)
 
         divisors = glom_model.update_divisors(c, compute_dtype)
-        # the SAME step construction as the sequential scan — fuse_ff and the
-        # remat policy apply to pipeline stages identically
-        build_step = glom_model.make_step_builder(
-            params_c, c, pos_embs, divisors, consensus_fn, ff_fn
-        )
 
-        def stage_chunk(levels, toks):
-            """k sequential GLOM iterations on one microbatch (one stage)."""
-            step = build_step(toks[:, :, None, :])
+        # capture point: stage cap_stage's iteration cap_off (1-based within
+        # the chunk) IS the state after capture_timestep total iterations.
+        # Both are static, so mid-chunk capture costs one traced `where` per
+        # iteration.  (None => no capture; t=0 is the init state, no stage.)
+        if capture_timestep:
+            cap_stage = (capture_timestep - 1) // k
+            cap_off = capture_timestep - cap_stage * k      # in [1, k]
+        else:
+            cap_stage = None
 
-            def body(carry, _):
-                return step(carry), None
-            out, _ = jax.lax.scan(body, levels, None, length=k)
-            return out
-
-        def pipelined(tokens_mb):
+        def pipelined(tokens_mb, params_sm, pos_embs_sm, init_state):
             """Runs identically on every device of the pipe axis; the stage
-            id comes from ``axis_index``."""
+            id comes from ``axis_index``.  Every TRACED value the body needs
+            (params, pos embs, init state) enters as an explicit argument —
+            closure-capturing traced arrays inside shard_map breaks once the
+            caller's inputs carry mesh shardings (e.g. from the previous
+            train step's output)."""
+            # the SAME step construction as the sequential scan — fuse_ff and
+            # the remat policy apply to pipeline stages identically
+            build_step = glom_model.make_step_builder(
+                params_sm, c, pos_embs_sm, divisors, consensus_fn, ff_fn
+            )
+
+            def stage_chunk(levels, toks):
+                """k sequential GLOM iterations on one microbatch (one
+                stage).  Returns ``(final, cap)`` where ``cap`` is the state
+                after the chunk's ``cap_off``-th iteration (meaningful only
+                on the capture-owning stage; zeros elsewhere/off)."""
+                step = build_step(toks[:, :, None, :])
+
+                def body(carry, i):
+                    state, cap = carry
+                    new = step(state)
+                    if cap is not None:
+                        cap = jnp.where(i == cap_off - 1, new, cap)
+                    return (new, cap), None
+
+                cap0 = None if cap_stage is None else jnp.zeros_like(levels)
+                (out, cap), _ = jax.lax.scan(
+                    body, (levels, cap0), jnp.arange(k)
+                )
+                return out, cap
+
             s = jax.lax.axis_index(pipe_axis)
             T = M + S - 1
             fwd_perm = [(i, i + 1) for i in range(S - 1)]
 
             def step(carry, t):
-                cur, out_buf = carry
+                cur, out_buf, cap_buf = carry
                 # boundary exchange: my just-finished state goes to stage
                 # s+1; stage 0 receives garbage (overwritten below)
                 recv = jax.lax.ppermute(cur, pipe_axis, fwd_perm) if S > 1 else cur
@@ -125,35 +156,65 @@ def make_pipelined_apply(
                     tokens_mb, idx, axis=0, keepdims=False
                 )
                 inp = jnp.where(s == 0, init_state, recv)
-                done = stage_chunk(inp, toks)
+                done, cap = stage_chunk(inp, toks)
                 active = (my_idx >= 0) & (my_idx < M)
                 cur = jnp.where(active, done, cur)
+
+                def retire(buf, val, write):
+                    # overwrite slot idx with `val` where this stage owns
+                    # the write, else keep the existing slot
+                    return jax.lax.dynamic_update_index_in_dim(
+                        buf,
+                        jnp.where(write, val, jax.lax.dynamic_index_in_dim(
+                            buf, idx, axis=0, keepdims=False)),
+                        idx, axis=0,
+                    )
+
                 # last stage retires one microbatch per step after the fill
-                write = active & (s == S - 1)
-                out_buf = jax.lax.dynamic_update_index_in_dim(
-                    out_buf,
-                    jnp.where(write, done, jax.lax.dynamic_index_in_dim(
-                        out_buf, idx, axis=0, keepdims=False)),
-                    idx, axis=0,
-                )
-                return (cur, out_buf), None
+                out_buf = retire(out_buf, done, active & (s == S - 1))
+                if cap_buf is not None:
+                    # the capture stage's mid-chunk snapshot IS the state
+                    # after capture_timestep iterations of this microbatch
+                    cap_buf = retire(cap_buf, cap, active & (s == cap_stage))
+                return (cur, out_buf, cap_buf), None
 
             out0 = jnp.zeros((M,) + init_state.shape, init_state.dtype)
-            (_, out_buf), _ = jax.lax.scan(
-                step, (init_state, out0), jnp.arange(T)
+            cap0 = None if cap_stage is None else jnp.zeros_like(out0)
+            (_, out_buf, cap_buf), _ = jax.lax.scan(
+                step, (init_state, out0, cap0), jnp.arange(T)
             )
             # out_buf is populated only on the last stage; psum replicates the
             # finished states across the pipe axis (all other stages hold 0)
-            mask = (s == S - 1).astype(out_buf.dtype)
-            return jax.lax.psum(out_buf * mask, pipe_axis)
+            def replicate(buf, owner):
+                return jax.lax.psum(buf * (s == owner).astype(buf.dtype), pipe_axis)
 
-        out = jax.shard_map(
+            out = replicate(out_buf, S - 1)
+            if cap_stage is None:
+                return out
+            return out, replicate(cap_buf, cap_stage)
+
+        run = jax.shard_map(
             pipelined,
             mesh=mesh,
-            in_specs=P(),      # tokens replicated over the pipe axis
+            # everything replicated over the pipe axis (params/tokens/state);
+            # only the schedule is parallel
+            in_specs=(P(), P(), P(), P()),
             out_specs=P(),     # finished states replicated (post-psum)
             check_vma=False,
-        )(tokens_mb)
-        return out.reshape(b, n, c.levels, c.dim)
+        )
+        args = (tokens_mb, params_c, pos_embs, init_state)
+        if capture_timestep is None:
+            out = run(*args)
+            return out.reshape(b, n, c.levels, c.dim)
+        if capture_timestep == 0:
+            # t=0 is the (broadcast) initial state — no stage computes it
+            out = run(*args).reshape(b, n, c.levels, c.dim)
+            captured = glom_model.initial_levels(params_c, b, c, compute_dtype)
+            return out, captured
+        out, captured = run(*args)
+        return (
+            out.reshape(b, n, c.levels, c.dim),
+            captured.reshape(b, n, c.levels, c.dim),
+        )
 
     return apply

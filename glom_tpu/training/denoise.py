@@ -45,8 +45,16 @@ def init_state(
     return DenoiseState(params, tx.init(params), jnp.zeros((), jnp.int32), k_train)
 
 
-def make_loss_fn(config: GlomConfig, train: TrainConfig, *, consensus_fn=None, ff_fn=None):
-    """loss(params, img, rng) -> (loss, recon).  Mirrors README.md:74-88."""
+def make_loss_fn(config: GlomConfig, train: TrainConfig, *, consensus_fn=None,
+                 ff_fn=None, apply_fn=None):
+    """loss(params, img, rng) -> (loss, recon).  Mirrors README.md:74-88.
+
+    ``apply_fn`` overrides the forward entirely — a pipeline-parallel caller
+    passes ``glom_tpu.parallel.pipeline.make_pipelined_apply(...)`` (which
+    closed over its mesh/config/consensus/FF choices) and then feeds the
+    resulting step fn to ``jax.jit`` itself; the contract is
+    ``apply_fn(glom_params, img, iters=..., capture_timestep=t) ->
+    (final, state_after_t)``."""
     iters = train.iters if train.iters is not None else config.default_iters
     timestep = train.loss_timestep if train.loss_timestep is not None else iters // 2 + 1
     if not 0 <= timestep <= iters:
@@ -68,10 +76,15 @@ def make_loss_fn(config: GlomConfig, train: TrainConfig, *, consensus_fn=None, f
             noised = img + noise
         # capture_timestep: only the loss timestep's state is kept — the
         # (iters+1, b, n, L, d) return_all stack never exists on this path
-        _, captured = glom_model.apply(
-            params["glom"], noised, config=config, iters=iters,
-            capture_timestep=timestep, consensus_fn=consensus_fn, ff_fn=ff_fn,
-        )
+        if apply_fn is not None:
+            _, captured = apply_fn(
+                params["glom"], noised, iters=iters, capture_timestep=timestep
+            )
+        else:
+            _, captured = glom_model.apply(
+                params["glom"], noised, config=config, iters=iters,
+                capture_timestep=timestep, consensus_fn=consensus_fn, ff_fn=ff_fn,
+            )
         tokens = captured[:b, :, train.loss_level]  # (b, n, d)
         recon = patches_to_images_apply(params["decoder"], tokens, config)
         # accumulate the loss in AT LEAST fp32 (bf16 compute upcasts; f64
@@ -101,6 +114,7 @@ def make_step_fn(
     *,
     consensus_fn=None,
     ff_fn=None,
+    apply_fn=None,
     microbatch_sharding=None,
 ):
     """Un-jitted train step ``state, img -> state, metrics`` — the body the
@@ -112,7 +126,8 @@ def make_step_fn(
     the batch) this is numerically the full-batch step; batch-coupled terms
     (InfoNCE consistency) see per-microbatch negatives instead — documented
     semantics, not drift."""
-    loss_fn = make_loss_fn(config, train, consensus_fn=consensus_fn, ff_fn=ff_fn)
+    loss_fn = make_loss_fn(config, train, consensus_fn=consensus_fn, ff_fn=ff_fn,
+                           apply_fn=apply_fn)
     accum = train.grad_accum_steps
 
     def step_fn(state: DenoiseState, img: jax.Array) -> Tuple[DenoiseState, dict]:

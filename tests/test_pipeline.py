@@ -100,6 +100,67 @@ def test_pipeline_honors_remat_and_fuse_ff():
     )
 
 
+def test_pipeline_capture_matches_sequential():
+    """capture_timestep at a stage boundary returns the same mid-trajectory
+    state as the sequential fast path."""
+    params = glom_model.init(jax.random.PRNGKey(9), CFG)
+    img = _img(4, key=10)
+    mesh = _mesh(2)
+    pp = make_pipelined_apply(mesh, CFG, num_microbatches=2)
+    for t in (0, 1, 2, 3, 4):  # boundary AND mid-chunk timesteps (k=2)
+        got_f, got_c = jax.jit(
+            lambda p, x, t=t: pp(p, x, iters=4, capture_timestep=t)
+        )(params, img)
+        want_f, want_c = glom_model.apply(
+            params, img, config=CFG, iters=4, capture_timestep=t
+        )
+        np.testing.assert_allclose(np.asarray(got_f), np.asarray(want_f),
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(got_c), np.asarray(want_c),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_train_step_matches_sequential():
+    """The denoising train step with the pipelined forward (apply_fn
+    override) produces the same loss and updated params as the sequential
+    step — PP training end-to-end."""
+    import optax
+
+    from glom_tpu.config import TrainConfig
+    from glom_tpu.training import denoise
+
+    # default loss_timestep (iters//2 + 1 = 3) — deliberately NOT a stage
+    # boundary for k=2, exercising the mid-chunk capture in the train step
+    train = TrainConfig(batch_size=4, iters=4, log_every=0)
+    tx = optax.adam(1e-3)
+    state = denoise.init_state(jax.random.PRNGKey(11), CFG, tx)
+    img = _img(4, key=12)
+
+    mesh = _mesh(2)
+    pp = make_pipelined_apply(mesh, CFG, num_microbatches=2)
+    step_pp = jax.jit(denoise.make_step_fn(CFG, train, tx, apply_fn=pp))
+    step_seq = jax.jit(denoise.make_step_fn(CFG, train, tx))
+
+    new_pp, m_pp = step_pp(state, img)
+    new_seq, m_seq = step_seq(state, img)
+    np.testing.assert_allclose(np.asarray(m_pp["loss"]), np.asarray(m_seq["loss"]),
+                               atol=1e-6, rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
+        ),
+        new_pp.params, new_seq.params,
+    )
+
+
+def test_pipeline_capture_range_validated():
+    params = glom_model.init(jax.random.PRNGKey(13), CFG)
+    mesh = _mesh(2)
+    pp = make_pipelined_apply(mesh, CFG)
+    with pytest.raises(ValueError, match="outside"):
+        pp(params, _img(4), iters=4, capture_timestep=5)
+
+
 def test_pipeline_validation():
     params = glom_model.init(jax.random.PRNGKey(6), CFG)
     mesh = _mesh(4)
