@@ -50,21 +50,35 @@ def make_pipelined_apply(
     config: GlomConfig,
     *,
     pipe_axis: str = "pipe",
+    data_axis: Optional[str] = None,
     num_microbatches: Optional[int] = None,
     consensus_fn=None,
     ff_fn=None,
 ):
-    """Build ``apply(params, img, *, iters) -> (b, n, L, d)`` running the
-    iteration loop as an S-stage GPipe pipeline over ``pipe_axis``.
+    """Build ``apply(params, img, *, iters, capture_timestep)`` running the
+    iteration loop as an S-stage GPipe pipeline over ``pipe_axis``.  Returns
+    the final ``(b, n, L, d)`` state — or, with ``capture_timestep=t``, the
+    tuple ``(final, state_after_t_iterations)`` (any ``t`` in ``[0, iters]``;
+    mid-chunk snapshots cost one traced ``where`` per iteration), matching
+    the contract ``glom_tpu.training.denoise.make_loss_fn`` expects of its
+    ``apply_fn`` override.
+
+    ``data_axis``: optional second mesh axis — every microbatch's batch dim
+    shards over it (PP x DP): each (stage, data-slice) device runs the
+    schedule on its slice of every microbatch, ``ppermute`` stays within the
+    data slice, and params remain replicated (their gradient psum over both
+    axes comes from the shard_map transpose).
 
     Constraints (checked at trace time): ``iters % S == 0`` (equal chunks)
-    and ``batch % num_microbatches == 0``.  ``num_microbatches`` defaults to
-    S (minimum that fills the pipe; more microbatches shrink the bubble).
+    and ``batch % num_microbatches == 0`` (and the per-microbatch batch
+    divisible by the data-axis size).  ``num_microbatches`` defaults to S
+    (minimum that fills the pipe; more microbatches shrink the bubble).
     Numerics are identical to :func:`glom_tpu.models.glom.apply` — asserted
     by ``tests/test_pipeline.py`` against the sequential forward.
     """
     c = config
     S = mesh.shape[pipe_axis]
+    D = mesh.shape[data_axis] if data_axis else 1
     M = num_microbatches or S
     if consensus_fn is None:
         consensus_fn = glom_model.make_consensus_fn(c)
@@ -87,6 +101,11 @@ def make_pipelined_apply(
         if b % M != 0:
             raise ValueError(f"batch {b} not divisible by {M} microbatches")
         mb = b // M
+        if mb % D != 0:
+            raise ValueError(
+                f"microbatch size {mb} (batch {b} / {M} microbatches) not "
+                f"divisible by data-axis size {D}"
+            )
 
         params_c, img_c, compute_dtype = glom_model.cast_for_compute(params, img, c)
 
@@ -193,13 +212,17 @@ def make_pipelined_apply(
                 return out
             return out, replicate(cap_buf, cap_stage)
 
+        # with a data axis, each microbatch's batch dim shards over it: the
+        # schedule runs per (stage, data-slice); without one everything is
+        # replicated over the pipe axis and only the schedule is parallel
+        sliced = P(None, data_axis) if data_axis else P()  # (M, mb, ...) dims
+        state_spec = P(data_axis) if data_axis else P()    # (mb, n, L, d) dims
         run = jax.shard_map(
             pipelined,
             mesh=mesh,
-            # everything replicated over the pipe axis (params/tokens/state);
-            # only the schedule is parallel
-            in_specs=(P(), P(), P(), P()),
-            out_specs=P(),     # finished states replicated (post-psum)
+            in_specs=(sliced, P(), P(), state_spec),
+            out_specs=sliced,  # finished states: pipe-replicated (post-psum),
+                               # data-sharded on the microbatch batch dim
             check_vma=False,
         )
         args = (tokens_mb, params_c, pos_embs, init_state)
