@@ -34,6 +34,23 @@ def test_every_train_config_field_has_a_cli_path():
         assert field in ns or field.replace("_", "-") in ns, field
 
 
+def test_is_tpu_device_predicate():
+    """TPU plugins can register under nonstandard platform names (this build
+    env's tunnel reports platform 'axon', device_kind 'TPU v5 lite0') — the
+    predicate must catch those AND not claim GPUs/CPUs."""
+    from glom_tpu.parallel.mesh import is_tpu_device
+
+    class Dev:
+        def __init__(self, platform, device_kind):
+            self.platform, self.device_kind = platform, device_kind
+
+    assert is_tpu_device(Dev("tpu", "TPU v4"))
+    assert is_tpu_device(Dev("axon", "TPU v5 lite0"))
+    assert not is_tpu_device(Dev("cpu", "cpu"))
+    assert not is_tpu_device(Dev("gpu", "NVIDIA A100-SXM4-40GB"))
+    assert not is_tpu_device(Dev("cuda", None))
+
+
 def test_glom_config_flags_roundtrip():
     args = parse_args([
         "--dim", "64", "--levels", "4", "--image-size", "32", "--patch-size", "8",
