@@ -91,6 +91,25 @@ class GlomConfig:
         """Per-(batch, patch) level-state shape ``(levels, dim)``."""
         return (self.levels, self.dim)
 
+    def to_json_dict(self) -> dict:
+        """JSON-serializable form (dtypes become their string names).  Used
+        to make checkpoint directories self-describing — the model config is
+        written next to the weights and validated on restore."""
+        d = dataclasses.asdict(self)
+        d["param_dtype"] = jnp.dtype(self.param_dtype).name
+        d["compute_dtype"] = (
+            None if self.compute_dtype is None else jnp.dtype(self.compute_dtype).name
+        )
+        return d
+
+    @classmethod
+    def from_json_dict(cls, d: dict) -> "GlomConfig":
+        d = dict(d)
+        d["param_dtype"] = jnp.dtype(d["param_dtype"])
+        if d.get("compute_dtype") is not None:
+            d["compute_dtype"] = jnp.dtype(d["compute_dtype"])
+        return cls(**d)
+
 
 @dataclasses.dataclass(frozen=True)
 class TrainConfig:
@@ -163,3 +182,20 @@ class TrainConfig:
                 f"batch_size {self.batch_size} not divisible by "
                 f"grad_accum_steps {self.grad_accum_steps}"
             )
+
+    def to_json_dict(self) -> dict:
+        """JSON-serializable form (tuples become lists); informational — the
+        training config may legitimately change across a resume."""
+        d = dataclasses.asdict(self)
+        if d.get("mesh_shape") is not None:
+            d["mesh_shape"] = list(d["mesh_shape"])
+        d["mesh_axes"] = list(d["mesh_axes"])
+        return d
+
+    @classmethod
+    def from_json_dict(cls, d: dict) -> "TrainConfig":
+        d = dict(d)
+        if d.get("mesh_shape") is not None:
+            d["mesh_shape"] = tuple(d["mesh_shape"])
+        d["mesh_axes"] = tuple(d.get("mesh_axes", ("data", "model", "seq")))
+        return cls(**d)

@@ -197,8 +197,71 @@ class Trainer:
             if err is not None:
                 raise err
 
+    def _write_config_json(self, directory: str) -> None:
+        """Make the checkpoint directory self-describing: the model config
+        (validated on restore) plus the training config (informational) next
+        to the weights.  Leader-only, atomic, written once per directory."""
+        import json
+        import os
+
+        if jax.process_index() != 0:
+            return
+        path = os.path.join(directory, "config.json")
+        if os.path.exists(path):
+            return
+        os.makedirs(directory, exist_ok=True)
+        payload = json.dumps(
+            {"glom": self.config.to_json_dict(),
+             "train": self.train_cfg.to_json_dict()},
+            indent=2,
+        ).encode()
+        ckpt_lib._atomic_write(directory, "config.json", lambda f: f.write(payload))
+
+    # fields that determine parameter shapes/meaning — a mismatch means the
+    # weights belong to a different architecture.  Execution knobs
+    # (attention_impl, remat, dtypes, ...) may legitimately change across a
+    # resume: every impl is numerically interchangeable (PARITY.md).
+    _ARCH_FIELDS = ("dim", "levels", "image_size", "patch_size", "channels", "ff_mult")
+
+    def _validate_config_json(self, directory: str) -> None:
+        import json
+        import os
+
+        path = os.path.join(directory, "config.json")
+        if not os.path.exists(path):
+            return  # pre-0.2 checkpoint dirs carry no config record
+        with open(path) as f:
+            recorded = json.load(f)["glom"]
+        mine = self.config.to_json_dict()
+        arch_diff = {
+            k: (recorded.get(k), mine.get(k))
+            for k in self._ARCH_FIELDS
+            if recorded.get(k) != mine.get(k)
+        }
+        if arch_diff:
+            raise ValueError(
+                f"checkpoint dir {directory} was written by a different model "
+                f"architecture; refusing to load its weights. Differing "
+                f"fields (checkpoint, this trainer): {arch_diff}"
+            )
+        other_diff = {
+            k: (recorded.get(k), mine.get(k))
+            for k in sorted(set(recorded) | set(mine))
+            if k not in self._ARCH_FIELDS and recorded.get(k) != mine.get(k)
+        }
+        if other_diff:
+            import warnings
+
+            warnings.warn(
+                f"resuming with different model-config knobs than the "
+                f"checkpoint was trained with (checkpoint, this trainer): "
+                f"{other_diff}",
+                stacklevel=2,
+            )
+
     def save(self, directory: str, *, data_state: Optional[dict] = None) -> str:
         self.finish_saves()  # order manifests; bound in-flight writes to one
+        self._write_config_json(directory)
         async_requested = self.train_cfg.async_checkpoint
         if async_requested and self.train_cfg.checkpoint_backend != "npz":
             import warnings
@@ -279,8 +342,15 @@ class Trainer:
         ``batches`` exposes ``state_dict``/``load_state_dict`` (the
         ``ImageFolderStream`` contract) its cursor is restored too, so the
         stream resumes on the exact next batch; stateless synthetic/folder
-        streams are unaffected."""
+        streams are unaffected.
+
+        If the directory carries a ``config.json`` (written by save), its
+        MODEL config must match this trainer's — loading weights into a
+        different architecture is refused rather than crashing downstream
+        (or, worse, silently reinterpreting shapes).  The recorded training
+        config is informational only (it may legitimately change)."""
         self.finish_saves()  # never read past an in-flight write
+        self._validate_config_json(directory)
         step, trees = ckpt_lib.restore(
             directory,
             {"params": self.state.params, "opt": self.state.opt_state, "rng": self.state.rng},

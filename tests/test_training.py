@@ -329,6 +329,60 @@ def test_checkpoint_async_roundtrip(tmp_path):
     assert ckpt_lib.latest_step(str(tmp_path)) == 4
 
 
+def test_config_json_roundtrip():
+    import jax.numpy as jnp
+
+    from glom_tpu.config import GlomConfig
+
+    c = GlomConfig(dim=64, levels=4, image_size=32, patch_size=8,
+                   compute_dtype=jnp.bfloat16, remat=True, ff_impl="pallas")
+    assert GlomConfig.from_json_dict(c.to_json_dict()) == c
+    t = TrainConfig(batch_size=16, mesh_shape=(2, 2, 2), async_checkpoint=True)
+    assert TrainConfig.from_json_dict(t.to_json_dict()) == t
+
+
+def test_checkpoint_dir_is_self_describing(tmp_path):
+    """save() writes config.json; restore() refuses a different architecture
+    and warns (but proceeds) on execution-knob differences."""
+    import json
+
+    c = TINY
+    t = TrainConfig(batch_size=8, iters=2, checkpoint_dir=str(tmp_path),
+                    checkpoint_every=2, steps=2, log_every=0)
+    Trainer(c, t).fit(synthetic_batches(8, 16), steps=2)
+    recorded = json.loads((tmp_path / "config.json").read_text())
+    assert recorded["glom"]["dim"] == c.dim
+
+    import dataclasses
+    import pytest
+
+    wrong_arch = dataclasses.replace(c, dim=c.dim * 2)
+    with pytest.raises(ValueError, match="different model architecture"):
+        Trainer(wrong_arch, t).restore(str(tmp_path))
+
+    knob_change = dataclasses.replace(c, remat=not c.remat)
+    with pytest.warns(UserWarning, match="different model-config knobs"):
+        assert Trainer(knob_change, t).restore(str(tmp_path)) == 2
+
+
+def test_training_is_deterministic():
+    """Same seed, same data => bit-identical params after several steps (the
+    whole step is one jitted graph; RNG is counter-based)."""
+    c = TINY
+    t = TrainConfig(batch_size=8, iters=2, steps=3, log_every=0, seed=7)
+
+    def run():
+        tr = Trainer(c, t)
+        tr.fit(synthetic_batches(8, 16, seed=5), steps=3)
+        return jax.device_get(tr.state.params)
+
+    p1, p2 = run(), run()
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        p1, p2,
+    )
+
+
 def test_checkpoint_orbax_backend_roundtrip(tmp_path):
     """backend='orbax' writes via StandardCheckpointer; restore() reads the
     backend from the manifest transparently."""
