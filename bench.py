@@ -38,39 +38,83 @@ def main():
     p.add_argument("--attention-impl", default="dense", choices=["dense", "pallas", "ring", "ulysses"])
     p.add_argument("--ff-impl", default="auto", choices=["auto", "dense", "pallas"],
                    help="auto = pallas on TPU (the fastest hardware-verified "
-                        "config: +10%% over dense), dense on the CPU fallback "
+                        "config: ~+10%% over dense, 282.4 vs 255.6 in the "
+                        "round-2 window), dense on the CPU fallback "
                         "(interpret-mode pallas would be pathologically slow)")
     p.add_argument("--fused-ff-bwd", action="store_true",
                    help="with --ff-impl pallas: fused Pallas backward kernels "
                         "instead of the default XLA einsum VJP")
-    p.add_argument("--device-probe-timeout", type=int, default=180,
-                   help="seconds allowed for device init before emitting an "
-                        "error JSON line and exiting; <= 0 disables the watchdog")
+    p.add_argument("--device-probe-timeout", type=int, default=240,
+                   help="seconds to retry-poll the accelerator relay before "
+                        "emitting an error JSON line and exiting; <= 0 "
+                        "disables the guard")
     args = p.parse_args()
 
     metric = "denoise_ssl_train_imgs_per_sec_per_chip"
     if args.config != "flagship":
         metric += f"_{args.config}"
 
-    # A wedged accelerator tunnel makes jax.devices() hang forever (even a
-    # probe subprocess can become unreapable in D-state); an in-process timer
-    # guarantees the JSON line gets emitted, with a single device init.
+    def _emit_error(msg):
+        print(json.dumps({
+            "metric": metric,
+            "value": 0.0,
+            "unit": "imgs/sec/chip",
+            "vs_baseline": 0.0,
+            "error": msg,
+        }), flush=True)
+
+    # Device guard.  A wedged axon tunnel makes jax.devices() hang forever,
+    # so never walk into device init blind: first retry-poll a cheap TCP
+    # probe of the relay (127.0.0.1:8083 — jax.devices() goes through it)
+    # in a loop until the deadline, so a tunnel that recovers mid-window is
+    # still caught; only once the port accepts do we attempt the one device
+    # init, itself under a watchdog (a port that accepts but a backend that
+    # hangs must still produce a JSON line).
+    import os
+
+    timer = None
+    expect_axon = "axon" in os.environ.get("JAX_PLATFORMS", "")
     if args.device_probe_timeout > 0:
-        import os
         import threading
 
+        init_budget = float(args.device_probe_timeout)
+        if expect_axon:
+            # Under an axon tunnel, poll the relay before touching jax at
+            # all — a dead relay makes jax.devices() hang forever, while the
+            # probe is cheap and a tunnel that recovers mid-window is caught.
+            import socket
+
+            def _relay_up():
+                try:
+                    with socket.create_connection(("127.0.0.1", 8083), timeout=3):
+                        return True
+                except OSError:
+                    return False
+
+            deadline = time.time() + args.device_probe_timeout
+            up = _relay_up()
+            while not up and time.time() < deadline:
+                time.sleep(5)
+                up = _relay_up()
+            if not up:
+                _emit_error(
+                    f"accelerator relay 127.0.0.1:8083 unreachable for "
+                    f"{args.device_probe_timeout}s (retry-polled)")
+                raise SystemExit(2)
+            # Port accepts: give the single init attempt a floor of 120s
+            # even if polling consumed most of the budget (first init after
+            # recovery can be slow).
+            init_budget = max(120.0, deadline - time.time())
+
+        # One init attempt, watchdog-guarded on EVERY platform — the timer
+        # only fires if jax.devices() itself wedges.
         def _watchdog():
-            print(json.dumps({
-                "metric": metric,
-                "value": 0.0,
-                "unit": "imgs/sec/chip",
-                "vs_baseline": 0.0,
-                "error": f"device init exceeded {args.device_probe_timeout}s "
-                         "(accelerator unreachable)",
-            }), flush=True)
+            _emit_error(
+                f"device init exceeded {init_budget:.0f}s "
+                "(accelerator unreachable or backend wedged)")
             os._exit(2)
 
-        timer = threading.Timer(args.device_probe_timeout, _watchdog)
+        timer = threading.Timer(init_budget, _watchdog)
         timer.daemon = True
         timer.start()
 
@@ -82,7 +126,7 @@ def main():
     from glom_tpu.training.trainer import Trainer
 
     on_tpu = jax.devices()[0].platform != "cpu"
-    if args.device_probe_timeout > 0:
+    if timer is not None:
         timer.cancel()  # device init completed; the guarded window is over
     if args.ff_impl == "auto":
         # pltpu kernels only lower on TPU; any other backend (cpu, gpu) takes

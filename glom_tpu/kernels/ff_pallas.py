@@ -348,7 +348,15 @@ def grouped_ff_pallas(
     hidden activation kept in VMEM.  ``fused_bwd=True`` additionally runs the
     backward through the fused Pallas kernels (hidden recomputed per tile,
     never in HBM); the default is the XLA einsum VJP until the fused backward
-    has a hardware A/B check on record (tools/hw_check.py)."""
+    has a hardware A/B check on record (tools/hw_check.py).
+
+    Fused-backward dtype contract: the incoming cotangent is cast to
+    ``x.dtype`` before entering the kernels (inside each tile everything
+    accumulates in f32).  On every ``jax.vjp``/``jax.grad`` path the
+    cotangent already matches the output dtype (= ``x.dtype``), so the cast
+    is a no-op there; it only matters for direct ``_backward_fused`` calls
+    with a wider cotangent, which therefore see bf16-precision grads —
+    tools/hw_check.py's bf16 A/B pins the realistic-case tolerances."""
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     return _ff_pallas(x, params, interpret, fused_bwd)

@@ -35,6 +35,7 @@ every microbatch can itself be data-sharded).
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -51,17 +52,25 @@ def make_pipelined_apply(
     *,
     pipe_axis: str = "pipe",
     data_axis: Optional[str] = None,
+    model_axis: Optional[str] = None,
+    seq_axis: Optional[str] = None,
     num_microbatches: Optional[int] = None,
     consensus_fn=None,
     ff_fn=None,
 ):
-    """Build ``apply(params, img, *, iters, capture_timestep)`` running the
-    iteration loop as an S-stage GPipe pipeline over ``pipe_axis``.  Returns
-    the final ``(b, n, L, d)`` state — or, with ``capture_timestep=t``, the
-    tuple ``(final, state_after_t_iterations)`` (any ``t`` in ``[0, iters]``;
-    mid-chunk snapshots cost one traced ``where`` per iteration), matching
-    the contract ``glom_tpu.training.denoise.make_loss_fn`` expects of its
-    ``apply_fn`` override.
+    """Build ``apply(params, img, *, iters, capture_timestep, return_all)``
+    running the iteration loop as an S-stage GPipe pipeline over
+    ``pipe_axis``.  Returns the final ``(b, n, L, d)`` state — or, with
+    ``capture_timestep=t``, the tuple ``(final, state_after_t_iterations)``
+    (any ``t`` in ``[0, iters]``; mid-chunk snapshots cost one traced
+    ``where`` per iteration), matching the contract
+    ``glom_tpu.training.denoise.make_loss_fn`` expects of its ``apply_fn``
+    override — or, with ``return_all=True``, the full ``(iters+1, b, n, L, d)``
+    trajectory (`glom_pytorch.py:147-148` contract): each stage stacks its
+    own k-iteration chunk, so the trajectory lives sharded over the pipe
+    axis until the final concatenation (no stage ever holds more than its
+    ``iters/S`` share).  ``capture_timestep`` takes precedence over
+    ``return_all``, mirroring the sequential ``apply``.
 
     ``data_axis``: optional second mesh axis — every microbatch's batch dim
     shards over it (PP x DP): each (stage, data-slice) device runs the
@@ -69,24 +78,71 @@ def make_pipelined_apply(
     data slice, and params remain replicated (their gradient psum over both
     axes comes from the shard_map transpose).
 
-    Constraints (checked at trace time): ``iters % S == 0`` (equal chunks)
-    and ``batch % num_microbatches == 0`` (and the per-microbatch batch
-    divisible by the data-axis size).  ``num_microbatches`` defaults to S
-    (minimum that fills the pipe; more microbatches shrink the bubble).
-    Numerics are identical to :func:`glom_tpu.models.glom.apply` — asserted
-    by ``tests/test_pipeline.py`` against the sequential forward.
+    ``model_axis``: optional tensor-parallel axis — each stage chunk's
+    grouped FFs run column-/row-parallel over it (w1 sharded on the hidden
+    dim, w2 on its input dim; one psum per FF call completes the
+    row-parallel matmul, with b2 added exactly once).  Composes with any
+    ``ff_fn`` (XLA einsum or the fused Pallas kernel — the wrap zeroes b2
+    per shard exactly like ``parallel.ff_shard``'s tp path).
+
+    ``seq_axis``: optional sequence-parallel axis — the ``n`` patch columns
+    shard over it and each stage's consensus runs the ring exchange
+    (``parallel.ring.ring_consensus_attention``) inside the same shard_map;
+    the n×n similarity never materializes and ppermutes stay within each
+    (stage, data-slice) submesh.  With ``seq_axis`` set, an explicit
+    ``consensus_fn`` MUST be a collective (in-shard_map) implementation over
+    ``seq_axis`` — e.g. ``ring.ring_consensus_attention`` or
+    ``ulysses._ulysses_local`` partial-bound to the axis name; a dense fn
+    would silently attend over only the local n/SP columns (shapes stay
+    valid), which is why the default installs the ring form for you.
+
+    Constraints (checked at trace time): ``iters % S == 0`` (equal chunks),
+    ``batch % num_microbatches == 0`` (and the per-microbatch batch
+    divisible by the data-axis size), ``n % seq_size == 0``, and the FF
+    hidden width divisible by the model-axis size.  ``num_microbatches``
+    defaults to S (minimum that fills the pipe; more microbatches shrink
+    the bubble).  Numerics are identical to
+    :func:`glom_tpu.models.glom.apply` — asserted by
+    ``tests/test_pipeline.py`` against the sequential forward.
     """
     c = config
     S = mesh.shape[pipe_axis]
     D = mesh.shape[data_axis] if data_axis else 1
+    SP = mesh.shape[seq_axis] if seq_axis else 1
+    TP = mesh.shape[model_axis] if model_axis else 1
     M = num_microbatches or S
     if consensus_fn is None:
-        consensus_fn = glom_model.make_consensus_fn(c)
+        if seq_axis is not None:
+            from glom_tpu.parallel.ring import ring_consensus_attention
+
+            consensus_fn = functools.partial(
+                ring_consensus_attention,
+                attend_self=c.consensus_self,
+                non_local_mask=glom_model.resolve_locality_mask(c),
+                axis_name=seq_axis,
+            )
+        else:
+            consensus_fn = glom_model.make_consensus_fn(c)
     if ff_fn is None:
         ff_fn = glom_model.make_ff_fn(c)
+    if model_axis is not None:
+        hidden = c.dim * c.ff_mult
+        if hidden % TP != 0:
+            raise ValueError(
+                f"FF hidden width {hidden} not divisible by model-axis size {TP}"
+            )
+        base_ff = ff_fn
+
+        def ff_fn(p, x):
+            # row-parallel second matmul: local partial with b2 = 0, one
+            # psum over the model axis, b2 added exactly once (exact — no
+            # b2/TP rounding); same contract as parallel.ff_shard's tp path
+            local = dict(p, b2=jnp.zeros_like(p["b2"]))
+            return jax.lax.psum(base_ff(local, x), model_axis) + p["b2"]
 
     def apply(params, img, *, iters: Optional[int] = None,
-              capture_timestep: Optional[int] = None):
+              capture_timestep: Optional[int] = None,
+              return_all: bool = False):
         glom_model.validate_img(img, c)
         if iters is None:
             iters = c.default_iters
@@ -106,6 +162,12 @@ def make_pipelined_apply(
                 f"microbatch size {mb} (batch {b} / {M} microbatches) not "
                 f"divisible by data-axis size {D}"
             )
+        if c.num_patches % SP != 0:
+            raise ValueError(
+                f"n={c.num_patches} patch columns not divisible by seq-axis "
+                f"size {SP}"
+            )
+        want_traj = return_all and capture_timestep is None
 
         params_c, img_c, compute_dtype = glom_model.cast_for_compute(params, img, c)
 
@@ -142,9 +204,11 @@ def make_pipelined_apply(
 
             def stage_chunk(levels, toks):
                 """k sequential GLOM iterations on one microbatch (one
-                stage).  Returns ``(final, cap)`` where ``cap`` is the state
-                after the chunk's ``cap_off``-th iteration (meaningful only
-                on the capture-owning stage; zeros elsewhere/off)."""
+                stage).  Returns ``(final, cap, ys)`` where ``cap`` is the
+                state after the chunk's ``cap_off``-th iteration (meaningful
+                only on the capture-owning stage; zeros elsewhere/off) and
+                ``ys`` is the stacked (k, ...) chunk trajectory (None unless
+                ``return_all``)."""
                 step = build_step(toks[:, :, None, :])
 
                 def body(carry, i):
@@ -152,20 +216,20 @@ def make_pipelined_apply(
                     new = step(state)
                     if cap is not None:
                         cap = jnp.where(i == cap_off - 1, new, cap)
-                    return (new, cap), None
+                    return (new, cap), (new if want_traj else None)
 
                 cap0 = None if cap_stage is None else jnp.zeros_like(levels)
-                (out, cap), _ = jax.lax.scan(
+                (out, cap), ys = jax.lax.scan(
                     body, (levels, cap0), jnp.arange(k)
                 )
-                return out, cap
+                return out, cap, ys
 
             s = jax.lax.axis_index(pipe_axis)
             T = M + S - 1
             fwd_perm = [(i, i + 1) for i in range(S - 1)]
 
             def step(carry, t):
-                cur, out_buf, cap_buf = carry
+                cur, out_buf, cap_buf, traj_buf = carry
                 # boundary exchange: my just-finished state goes to stage
                 # s+1; stage 0 receives garbage (overwritten below)
                 recv = jax.lax.ppermute(cur, pipe_axis, fwd_perm) if S > 1 else cur
@@ -175,7 +239,7 @@ def make_pipelined_apply(
                     tokens_mb, idx, axis=0, keepdims=False
                 )
                 inp = jnp.where(s == 0, init_state, recv)
-                done, cap = stage_chunk(inp, toks)
+                done, cap, ys = stage_chunk(inp, toks)
                 active = (my_idx >= 0) & (my_idx < M)
                 cur = jnp.where(active, done, cur)
 
@@ -195,13 +259,26 @@ def make_pipelined_apply(
                     # the capture stage's mid-chunk snapshot IS the state
                     # after capture_timestep iterations of this microbatch
                     cap_buf = retire(cap_buf, cap, active & (s == cap_stage))
-                return (cur, out_buf, cap_buf), None
+                if traj_buf is not None:
+                    # EVERY stage banks its own chunk of the trajectory —
+                    # slot m holds this stage's k states of microbatch m
+                    traj_buf = retire(traj_buf, ys, active)
+                return (cur, out_buf, cap_buf, traj_buf), None
 
             out0 = jnp.zeros((M,) + init_state.shape, init_state.dtype)
             cap0 = None if cap_stage is None else jnp.zeros_like(out0)
-            (_, out_buf, cap_buf), _ = jax.lax.scan(
-                step, (init_state, out0, cap0), jnp.arange(T)
+            traj0 = (
+                jnp.zeros((M, k) + init_state.shape, init_state.dtype)
+                if want_traj else None
             )
+            (_, out_buf, cap_buf, traj_buf), _ = jax.lax.scan(
+                step, (init_state, out0, cap0, traj0), jnp.arange(T)
+            )
+            if want_traj:
+                # no psum: each stage RETURNS its own chunk; the shard_map
+                # out_spec concatenates the (1, M, k, ...) buffers along the
+                # pipe axis, so the trajectory stays pipe-sharded
+                return traj_buf[None]
             # out_buf is populated only on the last stage; psum replicates the
             # finished states across the pipe axis (all other stages hold 0)
             def replicate(buf, owner):
@@ -212,20 +289,47 @@ def make_pipelined_apply(
                 return out
             return out, replicate(cap_buf, cap_stage)
 
-        # with a data axis, each microbatch's batch dim shards over it: the
-        # schedule runs per (stage, data-slice); without one everything is
-        # replicated over the pipe axis and only the schedule is parallel
-        sliced = P(None, data_axis) if data_axis else P()  # (M, mb, ...) dims
-        state_spec = P(data_axis) if data_axis else P()    # (mb, n, L, d) dims
+        # with a data axis, each microbatch's batch dim shards over it; with
+        # a seq axis, the n column dim shards too: the schedule runs per
+        # (stage, data-slice, seq-slice); otherwise everything is replicated
+        # over the pipe axis and only the schedule is parallel
+        sliced = P(None, data_axis, seq_axis)       # (M, mb, n, L, d) dims
+        token_spec = P(None, data_axis, seq_axis)   # (M, mb, n, d) dims
+        pos_spec = P(None, seq_axis)                # (1, n, 1, d) dims
+        state_spec = P(data_axis, seq_axis)         # (mb, n, L, d) dims
+        if model_axis is not None:
+            # TP: hidden dim sharded (w1 column-, w2 row-wise, b1 with the
+            # hidden); b2 replicated — added once, after the psum
+            net_spec = {"w1": P(None, None, model_axis), "b1": P(None, model_axis),
+                        "w2": P(None, model_axis, None), "b2": P(None, None)}
+        else:
+            net_spec = {"w1": P(), "b1": P(), "w2": P(), "b2": P()}
+        nets = {k: params_c[k] for k in ("bottom_up", "top_down")}
+        nets_spec = {"bottom_up": net_spec, "top_down": net_spec}
+        out_specs = (
+            P(pipe_axis, None, None, data_axis, seq_axis)  # (S, M, k, mb, n, L, d)
+            if want_traj
+            else ((sliced, sliced) if capture_timestep else sliced)
+        )
         run = jax.shard_map(
             pipelined,
             mesh=mesh,
-            in_specs=(sliced, P(), P(), state_spec),
-            out_specs=sliced,  # finished states: pipe-replicated (post-psum),
-                               # data-sharded on the microbatch batch dim
+            in_specs=(token_spec, nets_spec, pos_spec, state_spec),
+            out_specs=out_specs,  # finished states: pipe-replicated
+                                  # (post-psum), data-sharded on the
+                                  # microbatch batch dim; trajectory:
+                                  # pipe-SHARDED on its stage-chunk dim
             check_vma=False,
         )
-        args = (tokens_mb, params_c, pos_embs, init_state)
+        args = (tokens_mb, nets, pos_embs, init_state)
+        if want_traj:
+            traj = run(*args)                       # (S, M, k, mb, n, L, d)
+            # time-major: t = s*k + j; batch index = m*mb + i (matches the
+            # tokens.reshape(M, mb, ...) microbatching)
+            traj = jnp.transpose(traj, (0, 2, 1, 3, 4, 5, 6))
+            traj = traj.reshape(iters, b, n, c.levels, c.dim)
+            t0 = glom_model.initial_levels(params_c, b, c, compute_dtype)
+            return jnp.concatenate([t0[None], traj], axis=0)
         if capture_timestep is None:
             out = run(*args)
             return out.reshape(b, n, c.levels, c.dim)

@@ -200,7 +200,12 @@ class Trainer:
     def _write_config_json(self, directory: str) -> None:
         """Make the checkpoint directory self-describing: the model config
         (validated on restore) plus the training config (informational) next
-        to the weights.  Leader-only, atomic, written once per directory."""
+        to the weights.  Leader-only, atomic, refreshed on every save so a
+        resume that legitimately changes execution knobs (attention_impl,
+        dtypes, lr, ...) updates the record instead of warning forever
+        against a stale one.  Architecture fields may never change within a
+        directory — saving a different architecture into an existing
+        checkpoint dir is refused (its weights would be unloadable anyway)."""
         import json
         import os
 
@@ -208,7 +213,20 @@ class Trainer:
             return
         path = os.path.join(directory, "config.json")
         if os.path.exists(path):
-            return
+            with open(path) as f:
+                recorded = json.load(f)["glom"]
+            mine = self.config.to_json_dict()
+            arch_diff = {
+                k: (recorded.get(k), mine.get(k))
+                for k in self._ARCH_FIELDS
+                if recorded.get(k) != mine.get(k)
+            }
+            if arch_diff:
+                raise ValueError(
+                    f"refusing to save into {directory}: it holds checkpoints "
+                    f"from a different model architecture. Differing fields "
+                    f"(directory, this trainer): {arch_diff}"
+                )
         os.makedirs(directory, exist_ok=True)
         payload = json.dumps(
             {"glom": self.config.to_json_dict(),
@@ -260,6 +278,13 @@ class Trainer:
             )
 
     def save(self, directory: str, *, data_state: Optional[dict] = None) -> str:
+        """Checkpoint the full training state; returns the artifact path
+        (leader) or "" (non-leader).  Durability contract: with
+        ``async_checkpoint`` the returned npz path is named immediately but
+        the background write may still be in flight — it is durable only
+        after :meth:`finish_saves` returns (``fit`` drains on every exit
+        path); a caller that opens the path before draining races the
+        writer.  Synchronous backends return only after the write."""
         self.finish_saves()  # order manifests; bound in-flight writes to one
         self._write_config_json(directory)
         async_requested = self.train_cfg.async_checkpoint
@@ -394,8 +419,18 @@ class Trainer:
                 self.finish_saves()
             except Exception:
                 # on the normal path _fit already drained (and would have
-                # raised); here an original exception is the one to surface
-                pass
+                # raised); here an original exception from _fit is the one to
+                # surface — but the user must still learn the last checkpoint
+                # write failed (e.g. ENOSPC), so warn before suppressing
+                import traceback
+                import warnings
+
+                warnings.warn(
+                    "async checkpoint write failed while handling another "
+                    "error; the latest checkpoint may be missing:\n"
+                    + traceback.format_exc(),
+                    stacklevel=2,
+                )
 
     def _fit(self, batches: Iterator[np.ndarray], steps: Optional[int] = None) -> dict:
         cfg = self.train_cfg

@@ -10,6 +10,21 @@ from glom_tpu.models.shim import Glom
 from glom_tpu.training.train import parse_args
 
 
+def test_version_matches_pyproject():
+    """``glom_tpu.__version__`` and pyproject.toml must never skew (the
+    round-2 bump missed the package attribute — this fails on any future
+    skew)."""
+    import pathlib
+    import re
+
+    import glom_tpu
+
+    pyproject = pathlib.Path(__file__).resolve().parents[1] / "pyproject.toml"
+    m = re.search(r'^version = "([^"]+)"', pyproject.read_text(), re.M)
+    assert m, "pyproject.toml has no version line"
+    assert glom_tpu.__version__ == m.group(1)
+
+
 def test_every_train_config_field_has_a_cli_path():
     """Guard against TrainConfig fields that can't be set from the CLI (two
     such drifts were caught by hand in verification; this automates it)."""

@@ -68,6 +68,33 @@ def main():
 
     check("fused FF backward A/B (512/6, n=256)", ff_bwd_ab)
 
+    # --- bf16 activations at flagship shapes (the training dtype) -----------
+    # jax.vjp forces the cotangent dtype to match the output (bf16), so the
+    # fused path's cast-to-x.dtype is a no-op on every reachable training
+    # path — this A/B checks the bf16 kernels at the exact flagship shapes.
+    def ff_bwd_bf16():
+        params = grouped_ff_init(jax.random.PRNGKey(10), dim=512, groups=6, mult=4)
+        x = jax.random.normal(jax.random.PRNGKey(11), (2, 256, 6, 512), jnp.bfloat16)
+        g = jax.random.normal(jax.random.PRNGKey(12), x.shape, jnp.bfloat16)
+
+        def grads(fused):
+            _, vjp = jax.vjp(
+                lambda x_, p_: grouped_ff_pallas(p_, x_, fused_bwd=fused), x, params
+            )
+            return vjp(g)
+
+        fused = jax.jit(lambda: grads(True))()
+        ref = jax.jit(lambda: grads(False))()
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=0.5, rtol=6e-2,  # bf16 cotangents, 256-row reductions
+            ),
+            fused, ref,
+        )
+
+    check("fused FF backward A/B bf16 (512/6, n=256)", ff_bwd_bf16)
+
     # --- consensus flash backward vs dense VJP ------------------------------
     def cons_bwd_ab():
         x = jax.random.normal(jax.random.PRNGKey(3), (2, 256, 6, 512))
