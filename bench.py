@@ -23,9 +23,11 @@ NORTH_STAR_IMGS_PER_SEC_PER_CHIP = 2000.0 / 16.0
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--config", default="flagship", choices=["flagship", "large"],
+    p.add_argument("--config", default="flagship", choices=["flagship", "large", "tiny"],
                    help="flagship = BASELINE config 1-3 (512/6/224/14, iters 12); "
-                        "large = BASELINE config 4 (1024/8/384/16, iters 16)")
+                        "large = BASELINE config 4 (1024/8/384/16, iters 16); "
+                        "tiny = 64/3/64/8 smoke config (CPU-runnable plumbing "
+                        "check, never a number of record)")
     p.add_argument("--batch-size", type=int, default=0, help="0 = auto by device kind")
     p.add_argument("--steps", type=int, default=0, help="0 = auto (20 on TPU, 2 on CPU)")
     p.add_argument("--warmup", type=int, default=-1, help="-1 = auto (3 on TPU, 1 on CPU)")
@@ -44,6 +46,21 @@ def main():
     p.add_argument("--fused-ff-bwd", action="store_true",
                    help="with --ff-impl pallas: fused Pallas backward kernels "
                         "instead of the default XLA einsum VJP")
+    p.add_argument("--data", default="synthetic", choices=["synthetic", "images"],
+                   help="synthetic = one resident host batch reused every "
+                        "step (pure device rate, the metric of record); "
+                        "images = stream real JPEG batches from --data-dir "
+                        "through ImageFolderStream each step (end-to-end "
+                        "input-path rate: decode threads + H2D overlap)")
+    p.add_argument("--data-dir", default=None,
+                   help="ImageFolder root for --data images (e.g. generated "
+                        "by examples/make_shapes_dataset.py)")
+    p.add_argument("--data-workers", type=int, default=8,
+                   help="decode threads for --data images")
+    p.add_argument("--decode", default="auto", choices=["auto", "python"],
+                   help="--data images decode path: auto = native C++ "
+                        "libjpeg batch decoder when available, python = "
+                        "force the per-file cv2/PIL thread pool (A/B lever)")
     p.add_argument("--device-probe-timeout", type=int, default=240,
                    help="seconds to retry-poll the accelerator relay before "
                         "emitting an error JSON line and exiting; <= 0 "
@@ -53,6 +70,10 @@ def main():
     metric = "denoise_ssl_train_imgs_per_sec_per_chip"
     if args.config != "flagship":
         metric += f"_{args.config}"
+    if args.data == "images":
+        metric += "_realdata"
+        if not args.data_dir:
+            raise SystemExit("--data images needs --data-dir")
 
     def _emit_error(msg):
         print(json.dumps({
@@ -143,6 +164,9 @@ def main():
     if args.config == "large":
         model_kwargs = dict(dim=1024, levels=8, image_size=384, patch_size=16)
         iters, per_chip_batch = 16, 4 if on_tpu else 1
+    elif args.config == "tiny":
+        model_kwargs = dict(dim=64, levels=3, image_size=64, patch_size=8)
+        iters, per_chip_batch = 4, 8
     else:
         model_kwargs = dict()  # flagship defaults: 512/6/224/14
         iters, per_chip_batch = 12, 32 if on_tpu else 4
@@ -161,17 +185,35 @@ def main():
     train = TrainConfig(batch_size=batch, iters=iters, log_every=0)
     trainer = Trainer(config, train)
 
-    batches = synthetic_batches(batch, config.image_size)
-    img = jax.device_put(next(batches), trainer._batch_sh)
+    if args.data == "images":
+        # full input path: disk JPEGs -> decode threads -> H2D, fresh batch
+        # every step (the stream's internal prefetch overlaps decode with
+        # the previous step's device compute)
+        from glom_tpu.training.image_stream import ImageFolderStream
+
+        batches = ImageFolderStream(
+            args.data_dir, batch, config.image_size,
+            process_index=0, process_count=1, workers=args.data_workers,
+            native_decode=None if args.decode == "auto" else False,
+        )
+
+        def next_img():
+            return jax.device_put(next(batches), trainer._batch_sh)
+    else:
+        batches = synthetic_batches(batch, config.image_size)
+        resident = jax.device_put(next(batches), trainer._batch_sh)
+
+        def next_img():
+            return resident
 
     state = trainer.state
     for _ in range(args.warmup):
-        state, metrics = trainer._step(state, img)
+        state, metrics = trainer._step(state, next_img())
     jax.block_until_ready(state.params)
 
     t0 = time.time()
     for _ in range(args.steps):
-        state, metrics = trainer._step(state, img)
+        state, metrics = trainer._step(state, next_img())
     jax.block_until_ready(state.params)
     dt = time.time() - t0
 

@@ -1,0 +1,166 @@
+"""Generate a disk-resident labeled JPEG dataset (zero-egress stand-in for
+CIFAR/ImageNet).
+
+The build container has no network access and ships no datasets, so the
+"real-data" input path (JPEG files on disk -> ``ImageFolderStream`` decode
+threads -> NCHW batches) is exercised with a procedurally rendered dataset:
+K shape classes drawn with cv2 primitives under heavy nuisance variation
+(position, scale, rotation, color, background gradient + noise, occluding
+distractors), written as JPEGs in the standard ImageFolder layout
+``root/<class_name>/img_NNNNN.jpg``.
+
+What makes it a meaningful SSL benchmark rather than noise: class identity
+is carried by *shape* (part-whole structure — the thing GLOM is built to
+represent, reference README.md:34-36), while color/pose/background are
+randomized per image, so a linear probe on frozen embeddings measures real
+invariant structure, not pixel statistics.  PSNR curves use the same images
+through the standard denoising objective.
+
+Usage:
+  python examples/make_shapes_dataset.py --root /tmp/shapes --per-class 250 \
+      --image-size 224
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+CLASSES = (
+    "circle", "square", "triangle", "cross",
+    "star", "ring", "stripes", "dots",
+)
+
+
+def _canvas(rng: np.random.Generator, s: int) -> np.ndarray:
+    """Background: random linear gradient + gaussian noise (uint8 HWC)."""
+    c0 = rng.integers(30, 120, 3).astype(np.float32)
+    c1 = rng.integers(30, 120, 3).astype(np.float32)
+    t = np.linspace(0.0, 1.0, s, dtype=np.float32)
+    axis = rng.integers(0, 2)
+    grad = t[:, None] if axis == 0 else t[None, :]
+    img = c0 + (c1 - c0) * grad[..., None]
+    img = img + rng.normal(0.0, 8.0, (s, s, 3)).astype(np.float32)
+    return np.clip(img, 0, 255).astype(np.uint8)
+
+
+def _color(rng: np.random.Generator) -> tuple:
+    # bright foreground, away from the dim background range
+    return tuple(int(v) for v in rng.integers(140, 256, 3))
+
+
+def _rot(pts: np.ndarray, center: np.ndarray, theta: float) -> np.ndarray:
+    c, s = np.cos(theta), np.sin(theta)
+    return (pts - center) @ np.array([[c, -s], [s, c]], np.float64).T + center
+
+
+def draw_class(img: np.ndarray, cls: str, rng: np.random.Generator) -> None:
+    """Draw one instance of ``cls`` onto ``img`` in place (cv2 BGR==RGB here:
+    channels are random so the order carries no signal)."""
+    import cv2
+
+    s = img.shape[0]
+    r = int(s * rng.uniform(0.15, 0.32))                 # scale
+    margin = r + 2
+    cx, cy = rng.integers(margin, s - margin, 2)          # position
+    theta = rng.uniform(0, 2 * np.pi)                     # rotation
+    col = _color(rng)
+    center = np.array([cx, cy], np.float64)
+
+    if cls == "circle":
+        cv2.circle(img, (int(cx), int(cy)), r, col, -1, cv2.LINE_AA)
+    elif cls == "ring":
+        w = max(2, r // 4)
+        cv2.circle(img, (int(cx), int(cy)), r, col, w, cv2.LINE_AA)
+    elif cls == "square":
+        pts = np.array([[-r, -r], [r, -r], [r, r], [-r, r]], np.float64) + center
+        pts = _rot(pts, center, theta)
+        cv2.fillPoly(img, [pts.astype(np.int32)], col, cv2.LINE_AA)
+    elif cls == "triangle":
+        ang = theta + np.array([0, 2 * np.pi / 3, 4 * np.pi / 3])
+        pts = center + r * np.stack([np.cos(ang), np.sin(ang)], -1)
+        cv2.fillPoly(img, [pts.astype(np.int32)], col, cv2.LINE_AA)
+    elif cls == "cross":
+        w = max(2, r // 3)
+        arm = np.array([[-r, -w], [r, -w], [r, w], [-r, w]], np.float64)
+        for extra in (0.0, np.pi / 2):
+            pts = _rot(arm + center, center, theta + extra)
+            cv2.fillPoly(img, [pts.astype(np.int32)], col, cv2.LINE_AA)
+    elif cls == "star":
+        ang = theta + np.arange(10) * np.pi / 5
+        rad = np.where(np.arange(10) % 2 == 0, r, r * 0.45)
+        pts = center + rad[:, None] * np.stack([np.cos(ang), np.sin(ang)], -1)
+        cv2.fillPoly(img, [pts.astype(np.int32)], col, cv2.LINE_AA)
+    elif cls == "stripes":
+        w = max(2, r // 4)
+        for k in (-2, -1, 0, 1, 2):
+            off = np.array([0.0, k * 2.5 * w])
+            band = np.array([[-r, -w / 2], [r, -w / 2], [r, w / 2], [-r, w / 2]],
+                            np.float64) + off
+            pts = _rot(band + center, center, theta)
+            cv2.fillPoly(img, [pts.astype(np.int32)], col, cv2.LINE_AA)
+    elif cls == "dots":
+        rd = max(2, r // 4)
+        for k in range(5):
+            ang = theta + 2 * np.pi * k / 5
+            p = center + r * 0.8 * np.array([np.cos(ang), np.sin(ang)])
+            cv2.circle(img, (int(p[0]), int(p[1])), rd, col, -1, cv2.LINE_AA)
+    else:
+        raise ValueError(cls)
+
+
+def _distract(img: np.ndarray, rng: np.random.Generator) -> None:
+    """Small random occluders/distractors that carry NO class signal."""
+    import cv2
+
+    s = img.shape[0]
+    for _ in range(rng.integers(0, 4)):
+        p0 = tuple(int(v) for v in rng.integers(0, s, 2))
+        p1 = tuple(int(v) for v in rng.integers(0, s, 2))
+        cv2.line(img, p0, p1, _color(rng), max(1, s // 112), cv2.LINE_AA)
+
+
+def render(cls: str, image_size: int, rng: np.random.Generator) -> np.ndarray:
+    img = _canvas(rng, image_size)
+    _distract(img, rng)
+    draw_class(img, cls, rng)
+    return img
+
+
+def generate(root: str, *, per_class: int = 250, image_size: int = 224,
+             seed: int = 0, quality: int = 90) -> int:
+    """Write the dataset; returns the number of files written.  Re-running
+    with the same arguments is a no-op (files are only written if absent)."""
+    import cv2
+
+    n = 0
+    for ci, cls in enumerate(CLASSES):
+        d = os.path.join(root, cls)
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            path = os.path.join(d, f"img_{i:05d}.jpg")
+            if not os.path.exists(path):
+                rng = np.random.default_rng((seed, ci, i))
+                img = render(cls, image_size, rng)
+                cv2.imwrite(path, img, [cv2.IMWRITE_JPEG_QUALITY, quality])
+            n += 1
+    return n
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--root", required=True)
+    p.add_argument("--per-class", type=int, default=250)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--quality", type=int, default=90)
+    args = p.parse_args()
+    n = generate(args.root, per_class=args.per_class, image_size=args.image_size,
+                 seed=args.seed, quality=args.quality)
+    print(f"{n} images across {len(CLASSES)} classes under {args.root}")
+
+
+if __name__ == "__main__":
+    main()

@@ -27,20 +27,24 @@ _load_failed = False
 
 def _build() -> bool:
     # compile to a per-process temp path and move into place so a killed g++
-    # can't leave a truncated .so, and concurrent builders can't interleave
+    # can't leave a truncated .so, and concurrent builders can't interleave.
+    # First try with libjpeg (the native decode path); if the toolchain has
+    # no libjpeg, fall back to a build without it — glom_has_jpeg() reports
+    # which one loaded.
     tmp = f"{_LIB}.build.{os.getpid()}"
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread", _SRC, "-o", tmp]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _LIB)
-        return True
-    except (OSError, subprocess.SubprocessError):
-        if os.path.exists(tmp):
-            try:
-                os.remove(tmp)
-            except OSError:
-                pass
-        return False
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread", _SRC, "-o", tmp]
+    for cmd in (base[:-2] + ["-DGLOM_WITH_JPEG"] + base[-2:] + ["-ljpeg"], base):
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, _LIB)
+            return True
+        except (OSError, subprocess.SubprocessError):
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+    return False
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -75,8 +79,47 @@ def load() -> Optional[ctypes.CDLL]:
         lib.glom_batch_f32.restype = None
         lib.glom_batch_u8_nhwc.argtypes = [u8p] + [ctypes.c_int64] * 4 + [lp, ctypes.c_int64, ctypes.c_int64, fp]
         lib.glom_batch_u8_nhwc.restype = None
+        lib.glom_has_jpeg.argtypes = []
+        lib.glom_has_jpeg.restype = ctypes.c_int
+        lib.glom_decode_jpeg_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, fp, ctypes.c_char_p, ctypes.c_int64,
+        ]
+        lib.glom_decode_jpeg_batch.restype = ctypes.c_int64
         _lib = lib
         return _lib
+
+
+def has_jpeg() -> bool:
+    """True when the loaded native core was linked against libjpeg."""
+    lib = load()
+    return bool(lib is not None and lib.glom_has_jpeg())
+
+
+def decode_jpeg_batch(paths, size: int, workers: int = 0) -> Optional[np.ndarray]:
+    """Multithreaded native JPEG decode of ``paths`` into a float32
+    ``(len(paths), 3, size, size)`` NCHW batch in [-1, 1] (shorter-side
+    resize + center crop, matching ``image_stream._decode``'s geometry with
+    bilinear interpolation).  ``workers`` caps the decode threads (0 = every
+    core).  Returns None when the native core or its libjpeg link is
+    unavailable (caller falls back to the Python decoders); raises
+    ValueError on an undecodable file."""
+    lib = load()
+    if lib is None or not lib.glom_has_jpeg():
+        return None
+    arr = (ctypes.c_char_p * len(paths))(*[os.fsencode(p) for p in paths])
+    out = np.empty((len(paths), 3, size, size), np.float32)
+    err = ctypes.create_string_buffer(512)
+    rc = lib.glom_decode_jpeg_batch(
+        arr, len(paths), size, workers,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), err, len(err),
+    )
+    if rc != 0:
+        raise ValueError(
+            f"native jpeg decode failed for {paths[rc - 1]}: "
+            f"{err.value.decode(errors='replace')}"
+        )
+    return out
 
 
 def assemble_batch(data: np.ndarray, idx: np.ndarray, size: int) -> Optional[np.ndarray]:

@@ -129,7 +129,14 @@ class ImageFolderStream:
         workers: int = 8,
         prefetch: int = 4,
         files: Optional[Sequence[str]] = None,
+        native_decode: Optional[bool] = None,
     ):
+        """``native_decode``: decode whole batches in the C++ core (libjpeg,
+        its own thread pool, zero Python per image — scales with cores where
+        the per-file Python path saturates on dispatch overhead).  Default
+        auto: used when the native core is jpeg-linked, the model wants RGB,
+        and every file is a .jpg/.jpeg; pass False to force the Python
+        decoders (cv2/PIL)."""
         if process_index is None or process_count is None:
             import jax
 
@@ -154,8 +161,31 @@ class ImageFolderStream:
         self._pos = 0
         self._perm = self._epoch_perm(0)
         self._pool = ThreadPoolExecutor(max_workers=workers)
+        self._workers = workers
         self._prefetch = max(1, prefetch)
-        self._pending: deque = deque()  # (state_before, future)
+        self._pending: deque = deque()  # (state_before, batch result getter)
+        if native_decode is None or native_decode:
+            from glom_tpu import native
+
+            available = (
+                channels == 3
+                and all(f.lower().endswith((".jpg", ".jpeg")) for f in self.files)
+                and native.has_jpeg()
+            )
+            if native_decode and not available:
+                raise ValueError(
+                    "native_decode=True but the native jpeg path is unusable "
+                    "(needs channels=3, all-.jpg/.jpeg files, and a "
+                    "libjpeg-linked native core); pass native_decode=None "
+                    "for auto-fallback or False for the python decoders"
+                )
+            native_decode = available
+        self._native_decode = bool(native_decode)
+        if self._native_decode:
+            # ONE native batch call in flight at a time: the C++ core
+            # parallelizes internally (capped at `workers` threads), so a
+            # wider slot count would multiply thread usage, not throughput
+            self._native_pool = ThreadPoolExecutor(max_workers=1)
 
     # -- determinism / resume --------------------------------------------
     def _epoch_perm(self, epoch: int) -> np.ndarray:
@@ -197,12 +227,27 @@ class ImageFolderStream:
     def __next__(self) -> np.ndarray:
         while len(self._pending) < self._prefetch:
             state, paths = self._advance()
-            # per-file futures (not a nested batch task): a batch-level task
-            # blocking on decodes in the same pool could deadlock it
-            futs = [
-                self._pool.submit(_decode, p, self.image_size, self.channels)
-                for p in paths
-            ]
-            self._pending.append((state, futs))
-        _, futs = self._pending.popleft()
-        return np.stack([f.result() for f in futs])
+            if self._native_decode:
+                # one future per batch on the single-slot native pool: the
+                # C++ core runs its own (worker-capped) threads per call
+                from glom_tpu import native
+
+                fut = self._native_pool.submit(
+                    native.decode_jpeg_batch, paths, self.image_size,
+                    self._workers,
+                )
+                get = fut.result
+            else:
+                # per-file futures (not a nested batch task): a batch-level
+                # task blocking on decodes in the same pool could deadlock it
+                futs = [
+                    self._pool.submit(_decode, p, self.image_size, self.channels)
+                    for p in paths
+                ]
+
+                def get(futs=futs):
+                    return np.stack([f.result() for f in futs])
+
+            self._pending.append((state, get))
+        _, get = self._pending.popleft()
+        return get()

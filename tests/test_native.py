@@ -53,3 +53,67 @@ def test_folder_pipeline_native_matches_numpy(tmp_path, lib):
     it_numpy = folder_batches(str(tmp_path), 4, 16, seed=7, use_native=False)
     for _ in range(3):
         np.testing.assert_array_equal(next(it_native), next(it_numpy))
+
+
+@pytest.fixture(scope="module")
+def jpeg_dataset(tmp_path_factory):
+    """A tiny generated shapes dataset (the zero-egress real-data stand-in;
+    examples/make_shapes_dataset.py)."""
+    pytest.importorskip("cv2")
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parents[1] / "examples"))
+    from make_shapes_dataset import generate
+
+    root = tmp_path_factory.mktemp("shapes")
+    generate(str(root), per_class=3, image_size=48)
+    return str(root)
+
+
+def test_native_jpeg_decode_matches_python(lib, jpeg_dataset):
+    if not native.has_jpeg():
+        pytest.skip("native core built without libjpeg")
+    from glom_tpu.training.image_stream import _decode, list_image_files
+
+    files = list_image_files(jpeg_dataset)[:6]
+    # same-size path (no resize): bit-level parity with the cv2/PIL decode
+    got = native.decode_jpeg_batch(files, 48)
+    want = np.stack([_decode(p, 48, 3) for p in files])
+    assert got.shape == want.shape == (6, 3, 48, 48)
+    np.testing.assert_allclose(got, want, atol=2 / 127.5)
+    # resize path (48 -> 32): bilinear vs cv2 INTER_AREA — geometry matches,
+    # interpolation differs; assert close in the mean, identical in range
+    got2 = native.decode_jpeg_batch(files, 32)
+    want2 = np.stack([_decode(p, 32, 3) for p in files])
+    assert float(np.abs(got2 - want2).mean()) < 0.05
+    assert got2.min() >= -1.0 and got2.max() <= 1.0
+
+
+def test_native_jpeg_decode_error_names_file(lib):
+    if not native.has_jpeg():
+        pytest.skip("native core built without libjpeg")
+    with pytest.raises(ValueError, match="missing_file"):
+        native.decode_jpeg_batch(["/tmp/definitely_missing_file.jpg"], 32)
+
+
+def test_image_stream_native_matches_python(lib, jpeg_dataset):
+    if not native.has_jpeg():
+        pytest.skip("native core built without libjpeg")
+    from glom_tpu.training.image_stream import ImageFolderStream
+
+    kw = dict(batch_size=4, image_size=48, process_index=0, process_count=1, seed=3)
+    s_native = ImageFolderStream(jpeg_dataset, native_decode=True, **kw)
+    s_python = ImageFolderStream(jpeg_dataset, native_decode=False, **kw)
+    assert s_native._native_decode and not s_python._native_decode
+    for _ in range(3):
+        np.testing.assert_allclose(next(s_native), next(s_python), atol=2 / 127.5)
+    # the resume cursor is decode-path-independent
+    assert s_native.state_dict() == s_python.state_dict()
+
+
+def test_image_stream_forced_native_unusable_raises(lib, jpeg_dataset):
+    from glom_tpu.training.image_stream import ImageFolderStream
+
+    with pytest.raises(ValueError, match="native jpeg path is unusable"):
+        ImageFolderStream(jpeg_dataset, batch_size=2, image_size=48, channels=1,
+                          process_index=0, process_count=1, native_decode=True)
