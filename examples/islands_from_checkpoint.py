@@ -1,0 +1,125 @@
+"""Render the island-agreement figure from a TRAINED checkpoint on real
+dataset images.
+
+Companion to ``make_islands_figure.py`` (which trains its own toy net
+inline): this one loads a denoising-SSL checkpoint produced by the Trainer
+(e.g. the real-data shapes run — BASELINE.md) together with its
+self-describing ``config.json``, picks images from the dataset the run
+trained on, and plots per-level neighbor cosine agreement over the
+iterative update (``glom_tpu.models.islands``) — the reference README's
+"cluster the levels to inspect for islands" suggestion
+(`/root/reference/README.md:34-36`) as an executable artifact.
+
+Run:
+  python examples/islands_from_checkpoint.py --checkpoint-dir /tmp/ckpt \
+      --data-dir /tmp/shapes --out docs/islands_realdata.png
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# runnable as `python examples/islands_from_checkpoint.py` from a checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--checkpoint-dir", required=True)
+    p.add_argument("--data-dir", required=True,
+                   help="ImageFolder root; one image per class is shown")
+    p.add_argument("--out", default="docs/islands_realdata.png")
+    p.add_argument("--iters", type=int, default=None)
+    p.add_argument("--rows", type=int, default=3, help="images (rows) to show")
+    args = p.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # host-side figure utility
+
+    import numpy as np
+    import optax
+
+    from glom_tpu import checkpoint as ckpt_lib
+    from glom_tpu.config import GlomConfig
+    from glom_tpu.models import glom as glom_model
+    from glom_tpu.models.islands import neighbor_agreement
+    from glom_tpu.training import denoise
+    from glom_tpu.training.image_stream import (
+        labels_from_paths, list_image_files, load_images,
+    )
+
+    with open(os.path.join(args.checkpoint_dir, "config.json")) as f:
+        config = GlomConfig.from_json_dict(json.load(f)["glom"])
+    iters = args.iters or config.default_iters
+
+    template = denoise.init_state(jax.random.PRNGKey(0), config, optax.sgd(0.0))
+    step, trees = ckpt_lib.restore(
+        args.checkpoint_dir, {"params": template.params}
+    )
+    params = trees["params"]["glom"]
+    print(f"restored step {step} from {args.checkpoint_dir}")
+
+    files = list_image_files(args.data_dir)
+    labels, names = labels_from_paths(files)
+    # one representative image per class, up to `rows`
+    picks = []
+    for ci in range(min(args.rows, len(names))):
+        idx = int(np.nonzero(labels == ci)[0][0])
+        picks.append(files[idx])
+    imgs = load_images(picks, config.image_size)
+
+    all_states = glom_model.apply(
+        params, imgs, config=config, iters=iters, return_all=True,
+    )  # (iters+1, rows, n, L, d)
+    side = config.num_patches_side
+    agree = np.stack([
+        np.asarray(neighbor_agreement(all_states[t], side))
+        for t in range(iters + 1)
+    ])  # (iters+1, rows, L, side, side)
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    L = config.levels
+    t_show = iters  # final state; one row per image
+    fig, axes = plt.subplots(
+        len(picks), L + 1,
+        figsize=(2.2 * (L + 1), 2.1 * len(picks) + 0.8),
+        constrained_layout=True, squeeze=False,
+    )
+    fig.suptitle(
+        f"Consensus islands on held dataset images (checkpoint step {step}, "
+        f"t = {t_show})\nneighbor cosine agreement per level — islands align "
+        "with the object vs background",
+        fontsize=11,
+    )
+    for r, path in enumerate(picks):
+        disp = np.clip((imgs[r].transpose(1, 2, 0) + 1) / 2, 0, 1)
+        ax = axes[r][0]
+        ax.imshow(disp)
+        ax.set_ylabel(os.path.basename(os.path.dirname(path)), fontsize=10)
+        ax.set_xticks([]); ax.set_yticks([])
+        if r == 0:
+            ax.set_title("input", fontsize=10)
+        for l in range(L):
+            ax = axes[r][l + 1]
+            im = ax.imshow(agree[t_show, r, l], vmin=0.0, vmax=1.0, cmap="Blues")
+            ax.set_xticks([]); ax.set_yticks([])
+            if r == 0:
+                ax.set_title(f"level {l}", fontsize=10)
+    cbar = fig.colorbar(im, ax=[axes[r][-1] for r in range(len(picks))],
+                        shrink=0.8, pad=0.02)
+    cbar.set_label("neighbor agreement", fontsize=9)
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    fig.savefig(args.out, dpi=110)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

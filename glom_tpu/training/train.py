@@ -94,11 +94,19 @@ def parse_args(argv=None):
     p.add_argument("--coordinator", default=None)
     p.add_argument("--num-processes", type=int, default=None)
     p.add_argument("--process-id", type=int, default=None)
+    # platform
+    p.add_argument("--platform", default="auto",
+                   help="force a JAX platform (e.g. 'cpu') instead of the "
+                        "auto-detected accelerator; 'auto' keeps the default. "
+                        "Set via jax.config (env JAX_PLATFORMS can be "
+                        "overridden by site plugins)")
     return p.parse_args(argv)
 
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.platform != "auto":
+        jax.config.update("jax_platforms", args.platform)
     initialize_distributed(args.coordinator, args.num_processes, args.process_id)
 
     import jax.numpy as jnp

@@ -55,4 +55,29 @@ python examples/make_shapes_dataset.py --root /tmp/shapes224 --per-class 250 --i
 run --data images --data-dir /tmp/shapes224
 run --data images --data-dir /tmp/shapes224 --decode python
 run --data images --data-dir /tmp/shapes224 --ff-impl pallas --fused-ff-bwd
+
+# flagship-scale real-data SSL (VERDICT r2 item 5, hardware leg): identical
+# recipe to the committed 64px CPU curve (docs/runs/shapes64_cpu.jsonl) at
+# the flagship config on the chip, then the islands figure re-rendered from
+# the resulting checkpoint.  ~32k images through the real JPEG input path.
+echo "=== $(date -u +%FT%TZ) flagship shapes SSL" | tee -a "$LOG"
+timeout 1200 python -m glom_tpu.training.train \
+  --data images --data-dir /tmp/shapes224 --batch-size 32 --steps 1000 \
+  --lr 3e-4 --eval-every 200 --eval-holdout 0.1 --log-every 100 \
+  --ff-impl pallas --checkpoint-dir /tmp/ckpt_shapes224 \
+  --checkpoint-every 500 --log-file docs/runs/shapes224_tpu.jsonl \
+  2>&1 | tail -4 | tee -a "$LOG"
+timeout 900 python examples/islands_from_checkpoint.py \
+  --checkpoint-dir /tmp/ckpt_shapes224 --data-dir /tmp/shapes224 \
+  --out docs/islands_realdata_224.png 2>&1 | tail -2 | tee -a "$LOG"
+
+# MFU at the sweep's best rate.  The max over the log is always a flagship
+# row (large-config rows run ~20x slower), so the flagship FLOP numerator in
+# tools/mfu.py matches; if a non-default batch size wins, rerun mfu.py by
+# hand with --batch-size to align the compiled-FLOPs count.
+best=$(grep -o '"value": [0-9.]*' "$LOG" | awk '{print $2}' | sort -g | tail -1)
+if [ -n "${best:-}" ]; then
+  echo "=== $(date -u +%FT%TZ) mfu at best rate $best" | tee -a "$LOG"
+  python tools/mfu.py --imgs-per-sec "$best" 2>&1 | tee -a "$LOG"
+fi
 echo "=== $(date -u +%FT%TZ) sweep done" | tee -a "$LOG"
