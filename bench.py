@@ -37,6 +37,9 @@ def main():
     p.add_argument("--remat-policy", default="full", choices=["full", "dots"])
     p.add_argument("--fuse-ff", action="store_true",
                    help="run bottom_up+top_down as one 2L-1-group call")
+    p.add_argument("--scan-unroll", type=int, default=1,
+                   help="unroll factor of the iteration scan (>1 lets XLA "
+                        "fuse/overlap across iterations; loop is 7-16 steps)")
     p.add_argument("--attention-impl", default="dense", choices=["dense", "pallas", "ring", "ulysses"])
     p.add_argument("--ff-impl", default="auto", choices=["auto", "dense", "pallas"],
                    help="auto = pallas on TPU (the fastest hardware-verified "
@@ -61,6 +64,11 @@ def main():
                    help="--data images decode path: auto = native C++ "
                         "libjpeg batch decoder when available, python = "
                         "force the per-file cv2/PIL thread pool (A/B lever)")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of a 3-step window "
+                        "(after warmup, excluded from the timed window) — "
+                        "the MFU/trace evidence leg; failures to trace are "
+                        "non-fatal so the number of record still prints")
     p.add_argument("--device-probe-timeout", type=int, default=240,
                    help="seconds to retry-poll the accelerator relay before "
                         "emitting an error JSON line and exiting; <= 0 "
@@ -84,65 +92,25 @@ def main():
             "error": msg,
         }), flush=True)
 
-    # Device guard.  A wedged axon tunnel makes jax.devices() hang forever,
-    # so never walk into device init blind: first retry-poll a cheap TCP
-    # probe of the relay (127.0.0.1:8083 — jax.devices() goes through it)
-    # in a loop until the deadline, so a tunnel that recovers mid-window is
-    # still caught; only once the port accepts do we attempt the one device
-    # init, itself under a watchdog (a port that accepts but a backend that
-    # hangs must still produce a JSON line).
-    import os
+    # Device guard (shared with tools/breakdown.py): retry-poll the relay,
+    # then watchdog the single init attempt — a dead or wedged tunnel must
+    # produce a JSON error line, never a silent hang.
+    from glom_tpu.device_guard import guard_device_init
 
-    timer = None
-    expect_axon = "axon" in os.environ.get("JAX_PLATFORMS", "")
-    if args.device_probe_timeout > 0:
-        import threading
-
-        init_budget = float(args.device_probe_timeout)
-        if expect_axon:
-            # Under an axon tunnel, poll the relay before touching jax at
-            # all — a dead relay makes jax.devices() hang forever, while the
-            # probe is cheap and a tunnel that recovers mid-window is caught.
-            import socket
-
-            def _relay_up():
-                try:
-                    with socket.create_connection(("127.0.0.1", 8083), timeout=3):
-                        return True
-                except OSError:
-                    return False
-
-            deadline = time.time() + args.device_probe_timeout
-            up = _relay_up()
-            while not up and time.time() < deadline:
-                time.sleep(5)
-                up = _relay_up()
-            if not up:
-                _emit_error(
-                    f"accelerator relay 127.0.0.1:8083 unreachable for "
-                    f"{args.device_probe_timeout}s (retry-polled)")
-                raise SystemExit(2)
-            # Port accepts: give the single init attempt a floor of 120s
-            # even if polling consumed most of the budget (first init after
-            # recovery can be slow).
-            init_budget = max(120.0, deadline - time.time())
-
-        # One init attempt, watchdog-guarded on EVERY platform — the timer
-        # only fires if jax.devices() itself wedges.
-        def _watchdog():
-            _emit_error(
-                f"device init exceeded {init_budget:.0f}s "
-                "(accelerator unreachable or backend wedged)")
-            os._exit(2)
-
-        timer = threading.Timer(init_budget, _watchdog)
-        timer.daemon = True
-        timer.start()
+    timer = guard_device_init(args.device_probe_timeout, _emit_error)
 
     import jax
+
+    try:
+        # persistent compile cache: a bench run after a prior sweep (or a
+        # driver run after the builder's) skips the 20-40s first compile
+        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax: cache flags absent; compile cold
     import jax.numpy as jnp
 
-    from glom_tpu.config import GlomConfig, TrainConfig
+    from glom_tpu.config import GlomConfig, TrainConfig, bench_preset
     from glom_tpu.training.data import synthetic_batches
     from glom_tpu.training.trainer import Trainer
 
@@ -161,15 +129,8 @@ def main():
         args.steps = 20 if on_tpu else 2
     if args.warmup < 0:
         args.warmup = 3 if on_tpu else 1
-    if args.config == "large":
-        model_kwargs = dict(dim=1024, levels=8, image_size=384, patch_size=16)
-        iters, per_chip_batch = 16, 4 if on_tpu else 1
-    elif args.config == "tiny":
-        model_kwargs = dict(dim=64, levels=3, image_size=64, patch_size=8)
-        iters, per_chip_batch = 4, 8
-    else:
-        model_kwargs = dict()  # flagship defaults: 512/6/224/14
-        iters, per_chip_batch = 12, 32 if on_tpu else 4
+    model_kwargs, iters, tpu_b, cpu_b = bench_preset(args.config)
+    per_chip_batch = tpu_b if on_tpu else cpu_b
     batch = args.batch_size or per_chip_batch * jax.device_count()
 
     config = GlomConfig(
@@ -177,6 +138,7 @@ def main():
         remat=not args.no_remat,
         remat_policy=args.remat_policy,
         fuse_ff=args.fuse_ff,
+        scan_unroll=args.scan_unroll,
         attention_impl=args.attention_impl,
         ff_impl=args.ff_impl,
         ff_fused_bwd=args.fused_ff_bwd,
@@ -210,6 +172,16 @@ def main():
     for _ in range(args.warmup):
         state, metrics = trainer._step(state, next_img())
     jax.block_until_ready(state.params)
+
+    if args.profile_dir:
+        try:
+            with jax.profiler.trace(args.profile_dir):
+                for _ in range(3):
+                    state, metrics = trainer._step(state, next_img())
+                jax.block_until_ready(state.params)
+            print(f"# trace written to {args.profile_dir}", flush=True)
+        except Exception as e:  # tracing must never cost the number of record
+            print(f"# trace failed ({type(e).__name__}: {e})", flush=True)
 
     t0 = time.time()
     for _ in range(args.steps):

@@ -52,8 +52,14 @@ class GlomConfig:
     # iteration (weights concatenated once per step, outside the scan):
     # halves the batched-GEMM / pallas dispatches on the FF hot path
     fuse_ff: bool = False
+    # lax.scan unroll factor for the iteration loop: >1 lets XLA fuse and
+    # overlap across iteration boundaries at the cost of a bigger program
+    # (the loop is short — 7-16 steps — so full unroll is viable)
+    scan_unroll: int = 1
 
     def __post_init__(self):
+        if self.scan_unroll < 1:
+            raise ValueError("scan_unroll must be >= 1")
         if self.image_size % self.patch_size != 0:
             raise ValueError(
                 f"image_size {self.image_size} not divisible by patch_size {self.patch_size}"
@@ -199,3 +205,30 @@ class TrainConfig:
             d["mesh_shape"] = tuple(d["mesh_shape"])
         d["mesh_axes"] = tuple(d.get("mesh_axes", ("data", "model", "seq")))
         return cls(**d)
+
+
+# Bench/tooling config presets — the ONE definition shared by bench.py,
+# tools/mfu.py, and tools/breakdown.py so their model shapes can't drift
+# (a preset edited in one tool but not another would silently score a
+# different model than the one benchmarked).
+#   flagship: the reference default (glom_pytorch.py:80-86) and the
+#             BASELINE.json metric-of-record config
+#   large:    BASELINE.json config 4 (dim=1024, levels=8, 384/16, n=576)
+#   tiny:     CPU-runnable smoke config, never a number of record
+BENCH_PRESETS = {
+    "flagship": dict(model_kwargs={}, iters=12, tpu_batch=32, cpu_batch=4),
+    "large": dict(
+        model_kwargs=dict(dim=1024, levels=8, image_size=384, patch_size=16),
+        iters=16, tpu_batch=4, cpu_batch=1,
+    ),
+    "tiny": dict(
+        model_kwargs=dict(dim=64, levels=3, image_size=64, patch_size=8),
+        iters=4, tpu_batch=8, cpu_batch=8,
+    ),
+}
+
+
+def bench_preset(name: str):
+    """``(model_kwargs, iters, per_chip_batch_tpu, per_chip_batch_cpu)``."""
+    p = BENCH_PRESETS[name]
+    return dict(p["model_kwargs"]), p["iters"], p["tpu_batch"], p["cpu_batch"]

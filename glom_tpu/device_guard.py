@@ -1,0 +1,75 @@
+"""Guarded JAX device init for tools that must not hang on a dead tunnel.
+
+This build environment reaches its TPU through a single-tenant relay; when
+the relay is down, the first backend touch (``jax.devices()``) blocks
+forever.  Tools that run unattended (bench.py, tools/breakdown.py, sweep
+legs) arm this guard instead of walking into device init blind:
+
+1. If the env expects the relay (``JAX_PLATFORMS`` mentions ``axon``),
+   retry-poll a cheap TCP probe of the relay until the deadline — a tunnel
+   that recovers mid-window is caught, a dead one produces a diagnosable
+   error line instead of a silent hang.
+2. Then arm a watchdog over the single device-init attempt (a port that
+   accepts but a backend that wedges must still produce output).
+
+Stdlib-only on purpose: importing this module must not initialize jax.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Callable, Optional
+
+RELAY_ADDR = ("127.0.0.1", 8083)
+
+
+def _relay_up(addr=RELAY_ADDR) -> bool:
+    try:
+        with socket.create_connection(addr, timeout=3):
+            return True
+    except OSError:
+        return False
+
+
+def guard_device_init(
+    timeout: float,
+    emit_error: Callable[[str], None],
+    *,
+    min_init_budget: float = 120.0,
+) -> Optional[threading.Timer]:
+    """Arm the guard; call ``.cancel()`` on the returned timer once device
+    init has succeeded.  ``emit_error`` receives a one-line diagnosis and
+    the process exits (code 2) if the deadline passes.  Returns None when
+    ``timeout <= 0`` (guard disabled)."""
+    if timeout <= 0:
+        return None
+
+    init_budget = float(timeout)
+    if "axon" in os.environ.get("JAX_PLATFORMS", ""):
+        deadline = time.time() + timeout
+        up = _relay_up()
+        while not up and time.time() < deadline:
+            time.sleep(5)
+            up = _relay_up()
+        if not up:
+            emit_error(
+                f"accelerator relay {RELAY_ADDR[0]}:{RELAY_ADDR[1]} "
+                f"unreachable for {timeout:.0f}s (retry-polled)")
+            raise SystemExit(2)
+        # First init after recovery can be slow: floor the init window even
+        # if polling consumed most of the budget.
+        init_budget = max(min_init_budget, deadline - time.time())
+
+    def _watchdog():
+        emit_error(
+            f"device init exceeded {init_budget:.0f}s "
+            "(accelerator unreachable or backend wedged)")
+        os._exit(2)
+
+    timer = threading.Timer(init_budget, _watchdog)
+    timer.daemon = True
+    timer.start()
+    return timer

@@ -254,6 +254,14 @@ def _vmem_bytes_bwd_dw(bn, hc, d, itemsize):
 
 
 def _backward_fused(x, params, g, *, interpret):
+    """Fused-backward contract: the incoming cotangent is cast to ``x.dtype``
+    before the kernels (accumulation inside stays f32 via
+    ``preferred_element_type``).  With bf16 activations this quantizes an f32
+    upstream cotangent one matmul earlier than the XLA-einsum VJP would —
+    A/B comparisons against the fallback must therefore drive both paths
+    through ``jax.vjp`` (which pins the cotangent to the output dtype), as
+    ``tools/hw_check.py`` does; do not hand-feed an f32 cotangent to one path
+    only."""
     b, n, gr, d = x.shape
     h = params["w1"].shape[-1]
     xt = jnp.transpose(x, (0, 2, 1, 3))           # (b, g, n, d)

@@ -318,11 +318,14 @@ def apply(
         t = capture_timestep
         if not 0 <= t <= iters:
             raise ValueError(f"capture_timestep {t} outside [0, {iters}]")
-        captured, _ = jax.lax.scan(body, levels, None, length=t)
-        final, _ = jax.lax.scan(body, captured, None, length=iters - t)
+        captured, _ = jax.lax.scan(body, levels, None, length=t,
+                                   unroll=min(c.scan_unroll, max(t, 1)))
+        final, _ = jax.lax.scan(body, captured, None, length=iters - t,
+                                unroll=min(c.scan_unroll, max(iters - t, 1)))
         return final, captured
 
-    final, ys = jax.lax.scan(body, levels, None, length=iters)
+    final, ys = jax.lax.scan(body, levels, None, length=iters,
+                             unroll=min(c.scan_unroll, max(iters, 1)))
 
     if capture_timestep is not None:
         all_states = jnp.concatenate([levels[None], ys], axis=0)

@@ -45,6 +45,19 @@ def init_state(
     return DenoiseState(params, tx.init(params), jnp.zeros((), jnp.int32), k_train)
 
 
+def resolve_loss_timestep(train: TrainConfig, iters: int) -> int:
+    """The iteration whose state feeds the loss: ``train.loss_timestep`` when
+    set (0 is a valid explicit choice — the t=0 init state), else the
+    reference recipe's default of ``iters // 2 + 1`` (the state after 7 of
+    12 iterations — README.md:83 reads ``all_levels[7]``).  The single
+    definition — MFU/breakdown accounting must use the same resolution or
+    their executed-iteration counts silently drift from the step fn's."""
+    t = train.loss_timestep if train.loss_timestep is not None else iters // 2 + 1
+    if not 0 <= t <= iters:
+        raise ValueError(f"loss_timestep {t} outside [0, {iters}]")
+    return t
+
+
 def make_loss_fn(config: GlomConfig, train: TrainConfig, *, consensus_fn=None,
                  ff_fn=None, apply_fn=None):
     """loss(params, img, rng) -> (loss, recon).  Mirrors README.md:74-88.
@@ -56,9 +69,7 @@ def make_loss_fn(config: GlomConfig, train: TrainConfig, *, consensus_fn=None,
     ``apply_fn(glom_params, img, iters=..., capture_timestep=t) ->
     (final, state_after_t)``."""
     iters = train.iters if train.iters is not None else config.default_iters
-    timestep = train.loss_timestep if train.loss_timestep is not None else iters // 2 + 1
-    if not 0 <= timestep <= iters:
-        raise ValueError(f"loss_timestep {timestep} outside [0, {iters}]")
+    timestep = resolve_loss_timestep(train, iters)
 
     two_views = train.consistency != "none"
 

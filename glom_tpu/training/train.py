@@ -39,6 +39,9 @@ def parse_args(argv=None):
                         "of the default XLA einsum VJP")
     p.add_argument("--fuse-ff", action="store_true",
                    help="bottom_up+top_down as one grouped call per iteration")
+    p.add_argument("--scan-unroll", type=int, default=1,
+                   help="iteration-scan unroll factor (XLA fuses/overlaps "
+                        "across iterations at >1)")
     # training
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--grad-accum-steps", type=int, default=1)
@@ -125,6 +128,7 @@ def main(argv=None):
         ff_impl=args.ff_impl,
         ff_fused_bwd=args.fused_ff_bwd,
         fuse_ff=args.fuse_ff,
+        scan_unroll=args.scan_unroll,
     )
     train_cfg = TrainConfig(
         batch_size=args.batch_size,

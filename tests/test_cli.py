@@ -135,3 +135,14 @@ def test_cli_images_with_heldout_eval(tmp_path, capsys):
     ck = [f for f in (tmp_path / "ck").iterdir() if f.suffix == ".npz"]
     keys = _np.load(str(ck[0])).files
     assert "data/epoch" in keys and "data/pos" in keys
+
+
+def test_cli_scan_unroll_and_platform_flags():
+    """--scan-unroll flows into GlomConfig; --platform parses (the config
+    update itself is exercised by every CPU run of the CLI in this suite)."""
+    from glom_tpu.training.train import parse_args
+
+    args = parse_args(["--scan-unroll", "3", "--platform", "cpu"])
+    assert args.scan_unroll == 3 and args.platform == "cpu"
+    args = parse_args([])
+    assert args.scan_unroll == 1 and args.platform == "auto"

@@ -276,3 +276,40 @@ def test_fuse_ff_matches_unfused():
             ),
             g_got, g_want,
         )
+
+
+def test_scan_unroll_matches_rolled():
+    """scan_unroll > 1 is an XLA scheduling knob only — forward (all output
+    modes) and backward must be bit-compatible with the rolled scan."""
+    import jax.numpy as jnp
+
+    img = jax.random.normal(jax.random.PRNGKey(2), (2, 3, 16, 16))
+    base = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4)
+    params = glom_model.init(jax.random.PRNGKey(0), base)
+    want_all = glom_model.apply(params, img, config=base, iters=5, return_all=True)
+    want_cap = glom_model.apply(params, img, config=base, iters=5, capture_timestep=3)
+    g_want = jax.grad(
+        lambda p: jnp.sum(glom_model.apply(p, img, config=base, iters=5) ** 2)
+    )(params)
+    for unroll in (2, 5, 9):  # mid, exact, > length (clamped)
+        c = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4,
+                       scan_unroll=unroll)
+        got_all = glom_model.apply(params, img, config=c, iters=5, return_all=True)
+        np.testing.assert_allclose(np.asarray(got_all), np.asarray(want_all), atol=1e-6)
+        got_cap = glom_model.apply(params, img, config=c, iters=5, capture_timestep=3)
+        for g, w in zip(got_cap, want_cap):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-6)
+        g_got = jax.grad(
+            lambda p: jnp.sum(glom_model.apply(p, img, config=c, iters=5) ** 2)
+        )(params)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            ),
+            g_got, g_want,
+        )
+
+
+def test_scan_unroll_validation():
+    with pytest.raises(ValueError, match="scan_unroll"):
+        GlomConfig(dim=16, levels=2, image_size=16, patch_size=4, scan_unroll=0)

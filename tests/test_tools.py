@@ -1,0 +1,50 @@
+"""Smoke tests for the perf-evidence tools (tools/breakdown.py, tools/mfu.py).
+
+These run the tools in-process on the tiny config so the hardware window
+never discovers an import error or signature drift the hard way.
+"""
+
+import os
+import runpy
+import sys
+
+import pytest
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+
+
+def _run_tool(path, argv, capsys):
+    old = sys.argv
+    sys.argv = [path] + argv
+    try:
+        runpy.run_path(path, run_name="__main__")
+    finally:
+        sys.argv = old
+    return capsys.readouterr().out
+
+
+def test_breakdown_tiny_cpu(capsys):
+    import json
+
+    out = _run_tool(
+        os.path.join(TOOLS, "breakdown.py"),
+        ["--config", "tiny", "--repeats", "1"], capsys,
+    )
+    data = json.loads(out)
+    names = {r["component"] for r in data["rows"]}
+    assert {"train_step_total", "forward_capture", "consensus_x_executed",
+            "grouped_ff_x_executed", "adam_update"} <= names
+    total = data["rows"][0]
+    assert total["pct_of_step"] == 100.0 and total["ms"] > 0
+
+
+def test_mfu_analytic_numbers(capsys):
+    out = _run_tool(
+        os.path.join(TOOLS, "mfu.py"),
+        ["--imgs-per-sec", "282.4", "--skip-compiled"], capsys,
+    )
+    # 7 executed iterations of 12, ~266 GF/img train => ~38% on v5e
+    assert "7 executed iterations of 12" in out
+    assert "MFU (model FLOPs)" in out
+    pct = float(out.split("MFU (model FLOPs)")[1].split("%")[0].split(":")[1])
+    assert 35.0 < pct < 42.0

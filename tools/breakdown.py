@@ -1,0 +1,175 @@
+"""Per-component wall-clock breakdown of the flagship train step.
+
+Times each sub-block of the denoising-SSL step in isolation on the attached
+device (jitted, median of repeats) and reports its share of the measured
+full-step time — the "name the top time sinks" companion to ``tools/mfu.py``
+(which pins the FLOP accounting) and the profiler trace (``bench.py
+--profile-dir``).  Because the pieces are re-jitted standalone, their sum
+can exceed the fused full step; the ranking, not the sum, is the signal.
+
+Reference cost structure this decomposes: the grouped FFs
+(`glom_pytorch.py:29-31`), consensus attention (`:60-72`), patch embed
+(`:94-97`) — plus the framework-side costs the reference leaves to torch
+(autograd backward, optimizer update).
+
+  python tools/breakdown.py                 # flagship, batch 32
+  python tools/breakdown.py --config large --batch-size 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# runnable as `python tools/breakdown.py` from a checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timed(fn, *args, repeats=5, warmup=2):
+    """Median seconds per call of a jitted fn (blocking on the result)."""
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    ts = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[len(ts) // 2]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="flagship",
+                   choices=["flagship", "large", "tiny"])
+    p.add_argument("--batch-size", type=int, default=0, help="0 = auto")
+    p.add_argument("--repeats", type=int, default=5)
+    p.add_argument("--fp32", action="store_true")
+    p.add_argument("--ff-impl", default="dense", choices=["dense", "pallas"])
+    p.add_argument("--platform", default="auto",
+                   help="force a JAX platform (e.g. 'cpu'); auto keeps default")
+    p.add_argument("--device-probe-timeout", type=int, default=240,
+                   help="seconds to retry-poll the accelerator relay before "
+                        "erroring out (<= 0 disables; ignored when "
+                        "--platform forces a local backend)")
+    args = p.parse_args()
+
+    from glom_tpu.device_guard import guard_device_init
+
+    def _emit_error(msg):
+        print(json.dumps({"error": msg}), flush=True)
+
+    timer = None
+    if args.platform == "auto":
+        timer = guard_device_init(args.device_probe_timeout, _emit_error)
+
+    import jax
+
+    if args.platform != "auto":
+        jax.config.update("jax_platforms", args.platform)
+
+    import jax.numpy as jnp
+    import optax
+
+    from glom_tpu.config import GlomConfig, TrainConfig
+    from glom_tpu.models import glom as glom_model
+    from glom_tpu.ops.consensus import consensus_attention
+    from glom_tpu.ops.feedforward import grouped_ff_apply
+    from glom_tpu.training import denoise
+
+    if args.ff_impl == "pallas":
+        from glom_tpu.kernels.ff_pallas import grouped_ff_pallas
+        ff_fn = grouped_ff_pallas
+    else:
+        ff_fn = grouped_ff_apply
+
+    from glom_tpu.config import bench_preset
+
+    kw, iters, tpu_b, cpu_b = bench_preset(args.config)
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if timer is not None:
+        timer.cancel()  # device init completed; the guarded window is over
+    batch = args.batch_size or (tpu_b if on_tpu else cpu_b)
+    config = GlomConfig(
+        compute_dtype=jnp.float32 if args.fp32 else jnp.bfloat16,
+        remat=True, ff_impl=args.ff_impl, **kw,
+    )
+    tcfg = TrainConfig(batch_size=batch, iters=iters, log_every=0)
+    executed = denoise.resolve_loss_timestep(tcfg, iters)
+    tx = optax.adam(1e-4)
+
+    rng = jax.random.PRNGKey(0)
+    state = denoise.init_state(rng, config, tx)
+    img = jax.device_put(
+        jax.random.normal(rng, (batch, 3, config.image_size, config.image_size))
+    )
+    n, L, d = config.num_patches, config.levels, config.dim
+    cdt = config.compute_dtype or jnp.float32
+    levels_state = jax.device_put(jax.random.normal(rng, (batch, n, L, d), cdt))
+    ff_in = levels_state  # grouped-FF input: one entry per level group
+    gparams = jax.tree.map(lambda a: a.astype(cdt), state.params["glom"])
+
+    rows = []
+
+    def record(name, seconds):
+        rows.append({"component": name, "ms": round(1e3 * seconds, 3)})
+
+    # --- full train step (forward + backward + adam), the bench quantity.
+    # Non-donated on purpose: the same `state` is reused across timing calls
+    # (bench.py measures the donated variant; the delta is buffer reuse).
+    step_nd = jax.jit(denoise.make_step_fn(config, tcfg, tx))
+    t_step = timed(lambda s, im: step_nd(s, im)[0].params["glom"]["init_levels"],
+                   state, img, repeats=args.repeats)
+    record("train_step_total", t_step)
+
+    # --- forward only, capture fast path (what the loss actually reads)
+    fwd = jax.jit(lambda prm, im: glom_model.apply(
+        prm, im, config=config, iters=iters, capture_timestep=executed))
+    t_fwd = timed(fwd, gparams, img, repeats=args.repeats)
+    record("forward_capture", t_fwd)
+
+    # --- consensus attention, one call x executed iterations at step shapes
+    cons = jax.jit(lambda lv: consensus_attention(
+        lv, attend_self=config.consensus_self))
+    t_cons = timed(cons, levels_state, repeats=args.repeats)
+    record("consensus_x_executed", t_cons * executed)
+
+    # --- grouped FF (bottom_up-shaped, L groups) x 1, then scaled:
+    # bottom_up (L groups) + top_down (L-1 groups) per iteration
+    ffp = jax.tree.map(lambda a: a.astype(cdt), state.params["glom"]["bottom_up"])
+    ffj = jax.jit(lambda prm, x: ff_fn(prm, x))
+    t_ff = timed(ffj, ffp, ff_in, repeats=args.repeats)
+    record("grouped_ff_x_executed", t_ff * executed * (2 * L - 1) / L)
+
+    # --- patch embed (once per step)
+    emb = jax.jit(lambda prm, im: glom_model.embed_inputs(prm, im, config)[0])
+    t_emb = timed(emb, gparams, img, repeats=args.repeats)
+    record("patch_embed", t_emb)
+
+    # --- optimizer update alone (adam over the param pytree)
+    grads = jax.tree.map(jnp.ones_like, state.params)
+    upd = jax.jit(lambda g, o, prm: tx.update(g, o, prm))
+    t_upd = timed(upd, grads, state.opt_state, state.params, repeats=args.repeats)
+    record("adam_update", t_upd)
+
+    total = rows[0]["ms"]
+    for r in rows:
+        r["pct_of_step"] = round(100.0 * r["ms"] / total, 1)
+    backward_ms = None
+    if t_fwd < t_step:
+        # residual = backward + loss/noise plumbing (backward dominates)
+        backward_ms = round(1e3 * (t_step - t_fwd), 3)
+    out = {
+        "config": args.config, "batch": batch, "executed_iters": executed,
+        "device": str(jax.devices()[0].platform),
+        "rows": rows, "residual_backward_ms": backward_ms,
+    }
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -73,22 +73,22 @@ def main():
 
     import jax.numpy as jnp
 
-    from glom_tpu.config import GlomConfig, TrainConfig
+    from glom_tpu.config import GlomConfig, TrainConfig, bench_preset
 
-    if args.config == "large":
-        config = GlomConfig(dim=1024, levels=8, image_size=384, patch_size=16,
-                            compute_dtype=jnp.bfloat16, remat=True)
-        iters = 16
-    else:
-        config = GlomConfig(compute_dtype=jnp.bfloat16, remat=True)
-        iters = 12
+    kw, iters, _, _ = bench_preset(args.config)
+    config = GlomConfig(compute_dtype=jnp.bfloat16, remat=True, **kw)
 
     # numerator 1: analytic model FLOPs.  Train step = forward + backward;
     # backward of a matmul graph is 2x the forward matmuls (dX and dW) =>
     # 3x forward, the standard convention (remat recompute excluded).
-    # Executed iterations = the loss timestep (default iters//2 + 1, matching
-    # TrainConfig) — the later iterations are dead code under the loss.
-    executed = args.loss_timestep if args.loss_timestep else iters // 2 + 1
+    # Executed iterations = the loss timestep — the later iterations are
+    # dead code under the loss; the resolution is the step fn's own
+    # (glom_tpu.training.denoise.resolve_loss_timestep).
+    from glom_tpu.training.denoise import resolve_loss_timestep
+
+    executed = resolve_loss_timestep(
+        TrainConfig(loss_timestep=args.loss_timestep or None, iters=iters), iters
+    )
     fwd = model_flops_per_image(config, executed)
     train_flops = 3.0 * fwd
 
