@@ -1,0 +1,100 @@
+"""Render the held-out learning curves (denoising PSNR + linear-probe
+accuracy vs step) from a Trainer JSONL log.
+
+Companion evidence to the islands figure: the reference ships its SSL
+recipe as documentation with no evaluation at all
+(`/root/reference/README.md:56-90`); here the framework's own eval suite
+logs held-out PSNR and probe accuracy, and this script turns the JSONL
+into the committed figure.
+
+  python examples/plot_curves.py --log docs/runs/shapes64_cpu.jsonl \
+      --out docs/curves_shapes64.png --chance 0.125
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# palette: categorical slots 1-2 of the validated reference palette
+# (dataviz skill); text/grid wear text tokens, never series color
+SURFACE = "#fcfcfb"
+TEXT = "#0b0b0b"
+TEXT_2 = "#52514e"
+BLUE = "#2a78d6"
+ORANGE = "#eb6834"
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--log", required=True)
+    p.add_argument("--out", default="docs/curves.png")
+    p.add_argument("--chance", type=float, default=None,
+                   help="chance accuracy for the probe panel reference line")
+    args = p.parse_args()
+
+    steps_p, psnr, steps_a, acc = [], [], [], []
+    with open(args.log) as f:
+        for line in f:
+            rec = json.loads(line)
+            if "eval_psnr_db" in rec:
+                steps_p.append(rec["step"]); psnr.append(rec["eval_psnr_db"])
+            if "probe_test_acc" in rec:
+                steps_a.append(rec["step"]); acc.append(rec["probe_test_acc"])
+    if not steps_p:
+        raise SystemExit(f"no eval records in {args.log}")
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    # one measure per panel (no dual axis); single series per panel, so the
+    # panel title names it and no legend box is needed.  Probe records are
+    # optional (train.py logs PSNR-only when labels are absent/single-class).
+    panels = [(steps_p, psnr, BLUE, "Held-out denoising PSNR (dB)")]
+    if steps_a:
+        panels.append((steps_a, acc, ORANGE, "Held-out linear-probe accuracy"))
+    fig, axes = plt.subplots(1, len(panels), figsize=(4.8 * len(panels), 3.4),
+                             constrained_layout=True, squeeze=False)
+    axes = axes[0]
+    fig.patch.set_facecolor(SURFACE)
+    panels = [(ax,) + row for ax, row in zip(axes, panels)]
+    for ax, xs, ys, color, title in panels:
+        ax.set_facecolor(SURFACE)
+        ax.plot(xs, ys, color=color, linewidth=2, marker="o", markersize=5,
+                markerfacecolor=color, markeredgecolor=SURFACE,
+                markeredgewidth=1.2, clip_on=False)
+        ax.set_title(title, fontsize=11, color=TEXT, loc="left")
+        ax.set_xlabel("training step", fontsize=9, color=TEXT_2)
+        ax.grid(axis="y", color="#e4e3df", linewidth=0.8)
+        ax.tick_params(colors=TEXT_2, labelsize=9)
+        for side in ("top", "right"):
+            ax.spines[side].set_visible(False)
+        for side in ("left", "bottom"):
+            ax.spines[side].set_color("#d0cfc9")
+        # selective direct labels: first and last point only
+        ax.annotate(f"{ys[0]:.2f}", (xs[0], ys[0]), textcoords="offset points",
+                    xytext=(2, -12), fontsize=9, color=TEXT_2)
+        ax.annotate(f"{ys[-1]:.2f}", (xs[-1], ys[-1]),
+                    textcoords="offset points", xytext=(-4, 7), fontsize=9,
+                    color=TEXT, fontweight="bold", ha="right")
+    if args.chance is not None and steps_a:
+        ax = axes[-1]
+        ax.axhline(args.chance, color=TEXT_2, linewidth=1, linestyle=(0, (4, 3)))
+        ax.annotate("chance", (ax.get_xlim()[1], args.chance),
+                    textcoords="offset points", xytext=(-2, 4), fontsize=9,
+                    color=TEXT_2, ha="right")
+        ax.set_ylim(0.0, max(acc) * 1.15)
+
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    fig.savefig(args.out, dpi=120, facecolor=SURFACE)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -50,6 +50,11 @@ def parse_args(argv=None):
     p.add_argument("--warmup-steps", type=int, default=0)
     p.add_argument("--weight-decay", type=float, default=0.0)
     p.add_argument("--iters", type=int, default=None)
+    p.add_argument("--loss-timestep", type=int, default=None,
+                   help="which trajectory state feeds the denoising loss "
+                        "(reference README.md:83 reads t=7 of 12); default "
+                        "iters//2+1 — also the executed-iteration count of "
+                        "the capture fast path")
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--eval-every", type=int, default=0,
@@ -138,6 +143,7 @@ def main(argv=None):
         warmup_steps=args.warmup_steps,
         weight_decay=args.weight_decay,
         iters=args.iters,
+        loss_timestep=args.loss_timestep,
         noise_std=args.noise_std,
         consistency=args.consistency,
         consistency_weight=args.consistency_weight,
@@ -206,6 +212,7 @@ def main(argv=None):
         eval_imgs, probe_kwargs = eval_data
         trainer.set_eval_suite(EvalSuite(
             config, eval_imgs, noise_std=args.noise_std, iters=args.iters,
+            timestep=args.loss_timestep,  # PSNR scores the trained state
             chunk=min(args.batch_size, len(eval_imgs)),
             consensus_fn=trainer._consensus_fn, ff_fn=trainer._ff_fn,
             **probe_kwargs,

@@ -262,10 +262,13 @@ class Trainer:
                 f"architecture; refusing to load its weights. Differing "
                 f"fields (checkpoint, this trainer): {arch_diff}"
             )
+        # iterate the RECORDED knobs only: fields added after the checkpoint
+        # was written (e.g. scan_unroll on a pre-0.3 dir) are a version
+        # artifact, not a changed knob, and must not warn
         other_diff = {
-            k: (recorded.get(k), mine.get(k))
-            for k in sorted(set(recorded) | set(mine))
-            if k not in self._ARCH_FIELDS and recorded.get(k) != mine.get(k)
+            k: (recorded[k], mine.get(k))
+            for k in sorted(recorded)
+            if k not in self._ARCH_FIELDS and recorded[k] != mine.get(k)
         }
         if other_diff:
             import warnings

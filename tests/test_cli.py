@@ -30,7 +30,8 @@ def test_every_train_config_field_has_a_cli_path():
     such drifts were caught by hand in verification; this automates it)."""
     args = parse_args([])
     covered_by_flag = {
-        "batch_size", "grad_accum_steps", "learning_rate", "lr_schedule", "warmup_steps", "weight_decay", "iters", "noise_std",
+        "batch_size", "grad_accum_steps", "learning_rate", "lr_schedule",
+        "warmup_steps", "weight_decay", "iters", "loss_timestep", "noise_std",
         "steps", "log_every", "eval_every", "checkpoint_every", "checkpoint_dir",
         "checkpoint_backend", "async_checkpoint",
         "profile_dir", "seed", "mesh_shape", "param_sharding",
@@ -38,7 +39,7 @@ def test_every_train_config_field_has_a_cli_path():
         "consistency_level",
     }
     # fields intentionally config-only (documented, no flag yet)
-    config_only = {"loss_timestep", "loss_level", "mesh_axes", "donate"}
+    config_only = {"loss_level", "mesh_axes", "donate"}
     fields = {f.name for f in dataclasses.fields(TrainConfig)}
     unaccounted = fields - covered_by_flag - config_only
     assert not unaccounted, f"TrainConfig fields missing from CLI mapping: {unaccounted}"
@@ -146,3 +147,10 @@ def test_cli_scan_unroll_and_platform_flags():
     assert args.scan_unroll == 3 and args.platform == "cpu"
     args = parse_args([])
     assert args.scan_unroll == 1 and args.platform == "auto"
+
+
+def test_cli_loss_timestep_flag():
+    from glom_tpu.training.train import parse_args
+
+    assert parse_args(["--loss-timestep", "3"]).loss_timestep == 3
+    assert parse_args([]).loss_timestep is None
