@@ -362,6 +362,17 @@ def restore(
         leaves = []
         for path, leaf in flat_paths[0]:
             key = prefix + _SEP + _SEP.join(_entry_str(p) for p in path) if path else prefix
+            if key not in arrays:
+                # the usual cause: the live pytree's STRUCTURE differs from
+                # what was saved (e.g. the optimizer config changed — adding
+                # grad_clip_norm wraps tx in optax.chain and renames every
+                # opt-state path) — say so instead of a bare KeyError
+                raise KeyError(
+                    f"checkpoint at {directory} step {step} has no entry "
+                    f"{key!r}; the {prefix!r} pytree structure differs from "
+                    f"the saved one (did the optimizer/model config change "
+                    f"between save and restore?)"
+                )
             arr = np.asarray(arrays[key])
             if arr.shape != np.shape(leaf):
                 raise ValueError(

@@ -132,6 +132,7 @@ class TrainConfig:
     lr_schedule: str = "constant"        # "constant" | "cosine" (linear warmup + cosine decay)
     warmup_steps: int = 0                # linear warmup from 0 (cosine schedule)
     weight_decay: float = 0.0
+    grad_clip_norm: float = 0.0          # 0 = off; else clip_by_global_norm
     iters: Optional[int] = None          # None => model default (2*levels)
     # README.md:83 reads the state at time index 7 of 13 and the top level.
     loss_timestep: Optional[int] = None  # None => iters // 2 + 1
@@ -183,6 +184,12 @@ class TrainConfig:
             )
         if self.grad_accum_steps < 1:
             raise ValueError(f"grad_accum_steps must be >= 1, got {self.grad_accum_steps}")
+        if self.grad_clip_norm < 0:
+            raise ValueError(
+                f"grad_clip_norm must be >= 0 (0 disables), got "
+                f"{self.grad_clip_norm} — a negative max norm would flip "
+                f"gradient signs"
+            )
         if self.batch_size % self.grad_accum_steps != 0:
             raise ValueError(
                 f"batch_size {self.batch_size} not divisible by "

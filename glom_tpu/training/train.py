@@ -49,6 +49,9 @@ def parse_args(argv=None):
     p.add_argument("--lr-schedule", default="constant", choices=["constant", "cosine"])
     p.add_argument("--warmup-steps", type=int, default=0)
     p.add_argument("--weight-decay", type=float, default=0.0)
+    p.add_argument("--grad-clip-norm", type=float, default=0.0,
+                   help="clip gradients by global norm before the optimizer "
+                        "(0 = off); logged grad_norm stays pre-clip")
     p.add_argument("--iters", type=int, default=None)
     p.add_argument("--loss-timestep", type=int, default=None,
                    help="which trajectory state feeds the denoising loss "
@@ -142,6 +145,7 @@ def main(argv=None):
         lr_schedule=args.lr_schedule,
         warmup_steps=args.warmup_steps,
         weight_decay=args.weight_decay,
+        grad_clip_norm=args.grad_clip_norm,
         iters=args.iters,
         loss_timestep=args.loss_timestep,
         noise_std=args.noise_std,

@@ -66,6 +66,12 @@ class Trainer:
                 if train.weight_decay
                 else optax.adam(lr)
             )
+            if train.grad_clip_norm:
+                # clip BEFORE the optimizer (the standard order); the logged
+                # grad_norm metric stays the raw pre-clip norm
+                tx = optax.chain(
+                    optax.clip_by_global_norm(train.grad_clip_norm), tx
+                )
         self.tx = tx
         self.logger = logger or MetricLogger()
 

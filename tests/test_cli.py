@@ -31,7 +31,8 @@ def test_every_train_config_field_has_a_cli_path():
     args = parse_args([])
     covered_by_flag = {
         "batch_size", "grad_accum_steps", "learning_rate", "lr_schedule",
-        "warmup_steps", "weight_decay", "iters", "loss_timestep", "noise_std",
+        "warmup_steps", "weight_decay", "grad_clip_norm", "iters",
+        "loss_timestep", "noise_std",
         "steps", "log_every", "eval_every", "checkpoint_every", "checkpoint_dir",
         "checkpoint_backend", "async_checkpoint",
         "profile_dir", "seed", "mesh_shape", "param_sharding",

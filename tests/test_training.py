@@ -709,3 +709,66 @@ def test_sharded_checkpoint_pruning(tmp_path):
     names = sorted(f for f in map(str, tmp_path.iterdir()) if "ckpt_" in f)
     steps_left = sorted({int(n.split("ckpt_")[1].split(".")[0]) for n in names})
     assert steps_left == [3, 4]
+
+
+def test_grad_clip_norm_chains_clipping():
+    """grad_clip_norm=c builds optax.chain(clip_by_global_norm(c), adam):
+    the trainer's tx must transform gradients exactly like the hand-built
+    chain (and differently from unclipped adam when the norm exceeds c)."""
+    import numpy as np
+    import optax
+
+    from glom_tpu.training.trainer import Trainer
+
+    cfg = GlomConfig(dim=16, levels=2, image_size=16, patch_size=4)
+    tr = Trainer(cfg, TrainConfig(batch_size=8, steps=1, log_every=0,
+                                  grad_clip_norm=1e-6, donate=False))
+    params = jax.device_get(tr.state.params)
+    grads = jax.tree_util.tree_map(lambda a: np.ones_like(a) * 3.0, params)
+
+    want_tx = optax.chain(optax.clip_by_global_norm(1e-6),
+                          optax.adam(tr.train_cfg.learning_rate))
+    got, _ = tr.tx.update(grads, tr.tx.init(params), params)
+    want, _ = want_tx.update(grads, want_tx.init(params), params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-7
+        ),
+        got, want,
+    )
+    # and it is NOT plain adam: the 1e-6 clip pushes per-element grads
+    # below adam's eps, so the clipped update visibly shrinks (adam is
+    # scale-invariant above eps — a loose clip would be indistinguishable
+    # in a single step)
+    plain = optax.adam(tr.train_cfg.learning_rate)
+    p2, _ = plain.update(grads, plain.init(params), params)
+    diffs = jax.tree_util.tree_map(
+        lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()), got, p2
+    )
+    assert jax.tree_util.tree_reduce(max, diffs) > 1e-5
+
+
+def test_grad_clip_negative_rejected():
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="grad_clip_norm"):
+        TrainConfig(batch_size=8, grad_clip_norm=-1.0)
+
+
+def test_restore_structure_mismatch_is_actionable(tmp_path):
+    """Restoring into a trainer whose optimizer config changed (different
+    opt-state pytree) names the missing path and the likely cause instead
+    of a bare KeyError."""
+    import numpy as np
+    import pytest as _pytest
+
+    from glom_tpu.training.trainer import Trainer
+
+    cfg = GlomConfig(dim=16, levels=2, image_size=16, patch_size=4)
+    tr = Trainer(cfg, TrainConfig(batch_size=8, steps=1, log_every=0,
+                                  donate=False))
+    tr.save(str(tmp_path))
+    tr2 = Trainer(cfg, TrainConfig(batch_size=8, steps=1, log_every=0,
+                                   grad_clip_norm=0.5, donate=False))
+    with _pytest.raises(KeyError, match="structure differs"):
+        tr2.restore(str(tmp_path))
