@@ -6,7 +6,7 @@ interpret-mode CPU tests cannot: Mosaic lowering, sublane/lane tiling,
 scoped-VMEM limits.  Exits non-zero on the first failure.
 
   python tools/hw_check.py            # full checklist
-  python tools/hw_check.py --quick    # skip the large config
+  python tools/hw_check.py --quick    # skip the large config + e2e step
 """
 
 from __future__ import annotations
@@ -148,6 +148,40 @@ def main():
             )
 
         check("fused FF backward A/B large (1024/8, n=576, bf16)", ff_bwd_large)
+
+    if args.quick:
+        print("ALL HARDWARE CHECKS PASSED (quick — large + e2e skipped)", flush=True)
+        return
+
+    # --- end-to-end train step: fused backward inside scan+remat+bf16 -------
+    # The default flip is about TRAINING; this exercises the kernels in the
+    # exact context the flag enables them (scan body, remat policy, bf16
+    # compute, value_and_grad) rather than as standalone VJPs.
+    def e2e_step_ab():
+        import optax
+
+        from glom_tpu.config import GlomConfig, TrainConfig
+        from glom_tpu.training import denoise
+
+        tcfg = TrainConfig(batch_size=2, iters=12, log_every=0)
+        tx = optax.adam(1e-4)
+        img = np.random.default_rng(0).standard_normal((2, 3, 224, 224)).astype(np.float32)
+        metrics = {}
+        for fused in (False, True):
+            cfg = GlomConfig(compute_dtype=jnp.bfloat16, remat=True,
+                             ff_impl="pallas", ff_fused_bwd=fused)
+            state = denoise.init_state(jax.random.PRNGKey(0), cfg, tx)
+            step = denoise.make_train_step(cfg, tcfg, tx, donate=False)
+            _, m = step(state, img)
+            metrics[fused] = {k: float(v) for k, v in m.items()}
+        # identical forward => identical loss; backward differs only in
+        # kernel rounding => grad norms must agree tightly
+        np.testing.assert_allclose(metrics[True]["loss"], metrics[False]["loss"],
+                                   rtol=1e-3)
+        np.testing.assert_allclose(metrics[True]["grad_norm"],
+                                   metrics[False]["grad_norm"], rtol=5e-2)
+
+    check("end-to-end train step A/B, fused vs XLA backward (flagship)", e2e_step_ab)
 
     print("ALL HARDWARE CHECKS PASSED", flush=True)
 
