@@ -2,9 +2,15 @@
 # Serialized hardware bench sweep (ONE process touches the accelerator at a
 # time — concurrent device clients wedge the tunnel; see BASELINE.md round-2
 # notes).  Results append to tools/hw_sweep.log with timestamps.
+#
+# QUICK=1 bash tools/hw_sweep.sh — short-window mode for a tunnel that
+# recovers late: hw_check gate, then only the highest-value bench rows
+# (record number, fused backward, no-remat/batch levers, profile trace),
+# ordered so an interrupt still leaves the essentials on record.
 set -u
 cd "$(dirname "$0")/.."
 LOG=tools/hw_sweep.log
+QUICK=${QUICK:-0}
 
 run() {
   echo "=== $(date -u +%FT%TZ) bench $*" | tee -a "$LOG"
@@ -27,6 +33,19 @@ if [ $rc -ne 0 ]; then
   # benching broken kernels would put meaningless numbers in the log
   { echo "!! hw_check rc=$rc — aborting sweep"; echo "$hc" | tail -30; } | tee -a "$LOG"
   exit $rc
+fi
+
+if [ "$QUICK" = "1" ]; then
+  run                                  # auto: pallas FF fwd on TPU — the record
+  run --ff-impl pallas --fused-ff-bwd
+  run --no-remat --ff-impl pallas
+  run --batch-size 64 --ff-impl pallas --fused-ff-bwd
+  run --scan-unroll 7 --ff-impl pallas
+  run --ff-impl pallas --profile-dir /tmp/glom_trace
+  best=$(grep -o '"value": [0-9.]*' "$LOG" | awk '{print $2}' | sort -g | tail -1)
+  [ -n "${best:-}" ] && python tools/mfu.py --imgs-per-sec "$best" 2>&1 | tee -a "$LOG"
+  echo "=== $(date -u +%FT%TZ) QUICK sweep done" | tee -a "$LOG"
+  exit 0
 fi
 
 run                                    # auto: pallas FF fwd on TPU
