@@ -457,11 +457,55 @@ class Trainer:
         if cfg.checkpoint_dir and ckpt_lib.latest_step(cfg.checkpoint_dir) is not None:
             resumed = self.restore(cfg.checkpoint_dir, batches=batches)
             self.logger.log(resumed, event=1.0)  # resume marker
+
+        # Preemption safety (TPU pods get SIGTERM'd): convert the signal to
+        # a flag, finish the in-flight step, checkpoint, and return cleanly —
+        # a preempted run resumes from its own final state, not the last
+        # periodic save.  Handlers only install on the main thread (signal
+        # module requirement) and are always restored; a previous handler of
+        # None (installed from C, not Python) restores to SIG_DFL.
+        self._stop_requested = False
+        prev_handlers = {}
+        import signal as _signal
+        import threading as _threading
+
+        if _threading.current_thread() is _threading.main_thread():
+            def _request_stop(signum, frame):
+                self._stop_requested = True
+
+            prev_handlers[_signal.SIGTERM] = _signal.signal(
+                _signal.SIGTERM, _request_stop
+            )
+
+        try:
+            return self._fit_loop(batches, steps, cfg, stateful_stream)
+        finally:
+            for sig, h in prev_handlers.items():
+                _signal.signal(sig, h if h is not None else _signal.SIG_DFL)
+
+    def _should_stop(self) -> bool:
+        """Cross-host agreement on the preemption flag: SIGTERM delivery can
+        skew across processes, and per-process checkpoint tiles written at
+        different steps would corrupt the resume — so in multi-process runs
+        every step's flag is OR-reduced over hosts (one tiny allgather,
+        negligible next to the step's own collectives) and all processes
+        stop at the same step."""
+        if jax.process_count() == 1:
+            return self._stop_requested
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray(self._stop_requested)
+        )
+        return bool(np.asarray(flags).any())
+
+    def _fit_loop(self, batches, steps, cfg, stateful_stream):
         last_metrics = {}
         last_saved = -1
         window_t0, window_imgs = time.time(), 0
         start_step = int(jax.device_get(self.state.step))
         profiling = False
+        completed = steps
         for i in range(start_step, steps):
             if cfg.profile_dir:
                 # trace a 3-step post-warmup window (steps 2,3,4 of this run),
@@ -516,10 +560,15 @@ class Trainer:
                     data_state=batches.state_dict() if stateful_stream else None,
                 )
                 last_saved = i + 1
+            if self._should_stop():
+                self.logger.log(i + 1, event=2.0)  # preemption-stop marker
+                completed = i + 1
+                break
         jax.block_until_ready(self.state.params)
         if profiling:
             jax.profiler.stop_trace()
-        if cfg.checkpoint_dir and cfg.checkpoint_every and last_saved != steps and start_step < steps:
+        if (cfg.checkpoint_dir and cfg.checkpoint_every
+                and last_saved != completed and start_step < completed):
             self.save(
                 cfg.checkpoint_dir,
                 data_state=batches.state_dict() if stateful_stream else None,

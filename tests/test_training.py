@@ -772,3 +772,62 @@ def test_restore_structure_mismatch_is_actionable(tmp_path):
                                    grad_clip_norm=0.5, donate=False))
     with _pytest.raises(KeyError, match="structure differs"):
         tr2.restore(str(tmp_path))
+
+
+@pytest.mark.slow
+def test_sigterm_checkpoints_and_exits_cleanly(tmp_path):
+    """Preemption safety: SIGTERM mid-fit finishes the in-flight step, writes
+    a checkpoint at the stop step (not just the last periodic multiple), logs
+    the stop marker, and exits 0 — so a preempted pod resumes from its own
+    final state."""
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+
+    log = tmp_path / "log.jsonl"
+    env = {k: v for k, v in os.environ.items() if not k.startswith(("XLA_", "JAX_"))}
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + env.get("PYTHONPATH", "").split(os.pathsep)
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "glom_tpu.training.train",
+         "--platform", "cpu", "--steps", "100000", "--batch-size", "4",
+         "--dim", "32", "--levels", "2", "--image-size", "16",
+         "--patch-size", "4", "--iters", "2", "--log-every", "5",
+         "--checkpoint-dir", str(tmp_path), "--checkpoint-every", "90000",
+         "--log-file", str(log)],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    # wait for training to actually progress (first log line), then SIGTERM
+    deadline = _time.time() + 240
+    while _time.time() < deadline:
+        if log.exists() and log.read_text().strip():
+            break
+        _time.sleep(1)
+        assert proc.poll() is None, proc.communicate()[0][-2000:]
+    else:
+        proc.kill()
+        raise AssertionError("trainer never logged a step")
+    proc.send_signal(signal.SIGTERM)
+    try:
+        out, _ = proc.communicate(timeout=180)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        raise AssertionError("trainer did not exit after SIGTERM: " + out[-2000:])
+    assert proc.returncode == 0, out[-2000:]
+
+    import json as _json
+
+    events = [_json.loads(l) for l in log.read_text().splitlines()]
+    stop = [e for e in events if e.get("event") == 2.0]
+    assert stop, events[-3:]
+    stop_step = stop[-1]["step"]
+    import glom_tpu.checkpoint as ckpt_lib
+
+    # checkpoint-every (90000) is unreachable in this window, so the ONLY
+    # possible save is the preemption one — at exactly the stop step
+    assert ckpt_lib.latest_step(str(tmp_path)) == stop_step
