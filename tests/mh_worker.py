@@ -112,3 +112,18 @@ host_tp = gather_to_host(trainer_tp.state.params, trainer_tp.mesh)
 digest_tp = digest_of(host_tp)
 np.testing.assert_allclose(digest_tp, digest, rtol=1e-5)
 print(f"TPOK {pid} {digest_tp:.10f}", flush=True)
+
+# --- SP (ring consensus) across the process boundary: columns sharded over
+# all 4 devices, ppermute K/V rotation crossing hosts every iteration.
+import dataclasses
+
+config_sp = dataclasses.replace(config, attention_impl="ring")
+train_sp = TrainConfig(
+    batch_size=BATCH, learning_rate=1e-3, iters=2, steps=STEPS, log_every=0,
+    donate=False, mesh_shape=(1, 1, 2 * nproc),
+)
+trainer_sp = Trainer(config_sp, train_sp)
+trainer_sp.fit(synthetic_batches(BATCH, config_sp.image_size, seed=0), steps=STEPS)
+digest_sp = digest_of(gather_to_host(trainer_sp.state.params, trainer_sp.mesh))
+np.testing.assert_allclose(digest_sp, digest, rtol=1e-5)
+print(f"SPOK {pid} {digest_sp:.10f}", flush=True)

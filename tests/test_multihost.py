@@ -62,8 +62,10 @@ def test_two_process_training_agrees_and_checkpoints(tmp_path):
     assert set(digests) == {"0", "1"}, outs
     assert all("SHARDOK" in out for out in outs), outs  # sharded ckpt round-trip
     # TP with the model axis spanning both processes (cross-host psum in the
-    # compute path) matches the DP result — asserted inside each worker
+    # compute path) and SP with the ring's ppermute crossing hosts each
+    # iteration both match the DP result — asserted inside each worker
     assert all("TPOK" in out for out in outs), outs
+    assert all("SPOK" in out for out in outs), outs
     # both processes hold identical global params after DP training
     assert digests["0"] == digests["1"], digests
 
