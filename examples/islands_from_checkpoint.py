@@ -18,7 +18,6 @@ Run:
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 
@@ -41,26 +40,16 @@ def main():
     jax.config.update("jax_platforms", "cpu")  # host-side figure utility
 
     import numpy as np
-    import optax
 
-    from glom_tpu import checkpoint as ckpt_lib
-    from glom_tpu.config import GlomConfig
     from glom_tpu.models import glom as glom_model
     from glom_tpu.models.islands import neighbor_agreement
-    from glom_tpu.training import denoise
+    from glom_tpu.training.denoise import load_checkpoint_params
     from glom_tpu.training.image_stream import (
         labels_from_paths, list_image_files, load_images,
     )
 
-    with open(os.path.join(args.checkpoint_dir, "config.json")) as f:
-        config = GlomConfig.from_json_dict(json.load(f)["glom"])
+    step, config, params = load_checkpoint_params(args.checkpoint_dir)
     iters = args.iters or config.default_iters
-
-    template = denoise.init_state(jax.random.PRNGKey(0), config, optax.sgd(0.0))
-    step, trees = ckpt_lib.restore(
-        args.checkpoint_dir, {"params": template.params}
-    )
-    params = trees["params"]["glom"]
     print(f"restored step {step} from {args.checkpoint_dir}")
 
     files = list_image_files(args.data_dir)

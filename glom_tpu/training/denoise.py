@@ -208,3 +208,21 @@ def make_train_step(
     return jax.jit(
         make_step_fn(config, train, tx), donate_argnums=(0,) if donate else ()
     )
+
+
+def load_checkpoint_params(directory: str):
+    """``(step, config, glom_params)`` from a self-describing Trainer
+    checkpoint dir (its ``config.json`` names the architecture; the state
+    template comes from a throwaway init).  The ONE loader shared by every
+    inference-side consumer (``training.extract``, the islands example) so
+    the checkpoint layout has a single read path."""
+    import json
+    import os
+
+    from glom_tpu import checkpoint as ckpt_lib
+
+    with open(os.path.join(directory, "config.json")) as f:
+        config = GlomConfig.from_json_dict(json.load(f)["glom"])
+    template = init_state(jax.random.PRNGKey(0), config, optax.sgd(0.0))
+    step, trees = ckpt_lib.restore(directory, {"params": template.params})
+    return step, config, trees["params"]["glom"]

@@ -1,0 +1,118 @@
+"""CLI embedding extraction: ``python -m glom_tpu.training.extract``.
+
+The reference's suggested downstream use of GLOM is to read the level
+states after the update loop settles ("return_all ... you can also pass
+back the levels" — `/root/reference/README.md:38-53`); this turns that into
+a batch workflow: load a Trainer checkpoint (self-describing via its
+``config.json``), stream an ImageFolder through the forward pass, and write
+mean-pooled per-column embeddings (plus labels from the directory layout)
+to one ``.npz`` — ready for probes, retrieval, or clustering.
+
+  python -m glom_tpu.training.extract --checkpoint-dir /ckpt \\
+      --data-dir /data --out embeddings.npz [--level -1 | --all-levels]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="GLOM embedding extraction")
+    p.add_argument("--checkpoint-dir", required=True,
+                   help="Trainer checkpoint dir (reads its config.json)")
+    p.add_argument("--data-dir", required=True, help="ImageFolder root")
+    p.add_argument("--out", default="embeddings.npz")
+    p.add_argument("--level", type=int, default=-1,
+                   help="which level's columns to mean-pool (default: top)")
+    p.add_argument("--all-levels", action="store_true",
+                   help="save (N, levels, dim) — one pooled vector per level")
+    p.add_argument("--iters", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--max-images", type=int, default=0, help="0 = all")
+    p.add_argument("--platform", default="auto",
+                   help="force a JAX platform (e.g. 'cpu')")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+
+    import jax
+
+    if args.platform != "auto":
+        jax.config.update("jax_platforms", args.platform)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from glom_tpu.models import glom as glom_model
+    from glom_tpu.training.denoise import load_checkpoint_params
+    from glom_tpu.training.image_stream import (
+        labels_from_paths, list_image_files, load_images,
+    )
+
+    step, config, params = load_checkpoint_params(args.checkpoint_dir)
+
+    files = list_image_files(args.data_dir)
+    if args.max_images:
+        files = files[:args.max_images]
+    if not files:
+        raise SystemExit(f"no images found under {args.data_dir}")
+    labels, class_names = labels_from_paths(files)
+
+    @jax.jit
+    def forward(prm, imgs):
+        out = glom_model.apply(prm, imgs, config=config, iters=args.iters)
+        pooled = jnp.mean(out, axis=1)               # (b, levels, dim)
+        return pooled if args.all_levels else pooled[:, args.level]
+
+    bs = args.batch_size
+
+    def decode(batch_files):
+        imgs = load_images(batch_files, config.image_size)
+        # static batch shape for the jit cache: pad the tail chunk, then trim
+        pad = bs - len(batch_files)
+        if pad:
+            imgs = np.concatenate(
+                [imgs, np.zeros((pad,) + imgs.shape[1:], imgs.dtype)]
+            )
+        return imgs
+
+    # one worker thread decodes batch i+1 while the device runs batch i —
+    # the decode/compute overlap ImageFolderStream gives training
+    from concurrent.futures import ThreadPoolExecutor
+
+    batches = [files[i:i + bs] for i in range(0, len(files), bs)]
+    chunks = []
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        pending = pool.submit(decode, batches[0])
+        for j, batch_files in enumerate(batches):
+            imgs = pending.result()
+            if j + 1 < len(batches):
+                pending = pool.submit(decode, batches[j + 1])
+            out = np.asarray(forward(params, imgs))
+            chunks.append(out[:len(batch_files)])
+    embeddings = np.concatenate(chunks)
+
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    np.savez(
+        args.out,
+        embeddings=embeddings.astype(np.float32),
+        labels=labels,
+        class_names=np.array(class_names),
+        paths=np.array(files),
+        checkpoint_step=step,
+        level=args.level if not args.all_levels else -999,
+    )
+    print(json.dumps({
+        "out": args.out, "n": int(embeddings.shape[0]),
+        "shape": list(embeddings.shape), "classes": len(class_names),
+        "checkpoint_step": int(step),
+    }))
+
+
+if __name__ == "__main__":
+    main()

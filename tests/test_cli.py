@@ -155,3 +155,54 @@ def test_cli_loss_timestep_flag():
 
     assert parse_args(["--loss-timestep", "3"]).loss_timestep == 3
     assert parse_args([]).loss_timestep is None
+
+
+def test_extract_cli_roundtrip(tmp_path, capsys):
+    """glom-tpu-extract: checkpoint + ImageFolder -> embeddings npz with
+    labels/class names; --all-levels emits one pooled vector per level."""
+    import numpy as np
+
+    try:
+        import cv2
+
+        def write(path, arr):
+            cv2.imwrite(str(path), arr[:, :, ::-1])
+    except ImportError:
+        from PIL import Image
+
+        def write(path, arr):
+            Image.fromarray(arr).save(str(path))
+
+    data = tmp_path / "data"
+    for i in range(8):
+        sub = data / f"class_{i % 2}"
+        sub.mkdir(parents=True, exist_ok=True)
+        write(sub / f"img_{i}.png",
+              np.full((16, 16, 3), 20 * i, dtype=np.uint8))
+
+    from glom_tpu.training.train import main as train_main
+
+    ckpt = tmp_path / "ckpt"
+    train_main(["--steps", "1", "--batch-size", "8", "--dim", "16",
+                "--levels", "2", "--image-size", "16", "--patch-size", "4",
+                "--iters", "2", "--log-every", "0",
+                "--checkpoint-dir", str(ckpt), "--checkpoint-every", "1"])
+
+    from glom_tpu.training.extract import main as extract_main
+
+    out = tmp_path / "emb.npz"
+    extract_main(["--checkpoint-dir", str(ckpt), "--data-dir", str(data),
+                  "--out", str(out), "--batch-size", "3"])  # pad-tail path
+    capsys.readouterr()
+    z = np.load(str(out), allow_pickle=False)
+    assert z["embeddings"].shape == (8, 16)
+    assert sorted(set(z["labels"].tolist())) == [0, 1]
+    assert list(z["class_names"]) == ["class_0", "class_1"]
+    assert int(z["checkpoint_step"]) == 1
+
+    out2 = tmp_path / "emb_all.npz"
+    extract_main(["--checkpoint-dir", str(ckpt), "--data-dir", str(data),
+                  "--out", str(out2), "--all-levels"])
+    capsys.readouterr()
+    z2 = np.load(str(out2), allow_pickle=False)
+    assert z2["embeddings"].shape == (8, 2, 16)
