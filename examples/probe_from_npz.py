@@ -1,0 +1,69 @@
+"""Fit a linear probe on embeddings extracted by ``glom-tpu-extract``.
+
+Closes the representation-quality loop from the command line: train with
+``glom-tpu-train``, extract with ``glom-tpu-extract``, probe here — the
+same closed-form ridge probe the held-out EvalSuite uses during training
+(`glom_tpu.training.eval.linear_probe`), applied to any saved npz.
+
+  python examples/probe_from_npz.py --npz embeddings.npz [--train-frac 0.5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--npz", required=True, help="output of glom-tpu-extract")
+    p.add_argument("--train-frac", type=float, default=0.5)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # host-side utility
+
+    import numpy as np
+
+    from glom_tpu.training.eval import linear_probe
+
+    z = np.load(args.npz)
+    emb, labels = z["embeddings"], z["labels"]
+    if emb.ndim == 3:  # --all-levels output: probe each level separately
+        per_level = {}
+        for l in range(emb.shape[1]):
+            per_level[f"level_{l}"] = _probe(
+                linear_probe, emb[:, l], labels, z, args
+            )
+        print(json.dumps({"n": int(emb.shape[0]), **per_level}))
+        return
+    print(json.dumps({
+        "n": int(emb.shape[0]),
+        **_probe(linear_probe, emb, labels, z, args),
+    }))
+
+
+def _probe(linear_probe, emb, labels, z, args):
+    import numpy as np
+
+    rng = np.random.default_rng(args.seed)
+    perm = rng.permutation(len(emb))
+    k = int(len(emb) * args.train_frac)
+    tr, te = perm[:k], perm[k:]
+    num_classes = len(z["class_names"])
+    train_acc, test_acc = linear_probe(
+        emb[tr], labels[tr], emb[te], labels[te], num_classes=num_classes
+    )
+    return {"train_acc": round(float(train_acc), 4),
+            "test_acc": round(float(test_acc), 4),
+            "chance": round(1.0 / num_classes, 4)}
+
+
+if __name__ == "__main__":
+    main()
