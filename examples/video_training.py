@@ -8,6 +8,11 @@ Run: python examples/video_training.py [--steps 40]
 """
 
 import argparse
+import os
+import sys
+
+# runnable as `python examples/video_training.py` from a checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import numpy as np
@@ -33,10 +38,86 @@ def moving_blob_clips(rng, t, b, size):
     return clips
 
 
+def bench(tiny=False):
+    """Flagship-scale stateful video rollout + train step (BASELINE config
+    5: consecutive frames with carried ``levels`` state): prints one JSON
+    line each for rollout frames/sec and train-step frames/sec on the
+    attached device.  ``tiny`` shrinks everything to a CPU-runnable smoke
+    (plumbing check, never a number of record)."""
+    import json
+    import time
+
+    import jax.numpy as jnp
+
+    frames, batch = (4, 2) if tiny else (8, 4)
+    kw = dict(dim=64, levels=3, image_size=64, patch_size=8) if tiny else {}
+    iters = 4 if tiny else 12
+    config = GlomConfig(compute_dtype=jnp.bfloat16, remat=True, **kw)
+    train = TrainConfig(batch_size=batch, learning_rate=1e-3, iters=iters,
+                        noise_std=0.3)
+    tx = optax.adam(train.learning_rate)
+    state = denoise.init_state(jax.random.PRNGKey(0), config, tx)
+    clips = np.random.default_rng(0).standard_normal(
+        (frames, batch, 3, config.image_size, config.image_size)
+    ).astype(np.float32)
+
+    roll = jax.jit(lambda p, c: rollout(p, c, config=config, iters=iters))
+    out = jax.block_until_ready(roll(state.params["glom"], clips))  # compile
+    t0 = time.time()
+    reps = 5
+    for _ in range(reps):
+        out = jax.block_until_ready(roll(state.params["glom"], clips))
+    dt = time.time() - t0
+    print(json.dumps({"metric": "video_rollout_frames_per_sec",
+                      "value": round(frames * batch * reps / dt, 1)}), flush=True)
+
+    step = make_video_train_step(config, train, tx, donate=False)
+    state, m = step(state, clips)  # compile
+    jax.block_until_ready(state.params)
+    t0 = time.time()
+    for _ in range(reps):
+        state, m = step(state, clips)
+    jax.block_until_ready(state.params)
+    dt = time.time() - t0
+    print(json.dumps({"metric": "video_train_frames_per_sec",
+                      "value": round(frames * batch * reps / dt, 1)}), flush=True)
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=40)
+    p.add_argument("--platform", default="auto",
+                   help="force a JAX platform (e.g. 'cpu')")
+    p.add_argument("--bench", action="store_true",
+                   help="flagship-scale rollout + train-step timing "
+                        "(BASELINE config 5) instead of the toy training run")
+    p.add_argument("--bench-tiny", action="store_true",
+                   help="CPU-runnable smoke variant of --bench")
+    p.add_argument("--device-probe-timeout", type=int, default=240,
+                   help="retry-poll the accelerator relay before device init "
+                        "(<= 0 disables; ignored when --platform is forced)")
     args = p.parse_args()
+
+    if args.platform != "auto":
+        jax.config.update("jax_platforms", args.platform)
+    if args.bench or args.bench_tiny:
+        timer = None
+        if args.platform == "auto":
+            # unattended sweep leg: a dead tunnel must produce an error
+            # line, never a silent hang (same contract as bench.py)
+            import json as _json
+
+            from glom_tpu.device_guard import guard_device_init
+
+            timer = guard_device_init(
+                args.device_probe_timeout,
+                lambda msg: print(_json.dumps({"error": msg}), flush=True),
+            )
+        jax.devices()  # the guarded init
+        if timer is not None:
+            timer.cancel()
+        bench(tiny=args.bench_tiny)
+        return
 
     config = GlomConfig(dim=64, levels=4, image_size=32, patch_size=8)
     train = TrainConfig(batch_size=4, learning_rate=1e-3, iters=4, noise_std=0.3)

@@ -1,7 +1,9 @@
 """Video denoising-SSL training.
 
-BASELINE.json config 5 is "consecutive frames with carried ``levels`` state,
-batched on TPU".  ``models/video.py`` gives the one-graph rollout; this adds
+Reference analogue: the stateful-video recipe the reference documents but
+ships no code for (`/root/reference/README.md:92-112` — pass ``levels``
+back in across frames).  BASELINE.json config 5 is "consecutive frames
+with carried ``levels`` state, batched on TPU".  ``models/video.py`` gives the one-graph rollout; this adds
 the training objective on top: every frame of a noised clip rolls through
 the scan-of-scans with carried state, each frame's final top level decodes
 through ``patches_to_images``, and the loss is the mean frame-reconstruction

@@ -42,7 +42,7 @@ if [ "$QUICK" = "1" ]; then
   run --batch-size 64 --ff-impl pallas --fused-ff-bwd
   run --scan-unroll 7 --ff-impl pallas
   run --ff-impl pallas --profile-dir /tmp/glom_trace
-  best=$(grep -o '"value": [0-9.]*' "$LOG" | awk '{print $2}' | sort -g | tail -1)
+  best=$(grep '"metric": "denoise_ssl_train_imgs_per_sec_per_chip"' "$LOG" | grep -o '"value": [0-9.]*' | awk '{print $2}' | sort -g | tail -1)
   [ -n "${best:-}" ] && python tools/mfu.py --imgs-per-sec "$best" 2>&1 | tee -a "$LOG"
   echo "=== $(date -u +%FT%TZ) QUICK sweep done" | tee -a "$LOG"
   exit 0
@@ -104,11 +104,22 @@ echo "=== $(date -u +%FT%TZ) breakdown" | tee -a "$LOG"
 timeout 600 python tools/breakdown.py 2>&1 | tee -a "$LOG"
 timeout 600 python tools/breakdown.py --ff-impl pallas 2>&1 | tee -a "$LOG"
 
+# Stateful video rollout + train step (BASELINE config 5 refresh) —
+# run()'s capture/rc pattern so a partial failure keeps the metrics that
+# DID print plus a distinguishable failure signature
+echo "=== $(date -u +%FT%TZ) video bench" | tee -a "$LOG"
+vout=$(timeout 900 python examples/video_training.py --bench 2>/tmp/hw_sweep_err.txt)
+vrc=$?
+echo "$vout" | grep '"metric"' | tee -a "$LOG"
+if [ $vrc -ne 0 ]; then
+  { echo "!! video bench rc=$vrc"; tail -15 /tmp/hw_sweep_err.txt; } | tee -a "$LOG"
+fi
+
 # MFU at the sweep's best rate.  The max over the log is always a flagship
 # row (large-config rows run ~20x slower), so the flagship FLOP numerator in
 # tools/mfu.py matches; if a non-default batch size wins, rerun mfu.py by
 # hand with --batch-size to align the compiled-FLOPs count.
-best=$(grep -o '"value": [0-9.]*' "$LOG" | awk '{print $2}' | sort -g | tail -1)
+best=$(grep '"metric": "denoise_ssl_train_imgs_per_sec_per_chip"' "$LOG" | grep -o '"value": [0-9.]*' | awk '{print $2}' | sort -g | tail -1)
 if [ -n "${best:-}" ]; then
   echo "=== $(date -u +%FT%TZ) mfu at best rate $best" | tee -a "$LOG"
   python tools/mfu.py --imgs-per-sec "$best" 2>&1 | tee -a "$LOG"
