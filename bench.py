@@ -40,7 +40,7 @@ def main():
     p.add_argument("--scan-unroll", type=int, default=1,
                    help="unroll factor of the iteration scan (>1 lets XLA "
                         "fuse/overlap across iterations; loop is 7-16 steps)")
-    p.add_argument("--attention-impl", default="dense", choices=["dense", "pallas", "ring", "ulysses"])
+    p.add_argument("--attention-impl", default="dense", choices=["auto", "dense", "pallas", "ring", "ulysses"])
     p.add_argument("--ff-impl", default="auto", choices=["auto", "dense", "pallas"],
                    help="auto = pallas on TPU (the fastest hardware-verified "
                         "config: ~+10%% over dense, 282.4 vs 255.6 in the "
@@ -120,9 +120,9 @@ def main():
     if args.ff_impl == "auto":
         # pltpu kernels only lower on TPU; any other backend (cpu, gpu) takes
         # the dense XLA path
-        from glom_tpu.parallel.mesh import is_tpu_device
+        from glom_tpu.parallel.mesh import default_backend_is_tpu
 
-        args.ff_impl = "pallas" if is_tpu_device(jax.devices()[0]) else "dense"
+        args.ff_impl = "pallas" if default_backend_is_tpu() else "dense"
     # CPU fallback exists so the bench cannot wedge a driver run; the metric
     # stays honest (it just reports the low CPU rate)
     if args.steps == 0:

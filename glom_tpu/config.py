@@ -40,7 +40,9 @@ class GlomConfig:
     # everything in backward — min memory, max recompute) vs "dots" saves
     # matmul outputs (recompute only elementwise — more memory, less FLOPs)
     remat_policy: str = "full"      # "full" | "dots"
-    attention_impl: str = "dense"   # "dense" | "pallas" | "ring" | "ulysses"
+    attention_impl: str = "dense"   # "auto" | "dense" | "pallas" | "ring" | "ulysses"
+    # ("auto": pallas on TPU when num_patches > 256 — the measured crossover —
+    #  else dense; resolved at make_consensus_fn time)
     ff_impl: str = "dense"          # "dense" | "pallas" (fused, hidden stays in VMEM)
     # with ff_impl="pallas": fused Pallas backward kernels (hidden recomputed
     # per tile, never in HBM) vs the XLA einsum VJP.  Default stays False
@@ -66,7 +68,7 @@ class GlomConfig:
             )
         if self.levels < 2:
             raise ValueError("levels must be >= 2 (top_down uses levels-1 groups)")
-        if self.attention_impl not in ("dense", "pallas", "ring", "ulysses"):
+        if self.attention_impl not in ("auto", "dense", "pallas", "ring", "ulysses"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
         if self.ff_impl not in ("dense", "pallas"):
             raise ValueError(f"unknown ff_impl {self.ff_impl!r}")

@@ -434,6 +434,15 @@ def _backward_flash(levels, mask_i8, out, lse, g, *, attend_self, interpret,
 _ONE_SHOT_MAX_N = 1024
 
 
+def supports_n(n: int) -> bool:
+    """True when this kernel family can handle ``n`` patch columns: the
+    one-shot kernel covers ``n <= _ONE_SHOT_MAX_N``; beyond that the blocked
+    kernel needs a multiple-of-8 K/V divisor of n (<= its default 512
+    chunk).  Mirrors the ValueError raised in ``_forward_blocked`` so
+    'auto' impl selection can fall back to dense instead of crashing."""
+    return n <= _ONE_SHOT_MAX_N or _pick_block(n, cap=512) < n
+
+
 def _dispatch(levels, mask_i8, attend_self, interpret, kv_block):
     n = levels.shape[1]
     if kv_block or n > _ONE_SHOT_MAX_N:

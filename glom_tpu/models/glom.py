@@ -21,6 +21,7 @@ Semantics pinned to the reference (SURVEY.md §2.1):
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Optional
 
@@ -216,8 +217,27 @@ def resolve_locality_mask(config: GlomConfig) -> Optional[jax.Array]:
 
 def make_consensus_fn(config: GlomConfig):
     """Resolve the attention implementation: XLA-dense (always-correct path),
-    Pallas fused kernel, or ring-sharded — all numerically interchangeable."""
+    Pallas fused kernel, or ring-sharded — all numerically interchangeable.
+
+    ``"auto"`` picks by measurement (BASELINE.md round-2): at n<=256 XLA's
+    fused softmax already matches the flash kernel (255.6 vs 253.4
+    imgs/sec/chip), while at n=576 the flash kernel wins — so: Pallas on a
+    TPU backend when ``num_patches > 256``, dense otherwise (incl. every
+    non-TPU backend, where pltpu kernels don't lower)."""
     mask = resolve_locality_mask(config)
+
+    impl = config.attention_impl
+    if impl == "auto":
+        from glom_tpu.kernels.consensus_pallas import supports_n
+        from glom_tpu.parallel.mesh import default_backend_is_tpu
+
+        impl = (
+            "pallas"
+            if config.num_patches > 256 and supports_n(config.num_patches)
+            and default_backend_is_tpu()
+            else "dense"
+        )
+        config = dataclasses.replace(config, attention_impl=impl)
 
     if config.attention_impl == "dense":
         return functools.partial(

@@ -28,6 +28,17 @@ def is_tpu_device(d: jax.Device) -> bool:
     return d.platform == "tpu" or "TPU" in (d.device_kind or "").upper()
 
 
+def default_backend_is_tpu() -> bool:
+    """True when computations will run on a TPU by default — respects an
+    active ``jax.default_device`` context (a user jitting to CPU for
+    debugging must not get TPU-only kernels picked for them).  The ONE
+    probe shared by every impl='auto' resolution."""
+    dev = jax.config.jax_default_device
+    if dev is not None:
+        return is_tpu_device(dev)
+    return is_tpu_device(jax.devices()[0])
+
+
 def make_mesh(
     mesh_shape: Optional[Sequence[int]] = None,
     axis_names: Sequence[str] = DEFAULT_AXES,

@@ -31,7 +31,7 @@ def parse_args(argv=None):
     p.add_argument("--remat-policy", default="full", choices=["full", "dots"],
                    help="what the scan-body checkpoint saves (dots = keep "
                         "matmul outputs, recompute only elementwise)")
-    p.add_argument("--attention-impl", default="dense", choices=["dense", "pallas", "ring", "ulysses"])
+    p.add_argument("--attention-impl", default="dense", choices=["auto", "dense", "pallas", "ring", "ulysses"])
     p.add_argument("--ff-impl", default="dense", choices=["dense", "pallas"])
     p.add_argument("--fused-ff-bwd", action="store_true",
                    help="with --ff-impl pallas: gradients via the fused Pallas "

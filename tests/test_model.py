@@ -313,3 +313,37 @@ def test_scan_unroll_matches_rolled():
 def test_scan_unroll_validation():
     with pytest.raises(ValueError, match="scan_unroll"):
         GlomConfig(dim=16, levels=2, image_size=16, patch_size=4, scan_unroll=0)
+
+
+def test_scan_unroll_full_removes_while_loop():
+    """scan_unroll >= iters must fully unroll the iteration loop — the
+    lowered HLO contains no `while` op (the compiler-contract behind the
+    bench's --scan-unroll lever), while the rolled default keeps one."""
+    img = np.zeros((1, 3, 16, 16), np.float32)
+    rolled = GlomConfig(dim=16, levels=2, image_size=16, patch_size=4)
+    params = glom_model.init(jax.random.PRNGKey(0), rolled)
+
+    def hlo(cfg):
+        return jax.jit(
+            lambda p, i: glom_model.apply(p, i, config=cfg, iters=4)
+        ).lower(params, img).as_text()
+
+    unrolled = GlomConfig(dim=16, levels=2, image_size=16, patch_size=4,
+                          scan_unroll=8)
+    assert "while" in hlo(rolled)
+    assert "while" not in hlo(unrolled)
+
+
+def test_attention_impl_auto_resolves():
+    """'auto' picks dense on non-TPU backends (and identical outputs); the
+    TPU side of the heuristic (pallas at n > 256) is exercised by the
+    hardware checklist."""
+    img = jax.random.normal(jax.random.PRNGKey(0), (1, 3, 16, 16))
+    base = GlomConfig(dim=16, levels=2, image_size=16, patch_size=4)
+    auto = GlomConfig(dim=16, levels=2, image_size=16, patch_size=4,
+                      attention_impl="auto")
+    params = glom_model.init(jax.random.PRNGKey(0), base)
+    np.testing.assert_array_equal(
+        np.asarray(glom_model.apply(params, img, config=auto, iters=2)),
+        np.asarray(glom_model.apply(params, img, config=base, iters=2)),
+    )
