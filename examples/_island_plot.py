@@ -1,0 +1,46 @@
+"""Shared island-figure grid: input column + one agreement panel per level.
+
+The single renderer behind ``islands_from_checkpoint.py`` and
+``islands_multi_object.py`` so the two published figures can't drift in
+styling (cmap, scale, dpi, layout).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def plot_island_grid(imgs_nchw, agree, row_labels, title, out, *, dpi=110):
+    """``imgs_nchw``: (R, 3, H, W) in [-1, 1]; ``agree``: (R, L, side, side)
+    neighbor-agreement maps; one figure row per image."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import numpy as np
+
+    rows, L = agree.shape[0], agree.shape[1]
+    fig, axes = plt.subplots(
+        rows, L + 1,
+        figsize=(2.2 * (L + 1), 2.1 * rows + 0.8),
+        constrained_layout=True, squeeze=False,
+    )
+    fig.suptitle(title, fontsize=11)
+    for r in range(rows):
+        ax = axes[r][0]
+        ax.imshow(np.clip((imgs_nchw[r].transpose(1, 2, 0) + 1) / 2, 0, 1))
+        ax.set_ylabel(row_labels[r], fontsize=10)
+        ax.set_xticks([]); ax.set_yticks([])
+        if r == 0:
+            ax.set_title("input", fontsize=10)
+        for l in range(L):
+            ax = axes[r][l + 1]
+            im = ax.imshow(agree[r, l], vmin=0.0, vmax=1.0, cmap="Blues")
+            ax.set_xticks([]); ax.set_yticks([])
+            if r == 0:
+                ax.set_title(f"level {l}", fontsize=10)
+    cbar = fig.colorbar(im, ax=[axes[r][-1] for r in range(rows)],
+                        shrink=0.8, pad=0.02)
+    cbar.set_label("neighbor agreement", fontsize=9)
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    fig.savefig(out, dpi=dpi)

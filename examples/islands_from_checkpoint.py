@@ -61,52 +61,19 @@ def main():
         picks.append(files[idx])
     imgs = load_images(picks, config.image_size)
 
-    all_states = glom_model.apply(
-        params, imgs, config=config, iters=iters, return_all=True,
-    )  # (iters+1, rows, n, L, d)
-    side = config.num_patches_side
-    agree = np.stack([
-        np.asarray(neighbor_agreement(all_states[t], side))
-        for t in range(iters + 1)
-    ])  # (iters+1, rows, L, side, side)
+    final = glom_model.apply(params, imgs, config=config, iters=iters)
+    agree = np.asarray(neighbor_agreement(final, config.num_patches_side))
 
-    import matplotlib
+    from _island_plot import plot_island_grid
 
-    matplotlib.use("Agg")
-    import matplotlib.pyplot as plt
-
-    L = config.levels
-    t_show = iters  # final state; one row per image
-    fig, axes = plt.subplots(
-        len(picks), L + 1,
-        figsize=(2.2 * (L + 1), 2.1 * len(picks) + 0.8),
-        constrained_layout=True, squeeze=False,
-    )
-    fig.suptitle(
+    plot_island_grid(
+        imgs, agree,
+        [os.path.basename(os.path.dirname(p)) for p in picks],
         f"Consensus islands on held dataset images (checkpoint step {step}, "
-        f"t = {t_show})\nneighbor cosine agreement per level — islands align "
+        f"t = {iters})\nneighbor cosine agreement per level — islands align "
         "with the object vs background",
-        fontsize=11,
+        args.out,
     )
-    for r, path in enumerate(picks):
-        disp = np.clip((imgs[r].transpose(1, 2, 0) + 1) / 2, 0, 1)
-        ax = axes[r][0]
-        ax.imshow(disp)
-        ax.set_ylabel(os.path.basename(os.path.dirname(path)), fontsize=10)
-        ax.set_xticks([]); ax.set_yticks([])
-        if r == 0:
-            ax.set_title("input", fontsize=10)
-        for l in range(L):
-            ax = axes[r][l + 1]
-            im = ax.imshow(agree[t_show, r, l], vmin=0.0, vmax=1.0, cmap="Blues")
-            ax.set_xticks([]); ax.set_yticks([])
-            if r == 0:
-                ax.set_title(f"level {l}", fontsize=10)
-    cbar = fig.colorbar(im, ax=[axes[r][-1] for r in range(len(picks))],
-                        shrink=0.8, pad=0.02)
-    cbar.set_label("neighbor agreement", fontsize=9)
-    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-    fig.savefig(args.out, dpi=110)
     print(f"wrote {args.out}")
 
 

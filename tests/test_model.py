@@ -347,3 +347,31 @@ def test_attention_impl_auto_resolves():
         np.asarray(glom_model.apply(params, img, config=auto, iters=2)),
         np.asarray(glom_model.apply(params, img, config=base, iters=2)),
     )
+
+
+def test_all_perf_knobs_combined_match_baseline():
+    """fuse_ff + scan_unroll + remat + bf16-off pallas FF together (the
+    knobs bench sweeps independently) must still match the plain forward —
+    guards against pairwise-tested knobs interacting wrongly when stacked."""
+    img = jax.random.normal(jax.random.PRNGKey(3), (2, 3, 16, 16))
+    base = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4)
+    params = glom_model.init(jax.random.PRNGKey(0), base)
+    want = glom_model.apply(params, img, config=base, iters=4,
+                            capture_timestep=2)
+    stacked = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4,
+                         fuse_ff=True, scan_unroll=4, remat=True,
+                         remat_policy="dots", ff_impl="pallas")
+    got = glom_model.apply(params, img, config=stacked, iters=4,
+                           capture_timestep=2)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=2e-5)
+    g_want = jax.grad(lambda p: jnp.sum(
+        glom_model.apply(p, img, config=base, iters=4) ** 2))(params)
+    g_got = jax.grad(lambda p: jnp.sum(
+        glom_model.apply(p, img, config=stacked, iters=4) ** 2))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4
+        ),
+        g_got, g_want,
+    )
