@@ -22,7 +22,9 @@ import os
 import sys
 
 # runnable as `python examples/islands_from_checkpoint.py` from a checkout
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root: glom_tpu package
+sys.path.insert(0, _HERE)                   # examples/: shared plot helper
 
 
 def main():

@@ -19,3 +19,17 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def write_image(path, arr):
+    """Write an RGB uint8 HWC array as an image file — the ONE cv2/PIL
+    fallback shared by every test that builds an on-disk image dataset
+    (cv2 stores BGR, hence the channel flip)."""
+    try:
+        import cv2
+
+        cv2.imwrite(str(path), arr[:, :, ::-1])
+    except ImportError:
+        from PIL import Image
+
+        Image.fromarray(arr).save(str(path))

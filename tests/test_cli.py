@@ -113,12 +113,9 @@ def test_cli_images_with_heldout_eval(tmp_path, capsys):
         sub.mkdir(parents=True, exist_ok=True)
         arr = rng.integers(0, 256, (20, 20, 3), dtype=np.uint8)
         arr[:, :, 0] = (i % 2) * 255  # class-coded red channel
-        try:
-            import cv2
-            cv2.imwrite(str(sub / f"i{i:03d}.png"), arr[:, :, ::-1])
-        except ImportError:
-            from PIL import Image
-            Image.fromarray(arr).save(str(sub / f"i{i:03d}.png"))
+        from tests.conftest import write_image
+
+        write_image(sub / f"i{i:03d}.png", arr)
 
     log = tmp_path / "log.jsonl"
     main([
@@ -162,16 +159,7 @@ def test_extract_cli_roundtrip(tmp_path, capsys):
     labels/class names; --all-levels emits one pooled vector per level."""
     import numpy as np
 
-    try:
-        import cv2
-
-        def write(path, arr):
-            cv2.imwrite(str(path), arr[:, :, ::-1])
-    except ImportError:
-        from PIL import Image
-
-        def write(path, arr):
-            Image.fromarray(arr).save(str(path))
+    from tests.conftest import write_image as write
 
     data = tmp_path / "data"
     for i in range(8):

@@ -1,0 +1,94 @@
+"""Smoke tests for the example scripts the hardware sweep runs unattended
+(tools/hw_sweep.sh renders figures from fresh checkpoints mid-window — an
+example broken by API drift would silently waste that window)."""
+
+import json
+import os
+import runpy
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _run(script, argv):
+    """Run an example with patched argv; argv AND sys.path are restored
+    (the scripts prepend examples/ + repo root, which would otherwise
+    shadow later imports for the rest of the pytest session)."""
+    old_argv, old_path = sys.argv, list(sys.path)
+    sys.argv = [script] + argv
+    try:
+        runpy.run_path(os.path.join(EXAMPLES, script), run_name="__main__")
+    finally:
+        sys.argv = old_argv
+        sys.path[:] = old_path
+
+
+@pytest.fixture(scope="module")
+def tiny_ckpt_and_data(tmp_path_factory):
+    """One tiny trained checkpoint + matching ImageFolder, shared by every
+    example smoke in this module."""
+    from tests.conftest import write_image as write
+
+    root = tmp_path_factory.mktemp("ex")
+    data = root / "data"
+    rng = np.random.default_rng(0)
+    for i in range(12):
+        sub = data / f"class_{i % 3}"
+        sub.mkdir(parents=True, exist_ok=True)
+        write(sub / f"img_{i}.png",
+              rng.integers(0, 255, (16, 16, 3), dtype=np.uint8))
+
+    from glom_tpu.training.train import main as train_main
+
+    ckpt = root / "ckpt"
+    train_main(["--steps", "1", "--batch-size", "8", "--dim", "16",
+                "--levels", "2", "--image-size", "16", "--patch-size", "4",
+                "--iters", "2", "--log-every", "0",
+                "--checkpoint-dir", str(ckpt), "--checkpoint-every", "1"])
+    return str(ckpt), str(data), root
+
+
+def test_islands_from_checkpoint_smoke(tiny_ckpt_and_data):
+    ckpt, data, root = tiny_ckpt_and_data
+    out = os.path.join(str(root), "islands.png")
+    _run("islands_from_checkpoint.py",
+         ["--checkpoint-dir", ckpt, "--data-dir", data, "--out", out])
+    assert os.path.getsize(out) > 1000
+
+
+def test_islands_multi_object_smoke(tiny_ckpt_and_data):
+    pytest.importorskip("cv2")  # scene drawing needs cv2 primitives
+    ckpt, _, root = tiny_ckpt_and_data
+    out = os.path.join(str(root), "islands_mo.png")
+    _run("islands_multi_object.py",
+         ["--checkpoint-dir", ckpt, "--out", out, "--pairs", "circle:square"])
+    assert os.path.getsize(out) > 1000
+
+
+def test_plot_curves_smoke(tiny_ckpt_and_data):
+    _, _, root = tiny_ckpt_and_data
+    log = os.path.join(str(root), "log.jsonl")
+    with open(log, "w") as f:
+        for s in (0, 100, 200):
+            f.write(json.dumps({"step": s, "eval_psnr_db": 10.0 + s / 50,
+                                "probe_test_acc": 0.1 + s / 1000}) + "\n")
+    out = os.path.join(str(root), "curves.png")
+    _run("plot_curves.py", ["--log", log, "--out", out, "--chance", "0.33"])
+    assert os.path.getsize(out) > 1000
+
+
+def test_extract_then_probe_smoke(tiny_ckpt_and_data, capsys):
+    ckpt, data, root = tiny_ckpt_and_data
+    npz = os.path.join(str(root), "emb.npz")
+    from glom_tpu.training.extract import main as extract_main
+
+    extract_main(["--checkpoint-dir", ckpt, "--data-dir", data, "--out", npz])
+    capsys.readouterr()
+    _run("probe_from_npz.py", ["--npz", npz])
+    out = capsys.readouterr().out
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["n"] == 12 and "test_acc" in rec
