@@ -69,6 +69,8 @@ run --config large --ff-impl pallas --attention-impl pallas
 run --config large --ff-impl pallas --attention-impl pallas --fused-ff-bwd
 run --config large --ff-impl pallas --attention-impl pallas --no-remat
 run --config large --ff-impl pallas --attention-impl pallas --scan-unroll 2
+run --config large --ff-impl pallas --attention-impl auto   # auto => pallas at n=576
+run --attention-impl auto                                   # auto => dense at n=256
 
 # real-data input path (VERDICT r2 item 6): generated shapes dataset through
 # ImageFolderStream; native C++ decode vs the python thread pool vs synthetic.
