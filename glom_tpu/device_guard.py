@@ -26,9 +26,10 @@ from typing import Callable, Optional
 RELAY_ADDR = ("127.0.0.1", 8083)
 
 
-def _relay_up(addr=RELAY_ADDR) -> bool:
+def _relay_up() -> bool:
+    # RELAY_ADDR resolved at call time (not def time) so tests can repoint it
     try:
-        with socket.create_connection(addr, timeout=3):
+        with socket.create_connection(RELAY_ADDR, timeout=3):
             return True
     except OSError:
         return False
@@ -52,7 +53,8 @@ def guard_device_init(
         deadline = time.time() + timeout
         up = _relay_up()
         while not up and time.time() < deadline:
-            time.sleep(5)
+            # never sleep past the deadline (a 1s budget must not pay 5s)
+            time.sleep(min(5.0, max(0.0, deadline - time.time())))
             up = _relay_up()
         if not up:
             emit_error(

@@ -1,0 +1,60 @@
+"""Unit tests for glom_tpu.device_guard (the retry-poll + watchdog that
+keeps bench/breakdown/sweep legs from hanging on a dead accelerator relay).
+The e2e behavior is exercised by running bench.py against the real relay;
+these pin the state machine without any device."""
+
+import socket
+
+import pytest
+
+from glom_tpu import device_guard
+
+
+def _closed_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # nothing listens here anymore
+    return port
+
+
+def test_disabled_guard_returns_none():
+    assert device_guard.guard_device_init(0, lambda m: None) is None
+    assert device_guard.guard_device_init(-5, lambda m: None) is None
+
+
+def test_non_axon_env_arms_cancellable_watchdog(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    fired = []
+    timer = device_guard.guard_device_init(30, fired.append)
+    assert timer is not None
+    timer.cancel()
+    assert fired == []
+
+
+def test_dead_relay_emits_error_and_exits(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setattr(device_guard, "RELAY_ADDR", ("127.0.0.1", _closed_port()))
+    msgs = []
+    with pytest.raises(SystemExit) as e:
+        device_guard.guard_device_init(1, msgs.append)
+    assert e.value.code == 2
+    assert msgs and "unreachable" in msgs[0] and "retry-polled" in msgs[0]
+
+
+def test_live_relay_proceeds_to_watchdog(monkeypatch):
+    # a real listener: the poll succeeds immediately and the guard falls
+    # through to the (cancellable) init watchdog
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    try:
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        monkeypatch.setattr(device_guard, "RELAY_ADDR", srv.getsockname())
+        fired = []
+        timer = device_guard.guard_device_init(30, fired.append)
+        assert timer is not None
+        timer.cancel()
+        assert fired == []
+    finally:
+        srv.close()
