@@ -1,5 +1,5 @@
-"""Render the held-out learning curves (denoising PSNR + linear-probe
-accuracy vs step) from a Trainer JSONL log.
+"""Render held-out learning curves (denoising PSNR + linear-probe accuracy
+vs step) from one or more Trainer JSONL logs.
 
 Companion evidence to the islands figure: the reference ships its SSL
 recipe as documentation with no evaluation at all
@@ -7,8 +7,17 @@ recipe as documentation with no evaluation at all
 logs held-out PSNR and probe accuracy, and this script turns the JSONL
 into the committed figure.
 
+Single run:
+
   python examples/plot_curves.py --log docs/runs/shapes64_cpu.jsonl \
       --out docs/curves_shapes64.png --chance 0.125
+
+A/B comparison (repeat --log, optional LABEL= prefix):
+
+  python examples/plot_curves.py \
+      --log base=docs/runs/plateau_base.jsonl \
+      --log mse=docs/runs/plateau_cons_mse.jsonl \
+      --out docs/curves_plateau.png --chance 0.125
 """
 
 from __future__ import annotations
@@ -20,56 +29,86 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# palette: categorical slots 1-2 of the validated reference palette
-# (dataviz skill); text/grid wear text tokens, never series color
+# palette: categorical slots of the validated reference palette (dataviz
+# skill); text/grid wear text tokens, never series color
 SURFACE = "#fcfcfb"
 TEXT = "#0b0b0b"
 TEXT_2 = "#52514e"
-BLUE = "#2a78d6"
-ORANGE = "#eb6834"
+SERIES = ["#2a78d6", "#eb6834", "#1a9b88", "#8a5cc9", "#c24d7d", "#8c8a84"]
 
 
-def main():
-    p = argparse.ArgumentParser()
-    p.add_argument("--log", required=True)
-    p.add_argument("--out", default="docs/curves.png")
-    p.add_argument("--chance", type=float, default=None,
-                   help="chance accuracy for the probe panel reference line")
-    args = p.parse_args()
-
+def _parse_log(path):
     steps_p, psnr, steps_a, acc = [], [], [], []
-    with open(args.log) as f:
+    with open(path) as f:
         for line in f:
             rec = json.loads(line)
             if "eval_psnr_db" in rec:
                 steps_p.append(rec["step"]); psnr.append(rec["eval_psnr_db"])
             if "probe_test_acc" in rec:
                 steps_a.append(rec["step"]); acc.append(rec["probe_test_acc"])
-    if not steps_p:
-        raise SystemExit(f"no eval records in {args.log}")
+    return steps_p, psnr, steps_a, acc
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--log", action="append", required=True,
+                   help="JSONL path, optionally LABEL=path; repeatable for "
+                        "an A/B comparison figure")
+    p.add_argument("--out", default="docs/curves.png")
+    p.add_argument("--chance", type=float, default=None,
+                   help="chance accuracy for the probe panel reference line")
+    args = p.parse_args()
+
+    runs = []  # (label, steps_p, psnr, steps_a, acc)
+    for spec in args.log:
+        # split on the FIRST '=': an explicit label can then carry any path,
+        # including hyperparameter-valued filenames like lr=3e-4.jsonl
+        label, sep, path = spec.partition("=")
+        if not sep or os.path.exists(spec):
+            label, path = "", spec
+        if not label:
+            label = os.path.splitext(os.path.basename(path))[0]
+        data = _parse_log(path)
+        if not data[0]:
+            raise SystemExit(f"no eval records in {path}")
+        runs.append((label,) + data)
 
     import matplotlib
 
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
 
-    # one measure per panel (no dual axis); single series per panel, so the
-    # panel title names it and no legend box is needed.  Probe records are
-    # optional (train.py logs PSNR-only when labels are absent/single-class).
-    panels = [(steps_p, psnr, BLUE, "Held-out denoising PSNR (dB)")]
-    if steps_a:
-        panels.append((steps_a, acc, ORANGE, "Held-out linear-probe accuracy"))
-    fig, axes = plt.subplots(1, len(panels), figsize=(4.8 * len(panels), 3.4),
+    # one measure per panel (no dual axis).  Single run: panel title names
+    # the series, direct first/last labels, no legend.  Multiple runs: one
+    # color per run, one legend on the first panel.
+    multi = len(runs) > 1
+    have_acc = any(r[3] for r in runs)
+    n_panels = 1 + int(have_acc)
+    fig, axes = plt.subplots(1, n_panels, figsize=(4.8 * n_panels, 3.4),
                              constrained_layout=True, squeeze=False)
     axes = axes[0]
     fig.patch.set_facecolor(SURFACE)
-    panels = [(ax,) + row for ax, row in zip(axes, panels)]
-    for ax, xs, ys, color, title in panels:
+    titles = ["Held-out denoising PSNR (dB)", "Held-out linear-probe accuracy"]
+    for panel, ax in enumerate(axes):
         ax.set_facecolor(SURFACE)
-        ax.plot(xs, ys, color=color, linewidth=2, marker="o", markersize=5,
-                markerfacecolor=color, markeredgecolor=SURFACE,
-                markeredgewidth=1.2, clip_on=False)
-        ax.set_title(title, fontsize=11, color=TEXT, loc="left")
+        for ri, (label, steps_p, psnr, steps_a, acc) in enumerate(runs):
+            xs, ys = (steps_p, psnr) if panel == 0 else (steps_a, acc)
+            if not xs:
+                continue
+            color = SERIES[ri % len(SERIES)]
+            ax.plot(xs, ys, color=color, linewidth=2, marker="o", markersize=4,
+                    markerfacecolor=color, markeredgecolor=SURFACE,
+                    markeredgewidth=1.0, clip_on=False,
+                    label=label if multi else None)
+            if not multi:
+                ax.annotate(f"{ys[0]:.2f}", (xs[0], ys[0]),
+                            textcoords="offset points", xytext=(2, -12),
+                            fontsize=9, color=TEXT_2)
+                ax.annotate(f"{ys[-1]:.2f}", (xs[-1], ys[-1]),
+                            textcoords="offset points", xytext=(-4, 7),
+                            fontsize=9, color=TEXT, fontweight="bold",
+                            ha="right")
+        ax.set_title(titles[panel], fontsize=11, color=TEXT, loc="left")
         ax.set_xlabel("training step", fontsize=9, color=TEXT_2)
         ax.grid(axis="y", color="#e4e3df", linewidth=0.8)
         ax.tick_params(colors=TEXT_2, labelsize=9)
@@ -77,19 +116,17 @@ def main():
             ax.spines[side].set_visible(False)
         for side in ("left", "bottom"):
             ax.spines[side].set_color("#d0cfc9")
-        # selective direct labels: first and last point only
-        ax.annotate(f"{ys[0]:.2f}", (xs[0], ys[0]), textcoords="offset points",
-                    xytext=(2, -12), fontsize=9, color=TEXT_2)
-        ax.annotate(f"{ys[-1]:.2f}", (xs[-1], ys[-1]),
-                    textcoords="offset points", xytext=(-4, 7), fontsize=9,
-                    color=TEXT, fontweight="bold", ha="right")
-    if args.chance is not None and steps_a:
+    if multi:
+        axes[0].legend(frameon=False, fontsize=9, labelcolor=TEXT_2,
+                       loc="lower right")
+    if args.chance is not None and have_acc:
         ax = axes[-1]
+        top = max(max(r[4]) for r in runs if r[4])
         ax.axhline(args.chance, color=TEXT_2, linewidth=1, linestyle=(0, (4, 3)))
         ax.annotate("chance", (ax.get_xlim()[1], args.chance),
                     textcoords="offset points", xytext=(-2, 4), fontsize=9,
                     color=TEXT_2, ha="right")
-        ax.set_ylim(0.0, max(acc) * 1.15)
+        ax.set_ylim(0.0, top * 1.15)
 
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     fig.savefig(args.out, dpi=120, facecolor=SURFACE)

@@ -26,6 +26,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
@@ -257,20 +258,27 @@ int glom_has_jpeg(void) { return 1; }
 // `max_workers` caps the decode threads (0 = every core — decode is
 // CPU-bound; callers bound it to their configured worker budget so decode
 // never oversubscribes the host against the TPU dispatch thread).
-// Returns 0 on success; on failure, 1 + index of the first failing file,
-// with its message copied into err (NUL-terminated, errlen cap).
+// Returns 0 on success; on failure, 1 + the LOWEST index among failing
+// files, with that file's message copied into err (NUL-terminated, errlen
+// cap).  Every file is decoded even once a failure is seen — failures are
+// exceptional, and skipping would make the reported index depend on thread
+// timing instead of the batch contents.
 int64_t glom_decode_jpeg_batch(const char* const* paths, int64_t bs, int64_t size,
                                int64_t max_workers, float* out, char* err,
                                int64_t errlen) {
   std::atomic<int64_t> bad(-1);
+  std::mutex bad_mu;
   const int64_t img_elems = 3 * size * size;
   parallel_for(bs, [&](int64_t b) {
-    if (bad.load(std::memory_order_relaxed) >= 0) return;
     std::string msg;
     if (!decode_jpeg_one(paths[b], size, out + b * img_elems, msg)) {
-      int64_t expected = -1;
-      if (bad.compare_exchange_strong(expected, b) && err && errlen > 0) {
-        std::snprintf(err, static_cast<size_t>(errlen), "%s", msg.c_str());
+      std::lock_guard<std::mutex> g(bad_mu);
+      int64_t cur = bad.load(std::memory_order_relaxed);
+      if (cur < 0 || b < cur) {
+        bad.store(b, std::memory_order_relaxed);
+        if (err && errlen > 0) {
+          std::snprintf(err, static_cast<size_t>(errlen), "%s", msg.c_str());
+        }
       }
     }
   }, /*cap=*/max_workers);

@@ -44,29 +44,49 @@ def param_pspecs(config: GlomConfig, *, model_axis: str = "model") -> dict:
 
 
 def level_sharded_pspecs(
-    config: GlomConfig, *, axis_size: int, model_axis: str = "model"
+    config: GlomConfig, *, axis_size: int, model_axis: str = "model",
+    extra_axes: "Optional[dict]" = None,
 ) -> dict:
     """EP-style alternative: each device owns whole level-MLPs (shard the
     group axis).  Deterministic routing — levels are always resident
     (SURVEY.md §2.3 'EP-shaped but deterministic').
 
     ``levels`` (bottom_up groups) and ``levels - 1`` (top_down groups) are
-    coprime, so each net is group-sharded only when its own group count
-    divides ``axis_size`` (the mesh's model-axis extent), and replicated
-    otherwise — with a loud warning, since a replicated net wastes the
-    model axis entirely."""
+    **coprime**, so no single mesh axis of size > 1 can evenly group-shard
+    both nets.  Two regimes:
+
+    * single axis (``extra_axes`` empty/None): a net is group-sharded only
+      when its group count divides ``axis_size``, replicated otherwise —
+      with a loud warning, since a replicated net wastes the model axis.
+    * factored expert axes (``extra_axes`` maps additional mesh-axis names
+      to their sizes): each net independently picks the largest candidate
+      axis whose size divides its group count.  A 3x2 factoring covers the
+      coprime pair exactly — e.g. levels=3 on axes {model: 3, model2: 2}
+      shards bottom_up (3 groups) over ``model`` and top_down (2 groups)
+      over ``model2``, so every device holds 1/3 of bottom_up and 1/2 of
+      top_down: both nets expert-sharded, no padding, even shards."""
     import warnings
 
+    candidates = [(model_axis, axis_size)]
+    if extra_axes:
+        candidates += list(extra_axes.items())
+    # largest dividing axis first — maximize the memory saving per net
+    candidates.sort(key=lambda kv: -kv[1])
+    any_capacity = any(size > 1 for _, size in candidates)
+
     def ff(name: str, groups: int) -> dict:
-        shard = axis_size > 1 and groups % axis_size == 0
-        if axis_size > 1 and not shard:
+        g_axis = None
+        for axis, size in candidates:
+            if size > 1 and groups % size == 0:
+                g_axis = axis
+                break
+        if any_capacity and g_axis is None:
             warnings.warn(
                 f"param_sharding='ep': {name} has {groups} groups, not divisible "
-                f"by model-axis size {axis_size} — replicating it (no memory "
-                f"saving on this net)",
+                f"by any expert-axis size ({dict(candidates)}) — replicating it "
+                f"(no memory saving on this net)",
                 stacklevel=3,
             )
-        g_axis = model_axis if shard else None
         return {
             "w1": P(g_axis, None, None),
             "b1": P(g_axis, None),

@@ -165,27 +165,38 @@ class ImageFolderStream:
         self._prefetch = max(1, prefetch)
         self._pending: deque = deque()  # (state_before, batch result getter)
         if native_decode is None or native_decode:
-            from glom_tpu import native
-
-            available = (
+            candidate = (
                 channels == 3
                 and all(f.lower().endswith((".jpg", ".jpeg")) for f in self.files)
-                and native.has_jpeg()
             )
-            if native_decode and not available:
-                raise ValueError(
-                    "native_decode=True but the native jpeg path is unusable "
-                    "(needs channels=3, all-.jpg/.jpeg files, and a "
-                    "libjpeg-linked native core); pass native_decode=None "
-                    "for auto-fallback or False for the python decoders"
-                )
-            native_decode = available
-        self._native_decode = bool(native_decode)
+            if native_decode:
+                from glom_tpu import native
+
+                if not (candidate and native.has_jpeg()):
+                    raise ValueError(
+                        "native_decode=True but the native jpeg path is unusable "
+                        "(needs channels=3, all-.jpg/.jpeg files, and a "
+                        "libjpeg-linked native core); pass native_decode=None "
+                        "for auto-fallback or False for the python decoders"
+                    )
+                native_decode = True
+            else:
+                # auto: defer the has_jpeg() probe to the first batch — its
+                # first call may pay the one-time native build (two g++
+                # attempts, up to 120s each), which must not land in the
+                # constructor of users who never pull a batch
+                native_decode = None if candidate else False
+        # True | False | None = auto-undecided until the first __next__
+        self._native_decode = native_decode
         if self._native_decode:
-            # ONE native batch call in flight at a time: the C++ core
-            # parallelizes internally (capped at `workers` threads), so a
-            # wider slot count would multiply thread usage, not throughput
-            self._native_pool = ThreadPoolExecutor(max_workers=1)
+            self._native_pool = self._make_native_pool()
+
+    @staticmethod
+    def _make_native_pool() -> ThreadPoolExecutor:
+        # ONE native batch call in flight at a time: the C++ core
+        # parallelizes internally (capped at `workers` threads), so a
+        # wider slot count would multiply thread usage, not throughput
+        return ThreadPoolExecutor(max_workers=1)
 
     # -- determinism / resume --------------------------------------------
     def _epoch_perm(self, epoch: int) -> np.ndarray:
@@ -225,6 +236,13 @@ class ImageFolderStream:
         return self
 
     def __next__(self) -> np.ndarray:
+        if self._native_decode is None:
+            # deferred auto-probe (see constructor): resolve once, here
+            from glom_tpu import native
+
+            self._native_decode = native.has_jpeg()
+            if self._native_decode:
+                self._native_pool = self._make_native_pool()
         while len(self._pending) < self._prefetch:
             state, paths = self._advance()
             if self._native_decode:

@@ -94,13 +94,60 @@ class Trainer:
                 f"data-axis size {self.mesh.shape[data_axis]}"
             )
 
+        # attention_impl='auto' is mesh-aware here (VERDICT r3 item 7): a
+        # real seq axis means the trainer was asked for sequence parallelism,
+        # so auto resolves to the ring (ppermute) consensus — the n-column
+        # state is sharded over 'seq' and a dense/pallas consensus would
+        # silently all-gather it.  Ring over Ulysses because ring has no
+        # L % seq constraint (Ulysses shards the level axis as heads).
+        # Without a >1 seq axis, model-level auto applies (dense at n<=256,
+        # pallas above — BASELINE.md round-2 measurement).
+        if config.attention_impl == "auto" and len(train.mesh_axes) > 2:
+            seq_size = self.mesh.shape.get(train.mesh_axes[2], 1)
+            if seq_size > 1:
+                if config.num_patches % seq_size == 0:
+                    import dataclasses
+
+                    config = dataclasses.replace(config, attention_impl="ring")
+                    self.config = config
+                else:
+                    import warnings
+
+                    warnings.warn(
+                        f"attention_impl='auto' cannot resolve to the ring "
+                        f"consensus: num_patches {config.num_patches} not "
+                        f"divisible by seq-axis size {seq_size} — falling "
+                        f"back to the model-level rule, which all-gathers "
+                        f"the seq-sharded state (no sequence parallelism in "
+                        f"the consensus)",
+                        stacklevel=2,
+                    )
+
         if train.param_sharding == "tp":
             glom_specs = param_pspecs(config, model_axis=model_axis)
         elif train.param_sharding == "ep":
             from glom_tpu.parallel.sharding import level_sharded_pspecs
 
+            # Trailing mesh axes past (data, model, seq) are additional
+            # expert-axis factors: levels and levels-1 are coprime, so a
+            # factored model axis (e.g. 3x2) is the only way to expert-shard
+            # BOTH nets evenly (see level_sharded_pspecs).
+            extra = {
+                a: self.mesh.shape[a]
+                for a in train.mesh_axes[3:]
+                if self.mesh.shape[a] > 1  # size-1 axes factor nothing
+            }
+            if extra and config.ff_impl == "pallas":
+                raise ValueError(
+                    "param_sharding='ep' with factored expert axes "
+                    f"({tuple(extra)}) requires ff_impl='dense': the Pallas "
+                    "FF shard_map composition shards over the single model "
+                    "axis only"
+                )
             glom_specs = level_sharded_pspecs(
-                config, model_axis=model_axis, axis_size=self.mesh.shape[model_axis]
+                config, model_axis=model_axis,
+                axis_size=self.mesh.shape[model_axis],
+                extra_axes=extra or None,
             )
         else:  # replicated
             glom_specs = jax.tree_util.tree_map(
@@ -483,15 +530,23 @@ class Trainer:
             for sig, h in prev_handlers.items():
                 _signal.signal(sig, h if h is not None else _signal.SIG_DFL)
 
-    def _should_stop(self) -> bool:
+    def _should_stop(self, poll: bool = True) -> bool:
         """Cross-host agreement on the preemption flag: SIGTERM delivery can
         skew across processes, and per-process checkpoint tiles written at
         different steps would corrupt the resume — so in multi-process runs
-        every step's flag is OR-reduced over hosts (one tiny allgather,
-        negligible next to the step's own collectives) and all processes
-        stop at the same step."""
+        the flag is OR-reduced over hosts and all processes stop at the same
+        step.  The allgather is a host-blocking barrier that would defeat
+        async-dispatch pipelining if issued every step, so multi-process
+        runs only poll it when ``poll`` is True (the caller passes the
+        logging cadence — preemption grace windows are tens of seconds, a
+        few-step delay is safe).  ``poll`` must be computed identically on
+        every host (it gates a collective)."""
         if jax.process_count() == 1:
             return self._stop_requested
+        if not poll:
+            # NOT the local flag: returning it here would let hosts diverge
+            # on the stop step; the decision is deferred to the next poll.
+            return False
         from jax.experimental import multihost_utils
 
         flags = multihost_utils.process_allgather(
@@ -506,6 +561,17 @@ class Trainer:
         start_step = int(jax.device_get(self.state.step))
         profiling = False
         completed = steps
+        stopped = False
+        # multi-process stop-flag poll cadence (see _should_stop): piggyback
+        # on the logging/checkpoint cadence when one is set, but never wait
+        # more than 10 steps — preemption grace windows are tens of seconds
+        # and a large checkpoint_every must not starve the flag.  Absolute
+        # step numbers so the poll lands on the same steps as the logging
+        # barrier after a resume.
+        stop_poll = min(
+            min((x for x in (cfg.log_every, cfg.checkpoint_every) if x), default=10),
+            10,
+        )
         for i in range(start_step, steps):
             if cfg.profile_dir:
                 # trace a 3-step post-warmup window (steps 2,3,4 of this run),
@@ -560,14 +626,19 @@ class Trainer:
                     data_state=batches.state_dict() if stateful_stream else None,
                 )
                 last_saved = i + 1
-            if self._should_stop():
+            if self._should_stop((i + 1) % stop_poll == 0):
                 self.logger.log(i + 1, event=2.0)  # preemption-stop marker
                 completed = i + 1
+                stopped = True
                 break
         jax.block_until_ready(self.state.params)
         if profiling:
             jax.profiler.stop_trace()
-        if (cfg.checkpoint_dir and cfg.checkpoint_every
+        # Final/preemption save: periodic saves need checkpoint_every, but a
+        # preemption save must happen whenever a checkpoint_dir exists at
+        # all — otherwise a checkpoint_every=0 run that catches SIGTERM
+        # would exit cleanly WITHOUT the state the stop marker promises.
+        if (cfg.checkpoint_dir and (cfg.checkpoint_every or stopped)
                 and last_saved != completed and start_step < completed):
             self.save(
                 cfg.checkpoint_dir,

@@ -1,0 +1,55 @@
+"""Worker for the 4-process pipeline-parallel multihost test.
+
+Each OS process owns ONE faked CPU device; jax.distributed joins them into
+a 4-device cluster, and the GPipe pipeline
+(glom_tpu.parallel.pipeline.make_pipelined_apply) runs with one STAGE per
+process — the inter-stage ppermute crosses the OS-process boundary every
+chunk, which is the "PP over DCN" leg the virtual-mesh dryrun cannot cover.
+
+Invoked by tests/test_multihost.py — not a test module itself.
+"""
+
+import os
+import sys
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+port = sys.argv[3]
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from glom_tpu.parallel.mesh import initialize_distributed
+
+initialize_distributed(f"localhost:{port}", nproc, pid)
+
+import numpy as np
+from jax.sharding import Mesh
+
+from glom_tpu.config import GlomConfig
+from glom_tpu.models import glom as glom_model
+from glom_tpu.parallel.pipeline import make_pipelined_apply
+
+assert len(jax.devices()) == nproc, jax.devices()
+
+cfg = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4)
+mesh = Mesh(np.array(jax.devices()), ("pipe",))
+pp = make_pipelined_apply(mesh, cfg, num_microbatches=nproc)
+
+params = glom_model.init(jax.random.PRNGKey(0), cfg)
+img = np.random.default_rng(1).standard_normal((nproc, 3, 16, 16)).astype(np.float32)
+
+# one jit computing pipelined vs sequential and the scalar error: a scalar
+# output is replicated, so every process can fetch it without a gather
+err_fn = jax.jit(
+    lambda p, x: jax.numpy.abs(
+        pp(p, x, iters=nproc) - glom_model.apply(p, x, config=cfg, iters=nproc)
+    ).max()
+)
+err = float(jax.device_get(err_fn(params, img)))
+assert err < 1e-4, f"cross-process pipelined forward diverges: {err}"
+print(f"PPOK {pid} {err:.2e}", flush=True)

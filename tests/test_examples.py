@@ -79,6 +79,11 @@ def test_plot_curves_smoke(tiny_ckpt_and_data):
     out = os.path.join(str(root), "curves.png")
     _run("plot_curves.py", ["--log", log, "--out", out, "--chance", "0.33"])
     assert os.path.getsize(out) > 1000
+    # multi-run comparison form (repeat --log with LABEL= prefixes)
+    out2 = os.path.join(str(root), "curves_ab.png")
+    _run("plot_curves.py", ["--log", f"a={log}", "--log", f"b={log}",
+                            "--out", out2, "--chance", "0.33"])
+    assert os.path.getsize(out2) > 1000
 
 
 def test_extract_then_probe_smoke(tiny_ckpt_and_data, capsys):

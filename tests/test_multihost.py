@@ -97,3 +97,36 @@ def test_two_process_training_agrees_and_checkpoints(tmp_path):
 
     local_digest = digest_of(jax.device_get(trainer.state.params))
     np.testing.assert_allclose(local_digest, digests["0"], rtol=1e-7)
+
+
+@pytest.mark.slow
+def test_four_process_pipeline_stages_cross_hosts():
+    """PP stages across the OS-process boundary (VERDICT r3 stretch #8):
+    4 processes x 1 device each form a ('pipe',) mesh; the GPipe schedule's
+    inter-stage ppermute crosses hosts every chunk.  The pipelined forward
+    must match the sequential scan on every process."""
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "mh_pp_worker.py")
+    env = {k: v for k, v in os.environ.items() if not k.startswith(("XLA_", "JAX_"))}
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + env.get("PYTHONPATH", "").split(os.pathsep)
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(pid), "4", str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for pid in range(4)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+            assert p.returncode == 0, out[-2000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert all("PPOK" in out for out in outs), outs

@@ -117,3 +117,17 @@ def test_image_stream_forced_native_unusable_raises(lib, jpeg_dataset):
     with pytest.raises(ValueError, match="native jpeg path is unusable"):
         ImageFolderStream(jpeg_dataset, batch_size=2, image_size=48, channels=1,
                           process_index=0, process_count=1, native_decode=True)
+
+
+def test_native_jpeg_decode_reports_lowest_failing_index(lib, jpeg_dataset):
+    """With multiple bad files in a batch, the error deterministically names
+    the LOWEST-index one (not whichever thread failed first temporally)."""
+    if not native.has_jpeg():
+        pytest.skip("native core built without libjpeg")
+    import glob
+    good = sorted(glob.glob(str(jpeg_dataset) + "/**/*.jpg", recursive=True))[:2]
+    assert good, "jpeg_dataset fixture yielded no files"
+    batch = [good[0], "/tmp/missing_aa.jpg", good[-1], "/tmp/missing_zz.jpg"]
+    for _ in range(5):  # thread timing must not change the report
+        with pytest.raises(ValueError, match="missing_aa"):
+            native.decode_jpeg_batch(batch, 32, workers=4)

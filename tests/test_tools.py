@@ -48,3 +48,58 @@ def test_mfu_analytic_numbers(capsys):
     assert "MFU (model FLOPs)" in out
     pct = float(out.split("MFU (model FLOPs)")[1].split("%")[0].split(":")[1])
     assert 35.0 < pct < 42.0
+
+
+class TestSweepLogBestRate:
+    """tools/sweep_log.py — session-scoped extraction for hw_sweep.sh
+    (VERDICT r3 weak #6: the QUICK-mode grep scanned the whole accumulated
+    log, so a stale session's rate could feed tools/mfu.py)."""
+
+    FLAGSHIP = '{"metric": "denoise_ssl_train_imgs_per_sec_per_chip", "value": %s, "unit": "imgs/sec/chip", "vs_baseline": 1.0}'
+
+    def _lines(self):
+        return [
+            "=== MARKER sweep-session 111-1",
+            self.FLAGSHIP % "282.4",            # previous session (stale)
+            '{"metric": "denoise_ssl_train_imgs_per_sec_per_chip_large", "value": 999.0}',
+            "=== MARKER sweep-session 222-2",
+            self.FLAGSHIP % "150.0",
+            self.FLAGSHIP % "163.3",
+            '{"metric": "denoise_ssl_train_imgs_per_sec_per_chip_tiny", "value": 500.0}',
+            '{"metric": "denoise_ssl_train_imgs_per_sec_per_chip_realdata", "value": 400.0}',
+            self.FLAGSHIP % "0.0",              # watchdog error row
+            "!! rc=2 garbage not json {",
+        ]
+
+    def test_scopes_to_last_marker(self):
+        from tools.sweep_log import best_rate
+        assert best_rate(self._lines(), "sweep-session 222-2") == 163.3
+
+    def test_stale_session_rate_excluded(self):
+        from tools.sweep_log import best_rate
+        # the 282.4 row belongs to the earlier session and must not win
+        assert best_rate(self._lines(), "sweep-session 222-2") < 282.4
+
+    def test_variant_metrics_excluded(self):
+        from tools.sweep_log import best_rate
+        # large/tiny/realdata rows carry different FLOP numerators
+        assert best_rate(self._lines(), None) == 282.4
+
+    def test_missing_marker_returns_none(self):
+        from tools.sweep_log import best_rate
+        assert best_rate(self._lines(), "sweep-session 333-3") is None
+
+    def test_zero_and_garbage_rows_ignored(self):
+        from tools.sweep_log import best_rate
+        assert best_rate(["{bad json", self.FLAGSHIP % "0.0"], None) is None
+
+    def test_cli_round_trip(self, tmp_path, capsys):
+        path = tmp_path / "hw_sweep.log"
+        path.write_text("\n".join(self._lines()) + "\n")
+        with pytest.raises(SystemExit) as exc:
+            _run_tool(
+                os.path.join(TOOLS, "sweep_log.py"),
+                ["--log", str(path), "--session", "sweep-session 222-2"], capsys,
+            )
+        assert exc.value.code == 0
+        assert float(capsys.readouterr().out.strip()) == 163.3

@@ -831,3 +831,82 @@ def test_sigterm_checkpoints_and_exits_cleanly(tmp_path):
     # checkpoint-every (90000) is unreachable in this window, so the ONLY
     # possible save is the preemption one — at exactly the stop step
     assert ckpt_lib.latest_step(str(tmp_path)) == stop_step
+
+
+def test_attention_auto_resolves_to_ring_under_seq_mesh():
+    """Mesh-aware 'auto' (VERDICT r3 #7): a trainer whose mesh has a real
+    seq axis resolves attention_impl='auto' to the ring consensus (the state
+    is seq-sharded — dense would silently all-gather it), and the resolved
+    trainer still trains."""
+    c = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4,
+                   attention_impl="auto")
+    t = TrainConfig(batch_size=8, iters=2, steps=2, log_every=1,
+                    mesh_shape=(2, 1, 4))
+    trainer = Trainer(c, t)
+    assert trainer.config.attention_impl == "ring"
+    assert trainer._consensus_fn is not None
+    metrics = trainer.fit(synthetic_batches(8, 16), steps=2)
+    assert np.isfinite(metrics["loss"])
+
+
+def test_attention_auto_stays_modellevel_without_seq_axis():
+    """With seq axis 1 the trainer leaves 'auto' to the model-level rule
+    (dense at n<=256 / non-TPU), so no mesh-bound consensus_fn is built."""
+    c = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4,
+                   attention_impl="auto")
+    t = TrainConfig(batch_size=8, iters=2, steps=2, log_every=1,
+                    mesh_shape=(8, 1, 1))
+    trainer = Trainer(c, t)
+    assert trainer.config.attention_impl == "auto"
+    assert trainer._consensus_fn is None
+    metrics = trainer.fit(synthetic_batches(8, 16), steps=2)
+    assert np.isfinite(metrics["loss"])
+
+
+def test_attention_auto_seq_mesh_matches_dense():
+    """The auto->ring resolution is numerically invisible: same seed, same
+    batch, ring-resolved seq-mesh step == dense single-axis step."""
+    c_auto = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4,
+                        attention_impl="auto")
+    c_dense = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4,
+                         attention_impl="dense")
+    t_seq = TrainConfig(batch_size=8, iters=2, steps=1, log_every=0,
+                        donate=False, mesh_shape=(2, 1, 4))
+    t_dp = TrainConfig(batch_size=8, iters=2, steps=1, log_every=0,
+                       donate=False, mesh_shape=(8, 1, 1))
+    tr_auto, tr_dense = Trainer(c_auto, t_seq), Trainer(c_dense, t_dp)
+    img = np.random.default_rng(7).standard_normal((8, 3, 16, 16)).astype(np.float32)
+    _, m_auto = tr_auto._step(tr_auto.state, jax.device_put(img, tr_auto._batch_sh))
+    _, m_dense = tr_dense._step(tr_dense.state, jax.device_put(img, tr_dense._batch_sh))
+    np.testing.assert_allclose(float(m_auto["loss"]), float(m_dense["loss"]),
+                               rtol=1e-5)
+
+
+def test_preemption_save_without_checkpoint_every(tmp_path):
+    """ADVICE r3: checkpoint_dir set but checkpoint_every=0 must still write
+    the preemption checkpoint when a stop is requested — the stop marker's
+    'resumes from its own final state' promise does not depend on periodic
+    saves being enabled."""
+    import glom_tpu.checkpoint as ckpt_lib
+
+    c = TINY
+    t = TrainConfig(batch_size=8, iters=2, steps=10, log_every=0,
+                    checkpoint_dir=str(tmp_path), checkpoint_every=0)
+    trainer = Trainer(c, t)
+
+    stream = synthetic_batches(8, 16)
+
+    class StopAfterOne:
+        def __init__(self):
+            self.n = 0
+        def __iter__(self):
+            return self
+        def __next__(self):
+            self.n += 1
+            if self.n == 2:
+                trainer._stop_requested = True
+            return next(stream)
+
+    trainer.fit(StopAfterOne(), steps=10)
+    step = ckpt_lib.latest_step(str(tmp_path))
+    assert step is not None and step >= 1

@@ -12,6 +12,16 @@ cd "$(dirname "$0")/.."
 LOG=tools/hw_sweep.log
 QUICK=${QUICK:-0}
 
+# Unique per-invocation marker: best-rate extraction for tools/mfu.py is
+# scoped to lines after this marker so a stale rate from a previous session
+# (different code/defaults) can never feed the current window's MFU claim.
+SESSION="sweep-session $(date -u +%s)-$$"
+echo "=== MARKER $SESSION" | tee -a "$LOG"
+
+best_rate() {
+  python tools/sweep_log.py --log "$LOG" --session "$SESSION"
+}
+
 run() {
   echo "=== $(date -u +%FT%TZ) bench $*" | tee -a "$LOG"
   out=$(timeout 500 python bench.py "$@" 2>/tmp/hw_sweep_err.txt)
@@ -46,7 +56,7 @@ if [ "$QUICK" = "1" ]; then
   run --batch-size 64 --ff-impl pallas --fused-ff-bwd
   run --scan-unroll 7 --ff-impl pallas
   run --ff-impl pallas --profile-dir /tmp/glom_trace
-  best=$(grep '"metric": "denoise_ssl_train_imgs_per_sec_per_chip"' "$LOG" | grep -o '"value": [0-9.]*' | awk '{print $2}' | sort -g | tail -1)
+  best=$(best_rate)
   [ -n "${best:-}" ] && python tools/mfu.py --imgs-per-sec "$best" 2>&1 | tee -a "$LOG"
   echo "=== $(date -u +%FT%TZ) QUICK sweep done" | tee -a "$LOG"
   exit 0
@@ -121,11 +131,12 @@ if [ $vrc -ne 0 ]; then
   { echo "!! video bench rc=$vrc"; tail -15 /tmp/hw_sweep_err.txt; } | tee -a "$LOG"
 fi
 
-# MFU at the sweep's best rate.  The max over the log is always a flagship
-# row (large-config rows run ~20x slower), so the flagship FLOP numerator in
-# tools/mfu.py matches; if a non-default batch size wins, rerun mfu.py by
-# hand with --batch-size to align the compiled-FLOPs count.
-best=$(grep '"metric": "denoise_ssl_train_imgs_per_sec_per_chip"' "$LOG" | grep -o '"value": [0-9.]*' | awk '{print $2}' | sort -g | tail -1)
+# MFU at this session's best flagship rate (tools/sweep_log.py scopes the
+# extraction to lines after this invocation's marker and to the exact
+# flagship metric — _large/_tiny/_realdata variants have different FLOP
+# numerators).  If a non-default batch size wins, rerun mfu.py by hand with
+# --batch-size to align the compiled-FLOPs count.
+best=$(best_rate)
 if [ -n "${best:-}" ]; then
   echo "=== $(date -u +%FT%TZ) mfu at best rate $best" | tee -a "$LOG"
   python tools/mfu.py --imgs-per-sec "$best" 2>&1 | tee -a "$LOG"

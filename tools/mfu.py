@@ -57,9 +57,10 @@ def main():
     p.add_argument("--chip", default="v5e", choices=sorted(PEAK_TFLOPS))
     p.add_argument("--config", default="flagship", choices=["flagship", "large"])
     p.add_argument("--batch-size", type=int, default=32)
-    p.add_argument("--loss-timestep", type=int, default=0,
-                   help="executed iterations (0 = TrainConfig default, "
-                        "iters//2+1)")
+    p.add_argument("--loss-timestep", type=int, default=None,
+                   help="executed iterations (unset = TrainConfig default, "
+                        "iters//2+1; 0 is a valid explicit choice — the "
+                        "t=0 state)")
     p.add_argument("--skip-compiled", action="store_true",
                    help="analytic numerator only (no jit / cost model)")
     args = p.parse_args()
@@ -87,7 +88,7 @@ def main():
     from glom_tpu.training.denoise import resolve_loss_timestep
 
     executed = resolve_loss_timestep(
-        TrainConfig(loss_timestep=args.loss_timestep or None, iters=iters), iters
+        TrainConfig(loss_timestep=args.loss_timestep, iters=iters), iters
     )
     fwd = model_flops_per_image(config, executed)
     train_flops = 3.0 * fwd

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Step-100 plateau diagnosis on the shapes64 SSL recipe (VERDICT r3 item 4).
+#
+# Round-3 evidence (docs/runs/shapes64_cpu.jsonl): held-out PSNR and probe
+# accuracy freeze after ~step 100.  Never diagnosed: the consistency losses
+# were not used, noise-std/lr were never swept, and the probe ran on 256
+# examples (probe_train_acc 1.0 -> interpolation regime, noisy test acc).
+#
+# This sweep fixes the protocol first (6000-image dataset, 2000 probe
+# examples split 50/50 so ridge can't interpolate), then A/Bs one lever per
+# leg against the same baseline, sequentially (single host core).  CPU-only
+# by construction (--platform cpu) — never touches the accelerator tunnel.
+set -u
+cd "$(dirname "$0")/.."
+OUT=docs/runs
+mkdir -p "$OUT"
+DATA=/tmp/shapes64b
+STEPS=${STEPS:-600}
+LOG=tools/plateau_sweep.log
+
+python examples/make_shapes_dataset.py --root "$DATA" --per-class 750 \
+  --image-size 64 2>&1 | tail -1 | tee -a "$LOG"
+
+leg() {
+  name=$1; shift
+  echo "=== $(date -u +%FT%TZ) leg $name: $*" | tee -a "$LOG"
+  timeout 3000 python -m glom_tpu.training.train \
+    --platform cpu --data images --data-dir "$DATA" \
+    --dim 128 --levels 4 --image-size 64 --patch-size 8 --iters 8 \
+    --batch-size 16 --steps "$STEPS" --log-every 50 \
+    --eval-every 200 --eval-holdout 0.35 \
+    --eval-max-images 2048 --probe-examples 2000 \
+    --log-file "$OUT/plateau_${name}.jsonl" "$@" 2>&1 | tail -2 | tee -a "$LOG"
+}
+
+leg base      --lr 3e-4
+leg cons_mse  --lr 3e-4 --consistency mse --consistency-weight 0.1
+leg cons_nce  --lr 3e-4 --consistency infonce --consistency-weight 0.1
+leg noise05   --lr 3e-4 --noise-std 0.5
+leg lr1e3     --lr 1e-3
+echo "=== $(date -u +%FT%TZ) plateau sweep done" | tee -a "$LOG"

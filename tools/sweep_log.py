@@ -1,0 +1,78 @@
+"""Session-scoped best-rate extraction from tools/hw_sweep.log.
+
+tools/hw_sweep.log accumulates across measurement windows; feeding
+``tools/mfu.py`` the max over the whole file can resurrect a rate from a
+previous session (different code, different defaults) and misreport the
+current window's MFU.  hw_sweep.sh therefore writes a unique session marker
+line at sweep start and extracts the best flagship rate only from lines
+after the LAST occurrence of that marker.
+
+Only the exact flagship metric counts: config variants are suffixed
+(``..._large`` / ``..._tiny`` / ``..._realdata`` — bench.py) and their FLOP
+numerators do not match tools/mfu.py's flagship accounting.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Iterable, Optional
+
+FLAGSHIP_METRIC = "denoise_ssl_train_imgs_per_sec_per_chip"
+
+
+def best_rate(lines: Iterable[str], session: Optional[str] = None) -> Optional[float]:
+    """Max flagship imgs/sec/chip from bench JSON lines, scoped to the part
+    of the log after the last ``session`` marker (whole input if None or the
+    marker never appears — a missing marker must not silently widen scope,
+    so callers pass session only when they wrote one)."""
+    lines = list(lines)
+    if session is not None:
+        for i in range(len(lines) - 1, -1, -1):
+            if session in lines[i]:
+                lines = lines[i + 1:]
+                break
+        else:
+            return None
+    best = None
+    for line in lines:
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if row.get("metric") != FLAGSHIP_METRIC:
+            continue
+        try:
+            value = float(row["value"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        if value > 0 and (best is None or value > best):
+            best = value
+    return best
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--log", required=True, help="path to hw_sweep.log")
+    p.add_argument("--session", default=None,
+                   help="session marker string; scope extraction to lines "
+                        "after its last occurrence")
+    args = p.parse_args(argv)
+    try:
+        with open(args.log) as f:
+            rate = best_rate(f, args.session)
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if rate is None:
+        return 1
+    print(rate)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
