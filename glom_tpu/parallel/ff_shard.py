@@ -15,10 +15,11 @@ Per ``TrainConfig.param_sharding`` (specs from ``glom_tpu.parallel.sharding``):
     device computes its partial second matmul with b2 = 0 inside the kernel;
     a single ``psum`` over the model axis completes the row-parallel matmul
     and b2 is added once, outside the shard_map (exact — no b2/S rounding).
-  * **ep** — whole level-MLPs are sharded over the model axis together with
-    the activations' group axis; no collective at all.  A net whose group
-    count does not divide the axis (top_down with L-1 groups, say) is
-    replicated, mirroring ``level_sharded_pspecs``.
+  * **ep** — whole level-MLPs are sharded over an expert axis together with
+    the activations' group axis; no collective at all.  With factored
+    expert axes (``extra_expert_axes``), each net dispatches to the axis
+    dividing its own group count via the shared ``pick_expert_axis`` rule —
+    a net no axis fits is replicated, mirroring ``level_sharded_pspecs``.
 
 The reference has no analogue (no parallelism code at all — SURVEY.md §2.3);
 this is the TPU-native composition of its ``GroupedFeedForward``
@@ -46,6 +47,7 @@ def make_sharded_ff_pallas(
     seq_axis: Optional[str] = None,
     interpret: Optional[bool] = None,
     fused_bwd: bool = False,
+    extra_expert_axes: tuple = (),
 ):
     """Returns ``ff_fn(params, x)`` — drop-in for
     :func:`glom_tpu.ops.feedforward.grouped_ff_apply` that runs the Pallas
@@ -98,19 +100,33 @@ def make_sharded_ff_pallas(
         return ff_fn
 
     if param_sharding == "ep":
-        ep_pspec = {"w1": P(model_axis, None, None), "b1": P(model_axis, None),
-                    "w2": P(model_axis, None, None), "b2": P(model_axis, None)}
-        run_ep = jax.shard_map(
-            kernel, mesh=mesh, in_specs=(ep_pspec, x_spec(model_axis)),
-            out_specs=x_spec(model_axis), check_vma=False,
-        )
+        from glom_tpu.parallel.sharding import pick_expert_axis
+
+        # one shard_map per candidate expert axis (factored EP: each net
+        # dispatches to the axis dividing its own group count — the same
+        # pick_expert_axis rule that placed the params, so the shard_map
+        # specs always agree with the jit-level NamedShardings)
+        candidates = [(model_axis, model_size)] + [
+            (a, mesh.shape[a]) for a in extra_expert_axes
+        ]
+
+        def ep_run(axis):
+            ep_pspec = {"w1": P(axis, None, None), "b1": P(axis, None),
+                        "w2": P(axis, None, None), "b2": P(axis, None)}
+            return jax.shard_map(
+                kernel, mesh=mesh, in_specs=(ep_pspec, x_spec(axis)),
+                out_specs=x_spec(axis), check_vma=False,
+            )
+
+        runs = {axis: ep_run(axis) for axis, size in candidates if size > 1}
 
         def ff_fn(params, x):
-            groups = params["w1"].shape[0]
-            if model_size > 1 and groups % model_size == 0:
-                return run_ep(params, x)
-            # group count not divisible (e.g. top_down's L-1): params are
-            # replicated by level_sharded_pspecs — run the DP form
+            # static dispatch: group count is a trace-time shape
+            axis = pick_expert_axis(params["w1"].shape[0], candidates)
+            if axis is not None:
+                return runs[axis](params, x)
+            # no axis divides this net's group count: params are replicated
+            # by level_sharded_pspecs — run the DP form
             return run_replicated(params, x)
 
         return ff_fn

@@ -70,16 +70,10 @@ def level_sharded_pspecs(
     candidates = [(model_axis, axis_size)]
     if extra_axes:
         candidates += list(extra_axes.items())
-    # largest dividing axis first — maximize the memory saving per net
-    candidates.sort(key=lambda kv: -kv[1])
     any_capacity = any(size > 1 for _, size in candidates)
 
     def ff(name: str, groups: int) -> dict:
-        g_axis = None
-        for axis, size in candidates:
-            if size > 1 and groups % size == 0:
-                g_axis = axis
-                break
+        g_axis = pick_expert_axis(groups, candidates)
         if any_capacity and g_axis is None:
             warnings.warn(
                 f"param_sharding='ep': {name} has {groups} groups, not divisible "
@@ -101,6 +95,18 @@ def level_sharded_pspecs(
         "bottom_up": ff("bottom_up", config.levels),
         "top_down": ff("top_down", config.levels - 1),
     }
+
+
+def pick_expert_axis(groups: int, candidates) -> "Optional[str]":
+    """The ONE expert-axis selection rule, shared by ``level_sharded_pspecs``
+    (param placement) and ``parallel.ff_shard`` (the Pallas shard_map specs)
+    so the two can never disagree: largest candidate axis whose size divides
+    ``groups``; stable order breaks ties; None when nothing fits.
+    ``candidates`` is an ordered ``[(axis_name, size), ...]``."""
+    for axis, size in sorted(candidates, key=lambda kv: -kv[1]):
+        if size > 1 and groups % size == 0:
+            return axis
+    return None
 
 
 def batch_pspec(data_axis: str = "data") -> P:

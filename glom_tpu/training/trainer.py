@@ -123,31 +123,24 @@ class Trainer:
                         stacklevel=2,
                     )
 
+        # Trailing mesh axes past (data, model, seq) are additional expert-
+        # axis factors under 'ep': levels and levels-1 are coprime, so a
+        # factored model axis (e.g. 3x2) is the only way to expert-shard
+        # BOTH nets evenly (see level_sharded_pspecs).  Computed ONCE here —
+        # the param specs and the Pallas shard_map must see the same tuple.
+        expert_axes = tuple(
+            a for a in train.mesh_axes[3:] if self.mesh.shape[a] > 1
+        )
+
         if train.param_sharding == "tp":
             glom_specs = param_pspecs(config, model_axis=model_axis)
         elif train.param_sharding == "ep":
             from glom_tpu.parallel.sharding import level_sharded_pspecs
 
-            # Trailing mesh axes past (data, model, seq) are additional
-            # expert-axis factors: levels and levels-1 are coprime, so a
-            # factored model axis (e.g. 3x2) is the only way to expert-shard
-            # BOTH nets evenly (see level_sharded_pspecs).
-            extra = {
-                a: self.mesh.shape[a]
-                for a in train.mesh_axes[3:]
-                if self.mesh.shape[a] > 1  # size-1 axes factor nothing
-            }
-            if extra and config.ff_impl == "pallas":
-                raise ValueError(
-                    "param_sharding='ep' with factored expert axes "
-                    f"({tuple(extra)}) requires ff_impl='dense': the Pallas "
-                    "FF shard_map composition shards over the single model "
-                    "axis only"
-                )
             glom_specs = level_sharded_pspecs(
                 config, model_axis=model_axis,
                 axis_size=self.mesh.shape[model_axis],
-                extra_axes=extra or None,
+                extra_axes={a: self.mesh.shape[a] for a in expert_axes} or None,
             )
         else:  # replicated
             glom_specs = jax.tree_util.tree_map(
@@ -178,6 +171,7 @@ class Trainer:
                 model_axis=model_axis,
                 seq_axis=train.mesh_axes[2] if len(train.mesh_axes) > 2 else None,
                 fused_bwd=config.ff_fused_bwd,
+                extra_expert_axes=expert_axes,
             )
         self._ff_fn = ff_fn
 

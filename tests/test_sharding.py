@@ -85,19 +85,42 @@ class TestLevelShardedPspecs:
             specs = level_sharded_pspecs(self._cfg(levels=3), axis_size=1)
         assert specs["bottom_up"]["w1"][0] is None and not caught
 
-    def test_trainer_rejects_factored_ep_with_pallas_ff(self):
+    def test_pick_expert_axis_rule(self):
+        from glom_tpu.parallel.sharding import pick_expert_axis
+        cands = [("model", 3), ("model2", 2)]
+        assert pick_expert_axis(3, cands) == "model"
+        assert pick_expert_axis(2, cands) == "model2"
+        assert pick_expert_axis(6, cands) == "model"   # largest divisor wins
+        assert pick_expert_axis(5, cands) is None
+        assert pick_expert_axis(4, [("m", 1)]) is None  # size-1 never picked
+
+    def test_factored_ep_composes_with_pallas_ff(self):
+        """Factored EP under ff_impl='pallas': each net's kernel runs in a
+        shard_map over ITS OWN expert axis (bottom_up over the 3-way axis,
+        top_down over the 2-way one) and the train step matches the dense
+        replicated step numerically."""
         import numpy as np
         import jax
-        import pytest
         from jax.sharding import Mesh
         from glom_tpu.config import GlomConfig, TrainConfig
         from glom_tpu.training.trainer import Trainer
-        cfg = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4,
-                         ff_impl="pallas")
-        mesh = Mesh(np.array(jax.devices()[:6]).reshape(1, 3, 1, 2),
-                    ("data", "model", "seq", "model2"))
-        train = TrainConfig(batch_size=2, iters=2, steps=1, log_every=0,
-                            mesh_axes=("data", "model", "seq", "model2"),
-                            param_sharding="ep")
-        with pytest.raises(ValueError, match="factored expert axes"):
-            Trainer(cfg, train, mesh=mesh)
+        axes = ("data", "model", "seq", "model2")
+        mesh = Mesh(np.array(jax.devices()[:6]).reshape(1, 3, 1, 2), axes)
+        c_pallas = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4,
+                              ff_impl="pallas")
+        c_dense = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4)
+        t_ep = TrainConfig(batch_size=2, iters=2, steps=1, log_every=0,
+                           donate=False, mesh_axes=axes, param_sharding="ep")
+        t_rep = TrainConfig(batch_size=2, iters=2, steps=1, log_every=0,
+                            donate=False, mesh_axes=axes,
+                            param_sharding="replicated")
+        tr_ep = Trainer(c_pallas, t_ep, mesh=mesh)
+        tr_rep = Trainer(c_dense, t_rep, mesh=mesh)
+        glom_p = tr_ep.state.params["glom"]
+        assert glom_p["bottom_up"]["w1"].sharding.spec[0] == "model"
+        assert glom_p["top_down"]["w1"].sharding.spec[0] == "model2"
+        img = np.random.default_rng(3).standard_normal((2, 3, 16, 16)).astype(np.float32)
+        _, m_ep = tr_ep._step(tr_ep.state, jax.device_put(img, tr_ep._batch_sh))
+        _, m_rep = tr_rep._step(tr_rep.state, jax.device_put(img, tr_rep._batch_sh))
+        np.testing.assert_allclose(float(m_ep["loss"]), float(m_rep["loss"]),
+                                   rtol=1e-5)

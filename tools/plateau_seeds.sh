@@ -1,0 +1,30 @@
+#!/bin/bash
+# Stage 2 of the plateau diagnosis (VERDICT r3 item 4): re-run the winning
+# recipe from tools/plateau_sweep.sh under 3 seeds so the post-step-300
+# improvement claim carries seed error bars, not one trajectory.
+#
+# Usage:  WINNER_FLAGS="--lr 3e-4 --consistency mse" bash tools/plateau_seeds.sh
+set -u
+cd "$(dirname "$0")/.."
+OUT=docs/runs
+DATA=/tmp/shapes64b
+STEPS=${STEPS:-600}
+LOG=tools/plateau_sweep.log
+WINNER_FLAGS=${WINNER_FLAGS:?set WINNER_FLAGS to the winning leg's flags}
+
+for seed in 0 1 2; do
+  echo "=== $(date -u +%FT%TZ) winner seed $seed: $WINNER_FLAGS" | tee -a "$LOG"
+  # fresh log per invocation: MetricLogger appends, and a rerun must not
+  # blend a stale session's records into the seed-variance evidence
+  rm -f "$OUT/plateau_winner_s${seed}.jsonl"
+  timeout 4000 python -m glom_tpu.training.train \
+    --platform cpu --data images --data-dir "$DATA" \
+    --dim 128 --levels 4 --image-size 64 --patch-size 8 --iters 8 \
+    --batch-size 16 --steps "$STEPS" --log-every 50 \
+    --eval-every 200 --eval-holdout 0.35 \
+    --eval-max-images 2048 --probe-examples 2000 \
+    --seed "$seed" \
+    --log-file "$OUT/plateau_winner_s${seed}.jsonl" \
+    $WINNER_FLAGS 2>&1 | tail -2 | tee -a "$LOG"
+done
+echo "=== $(date -u +%FT%TZ) seeds done" | tee -a "$LOG"
