@@ -103,3 +103,23 @@ class TestSweepLogBestRate:
             )
         assert exc.value.code == 0
         assert float(capsys.readouterr().out.strip()) == 163.3
+
+
+def test_plateau_report_table(tmp_path, capsys):
+    """tools/plateau_report.py: one row per leg, post-200 deltas computed
+    from the first and last eval >= step 200."""
+    import json as _json
+    p = tmp_path / "plateau_demo.jsonl"
+    with open(p, "w") as f:
+        for s, psnr, acc in [(200, 17.0, 0.20), (400, 17.5, 0.30),
+                             (600, 18.0, 0.40)]:
+            f.write(_json.dumps({"step": s, "eval_psnr_db": psnr,
+                                 "probe_test_acc": acc}) + "\n")
+        f.write(_json.dumps({"step": 600, "loss": 0.1}) + "\n")  # non-eval row
+    with pytest.raises(SystemExit) as exc:
+        _run_tool(os.path.join(TOOLS, "plateau_report.py"), [str(p)], capsys)
+    assert exc.value.code == 0
+    out = capsys.readouterr().out
+    assert "| demo |" in out
+    assert "+1.00" in out      # PSNR 17.0 -> 18.0 post-200
+    assert "+0.200" in out     # acc 0.20 -> 0.40

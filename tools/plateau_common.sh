@@ -1,0 +1,23 @@
+# Shared recipe for the plateau diagnosis runs (sourced by
+# tools/plateau_sweep.sh and tools/plateau_seeds.sh) — ONE definition of
+# the dataset and the training/eval protocol so the seed reruns always
+# reproduce the winning leg's conditions.
+DATA=${DATA:-/tmp/shapes64b}
+STEPS=${STEPS:-600}
+OUT=docs/runs
+
+# model + protocol flags common to every leg (the hardened probe: 2000
+# held-out labeled examples, 50/50 ridge split, so train acc < 1)
+PLATEAU_FLAGS=(
+  --platform cpu --data images --data-dir "$DATA"
+  --dim 128 --levels 4 --image-size 64 --patch-size 8 --iters 8
+  --batch-size 16 --steps "$STEPS" --log-every 50
+  --eval-every 200 --eval-holdout 0.35
+  --eval-max-images 2048 --probe-examples 2000
+)
+
+ensure_dataset() {
+  # generate() skips existing files: no-op when complete, repairs partial
+  python examples/make_shapes_dataset.py --root "$DATA" --per-class 750 \
+    --image-size 64 2>&1 | tail -1
+}

@@ -1,0 +1,76 @@
+"""Tabulate the plateau-sweep JSONLs (tools/plateau_sweep.sh) into one
+markdown table: per leg, held-out PSNR and probe accuracy at each eval
+step, plus the step-200 -> final deltas that answer the diagnosis question
+("does anything still improve after step 300?").
+
+  python tools/plateau_report.py docs/runs/plateau_*.jsonl
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def leg_rows(path):
+    rows = []
+    with open(path) as f:
+        for line in f:
+            # timeout-killed runs can truncate the file mid-line; a bad
+            # line must not abort the report for the intact legs
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if "eval_psnr_db" in rec:
+                rows.append((rec["step"], rec["eval_psnr_db"],
+                             rec.get("probe_test_acc")))
+    return rows
+
+
+def main(paths):
+    if not paths:
+        print("usage: plateau_report.py <jsonl> [...]", file=sys.stderr)
+        return 1
+    legs = {}
+    steps = set()
+    for p in paths:
+        name = os.path.splitext(os.path.basename(p))[0].replace("plateau_", "")
+        rows = leg_rows(p)
+        if rows:
+            legs[name] = {s: (psnr, acc) for s, psnr, acc in rows}
+            steps.update(legs[name])
+    steps = sorted(steps)
+    header = "| leg | " + " | ".join(
+        f"PSNR@{s} / acc@{s}" for s in steps
+    ) + " | ΔPSNR post-200 | Δacc post-200 |"
+    print(header)
+    print("|" + "---|" * (len(steps) + 3))
+    for name, by_step in sorted(legs.items()):
+        cells = []
+        for s in steps:
+            if s in by_step:
+                psnr, acc = by_step[s]
+                cells.append(f"{psnr:.2f} / " + (f"{acc:.3f}" if acc is not None else "—"))
+            else:
+                cells.append("—")
+        have = [s for s in by_step if s >= 200]
+        if have:
+            first, last = min(have), max(have)
+            dpsnr = by_step[last][0] - by_step[first][0]
+            a0, a1 = by_step[first][1], by_step[last][1]
+            dacc = (a1 - a0) if (a0 is not None and a1 is not None) else None
+            cells.append(f"{dpsnr:+.2f}")
+            cells.append(f"{dacc:+.3f}" if dacc is not None else "—")
+        else:
+            cells += ["—", "—"]
+        print(f"| {name} | " + " | ".join(cells) + " |")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

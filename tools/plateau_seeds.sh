@@ -4,27 +4,29 @@
 # improvement claim carries seed error bars, not one trajectory.
 #
 # Usage:  WINNER_FLAGS="--lr 3e-4 --consistency mse" bash tools/plateau_seeds.sh
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")/.."
-OUT=docs/runs
-DATA=/tmp/shapes64b
-STEPS=${STEPS:-600}
+. tools/plateau_common.sh
 LOG=tools/plateau_sweep.log
-WINNER_FLAGS=${WINNER_FLAGS:?set WINNER_FLAGS to the winning leg's flags}
+WINNER_FLAGS=${WINNER_FLAGS:?"set WINNER_FLAGS to the winning leg flags"}
 
+ensure_dataset | tee -a "$LOG"
+
+fails=0
 for seed in 0 1 2; do
   echo "=== $(date -u +%FT%TZ) winner seed $seed: $WINNER_FLAGS" | tee -a "$LOG"
   # fresh log per invocation: MetricLogger appends, and a rerun must not
   # blend a stale session's records into the seed-variance evidence
   rm -f "$OUT/plateau_winner_s${seed}.jsonl"
   timeout 4000 python -m glom_tpu.training.train \
-    --platform cpu --data images --data-dir "$DATA" \
-    --dim 128 --levels 4 --image-size 64 --patch-size 8 --iters 8 \
-    --batch-size 16 --steps "$STEPS" --log-every 50 \
-    --eval-every 200 --eval-holdout 0.35 \
-    --eval-max-images 2048 --probe-examples 2000 \
-    --seed "$seed" \
+    "${PLATEAU_FLAGS[@]}" --seed "$seed" \
     --log-file "$OUT/plateau_winner_s${seed}.jsonl" \
     $WINNER_FLAGS 2>&1 | tail -2 | tee -a "$LOG"
+  rc=$?
+  if [ $rc -ne 0 ]; then
+    echo "!! seed $seed rc=$rc" | tee -a "$LOG"
+    fails=$((fails + 1))
+  fi
 done
-echo "=== $(date -u +%FT%TZ) seeds done" | tee -a "$LOG"
+echo "=== $(date -u +%FT%TZ) seeds done ($fails failed)" | tee -a "$LOG"
+exit "$fails"

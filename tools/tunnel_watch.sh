@@ -55,6 +55,12 @@ while true; do
         QUICK_DONE=1
       fi
     fi
+    if [ "$DEADLINE_EPOCH" -gt 0 ] && [ "$(date +%s)" -ge "$DEADLINE_EPOCH" ]; then
+      # re-check between sweeps: QUICK alone can run past the deadline, and
+      # the FULL sweep is hours of single-client tunnel time
+      note "deadline reached after QUICK phase — exiting (tunnel left free)"
+      exit 3
+    fi
     if [ "$QUICK_DONE" = "1" ] && probe; then
       note "starting FULL sweep"
       bash tools/hw_sweep.sh >>"$LOG" 2>&1
