@@ -24,6 +24,25 @@ from glom_tpu.models import glom as glom_model
 from glom_tpu.models.heads import patches_to_images_apply
 
 
+def embed_levels(
+    params: dict,
+    imgs: jax.Array,
+    *,
+    config: GlomConfig,
+    iters: Optional[int] = None,
+    consensus_fn=None,
+    ff_fn=None,
+) -> jax.Array:
+    """``(b, c, H, W) -> (b, L, d)`` mean-pooled (over columns) final-state
+    embeddings at EVERY level — one forward serves both the single-level
+    probe and the all-levels concat probe."""
+    out = glom_model.apply(
+        params, imgs, config=config, iters=iters, consensus_fn=consensus_fn,
+        ff_fn=ff_fn,
+    )
+    return jnp.mean(out, axis=1)
+
+
 def embed(
     params: dict,
     imgs: jax.Array,
@@ -36,11 +55,10 @@ def embed(
 ) -> jax.Array:
     """``(b, c, H, W) -> (b, d)`` mean-pooled final-state embeddings at
     ``level``."""
-    out = glom_model.apply(
+    return embed_levels(
         params, imgs, config=config, iters=iters, consensus_fn=consensus_fn,
         ff_fn=ff_fn,
-    )
-    return jnp.mean(out[:, :, level], axis=1)
+    )[:, level]
 
 
 def linear_probe(
@@ -148,8 +166,9 @@ class EvalSuite:
             config, noise_std=noise_std, iters=iters, timestep=timestep,
             level=level, consensus_fn=consensus_fn, ff_fn=ff_fn,
         ))
+        self._level = level
         self._embed = jax.jit(functools.partial(
-            embed, config=config, iters=iters, level=level,
+            embed_levels, config=config, iters=iters,
             consensus_fn=consensus_fn, ff_fn=ff_fn,
         ))
 
@@ -183,8 +202,10 @@ class EvalSuite:
         return np.concatenate(outs), n
 
     def run(self, params: dict, rng: jax.Array) -> dict:
-        """``{"eval_psnr_db": ..., ("probe_train_acc", "probe_test_acc")}``
-        — all on data the train step has never consumed."""
+        """``{"eval_psnr_db": ..., ("probe_train_acc", "probe_test_acc",
+        "probe_all_train_acc", "probe_all_test_acc")}`` — the ``probe_all``
+        pair is the all-levels-concat probe; all metrics are computed on
+        data the train step has never consumed."""
         import numpy as np
 
         psnrs = []
@@ -195,16 +216,30 @@ class EvalSuite:
         metrics = {"eval_psnr_db": float(np.mean(psnrs))}
 
         if self.probe_images is not None:
-            feats, n_used = self._chunked_embed(params["glom"], self.probe_images)
+            # (N, L, d) per-level pooled embeddings from ONE forward pass
+            lvl_feats, n_used = self._chunked_embed(
+                params["glom"], self.probe_images
+            )
             labels = self.probe_labels[:n_used]
             k = min(self._probe_split, n_used - 1)
-            tr_acc, te_acc = linear_probe(
-                jnp.asarray(feats[:k]), jnp.asarray(labels[:k]),
-                jnp.asarray(feats[k:]), jnp.asarray(labels[k:]),
-                num_classes=self.num_classes,
-            )
+
+            def probe(feats):
+                return linear_probe(
+                    jnp.asarray(feats[:k]), jnp.asarray(labels[:k]),
+                    jnp.asarray(feats[k:]), jnp.asarray(labels[k:]),
+                    num_classes=self.num_classes,
+                )
+
+            # metric of record: the configured single level (top by default)
+            tr_acc, te_acc = probe(lvl_feats[:, self._level])
             metrics["probe_train_acc"] = tr_acc
             metrics["probe_test_acc"] = te_acc
+            # companion: all levels concatenated (L*d features) — the whole
+            # part-whole hierarchy's linear decodability, not just the top
+            all_feats = lvl_feats.reshape(len(lvl_feats), -1)
+            tr_all, te_all = probe(all_feats)
+            metrics["probe_all_train_acc"] = tr_all
+            metrics["probe_all_test_acc"] = te_all
         return metrics
 
 

@@ -90,7 +90,9 @@ def test_eval_suite_heldout_metrics():
     m = suite.run(state.params, jax.random.PRNGKey(1))
     assert np.isfinite(m["eval_psnr_db"])
     assert m["probe_test_acc"] > 0.6  # mean intensity survives pooling
-    assert set(m) == {"eval_psnr_db", "probe_train_acc", "probe_test_acc"}
+    assert set(m) == {"eval_psnr_db", "probe_train_acc", "probe_test_acc",
+                      "probe_all_train_acc", "probe_all_test_acc"}
+    assert np.isfinite(m["probe_all_test_acc"])
 
 
 def test_holdout_split_disjoint_and_deterministic():
