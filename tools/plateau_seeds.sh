@@ -18,7 +18,10 @@ for seed in 0 1 2; do
   # fresh log per invocation: MetricLogger appends, and a rerun must not
   # blend a stale session's records into the seed-variance evidence
   rm -f "$OUT/plateau_winner_s${seed}.jsonl"
-  timeout 4000 python -m glom_tpu.training.train \
+  # two-view consistency legs run ~7s/step on the single host core: 600
+  # steps + 3 eval points needs ~5000s; clipping a seed run would hand the
+  # variance analysis a shorter trajectory than its siblings
+  timeout 6000 python -m glom_tpu.training.train \
     "${PLATEAU_FLAGS[@]}" --seed "$seed" \
     --log-file "$OUT/plateau_winner_s${seed}.jsonl" \
     $WINNER_FLAGS 2>&1 | tail -2 | tee -a "$LOG"

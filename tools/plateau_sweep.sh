@@ -6,31 +6,36 @@
 # were not used, noise-std/lr were never swept, and the probe ran on 256
 # examples (probe_train_acc 1.0 -> interpolation regime, noisy test acc).
 #
-# This sweep fixes the protocol first (6000-image dataset, 2000 probe
-# examples split 50/50 so ridge can't interpolate), then A/Bs one lever per
-# leg against the same baseline, sequentially (single host core).  CPU-only
-# by construction (--platform cpu) — never touches the accelerator tunnel.
-set -u
+# This sweep fixes the protocol first (tools/plateau_common.sh: 6000-image
+# dataset, 2000 probe examples split 50/50 so ridge can't interpolate),
+# then A/Bs one lever per leg against the same baseline, sequentially
+# (single host core).  CPU-only by construction (--platform cpu) — never
+# touches the accelerator tunnel.  Findings: BASELINE.md round-4 section.
+set -u -o pipefail
 cd "$(dirname "$0")/.."
-OUT=docs/runs
-mkdir -p "$OUT"
-DATA=/tmp/shapes64b
-STEPS=${STEPS:-600}
+. tools/plateau_common.sh
 LOG=tools/plateau_sweep.log
 
-python examples/make_shapes_dataset.py --root "$DATA" --per-class 750 \
-  --image-size 64 2>&1 | tail -1 | tee -a "$LOG"
+# a failed/partial dataset generation must stop the sweep — legs trained
+# on a class-skewed dataset would record themselves as valid A/B evidence
+ensure_dataset | tee -a "$LOG" || { echo "!! dataset generation failed" | tee -a "$LOG"; exit 1; }
 
 leg() {
   name=$1; shift
   echo "=== $(date -u +%FT%TZ) leg $name: $*" | tee -a "$LOG"
-  timeout 3000 python -m glom_tpu.training.train \
-    --platform cpu --data images --data-dir "$DATA" \
-    --dim 128 --levels 4 --image-size 64 --patch-size 8 --iters 8 \
-    --batch-size 16 --steps "$STEPS" --log-every 50 \
-    --eval-every 200 --eval-holdout 0.35 \
-    --eval-max-images 2048 --probe-examples 2000 \
+  # fresh log per invocation: MetricLogger appends, and a rerun must not
+  # blend a stale session's records into the A/B evidence
+  rm -f "$OUT/plateau_${name}.jsonl"
+  # 5500s: two-view consistency legs run ~7s/step (one batched 2b-view
+  # scan) — 600 steps + 3 eval points; a 3000s budget clipped the round-4
+  # cons legs at ~step 420
+  timeout 5500 python -m glom_tpu.training.train \
+    "${PLATEAU_FLAGS[@]}" \
     --log-file "$OUT/plateau_${name}.jsonl" "$@" 2>&1 | tail -2 | tee -a "$LOG"
+  rc=$?
+  if [ $rc -ne 0 ]; then
+    echo "!! leg $name rc=$rc" | tee -a "$LOG"
+  fi
 }
 
 leg base      --lr 3e-4
