@@ -69,9 +69,16 @@ def linear_probe(
     *,
     num_classes: int,
     l2: float = 1e-3,
+    l2_grid=None,
 ) -> Tuple[float, float]:
     """Closed-form ridge regression to one-hot targets on frozen embeddings;
-    returns ``(train_accuracy, test_accuracy)``."""
+    returns ``(train_accuracy, test_accuracy)``.
+
+    ``l2_grid``: optional candidate list — the ridge strength is then chosen
+    on a held-out tail (last 20%) of the TRAIN half and the winner refit on
+    the full train half.  A fixed ``l2`` tuned for d=128 features
+    over-shrinks a d=512 concat probe; the grid makes feature sets of
+    different widths comparable.  Test data never influences the choice."""
     x = train_x.astype(jnp.float32)
     mean, std = x.mean(0), x.std(0) + 1e-6
     x = (x - mean) / std
@@ -79,13 +86,34 @@ def linear_probe(
 
     onehot = jax.nn.one_hot(train_y, num_classes)
     d = x.shape[1]
-    w = jnp.linalg.solve(x.T @ x + l2 * jnp.eye(d), x.T @ onehot)
+    eye = jnp.eye(d)
 
-    def acc(feats, labels):
+    def fit(feats, targets, reg):
+        return jnp.linalg.solve(
+            feats.T @ feats + reg * eye, feats.T @ targets
+        )
+
+    def acc_w(w, feats, labels):
         pred = jnp.argmax(feats @ w, axis=-1)
         return float(jnp.mean((pred == labels).astype(jnp.float32)))
 
-    return acc(x, train_y), acc(xt, test_y)
+    n_fit = max(1, int(len(x) * 0.8))
+    # Grid selection needs a non-degenerate validation tail: below ~5
+    # examples the choice is effectively random — fall back to the fixed l2.
+    if l2_grid is not None and len(x) - n_fit >= 5:
+        # Gram/crossterm are candidate-independent; build once, solve per l2
+        g = x[:n_fit].T @ x[:n_fit]
+        b = x[:n_fit].T @ onehot[:n_fit]
+        best = None
+        for cand in l2_grid:
+            w_val = jnp.linalg.solve(g + cand * eye, b)
+            val_acc = acc_w(w_val, x[n_fit:], train_y[n_fit:])
+            if best is None or val_acc > best[0]:
+                best = (val_acc, cand)
+        l2 = best[1]
+
+    w = fit(x, onehot, l2)
+    return acc_w(w, x, train_y), acc_w(w, xt, test_y)
 
 
 def make_psnr_fn(
@@ -149,6 +177,7 @@ class EvalSuite:
         probe_labels=None,
         num_classes: Optional[int] = None,
         probe_train_fraction: float = 0.5,
+        probe_l2_grid=None,
         noise_std: float = 1.0,
         iters: Optional[int] = None,
         timestep: Optional[int] = None,
@@ -189,6 +218,7 @@ class EvalSuite:
             self.probe_images = imgs
             self.probe_labels = labels
             self._probe_split = n_train
+            self._probe_l2_grid = probe_l2_grid
             self.num_classes = num_classes
 
     def _chunked_embed(self, params, imgs):
@@ -228,6 +258,7 @@ class EvalSuite:
                     jnp.asarray(feats[:k]), jnp.asarray(labels[:k]),
                     jnp.asarray(feats[k:]), jnp.asarray(labels[k:]),
                     num_classes=self.num_classes,
+                    l2_grid=self._probe_l2_grid,
                 )
 
             # metric of record: the configured single level (top by default)

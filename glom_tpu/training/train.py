@@ -82,6 +82,10 @@ def parse_args(argv=None):
     p.add_argument("--probe-examples", type=int, default=256,
                    help="held-out labeled examples for the linear probe "
                         "(0 disables the probe)")
+    p.add_argument("--probe-l2-grid", type=float, nargs="+", default=None,
+                   help="candidate ridge strengths for the probe, chosen on "
+                        "a held-out tail of the probe-train half (default: "
+                        "fixed l2=1e-3)")
     p.add_argument("--eval-max-images", type=int, default=1024,
                    help="cap on held-out images decoded into host RAM and "
                         "scored per eval point (ImageNet-scale holdouts "
@@ -194,6 +198,7 @@ def main(argv=None):
                 probe_kwargs = dict(
                     probe_images=eval_imgs[:args.probe_examples],
                     probe_labels=labels, num_classes=len(names),
+                    probe_l2_grid=args.probe_l2_grid,
                 )
         eval_data = (eval_imgs, probe_kwargs)
         batches = ImageFolderStream(

@@ -131,3 +131,21 @@ def test_trainer_runs_eval_suite_on_heldout(tmp_path):
     ev = [r for r in rows if "probe_test_acc" in r]
     assert len(ev) == 2  # eval_every=1, 2 steps
     assert all(np.isfinite(r["eval_psnr_db"]) for r in ev)
+
+
+def test_linear_probe_l2_grid_helps_wide_features():
+    """A fixed l2 tuned for narrow features over-shrinks nothing here, but
+    the grid must (a) never use test data and (b) pick an l2 that performs
+    at least as well on a case where the fixed default is badly mis-scaled."""
+    rng = np.random.default_rng(2)
+    centers = rng.standard_normal((4, 64)) * 2.0
+    labels = rng.integers(0, 4, size=240)
+    feats = (centers[labels] + rng.standard_normal((240, 64)) * 1.5).astype(np.float32)
+    tr_x, tr_y = jnp.asarray(feats[:160]), jnp.asarray(labels[:160])
+    te_x, te_y = jnp.asarray(feats[160:]), jnp.asarray(labels[160:])
+    # absurdly large fixed l2 shrinks the probe to chance-ish
+    _, acc_fixed = linear_probe(tr_x, tr_y, te_x, te_y, num_classes=4, l2=1e6)
+    _, acc_grid = linear_probe(tr_x, tr_y, te_x, te_y, num_classes=4,
+                               l2=1e6, l2_grid=[1e-3, 1e-1, 1e1, 1e6])
+    assert acc_grid >= acc_fixed
+    assert acc_grid > 0.5
