@@ -23,6 +23,9 @@ def main():
     p.add_argument("--npz", required=True, help="output of glom-tpu-extract")
     p.add_argument("--train-frac", type=float, default=0.5)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--l2-grid", type=float, nargs="+", default=None,
+                   help="cross-validate the ridge strength over these "
+                        "candidates (default: fixed l2=1e-3)")
     args = p.parse_args()
 
     import jax
@@ -58,7 +61,8 @@ def _probe(linear_probe, emb, labels, z, args):
     tr, te = perm[:k], perm[k:]
     num_classes = len(z["class_names"])
     train_acc, test_acc = linear_probe(
-        emb[tr], labels[tr], emb[te], labels[te], num_classes=num_classes
+        emb[tr], labels[tr], emb[te], labels[te], num_classes=num_classes,
+        l2_grid=args.l2_grid,
     )
     return {"train_acc": round(float(train_acc), 4),
             "test_acc": round(float(test_acc), 4),
