@@ -10,7 +10,9 @@ cd "$(dirname "$0")/.."
 LOG=tools/plateau_sweep.log
 WINNER_FLAGS=${WINNER_FLAGS:?"set WINNER_FLAGS to the winning leg flags"}
 
-ensure_dataset | tee -a "$LOG"
+# a failed/partial dataset generation must stop the runs — seeds trained
+# on a class-skewed dataset would record themselves as valid evidence
+ensure_dataset | tee -a "$LOG" || { echo "!! dataset generation failed" | tee -a "$LOG"; exit 1; }
 
 fails=0
 for seed in 0 1 2; do
