@@ -166,6 +166,15 @@ class TrainConfig:
     # "ep" shards whole level-MLPs (expert-style), "replicated" ignores it
     param_sharding: str = "tp"
     donate: bool = True
+    # multi-process preemption-flag poll cadence, in steps.  The flag is
+    # OR-reduced over hosts (a collective), so the cadence must be a step
+    # count — wall-clock polling would diverge across hosts.  The default
+    # assumes sub-second steps: SIGTERM-to-checkpoint latency is about
+    # stop_poll_steps * step_time, so at multi-second step times (large
+    # configs, grad accumulation) LOWER this to keep latency inside the
+    # preemption grace window.  Single-process runs poll a local flag every
+    # step regardless.
+    stop_poll_steps: int = 10
 
     def __post_init__(self):
         if self.param_sharding not in ("tp", "ep", "replicated"):
@@ -196,6 +205,10 @@ class TrainConfig:
             raise ValueError(
                 f"batch_size {self.batch_size} not divisible by "
                 f"grad_accum_steps {self.grad_accum_steps}"
+            )
+        if self.stop_poll_steps < 1:
+            raise ValueError(
+                f"stop_poll_steps must be >= 1, got {self.stop_poll_steps}"
             )
 
     def to_json_dict(self) -> dict:

@@ -100,7 +100,9 @@ def linear_probe(
     n_fit = max(1, int(len(x) * 0.8))
     # Grid selection needs a non-degenerate validation tail: below ~5
     # examples the choice is effectively random — fall back to the fixed l2.
-    if l2_grid is not None and len(x) - n_fit >= 5:
+    # len() guard: [] must fall back to the fixed l2 (best would stay None),
+    # and numpy-array grids must not hit ambiguous bool(array)
+    if l2_grid is not None and len(l2_grid) > 0 and len(x) - n_fit >= 5:
         # Gram/crossterm are candidate-independent; build once, solve per l2
         g = x[:n_fit].T @ x[:n_fit]
         b = x[:n_fit].T @ onehot[:n_fit]

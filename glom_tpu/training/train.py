@@ -60,6 +60,11 @@ def parse_args(argv=None):
                         "the capture fast path")
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--stop-poll-steps", type=int, default=10,
+                   help="multi-process preemption-flag poll cadence (steps); "
+                        "lower it when step times are multi-second so "
+                        "SIGTERM-to-checkpoint latency stays inside the "
+                        "preemption grace window")
     p.add_argument("--eval-every", type=int, default=0,
                    help="log denoising PSNR every N steps (0 = off)")
     p.add_argument("--seed", type=int, default=0)
@@ -159,6 +164,7 @@ def main(argv=None):
         consistency_level=args.consistency_level,
         steps=args.steps,
         log_every=args.log_every,
+        stop_poll_steps=args.stop_poll_steps,
         eval_every=args.eval_every,
         checkpoint_every=args.checkpoint_every,
         checkpoint_dir=args.checkpoint_dir,

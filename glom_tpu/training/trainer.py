@@ -558,13 +558,18 @@ class Trainer:
         stopped = False
         # multi-process stop-flag poll cadence (see _should_stop): piggyback
         # on the logging/checkpoint cadence when one is set, but never wait
-        # more than 10 steps — preemption grace windows are tens of seconds
-        # and a large checkpoint_every must not starve the flag.  Absolute
+        # more than cfg.stop_poll_steps — preemption grace windows are tens
+        # of seconds and a large checkpoint_every must not starve the flag.
+        # The cap is a step count, not wall-clock (the poll gates a
+        # collective, so it must be computed identically on every host);
+        # runs with multi-second steps should lower cfg.stop_poll_steps so
+        # stop_poll * step_time stays inside the grace window.  Absolute
         # step numbers so the poll lands on the same steps as the logging
         # barrier after a resume.
         stop_poll = min(
-            min((x for x in (cfg.log_every, cfg.checkpoint_every) if x), default=10),
-            10,
+            x
+            for x in (cfg.log_every, cfg.checkpoint_every, cfg.stop_poll_steps)
+            if x
         )
         for i in range(start_step, steps):
             if cfg.profile_dir:

@@ -149,3 +149,16 @@ def test_linear_probe_l2_grid_helps_wide_features():
                                l2=1e6, l2_grid=[1e-3, 1e-1, 1e1, 1e6])
     assert acc_grid >= acc_fixed
     assert acc_grid > 0.5
+
+
+def test_linear_probe_empty_l2_grid_falls_back_to_fixed():
+    """l2_grid=[] must behave exactly like l2_grid=None (fixed l2), not
+    crash with best=None (ADVICE r4)."""
+    rng = np.random.default_rng(3)
+    feats = rng.standard_normal((80, 16)).astype(np.float32)
+    labels = rng.integers(0, 2, size=80)
+    tr_x, tr_y = jnp.asarray(feats[:60]), jnp.asarray(labels[:60])
+    te_x, te_y = jnp.asarray(feats[60:]), jnp.asarray(labels[60:])
+    a_none = linear_probe(tr_x, tr_y, te_x, te_y, num_classes=2, l2_grid=None)
+    a_empty = linear_probe(tr_x, tr_y, te_x, te_y, num_classes=2, l2_grid=[])
+    assert a_none == a_empty

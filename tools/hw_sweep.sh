@@ -11,6 +11,8 @@ set -u
 cd "$(dirname "$0")/.."
 LOG=tools/hw_sweep.log
 QUICK=${QUICK:-0}
+FAILS=0   # legs that failed after the hw_check gate; non-zero exit so the
+          # watcher's retry loop can tell a mid-sweep tunnel death from success
 
 # Unique per-invocation marker: best-rate extraction for tools/mfu.py is
 # scoped to lines after this marker so a stale rate from a previous session
@@ -31,6 +33,7 @@ run() {
     # keep the failure signature: a Mosaic lowering error must be
     # distinguishable from a dead tunnel in the log
     { echo "!! rc=$rc"; tail -15 /tmp/hw_sweep_err.txt; } | tee -a "$LOG"
+    FAILS=$((FAILS + 1))
   fi
 }
 
@@ -57,8 +60,14 @@ if [ "$QUICK" = "1" ]; then
   run --scan-unroll 7 --ff-impl pallas
   run --ff-impl pallas --profile-dir /tmp/glom_trace
   best=$(best_rate)
-  [ -n "${best:-}" ] && python tools/mfu.py --imgs-per-sec "$best" 2>&1 | tee -a "$LOG"
-  echo "=== $(date -u +%FT%TZ) QUICK sweep done" | tee -a "$LOG"
+  if [ -n "${best:-}" ]; then
+    python tools/mfu.py --imgs-per-sec "$best" 2>&1 | tee -a "$LOG"
+    if [ "${PIPESTATUS[0]}" -ne 0 ]; then
+      echo "!! mfu rc=${PIPESTATUS[0]}" | tee -a "$LOG"; FAILS=$((FAILS + 1))
+    fi
+  fi
+  echo "=== $(date -u +%FT%TZ) QUICK sweep done (failed legs: $FAILS)" | tee -a "$LOG"
+  [ "$FAILS" -eq 0 ] || exit 1
   exit 0
 fi
 
@@ -91,6 +100,9 @@ run --attention-impl auto                                   # auto => dense at n
 # generate() skips existing files, so this is a no-op when already complete
 # and repairs a partially generated dataset.
 python examples/make_shapes_dataset.py --root /tmp/shapes224 --per-class 250 --image-size 224 | tee -a "$LOG"
+if [ "${PIPESTATUS[0]}" -ne 0 ]; then
+  echo "!! make_shapes_dataset rc=${PIPESTATUS[0]}" | tee -a "$LOG"; FAILS=$((FAILS + 1))
+fi
 run --data images --data-dir /tmp/shapes224
 run --data images --data-dir /tmp/shapes224 --decode python
 run --data images --data-dir /tmp/shapes224 --ff-impl pallas --fused-ff-bwd
@@ -106,9 +118,15 @@ timeout 1200 python -m glom_tpu.training.train \
   --ff-impl pallas --checkpoint-dir /tmp/ckpt_shapes224 \
   --checkpoint-every 500 --log-file docs/runs/shapes224_tpu.jsonl \
   2>&1 | tail -4 | tee -a "$LOG"
+if [ "${PIPESTATUS[0]}" -ne 0 ]; then
+  echo "!! flagship SSL leg rc=${PIPESTATUS[0]}" | tee -a "$LOG"; FAILS=$((FAILS + 1))
+fi
 timeout 900 python examples/islands_from_checkpoint.py \
   --checkpoint-dir /tmp/ckpt_shapes224 --data-dir /tmp/shapes224 \
   --out docs/islands_realdata_224.png 2>&1 | tail -2 | tee -a "$LOG"
+if [ "${PIPESTATUS[0]}" -ne 0 ]; then
+  echo "!! islands leg rc=${PIPESTATUS[0]}" | tee -a "$LOG"; FAILS=$((FAILS + 1))
+fi
 
 # Profile trace of the best-known config (VERDICT r2 item 4): one bench run
 # with a 3-step jax.profiler window so the MFU claim has a trace behind it.
@@ -118,7 +136,13 @@ ls -R /tmp/glom_trace 2>/dev/null | tail -5 | tee -a "$LOG"
 # Component wall-clock breakdown on the chip (the top-time-sinks evidence)
 echo "=== $(date -u +%FT%TZ) breakdown" | tee -a "$LOG"
 timeout 600 python tools/breakdown.py 2>&1 | tee -a "$LOG"
+if [ "${PIPESTATUS[0]}" -ne 0 ]; then
+  echo "!! breakdown rc=${PIPESTATUS[0]}" | tee -a "$LOG"; FAILS=$((FAILS + 1))
+fi
 timeout 600 python tools/breakdown.py --ff-impl pallas 2>&1 | tee -a "$LOG"
+if [ "${PIPESTATUS[0]}" -ne 0 ]; then
+  echo "!! breakdown(pallas) rc=${PIPESTATUS[0]}" | tee -a "$LOG"; FAILS=$((FAILS + 1))
+fi
 
 # Stateful video rollout + train step (BASELINE config 5 refresh) —
 # run()'s capture/rc pattern so a partial failure keeps the metrics that
@@ -129,6 +153,7 @@ vrc=$?
 echo "$vout" | grep '"metric"' | tee -a "$LOG"
 if [ $vrc -ne 0 ]; then
   { echo "!! video bench rc=$vrc"; tail -15 /tmp/hw_sweep_err.txt; } | tee -a "$LOG"
+  FAILS=$((FAILS + 1))
 fi
 
 # MFU at this session's best flagship rate (tools/sweep_log.py scopes the
@@ -140,5 +165,10 @@ best=$(best_rate)
 if [ -n "${best:-}" ]; then
   echo "=== $(date -u +%FT%TZ) mfu at best rate $best" | tee -a "$LOG"
   python tools/mfu.py --imgs-per-sec "$best" 2>&1 | tee -a "$LOG"
+  if [ "${PIPESTATUS[0]}" -ne 0 ]; then
+    echo "!! mfu rc=${PIPESTATUS[0]}" | tee -a "$LOG"; FAILS=$((FAILS + 1))
+  fi
 fi
-echo "=== $(date -u +%FT%TZ) sweep done" | tee -a "$LOG"
+echo "=== $(date -u +%FT%TZ) sweep done (failed legs: $FAILS)" | tee -a "$LOG"
+[ "$FAILS" -eq 0 ] || exit 1
+exit 0
