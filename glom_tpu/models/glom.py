@@ -274,6 +274,7 @@ def apply(
     capture_timestep: Optional[int] = None,
     consensus_fn=None,
     ff_fn=None,
+    state_sharding=None,
 ) -> jax.Array:
     """Forward pass.
 
@@ -293,6 +294,14 @@ def apply(
     overrides the grouped-FF implementation — used to inject the
     shard_map-wrapped Pallas FF
     (``glom_tpu.parallel.ff_shard.make_sharded_ff_pallas``).
+
+    ``state_sharding`` (a ``NamedSharding``, Trainer-injected under a mesh)
+    pins the ``(b, n, L, d)`` scan carry to the activation layout — batch
+    over data, columns over seq, NEVER the level axis over an expert axis —
+    so GSPMD cannot propagate expert-sharded param layouts onto the carried
+    state (the factored-EP "involuntary full rematerialization" failure
+    mode: two nets with different expert axes would reshard the full state
+    every iteration).
     """
     c = config
     validate_img(img, c)
@@ -326,8 +335,13 @@ def apply(
         bottom_level
     )
 
+    if state_sharding is not None:
+        levels = jax.lax.with_sharding_constraint(levels, state_sharding)
+
     def body(carry, _):
         new = step(carry)
+        if state_sharding is not None:
+            new = jax.lax.with_sharding_constraint(new, state_sharding)
         return new, (new if return_all else None)
 
     if capture_timestep is not None and not return_all:

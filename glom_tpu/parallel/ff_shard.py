@@ -33,7 +33,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from glom_tpu.kernels.ff_pallas import grouped_ff_pallas
 
@@ -120,11 +120,27 @@ def make_sharded_ff_pallas(
 
         runs = {axis: ep_run(axis) for axis, size in candidates if size > 1}
 
+        # Activations must never LEAVE this fn sharded over an expert axis:
+        # with factored expert axes the two nets use different axes, and a
+        # scan carry that flip-flops between those layouts hits GSPMD's
+        # "involuntary full rematerialization" (replicate-then-partition
+        # every iteration).  Constraining the output back to the plain
+        # (data, seq) activation layout makes XLA emit one all-gather over
+        # the expert axis instead — the collective the math requires.
+        act_sh = NamedSharding(mesh, x_spec())
+
         def ff_fn(params, x):
             # static dispatch: group count is a trace-time shape
             axis = pick_expert_axis(params["w1"].shape[0], candidates)
             if axis is not None:
-                return runs[axis](params, x)
+                # pin the input as well: the slice/pad chains that build each
+                # net's x share sources, and without a constraint boundary
+                # GSPMD propagates BOTH nets' expert axes onto them (the
+                # replicated→expert-sharded partition below is a free local
+                # slice; expert↔expert is the remat)
+                x = jax.lax.with_sharding_constraint(x, act_sh)
+                out = runs[axis](params, x)
+                return jax.lax.with_sharding_constraint(out, act_sh)
             # no axis divides this net's group count: params are replicated
             # by level_sharded_pspecs — run the DP form
             return run_replicated(params, x)

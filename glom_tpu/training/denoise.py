@@ -59,7 +59,7 @@ def resolve_loss_timestep(train: TrainConfig, iters: int) -> int:
 
 
 def make_loss_fn(config: GlomConfig, train: TrainConfig, *, consensus_fn=None,
-                 ff_fn=None, apply_fn=None):
+                 ff_fn=None, apply_fn=None, state_sharding=None):
     """loss(params, img, rng) -> (loss, recon).  Mirrors README.md:74-88.
 
     ``apply_fn`` overrides the forward entirely — a pipeline-parallel caller
@@ -95,6 +95,7 @@ def make_loss_fn(config: GlomConfig, train: TrainConfig, *, consensus_fn=None,
             _, captured = glom_model.apply(
                 params["glom"], noised, config=config, iters=iters,
                 capture_timestep=timestep, consensus_fn=consensus_fn, ff_fn=ff_fn,
+                state_sharding=state_sharding,
             )
         tokens = captured[:b, :, train.loss_level]  # (b, n, d)
         recon = patches_to_images_apply(params["decoder"], tokens, config)
@@ -127,6 +128,7 @@ def make_step_fn(
     ff_fn=None,
     apply_fn=None,
     microbatch_sharding=None,
+    state_sharding=None,
 ):
     """Un-jitted train step ``state, img -> state, metrics`` — the body the
     Trainer jits with explicit shardings/donation.
@@ -138,7 +140,7 @@ def make_step_fn(
     (InfoNCE consistency) see per-microbatch negatives instead — documented
     semantics, not drift."""
     loss_fn = make_loss_fn(config, train, consensus_fn=consensus_fn, ff_fn=ff_fn,
-                           apply_fn=apply_fn)
+                           apply_fn=apply_fn, state_sharding=state_sharding)
     accum = train.grad_accum_steps
 
     def step_fn(state: DenoiseState, img: jax.Array) -> Tuple[DenoiseState, dict]:

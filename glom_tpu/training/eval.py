@@ -128,10 +128,12 @@ def make_psnr_fn(
     data_range: float = 2.0,
     consensus_fn=None,
     ff_fn=None,
+    state_sharding=None,
 ):
     """Build the pure, jittable eval twin of the denoising objective:
     ``(params, imgs, rng) -> psnr_db`` scalar.  ``consensus_fn`` threads the
-    mesh-bound ring/ulysses consensus exactly as the train step does."""
+    mesh-bound ring/ulysses consensus exactly as the train step does;
+    ``state_sharding`` likewise pins the scan carry (see glom.apply)."""
     if iters is None:
         iters = config.default_iters
     if timestep is None:
@@ -142,6 +144,7 @@ def make_psnr_fn(
         _, captured = glom_model.apply(
             params["glom"], noised, config=config, iters=iters,
             capture_timestep=timestep, consensus_fn=consensus_fn, ff_fn=ff_fn,
+            state_sharding=state_sharding,
         )
         recon = patches_to_images_apply(
             params["decoder"], captured[:, :, level], config

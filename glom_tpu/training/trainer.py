@@ -197,6 +197,16 @@ class Trainer:
             )
         self._consensus_fn = consensus_fn
 
+        # Pin the (b, n, L, d) scan carry to the activation layout (batch
+        # over data, columns over seq) so expert-sharded param layouts can
+        # never propagate onto the carried state — see glom.apply's
+        # state_sharding doc for the factored-EP failure mode this blocks.
+        act_sh = None
+        if self.mesh.devices.size > 1:
+            seq_ax = train.mesh_axes[2] if len(train.mesh_axes) > 2 else None
+            act_sh = NamedSharding(self.mesh, P(data_axis, seq_ax))
+        self._act_sh = act_sh
+
         self._eval_suite = eval_suite
         self._eval = None
         if train.eval_every and eval_suite is None:
@@ -207,6 +217,7 @@ class Trainer:
                     config, noise_std=train.noise_std, iters=train.iters,
                     timestep=train.loss_timestep, level=train.loss_level,
                     consensus_fn=consensus_fn, ff_fn=ff_fn,
+                    state_sharding=act_sh,
                 )
             )
 
@@ -217,7 +228,7 @@ class Trainer:
         self._step = jax.jit(
             denoise.make_step_fn(
                 config, train, tx, consensus_fn=consensus_fn, ff_fn=ff_fn,
-                microbatch_sharding=micro_sh,
+                microbatch_sharding=micro_sh, state_sharding=act_sh,
             ),
             in_shardings=(self._state_sh, self._batch_sh),
             out_shardings=(self._state_sh, NamedSharding(self.mesh, P())),

@@ -1,0 +1,114 @@
+"""Offline ranking of the MFU levers by compiled-FLOPs reduction.
+
+The identified single-chip perf levers (BASELINE.md round-2/3 analysis:
+remat policy, batch size, fuse_ff, scan_unroll) were queued for hardware
+A/B but unranked — so a short tunnel window could be spent on a weak
+lever first.  XLA's cost model is a compile-time fact available on CPU:
+this tool compiles the REAL train step per lever config and reports
+executed FLOPs/img and bytes/img relative to the flagship baseline, so
+the hardware sweep order (tools/hw_sweep.sh QUICK mode) can be set by
+predicted win before any chip time is spent.
+
+Caveats (also printed):
+  * the CPU backend's cost model under-counts fused dot bodies (~0.1x the
+    analytic count on this step) — treat RATIOS between configs as the
+    signal, not absolute FLOPs;
+  * levers inside Pallas kernels (ff_impl=pallas, ff_fused_bwd) are
+    opaque custom calls to the cost model and CANNOT be ranked offline —
+    they stay in the sweep on round-2 evidence (fwd kernel +11%);
+  * FLOPs reduction predicts the win for a compute-bound step; bytes/img
+    is reported because a lever that trades FLOPs for HBM traffic (remat
+    off) can under-deliver when the step goes bandwidth-bound.
+
+  python tools/rank_levers.py            # full table, ~minutes of compiles
+  python tools/rank_levers.py --json     # machine-readable rows
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def lever_configs():
+    """(name, config_overrides, train_overrides) per lever — mirrors the
+    bench.py flags in tools/hw_sweep.sh QUICK mode."""
+    return [
+        ("base(remat-full,b32)", {}, {}),
+        ("remat-dots", {"remat_policy": "dots"}, {}),
+        ("no-remat", {"remat": False}, {}),
+        ("batch64", {}, {"batch_size": 64}),
+        ("batch128", {}, {"batch_size": 128}),
+        ("no-remat+batch64", {"remat": False}, {"batch_size": 64}),
+        ("fuse_ff", {"fuse_ff": True}, {}),
+        ("scan-unroll2", {"scan_unroll": 2}, {}),
+        ("scan-unroll7", {"scan_unroll": 7}, {}),
+    ]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="flagship", choices=["flagship", "large"])
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args()
+
+    import jax
+
+    if jax.default_backend() != "tpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import optax
+
+    from glom_tpu.config import GlomConfig, TrainConfig, bench_preset
+    from glom_tpu.profiling import cost_analysis
+    from glom_tpu.training import denoise
+
+    kw, iters, tpu_batch, _ = bench_preset(args.config)
+    rows = []
+    base_flops = base_bytes = None
+    for name, c_over, t_over in lever_configs():
+        config = GlomConfig(compute_dtype=jnp.bfloat16, remat=True, **kw, **c_over)
+        batch = t_over.get("batch_size", tpu_batch)
+        train = TrainConfig(batch_size=batch, iters=iters, log_every=0)
+        tx = optax.adam(1e-4)
+        step = denoise.make_step_fn(config, train, tx)
+        rng = jax.random.PRNGKey(0)
+        state = jax.eval_shape(lambda: denoise.init_state(rng, config, tx))
+        img = jax.ShapeDtypeStruct(
+            (batch, 3, config.image_size, config.image_size), jnp.float32
+        )
+        try:
+            cost = cost_analysis(jax.jit(step), state, img)
+        except Exception as e:  # a lever that fails to compile is itself a finding
+            print(f"{name}: compile failed: {e}", file=sys.stderr)
+            continue
+        flops = float(cost.get("flops", float("nan"))) / batch
+        byts = float(cost.get("bytes accessed", float("nan"))) / batch
+        if base_flops is None:
+            base_flops, base_bytes = flops, byts
+        rows.append({
+            "lever": name,
+            "flops_per_img_gf": round(flops / 1e9, 2),
+            "bytes_per_img_mb": round(byts / 1e6, 1),
+            "flops_vs_base": round(flops / base_flops, 3),
+            "bytes_vs_base": round(byts / base_bytes, 3),
+        })
+        print(f"{name:24s} flops/img {flops/1e9:8.2f} GF ({flops/base_flops:5.3f}x) "
+              f"bytes/img {byts/1e6:8.1f} MB ({byts/base_bytes:5.3f}x)", flush=True)
+
+    ranked = sorted(rows[1:], key=lambda r: r["flops_vs_base"])
+    print("\npredicted order (fewest executed FLOPs first):")
+    for r in ranked:
+        print(f"  {r['lever']:24s} {r['flops_vs_base']:.3f}x flops, "
+              f"{r['bytes_vs_base']:.3f}x bytes")
+    if jax.default_backend() == "cpu":
+        print("\nnote: CPU cost model under-counts fused dots — ratios are the "
+              "signal, not absolute GF", file=sys.stderr)
+    if args.json:
+        print(json.dumps({"config": args.config, "rows": rows}))
+
+
+if __name__ == "__main__":
+    main()
