@@ -1,0 +1,167 @@
+"""SGD loss-curve parity vs the torch reference at a non-toy config.
+
+``tests/test_train_parity.py`` proves step-for-step parity at dim=32/16px/5
+steps; BASELINE.json's north star is the same property at flagship scale.
+This script runs the identical protocol (same converted weights, same data,
+same precomputed noise, plain SGD both sides) at the largest config that
+fits CPU minutes — default dim=128, levels=4, 64px, 20 steps — and commits
+the evidence: both curves to a JSON + PNG under docs/, plus the same
+rtol assertion the test uses.
+
+Reference recipe being mirrored: /root/reference/README.md:56-90 (noise →
+forward → decode one timestep's top level → MSE), model
+/root/reference/glom_pytorch/glom_pytorch.py:78-148.
+
+  python examples/train_parity_curves.py           # ~minutes on CPU
+  python examples/train_parity_curves.py --steps 20 --dim 128
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--dim", type=int, default=128)
+    p.add_argument("--levels", type=int, default=4)
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--patch-size", type=int, default=8)
+    p.add_argument("--iters", type=int, default=6)
+    p.add_argument("--timestep", type=int, default=4)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--rtol", type=float, default=2e-3)
+    p.add_argument("--reference", default="/root/reference")
+    p.add_argument("--out-prefix", default="docs/parity_curves_128")
+    args = p.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # numeric parity belongs on fp32 CPU
+
+    import jax.numpy as jnp
+    import torch
+    from torch import nn
+
+    from glom_tpu.config import GlomConfig
+    from glom_tpu.convert import torch_to_jax
+    from glom_tpu.models import glom as glom_model
+    from glom_tpu.models.heads import patches_to_images_apply
+
+    if args.reference not in sys.path:
+        sys.path.insert(0, args.reference)
+    from glom_pytorch import Glom as TorchGlom
+
+    c = GlomConfig(dim=args.dim, levels=args.levels,
+                   image_size=args.image_size, patch_size=args.patch_size)
+    s = args.image_size // args.patch_size
+    rng = np.random.default_rng(0)
+    torch.manual_seed(0)
+
+    tmodel = TorchGlom(dim=args.dim, levels=args.levels,
+                       image_size=args.image_size, patch_size=args.patch_size)
+    tdecoder = nn.Linear(args.dim, args.patch_size ** 2 * 3)
+    params_j = torch_to_jax(tmodel.state_dict(), c)
+    dec_w = tdecoder.weight.detach().numpy().T.copy()
+    dec_b = tdecoder.bias.detach().numpy().copy()
+
+    shape = (args.batch, 3, args.image_size, args.image_size)
+    imgs = [rng.standard_normal(shape).astype(np.float32) for _ in range(args.steps)]
+    noises = [rng.standard_normal(shape).astype(np.float32) for _ in range(args.steps)]
+
+    # --- torch side ---
+    opt = torch.optim.SGD(
+        list(tmodel.parameters()) + list(tdecoder.parameters()), lr=args.lr
+    )
+    torch_losses = []
+    for img_np, noise_np in zip(imgs, noises):
+        img = torch.from_numpy(img_np)
+        all_levels = tmodel(img + torch.from_numpy(noise_np),
+                            iters=args.iters, return_all=True)
+        top = all_levels[args.timestep, :, :, -1]
+        patches = tdecoder(top)
+        recon = (
+            patches.reshape(args.batch, s, s, args.patch_size, args.patch_size, 3)
+            .permute(0, 5, 1, 3, 2, 4)
+            .reshape(*shape)
+        )
+        loss = torch.nn.functional.mse_loss(img, recon)
+        opt.zero_grad()
+        loss.backward()
+        opt.step()
+        torch_losses.append(float(loss.detach()))
+        print(f"torch step {len(torch_losses):3d} loss {torch_losses[-1]:.6f}",
+              flush=True)
+
+    # --- jax side: converted weights, same decoder, same SGD ---
+    params = {"glom": params_j,
+              "decoder": {"w": jnp.asarray(dec_w), "b": jnp.asarray(dec_b)}}
+
+    def loss_fn(p, img, noise):
+        all_levels = glom_model.apply(
+            p["glom"], img + noise, config=c, iters=args.iters, return_all=True
+        )
+        top = all_levels[args.timestep, :, :, -1]
+        recon = patches_to_images_apply(p["decoder"], top, c)
+        return jnp.mean((recon - img) ** 2)
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+    jax_losses = []
+    for img_np, noise_np in zip(imgs, noises):
+        loss, grads = grad_fn(params, jnp.asarray(img_np), jnp.asarray(noise_np))
+        params = jax.tree_util.tree_map(lambda q, g: q - args.lr * g, params, grads)
+        jax_losses.append(float(loss))
+        print(f"jax   step {len(jax_losses):3d} loss {jax_losses[-1]:.6f}",
+              flush=True)
+
+    rel = np.max(np.abs(np.array(jax_losses) - np.array(torch_losses))
+                 / np.array(torch_losses))
+    record = {
+        "config": {"dim": args.dim, "levels": args.levels,
+                   "image_size": args.image_size, "patch_size": args.patch_size,
+                   "iters": args.iters, "timestep": args.timestep,
+                   "batch": args.batch, "lr": args.lr, "steps": args.steps},
+        "torch_losses": torch_losses,
+        "jax_losses": jax_losses,
+        "max_rel_diff": float(rel),
+        "rtol": args.rtol,
+    }
+    os.makedirs(os.path.dirname(args.out_prefix) or ".", exist_ok=True)
+    with open(args.out_prefix + ".json", "w") as f:
+        json.dump(record, f, indent=1)
+
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots(figsize=(6, 4))
+        steps = np.arange(1, args.steps + 1)
+        ax.plot(steps, torch_losses, "o-", label="torch reference", alpha=0.7)
+        ax.plot(steps, jax_losses, "x--", label="glom_tpu", alpha=0.9)
+        ax.set_xlabel("SGD step")
+        ax.set_ylabel("denoise MSE loss")
+        ax.set_title(f"loss-curve parity, dim={args.dim} L={args.levels} "
+                     f"{args.image_size}px (max rel diff {rel:.1e})")
+        ax.legend()
+        fig.tight_layout()
+        fig.savefig(args.out_prefix + ".png", dpi=120)
+        print(f"wrote {args.out_prefix}.png")
+    except ImportError:
+        print("matplotlib unavailable — JSON only")
+
+    np.testing.assert_allclose(jax_losses, torch_losses, rtol=args.rtol)
+    print(f"PARITY OK: {args.steps} steps, max rel diff {rel:.2e} "
+          f"(rtol {args.rtol})")
+
+
+if __name__ == "__main__":
+    main()

@@ -21,7 +21,7 @@ docstring for status.
 from glom_tpu.config import GlomConfig, TrainConfig
 from glom_tpu.models.shim import Glom
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 __all__ = ["Glom", "GlomConfig", "TrainConfig", "Trainer", "__version__"]
 

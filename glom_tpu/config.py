@@ -146,6 +146,13 @@ class TrainConfig:
     consistency_weight: float = 0.1
     consistency_temperature: float = 0.1
     consistency_level: int = -1          # which level to regularize
+    # decoder head for the reconstruction loss: "linear" is the reference
+    # recipe (README.md:78-84, single Linear on ONE level — the parity
+    # default); "mlp" / "linear_all" / "mlp_all" strengthen only the decode
+    # path (2-layer gelu MLP and/or all-levels-concat input) for the
+    # 18 dB decoder-bottleneck A/B (BASELINE.md round-4 diagnosis)
+    decoder: str = "linear"
+    decoder_hidden_mult: int = 2         # mlp hidden = mult * dim
     steps: int = 100
     log_every: int = 10
     eval_every: int = 0              # 0 => disabled; logs denoise PSNR
@@ -209,6 +216,16 @@ class TrainConfig:
         if self.stop_poll_steps < 1:
             raise ValueError(
                 f"stop_poll_steps must be >= 1, got {self.stop_poll_steps}"
+            )
+        from glom_tpu.models.heads import DECODER_ARCHS
+
+        if self.decoder not in DECODER_ARCHS:
+            raise ValueError(
+                f"unknown decoder arch {self.decoder!r}; one of {DECODER_ARCHS}"
+            )
+        if self.decoder_hidden_mult < 1:
+            raise ValueError(
+                f"decoder_hidden_mult must be >= 1, got {self.decoder_hidden_mult}"
             )
 
     def to_json_dict(self) -> dict:

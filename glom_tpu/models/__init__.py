@@ -1,5 +1,11 @@
 from glom_tpu.models.glom import init, apply, param_count, make_consensus_fn
-from glom_tpu.models.heads import patches_to_images_init, patches_to_images_apply
+from glom_tpu.models.heads import (
+    DECODER_ARCHS,
+    decoder_apply,
+    decoder_init,
+    patches_to_images_apply,
+    patches_to_images_init,
+)
 from glom_tpu.models.shim import Glom
 
 __all__ = [
@@ -9,5 +15,8 @@ __all__ = [
     "make_consensus_fn",
     "patches_to_images_init",
     "patches_to_images_apply",
+    "DECODER_ARCHS",
+    "decoder_init",
+    "decoder_apply",
     "Glom",
 ]

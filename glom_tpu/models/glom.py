@@ -215,26 +215,58 @@ def resolve_locality_mask(config: GlomConfig) -> Optional[jax.Array]:
     return None
 
 
+# Measured dense→pallas crossover per TPU generation: at n <= entry the XLA
+# fused-softmax dense consensus matches or beats the flash kernel, above it
+# the Pallas kernel wins.  One row per generation, each with its measurement
+# provenance; ``tools/crossover.py`` re-measures and prints the row for the
+# chip it runs on (tools/hw_sweep.sh runs it every full sweep).
+ATTENTION_CROSSOVER_N = {
+    # v5e: BASELINE.md round-2 window (one chip via the axon tunnel) —
+    # n=256: dense 255.6 vs pallas 253.4 imgs/sec/chip; n=576: pallas wins
+    "v5e": 256,
+}
+# generations with no measured row fall back to the v5e value, with a
+# warning naming the re-measurement tool
+_CROSSOVER_FALLBACK_N = 256
+
+
 def make_consensus_fn(config: GlomConfig):
     """Resolve the attention implementation: XLA-dense (always-correct path),
     Pallas fused kernel, or ring-sharded — all numerically interchangeable.
 
-    ``"auto"`` picks by measurement (BASELINE.md round-2): at n<=256 XLA's
-    fused softmax already matches the flash kernel (255.6 vs 253.4
-    imgs/sec/chip), while at n=576 the flash kernel wins — so: Pallas on a
-    TPU backend when ``num_patches > 256``, dense otherwise (incl. every
-    non-TPU backend, where pltpu kernels don't lower)."""
+    ``"auto"`` picks by measurement: Pallas on a TPU backend when
+    ``num_patches`` exceeds the generation's measured crossover
+    (:data:`ATTENTION_CROSSOVER_N`), dense otherwise (incl. every non-TPU
+    backend, where pltpu kernels don't lower).  An unmeasured generation
+    warns and uses the v5e fallback."""
     mask = resolve_locality_mask(config)
 
     impl = config.attention_impl
     if impl == "auto":
         from glom_tpu.kernels.consensus_pallas import supports_n
-        from glom_tpu.parallel.mesh import default_backend_is_tpu
+        from glom_tpu.parallel.mesh import default_backend_is_tpu, tpu_generation
 
+        on_tpu = default_backend_is_tpu()
+        crossover = _CROSSOVER_FALLBACK_N
+        if on_tpu:
+            gen = tpu_generation()
+            if gen in ATTENTION_CROSSOVER_N:
+                crossover = ATTENTION_CROSSOVER_N[gen]
+            else:
+                import warnings
+
+                warnings.warn(
+                    f"attention_impl='auto': no measured dense/pallas "
+                    f"crossover for TPU generation {gen!r} — using "
+                    f"n>{_CROSSOVER_FALLBACK_N} from v5e; run "
+                    f"tools/crossover.py on this chip and add the row to "
+                    f"glom_tpu.models.glom.ATTENTION_CROSSOVER_N",
+                    stacklevel=2,
+                )
         impl = (
             "pallas"
-            if config.num_patches > 256 and supports_n(config.num_patches)
-            and default_backend_is_tpu()
+            if config.num_patches > crossover and supports_n(config.num_patches)
+            and on_tpu
             else "dense"
         )
         config = dataclasses.replace(config, attention_impl=impl)

@@ -29,3 +29,66 @@ def patches_to_images_apply(params: dict, tokens: jax.Array, config: GlomConfig)
     """``(b, n, dim) -> (b, c, H, W)`` reconstruction (`README.md:78-84`)."""
     patches = tokens @ params["w"] + params["b"]
     return unpatchify(patches, config.patch_size, config.image_size, config.channels)
+
+
+# The decoder-strength ladder for the 18 dB "decoder bottleneck" A/B
+# (BASELINE.md round-4 diagnosis: PSNR pins at ~18 dB while the probe keeps
+# improving — asserted to be the single-Linear top-level head saturating,
+# here made falsifiable).  "linear" is the reference head above and the
+# default everywhere; the others strengthen ONLY the decode path:
+#   mlp        — 2-layer MLP (gelu), top level only
+#   linear_all — Linear over the concat of all L levels
+#   mlp_all    — 2-layer MLP over the concat of all L levels
+DECODER_ARCHS = ("linear", "mlp", "linear_all", "mlp_all")
+
+
+def _linear_init(rng: jax.Array, fan_in: int, fan_out: int, dtype) -> dict:
+    """torch nn.Linear default: U(-1/sqrt(fan_in), 1/sqrt(fan_in))."""
+    kw, kb = jax.random.split(rng)
+    bound = fan_in ** -0.5
+    return {
+        "w": jax.random.uniform(kw, (fan_in, fan_out), dtype, -bound, bound),
+        "b": jax.random.uniform(kb, (fan_out,), dtype, -bound, bound),
+    }
+
+
+def decoder_init(
+    rng: jax.Array, config: GlomConfig, *, arch: str = "linear",
+    hidden_mult: int = 2, dtype=jnp.float32,
+) -> dict:
+    """Params for a :data:`DECODER_ARCHS` head.  ``arch='linear'`` is
+    byte-identical to :func:`patches_to_images_init` (reference parity)."""
+    if arch == "linear":
+        return patches_to_images_init(rng, config, dtype)
+    in_dim = config.dim * (config.levels if arch.endswith("_all") else 1)
+    if arch == "linear_all":
+        return _linear_init(rng, in_dim, config.patch_dim, dtype)
+    if arch in ("mlp", "mlp_all"):
+        k1, k2 = jax.random.split(rng)
+        hidden = hidden_mult * config.dim
+        l1 = _linear_init(k1, in_dim, hidden, dtype)
+        l2 = _linear_init(k2, hidden, config.patch_dim, dtype)
+        return {"w1": l1["w"], "b1": l1["b"], "w2": l2["w"], "b2": l2["b"]}
+    raise ValueError(f"unknown decoder arch {arch!r}; one of {DECODER_ARCHS}")
+
+
+def decoder_apply(
+    params: dict, state: jax.Array, config: GlomConfig, *,
+    arch: str = "linear", level: int = -1,
+) -> jax.Array:
+    """``(b, n, L, dim) level state -> (b, c, H, W)`` reconstruction.
+    Selects ``level`` (or concatenates all levels for ``*_all``) and decodes
+    per ``arch``; ``arch='linear'`` reproduces the reference recipe's
+    ``all_levels[..., level]`` + Linear exactly."""
+    if arch.endswith("_all"):
+        b, n = state.shape[:2]
+        tokens = state.reshape(b, n, config.levels * config.dim)
+    else:
+        tokens = state[:, :, level]
+    if arch in ("linear", "linear_all"):
+        # the ONE definition of the reference decode path
+        return patches_to_images_apply(params, tokens, config)
+    # exact-erf gelu, matching the model FFs (ops/feedforward.py)
+    h = jax.nn.gelu(tokens @ params["w1"] + params["b1"], approximate=False)
+    patches = h @ params["w2"] + params["b2"]
+    return unpatchify(patches, config.patch_size, config.image_size, config.channels)

@@ -28,6 +28,29 @@ def is_tpu_device(d: jax.Device) -> bool:
     return d.platform == "tpu" or "TPU" in (d.device_kind or "").upper()
 
 
+def tpu_generation(d: Optional[jax.Device] = None) -> Optional[str]:
+    """Normalized TPU generation of ``d`` (default: the default device) —
+    'v4', 'v5e', 'v5p', 'v6e', ... — or None off-TPU / unparseable.
+
+    Parsed from ``device_kind`` ('TPU v4', 'TPU v5 lite0', 'TPU v5e',
+    'TPU v5p', ...): 'lite' marks the e-variant ('v5 lite' == v5e).  The
+    ONE parser behind every per-generation lookup (attention crossover
+    table, MFU peak-TFLOPs) so generation naming cannot drift."""
+    import re
+
+    if d is None:
+        dev = jax.config.jax_default_device
+        d = dev if dev is not None else jax.devices()[0]
+    if not is_tpu_device(d):
+        return None
+    kind = (d.device_kind or "").lower()
+    m = re.search(r"v(\d+)\s*(lite|[ep])?", kind)
+    if not m:
+        return None
+    suffix = {"lite": "e", "e": "e", "p": "p", None: ""}[m.group(2)]
+    return f"v{m.group(1)}{suffix}"
+
+
 def default_backend_is_tpu() -> bool:
     """True when computations will run on a TPU by default — respects an
     active ``jax.default_device`` context (a user jitting to CPU for

@@ -22,7 +22,7 @@ import optax
 
 from glom_tpu.config import GlomConfig, TrainConfig
 from glom_tpu.models import glom as glom_model
-from glom_tpu.models.heads import patches_to_images_apply, patches_to_images_init
+from glom_tpu.models.heads import decoder_apply, decoder_init
 
 
 class DenoiseState(NamedTuple):
@@ -35,12 +35,18 @@ class DenoiseState(NamedTuple):
 
 
 def init_state(
-    rng: jax.Array, config: GlomConfig, tx: optax.GradientTransformation
+    rng: jax.Array, config: GlomConfig, tx: optax.GradientTransformation,
+    *, decoder: str = "linear", decoder_hidden_mult: int = 2,
 ) -> DenoiseState:
+    """``decoder``/``decoder_hidden_mult`` mirror the TrainConfig fields;
+    the 'linear' default is the reference head (README.md:78-84)."""
     k_glom, k_dec, k_train = jax.random.split(rng, 3)
     params = {
         "glom": glom_model.init(k_glom, config),
-        "decoder": patches_to_images_init(k_dec, config, config.param_dtype),
+        "decoder": decoder_init(
+            k_dec, config, arch=decoder, hidden_mult=decoder_hidden_mult,
+            dtype=config.param_dtype,
+        ),
     }
     return DenoiseState(params, tx.init(params), jnp.zeros((), jnp.int32), k_train)
 
@@ -97,8 +103,12 @@ def make_loss_fn(config: GlomConfig, train: TrainConfig, *, consensus_fn=None,
                 capture_timestep=timestep, consensus_fn=consensus_fn, ff_fn=ff_fn,
                 state_sharding=state_sharding,
             )
-        tokens = captured[:b, :, train.loss_level]  # (b, n, d)
-        recon = patches_to_images_apply(params["decoder"], tokens, config)
+        # level selection (reference: all_levels[t][..., -1]) + decode live
+        # in decoder_apply; arch='linear' is the exact reference recipe
+        recon = decoder_apply(
+            params["decoder"], captured[:b], config,
+            arch=train.decoder, level=train.loss_level,
+        )
         # accumulate the loss in AT LEAST fp32 (bf16 compute upcasts; f64
         # params keep f64 — matters for finite-difference grad checks)
         acc_dt = jnp.promote_types(recon.dtype, jnp.float32)
@@ -224,7 +234,16 @@ def load_checkpoint_params(directory: str):
     from glom_tpu import checkpoint as ckpt_lib
 
     with open(os.path.join(directory, "config.json")) as f:
-        config = GlomConfig.from_json_dict(json.load(f)["glom"])
-    template = init_state(jax.random.PRNGKey(0), config, optax.sgd(0.0))
+        payload = json.load(f)
+    config = GlomConfig.from_json_dict(payload["glom"])
+    # the decoder arch changes the saved param tree — the template must
+    # match what the trainer actually wrote (train config is informational
+    # but authoritative for this)
+    tcfg = payload.get("train") or {}
+    template = init_state(
+        jax.random.PRNGKey(0), config, optax.sgd(0.0),
+        decoder=tcfg.get("decoder", "linear"),
+        decoder_hidden_mult=tcfg.get("decoder_hidden_mult", 2),
+    )
     step, trees = ckpt_lib.restore(directory, {"params": template.params})
     return step, config, trees["params"]["glom"]

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 from glom_tpu.config import GlomConfig
 from glom_tpu.models import glom as glom_model
-from glom_tpu.models.heads import patches_to_images_apply
+from glom_tpu.models.heads import decoder_apply
 
 
 def embed_levels(
@@ -129,6 +129,7 @@ def make_psnr_fn(
     consensus_fn=None,
     ff_fn=None,
     state_sharding=None,
+    decoder: str = "linear",
 ):
     """Build the pure, jittable eval twin of the denoising objective:
     ``(params, imgs, rng) -> psnr_db`` scalar.  ``consensus_fn`` threads the
@@ -146,8 +147,8 @@ def make_psnr_fn(
             capture_timestep=timestep, consensus_fn=consensus_fn, ff_fn=ff_fn,
             state_sharding=state_sharding,
         )
-        recon = patches_to_images_apply(
-            params["decoder"], captured[:, :, level], config
+        recon = decoder_apply(
+            params["decoder"], captured, config, arch=decoder, level=level
         )
         mse = jnp.mean((recon.astype(jnp.float32) - imgs.astype(jnp.float32)) ** 2)
         return 20.0 * jnp.log10(data_range) - 10.0 * jnp.log10(mse)
@@ -190,6 +191,7 @@ class EvalSuite:
         chunk: int = 32,
         consensus_fn=None,
         ff_fn=None,
+        decoder: str = "linear",
     ):
         import numpy as np
 
@@ -199,6 +201,7 @@ class EvalSuite:
         self._psnr = jax.jit(make_psnr_fn(
             config, noise_std=noise_std, iters=iters, timestep=timestep,
             level=level, consensus_fn=consensus_fn, ff_fn=ff_fn,
+            decoder=decoder,
         ))
         self._level = level
         self._embed = jax.jit(functools.partial(

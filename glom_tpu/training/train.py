@@ -74,6 +74,14 @@ def parse_args(argv=None):
     p.add_argument("--consistency-weight", type=float, default=0.1)
     p.add_argument("--consistency-temperature", type=float, default=0.1)
     p.add_argument("--consistency-level", type=int, default=-1)
+    p.add_argument("--decoder", default="linear",
+                   choices=["linear", "mlp", "linear_all", "mlp_all"],
+                   help="reconstruction head: 'linear' = the reference "
+                        "recipe (one Linear on one level); the others "
+                        "strengthen only the decode path (decoder-"
+                        "bottleneck A/B)")
+    p.add_argument("--decoder-hidden-mult", type=int, default=2,
+                   help="mlp decoder hidden width = mult * dim")
     # data
     p.add_argument("--data", default="synthetic",
                    choices=["synthetic", "folder", "images"],
@@ -162,6 +170,8 @@ def main(argv=None):
         consistency_weight=args.consistency_weight,
         consistency_temperature=args.consistency_temperature,
         consistency_level=args.consistency_level,
+        decoder=args.decoder,
+        decoder_hidden_mult=args.decoder_hidden_mult,
         steps=args.steps,
         log_every=args.log_every,
         stop_poll_steps=args.stop_poll_steps,
@@ -230,6 +240,7 @@ def main(argv=None):
             timestep=args.loss_timestep,  # PSNR scores the trained state
             chunk=min(args.batch_size, len(eval_imgs)),
             consensus_fn=trainer._consensus_fn, ff_fn=trainer._ff_fn,
+            decoder=args.decoder,
             **probe_kwargs,
         ))
     final = trainer.fit(batches)

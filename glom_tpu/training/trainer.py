@@ -28,7 +28,12 @@ from glom_tpu.training import denoise
 from glom_tpu.training.metrics import MetricLogger
 
 
-def _decoder_specs() -> dict:
+def _decoder_specs(arch: str = "linear") -> dict:
+    """Replicated specs matching heads.decoder_init's tree for ``arch``
+    (the decoder is tiny; it never shards)."""
+    if arch in ("mlp", "mlp_all"):
+        return {"w1": P(None, None), "b1": P(None),
+                "w2": P(None, None), "b2": P(None)}
     return {"w": P(None, None), "b": P(None)}
 
 
@@ -146,15 +151,20 @@ class Trainer:
             glom_specs = jax.tree_util.tree_map(
                 lambda _: P(), param_pspecs(config), is_leaf=lambda x: isinstance(x, P)
             )
-        spec_tree = {"glom": glom_specs, "decoder": _decoder_specs()}
+        spec_tree = {"glom": glom_specs, "decoder": _decoder_specs(train.decoder)}
         rng = jax.random.PRNGKey(train.seed)
-        abstract = jax.eval_shape(lambda: denoise.init_state(rng, config, tx))
+
+        def _init():
+            return denoise.init_state(
+                rng, config, tx, decoder=train.decoder,
+                decoder_hidden_mult=train.decoder_hidden_mult,
+            )
+
+        abstract = jax.eval_shape(_init)
         self._state_sh = state_shardings(self.mesh, abstract, spec_tree)
         self._batch_sh = NamedSharding(self.mesh, batch_pspec(data_axis))
 
-        init_fn = jax.jit(
-            lambda: denoise.init_state(rng, config, tx), out_shardings=self._state_sh
-        )
+        init_fn = jax.jit(_init, out_shardings=self._state_sh)
         self.state = init_fn()
 
         ff_fn = None
@@ -217,7 +227,7 @@ class Trainer:
                     config, noise_std=train.noise_std, iters=train.iters,
                     timestep=train.loss_timestep, level=train.loss_level,
                     consensus_fn=consensus_fn, ff_fn=ff_fn,
-                    state_sharding=act_sh,
+                    state_sharding=act_sh, decoder=train.decoder,
                 )
             )
 

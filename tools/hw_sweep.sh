@@ -53,11 +53,17 @@ if [ $rc -ne 0 ]; then
 fi
 
 if [ "$QUICK" = "1" ]; then
+  # Order set by tools/rank_levers.py (BASELINE.md round-5 predicted-deltas
+  # table): remat-dots and no-remat are the only levers that cut executed
+  # FLOPs (0.872x / 0.865x); scan-unroll is a predicted 3-7x LOSER under
+  # remat=full (the unrolled body rematerializes wholesale) and is demoted
+  # to the FULL sweep for calibration only.  fused-ff-bwd is kernel-opaque
+  # to the cost model — stays on round-2 evidence.
   run                                  # auto: pallas FF fwd on TPU — the record
   run --ff-impl pallas --fused-ff-bwd
+  run --remat-policy dots --ff-impl pallas --fused-ff-bwd
   run --no-remat --ff-impl pallas
   run --batch-size 64 --ff-impl pallas --fused-ff-bwd
-  run --scan-unroll 7 --ff-impl pallas
   run --ff-impl pallas --profile-dir /tmp/glom_trace
   best=$(best_rate)
   if [ -n "${best:-}" ]; then
@@ -94,6 +100,15 @@ run --config large --ff-impl pallas --attention-impl pallas --no-remat
 run --config large --ff-impl pallas --attention-impl pallas --scan-unroll 2
 run --config large --ff-impl pallas --attention-impl auto   # auto => pallas at n=576
 run --attention-impl auto                                   # auto => dense at n=256
+
+# dense/pallas attention crossover on THIS chip generation (feeds the
+# per-generation table in glom_tpu.models.glom.ATTENTION_CROSSOVER_N —
+# the printed row says whether the committed entry needs updating)
+echo "=== $(date -u +%FT%TZ) attention crossover" | tee -a "$LOG"
+timeout 900 python tools/crossover.py 2>&1 | tee -a "$LOG"
+if [ "${PIPESTATUS[0]}" -ne 0 ]; then
+  echo "!! crossover rc=${PIPESTATUS[0]}" | tee -a "$LOG"; FAILS=$((FAILS + 1))
+fi
 
 # real-data input path (VERDICT r2 item 6): generated shapes dataset through
 # ImageFolderStream; native C++ decode vs the python thread pool vs synthetic.

@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-5 plateau + decoder-A/B legs (VERDICT r4 next-round items 4 & 5),
+# in strict value order so a clipped session still banks the essentials:
+#   1-2. seeds 1 and 2 of the infonce+noise0.5 combo (round-4 ran seed 0
+#        only — docs/runs/plateau_nce_noise05.jsonl)
+#   3.   decoder-bottleneck A/B: the strongest config-gated decoder
+#        (mlp_all) under the otherwise-identical plateau protocol; the
+#        linear control is the committed plateau_base.jsonl
+#   4-5. the two round-4 legs that timed out before step 600 (cons_mse
+#        @~400, cons_nce @~434), re-run under the raised 7000s budget
+# Serial: everything shares the single host core, and interleaved legs
+# would double every step time without finishing anything sooner.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+. tools/plateau_common.sh
+LOG=tools/plateau_sweep.log
+
+ensure_dataset | tee -a "$LOG" || { echo "!! dataset generation failed" | tee -a "$LOG"; exit 1; }
+
+fails=0
+run_leg() {
+  out=$1; shift
+  echo "=== $(date -u +%FT%TZ) r5 leg $out: $*" | tee -a "$LOG"
+  rm -f "$OUT/${out}.jsonl"
+  timeout 7000 python -m glom_tpu.training.train \
+    "${PLATEAU_FLAGS[@]}" \
+    --log-file "$OUT/${out}.jsonl" "$@" 2>&1 | tail -2 | tee -a "$LOG"
+  rc=$?
+  if [ $rc -ne 0 ]; then
+    echo "!! r5 leg $out rc=$rc" | tee -a "$LOG"
+    fails=$((fails + 1))
+  fi
+}
+
+COMBO="--lr 3e-4 --consistency infonce --consistency-weight 0.1 --noise-std 0.5"
+run_leg plateau_nce_noise05_s1 --seed 1 $COMBO
+run_leg plateau_nce_noise05_s2 --seed 2 $COMBO
+run_leg plateau_dec_mlp_all --lr 3e-4 --decoder mlp_all
+run_leg plateau_cons_mse --lr 3e-4 --consistency mse --consistency-weight 0.1
+run_leg plateau_cons_nce --lr 3e-4 --consistency infonce --consistency-weight 0.1
+
+echo "=== $(date -u +%FT%TZ) r5 plateau legs done ($fails failed)" | tee -a "$LOG"
+exit "$fails"

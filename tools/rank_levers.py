@@ -55,8 +55,10 @@ def main():
 
     import jax
 
-    if jax.default_backend() != "tpu":
-        jax.config.update("jax_platforms", "cpu")
+    # compile-only tool: always CPU.  (Querying the backend to "detect" TPU
+    # would itself initialize the axon plugin and hang on a dead tunnel —
+    # force the platform BEFORE any device query.)
+    jax.config.update("jax_platforms", "cpu")
 
     import jax.numpy as jnp
     import optax
@@ -69,7 +71,8 @@ def main():
     rows = []
     base_flops = base_bytes = None
     for name, c_over, t_over in lever_configs():
-        config = GlomConfig(compute_dtype=jnp.bfloat16, remat=True, **kw, **c_over)
+        config = GlomConfig(compute_dtype=jnp.bfloat16,
+                            **{**kw, "remat": True, **c_over})
         batch = t_over.get("batch_size", tpu_batch)
         train = TrainConfig(batch_size=batch, iters=iters, log_every=0)
         tx = optax.adam(1e-4)

@@ -1,0 +1,32 @@
+#!/bin/bash
+# 128px shapes SSL leg (VERDICT r4 next-round item 6): demonstrate
+# representation learning past toy resolution WITHOUT hardware — same
+# hardened probe protocol as the 64px plateau runs (2000 probe examples,
+# 0.35 holdout), model scaled to 128px (n=256 patch columns, the flagship
+# sequence length).  STEPS env overrides the budget (default 600 = the
+# plateau-leg horizon; raise for an overnight run).
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+LOG=tools/plateau_sweep.log
+DATA=/tmp/shapes128
+STEPS=${STEPS:-600}
+
+python examples/make_shapes_dataset.py --root "$DATA" --per-class 750 \
+  --image-size 128 2>&1 | tail -1 | tee -a "$LOG"
+if [ "${PIPESTATUS[0]}" -ne 0 ]; then
+  echo "!! shapes128 dataset generation failed" | tee -a "$LOG"; exit 1
+fi
+
+echo "=== $(date -u +%FT%TZ) shapes128 SSL ($STEPS steps)" | tee -a "$LOG"
+rm -f docs/runs/shapes128_cpu.jsonl
+timeout "${TIMEOUT:-20000}" python -m glom_tpu.training.train \
+  --platform cpu --data images --data-dir "$DATA" \
+  --dim 128 --levels 4 --image-size 128 --patch-size 8 --iters 8 \
+  --batch-size 16 --steps "$STEPS" --log-every 50 \
+  --lr 3e-4 --consistency infonce --consistency-weight 0.1 \
+  --eval-every 200 --eval-holdout 0.35 \
+  --eval-max-images 2048 --probe-examples 2000 \
+  --log-file docs/runs/shapes128_cpu.jsonl 2>&1 | tail -2 | tee -a "$LOG"
+rc=$?
+[ $rc -ne 0 ] && { echo "!! shapes128 rc=$rc" | tee -a "$LOG"; exit $rc; }
+echo "=== $(date -u +%FT%TZ) shapes128 done" | tee -a "$LOG"
