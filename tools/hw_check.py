@@ -3,7 +3,9 @@
 Run on a real TPU after any kernel change (serialized — this must be the
 only process touching the accelerator).  Exercises the paths that
 interpret-mode CPU tests cannot: Mosaic lowering, sublane/lane tiling,
-scoped-VMEM limits.  Exits non-zero on the first failure.
+scoped-VMEM limits.  Runs the full checklist and classifies failures:
+exit 0 = all green; exit 3 = only fused-FF-backward legs failed (sweep may
+bench the non-fused paths); exit 1 = a baseline path failed.
 
   python tools/hw_check.py            # full checklist
   python tools/hw_check.py --quick    # skip the large config + e2e step
@@ -17,10 +19,42 @@ import sys
 import numpy as np
 
 
-def check(name, fn):
+FAILURES = []  # (name, is_fused_bwd_leg)
+
+
+def check(name, fn, fused_leg=False):
+    """Run one checklist item; record instead of aborting so a single broken
+    kernel doesn't forfeit a whole tunnel window.  Exit codes at the end:
+    0 = all green; 3 = only fused-FF-backward legs failed (the sweep can
+    still bench everything else); 1 = a baseline path failed (benching would
+    record meaningless numbers — abort)."""
     print(f"-- {name} ...", flush=True)
-    fn()
+    try:
+        fn()
+    except Exception as e:  # noqa: BLE001 — signature goes to the log
+        import traceback
+        traceback.print_exc()
+        print(f"   FAIL: {type(e).__name__}", flush=True)
+        FAILURES.append((name, fused_leg))
+        return
     print(f"   ok", flush=True)
+
+
+def finish(*, quick):
+    suffix = " (quick — large + e2e skipped)" if quick else ""
+    if not FAILURES:
+        print(f"ALL HARDWARE CHECKS PASSED{suffix}", flush=True)
+        return
+    for name, fused in FAILURES:
+        kind = "fused-bwd" if fused else "BASELINE"
+        print(f"FAILED [{kind}] {name}", flush=True)
+    if all(fused for _, fused in FAILURES):
+        # exit 3, not 2: argparse uses 2 for usage errors, and the sweep must
+        # never read "bad flag, zero checks ran" as "baseline verified"
+        print("only fused-FF-backward legs failed — baseline paths are "
+              "benchable (exit 3)", flush=True)
+        sys.exit(3)
+    sys.exit(1)
 
 
 def main():
@@ -47,6 +81,27 @@ def main():
 
     tol = dict(atol=2e-2, rtol=2e-2)  # bf16-pass matmuls on TPU fp32 defaults
 
+    def assert_close_scaled(a, b, *, rel_fro=2e-3, elem=2e-2):
+        """Leaf-magnitude-aware A/B comparison for fp32 grads under TPU
+        bf16-pass matmuls.  A uniform atol is miscalibrated across leaves
+        whose magnitudes differ by the reduction length: db1 sums 512 rows,
+        so its elements sit ~20x above dx's and carry ~20x the pass-rounding
+        ulp (first v5e window, 2026-07-31: max|diff| 4.6e-2 on 35/12288 db1
+        elements, i.e. 0.4% of max|db1| — pure reduction noise).  Structured
+        kernel bugs (a dropped/doubled tile) move whole rows by O(50%) and
+        are caught by the relative-Frobenius bound at 2e-3."""
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        fro = np.linalg.norm(a - b) / max(np.linalg.norm(b), 1e-12)
+        if fro > rel_fro:
+            raise AssertionError(f"rel-Frobenius {fro:.3e} > {rel_fro:.1e} "
+                                 f"(shape {a.shape})")
+        cap = elem * max(1.0, float(np.abs(b).max()))
+        worst = float(np.abs(a - b).max())
+        if worst > cap:
+            raise AssertionError(f"max|diff| {worst:.3e} > {cap:.3e} "
+                                 f"(= {elem:.0e} * max|ref|, shape {a.shape})")
+
     # --- fused FF backward vs XLA VJP, flagship shapes ----------------------
     def ff_bwd_ab():
         params = grouped_ff_init(jax.random.PRNGKey(0), dim=512, groups=6, mult=4)
@@ -61,12 +116,9 @@ def main():
 
         fused = jax.jit(lambda: grads(True))()
         ref = jax.jit(lambda: grads(False))()
-        jax.tree_util.tree_map(
-            lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), **tol),
-            fused, ref,
-        )
+        jax.tree_util.tree_map(assert_close_scaled, fused, ref)
 
-    check("fused FF backward A/B (512/6, n=256)", ff_bwd_ab)
+    check("fused FF backward A/B (512/6, n=256)", ff_bwd_ab, fused_leg=True)
 
     # --- bf16 activations at flagship shapes (the training dtype) -----------
     # jax.vjp forces the cotangent dtype to match the output (bf16), so the
@@ -93,7 +145,7 @@ def main():
             fused, ref,
         )
 
-    check("fused FF backward A/B bf16 (512/6, n=256)", ff_bwd_bf16)
+    check("fused FF backward A/B bf16 (512/6, n=256)", ff_bwd_bf16, fused_leg=True)
 
     # --- consensus flash backward vs dense VJP ------------------------------
     def cons_bwd_ab():
@@ -147,43 +199,55 @@ def main():
                 fused, ref,
             )
 
-        check("fused FF backward A/B large (1024/8, n=576, bf16)", ff_bwd_large)
+        check("fused FF backward A/B large (1024/8, n=576, bf16)", ff_bwd_large, fused_leg=True)
 
     if args.quick:
-        print("ALL HARDWARE CHECKS PASSED (quick — large + e2e skipped)", flush=True)
+        finish(quick=True)
         return
 
     # --- end-to-end train step: fused backward inside scan+remat+bf16 -------
     # The default flip is about TRAINING; this exercises the kernels in the
     # exact context the flag enables them (scan body, remat policy, bf16
     # compute, value_and_grad) rather than as standalone VJPs.
-    def e2e_step_ab():
-        import optax
+    import optax
 
-        from glom_tpu.config import GlomConfig, TrainConfig
-        from glom_tpu.training import denoise
+    from glom_tpu.config import GlomConfig, TrainConfig
+    from glom_tpu.training import denoise
 
+    e2e_metrics = {}
+
+    def e2e_step(fused):
         tcfg = TrainConfig(batch_size=2, iters=12, log_every=0)
         tx = optax.adam(1e-4)
         img = np.random.default_rng(0).standard_normal((2, 3, 224, 224)).astype(np.float32)
-        metrics = {}
-        for fused in (False, True):
-            cfg = GlomConfig(compute_dtype=jnp.bfloat16, remat=True,
-                             ff_impl="pallas", ff_fused_bwd=fused)
-            state = denoise.init_state(jax.random.PRNGKey(0), cfg, tx)
-            step = denoise.make_train_step(cfg, tcfg, tx, donate=False)
-            _, m = step(state, img)
-            metrics[fused] = {k: float(v) for k, v in m.items()}
+        cfg = GlomConfig(compute_dtype=jnp.bfloat16, remat=True,
+                         ff_impl="pallas", ff_fused_bwd=fused)
+        state = denoise.init_state(jax.random.PRNGKey(0), cfg, tx)
+        step = denoise.make_train_step(cfg, tcfg, tx, donate=False)
+        _, m = step(state, img)
+        e2e_metrics[fused] = {k: float(v) for k, v in m.items()}
+
+    def e2e_compare():
+        if False not in e2e_metrics:
+            # don't pay the fused compile when there is nothing to compare to
+            raise AssertionError("non-fused e2e leg did not run — no reference")
+        e2e_step(True)
         # identical forward => identical loss; backward differs only in
         # kernel rounding => grad norms must agree tightly
-        np.testing.assert_allclose(metrics[True]["loss"], metrics[False]["loss"],
-                                   rtol=1e-3)
-        np.testing.assert_allclose(metrics[True]["grad_norm"],
-                                   metrics[False]["grad_norm"], rtol=5e-2)
+        np.testing.assert_allclose(e2e_metrics[True]["loss"],
+                                   e2e_metrics[False]["loss"], rtol=1e-3)
+        np.testing.assert_allclose(e2e_metrics[True]["grad_norm"],
+                                   e2e_metrics[False]["grad_norm"], rtol=5e-2)
 
-    check("end-to-end train step A/B, fused vs XLA backward (flagship)", e2e_step_ab)
+    # the non-fused leg exercises the BASELINE backward in the exact training
+    # context (scan+remat+bf16) — a failure there must abort the sweep, so it
+    # is its own baseline-classified check, not part of the fused A/B
+    check("end-to-end train step, XLA backward (flagship)",
+          lambda: e2e_step(False))
+    check("end-to-end train step A/B, fused vs XLA backward (flagship)",
+          e2e_compare, fused_leg=True)
 
-    print("ALL HARDWARE CHECKS PASSED", flush=True)
+    finish(quick=False)
 
 
 if __name__ == "__main__":

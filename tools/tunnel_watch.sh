@@ -51,7 +51,10 @@ while true; do
       QUICK=1 bash tools/hw_sweep.sh >>"$LOG" 2>&1
       rc=$?
       note "QUICK sweep rc=$rc"
-      if [ $rc -eq 0 ]; then
+      # rc=3: all legs benched clean but the fused-bwd kernels were
+      # quarantined by hw_check — the phase is done (retrying cannot fix a
+      # deterministic kernel failure); the quarantine stays visible here
+      if [ $rc -eq 0 ] || [ $rc -eq 3 ]; then
         QUICK_DONE=1
       fi
     fi
@@ -66,7 +69,8 @@ while true; do
       bash tools/hw_sweep.sh >>"$LOG" 2>&1
       frc=$?
       note "FULL sweep rc=$frc"
-      if [ $frc -eq 0 ]; then
+      if [ $frc -eq 0 ] || [ $frc -eq 3 ]; then
+        [ $frc -eq 3 ] && note "NOTE: fused-bwd legs were quarantined (hw_check) — see hw_sweep.log"
         note "QUICK + FULL sweeps complete — watcher exiting (tunnel left free)"
         exit 0
       fi
