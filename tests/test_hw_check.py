@@ -1,0 +1,106 @@
+"""Unit tests for the on-device checklist's host-side logic.
+
+tools/hw_check.py only runs its kernels on a real TPU, but its failure
+classification and tolerance policy decide whether a scarce tunnel window
+is spent benching or aborted — those must not regress silently, so the
+pure-host pieces are tested here on CPU.
+"""
+
+import importlib.util
+import pathlib
+
+import numpy as np
+import pytest
+
+_PATH = pathlib.Path(__file__).resolve().parent.parent / "tools" / "hw_check.py"
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("hw_check_under_test", _PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture()
+def hwc():
+    return _load()
+
+
+def _exit_code(hwc, failures):
+    hwc.FAILURES[:] = failures
+    try:
+        hwc.finish(quick=False)
+        return 0
+    except SystemExit as e:
+        return e.code
+
+
+class TestFailureClassification:
+    def test_all_green_exits_zero(self, hwc):
+        assert _exit_code(hwc, []) == 0
+
+    def test_fused_only_exits_three(self, hwc):
+        # 3, not 2: argparse exits 2 on a bad flag, and the sweep must never
+        # read "usage error, zero checks ran" as "baseline verified"
+        assert _exit_code(hwc, [("a", True)]) == 3
+        assert _exit_code(hwc, [("a", True), ("b", True)]) == 3
+
+    def test_baseline_failure_exits_one(self, hwc):
+        assert _exit_code(hwc, [("a", False)]) == 1
+        assert _exit_code(hwc, [("a", True), ("b", False)]) == 1
+
+    def test_check_records_instead_of_raising(self, hwc):
+        hwc.FAILURES[:] = []
+
+        def boom():
+            raise AssertionError("x")
+
+        hwc.check("leg", boom, fused_leg=True)  # must not raise
+        assert hwc.FAILURES == [("leg", True)]
+        hwc.check("ok-leg", lambda: None)
+        assert hwc.FAILURES == [("leg", True)]
+
+
+class TestScaledTolerance:
+    """assert_close_scaled: accept measured bf16-pass reduction noise,
+    reject structured kernel bugs."""
+
+    def test_accepts_observed_v5e_noise_profile(self, hwc):
+        # reproduce the first-window failure profile: a (6, 2048) leaf of
+        # magnitude ~11 with a handful of elements off by up to 4.6e-2 —
+        # this is what the old uniform atol=2e-2 wrongly rejected
+        rng = np.random.default_rng(0)
+        ref = rng.normal(0.0, 11.0, (6, 2048)).astype(np.float32)
+        got = ref.copy()
+        idx = rng.choice(ref.size, 35, replace=False)
+        got.flat[idx] += rng.uniform(-4.6e-2, 4.6e-2, 35).astype(np.float32)
+        hwc.assert_close_scaled(got, ref)
+
+    def test_rejects_dropped_tile(self, hwc):
+        # a backward kernel that drops one (128-row) accumulation tile of a
+        # 512-row reduction shifts the whole leaf by ~sqrt(128/512) = 50%
+        rng = np.random.default_rng(1)
+        tiles = rng.normal(0.0, 1.0, (4, 6, 2048)).astype(np.float32)
+        ref = tiles.sum(axis=0)
+        got = tiles[:3].sum(axis=0)
+        with pytest.raises(AssertionError, match="rel-Frobenius"):
+            hwc.assert_close_scaled(got, ref)
+
+    def test_rejects_single_large_outlier(self, hwc):
+        # Frobenius alone would average away one badly-wrong element; the
+        # element-wise cap (2e-2 * max|ref|) must catch it
+        rng = np.random.default_rng(2)
+        ref = rng.normal(0.0, 11.0, (6, 2048)).astype(np.float32)
+        got = ref.copy()
+        got[0, 0] += 0.05 * np.abs(ref).max()
+        with pytest.raises(AssertionError, match="max"):
+            hwc.assert_close_scaled(got, ref)
+
+    def test_small_magnitude_leaf_keeps_floor(self, hwc):
+        # leaves with max|ref| < 1 fall back to the absolute floor of 2e-2
+        ref = np.full((8, 8), 1e-3, np.float32)
+        got = ref + 1.9e-2
+        hwc.assert_close_scaled(got, ref, rel_fro=np.inf)
+        with pytest.raises(AssertionError):
+            hwc.assert_close_scaled(ref + 2.5e-2, ref, rel_fro=np.inf)

@@ -22,6 +22,28 @@ import numpy as np
 FAILURES = []  # (name, is_fused_bwd_leg)
 
 
+def assert_close_scaled(a, b, *, rel_fro=2e-3, elem=2e-2):
+    """Leaf-magnitude-aware A/B comparison for fp32 grads under TPU
+    bf16-pass matmuls.  A uniform atol is miscalibrated across leaves
+    whose magnitudes differ by the reduction length: db1 sums 512 rows,
+    so its elements sit ~20x above dx's and carry ~20x the pass-rounding
+    ulp (first v5e window, 2026-07-31: max|diff| 4.6e-2 on 35/12288 db1
+    elements, i.e. 0.4% of max|db1| — pure reduction noise).  Structured
+    kernel bugs (a dropped/doubled tile) move whole rows by O(50%) and
+    are caught by the relative-Frobenius bound at 2e-3."""
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    fro = np.linalg.norm(a - b) / max(np.linalg.norm(b), 1e-12)
+    if fro > rel_fro:
+        raise AssertionError(f"rel-Frobenius {fro:.3e} > {rel_fro:.1e} "
+                             f"(shape {a.shape})")
+    cap = elem * max(1.0, float(np.abs(b).max()))
+    worst = float(np.abs(a - b).max())
+    if worst > cap:
+        raise AssertionError(f"max|diff| {worst:.3e} > {cap:.3e} "
+                             f"(= {elem:.0e} * max|ref|, shape {a.shape})")
+
+
 def check(name, fn, fused_leg=False):
     """Run one checklist item; record instead of aborting so a single broken
     kernel doesn't forfeit a whole tunnel window.  Exit codes at the end:
@@ -80,27 +102,6 @@ def main():
     from glom_tpu.ops.feedforward import grouped_ff_apply, grouped_ff_init
 
     tol = dict(atol=2e-2, rtol=2e-2)  # bf16-pass matmuls on TPU fp32 defaults
-
-    def assert_close_scaled(a, b, *, rel_fro=2e-3, elem=2e-2):
-        """Leaf-magnitude-aware A/B comparison for fp32 grads under TPU
-        bf16-pass matmuls.  A uniform atol is miscalibrated across leaves
-        whose magnitudes differ by the reduction length: db1 sums 512 rows,
-        so its elements sit ~20x above dx's and carry ~20x the pass-rounding
-        ulp (first v5e window, 2026-07-31: max|diff| 4.6e-2 on 35/12288 db1
-        elements, i.e. 0.4% of max|db1| — pure reduction noise).  Structured
-        kernel bugs (a dropped/doubled tile) move whole rows by O(50%) and
-        are caught by the relative-Frobenius bound at 2e-3."""
-        a = np.asarray(a, np.float32)
-        b = np.asarray(b, np.float32)
-        fro = np.linalg.norm(a - b) / max(np.linalg.norm(b), 1e-12)
-        if fro > rel_fro:
-            raise AssertionError(f"rel-Frobenius {fro:.3e} > {rel_fro:.1e} "
-                                 f"(shape {a.shape})")
-        cap = elem * max(1.0, float(np.abs(b).max()))
-        worst = float(np.abs(a - b).max())
-        if worst > cap:
-            raise AssertionError(f"max|diff| {worst:.3e} > {cap:.3e} "
-                                 f"(= {elem:.0e} * max|ref|, shape {a.shape})")
 
     # --- fused FF backward vs XLA VJP, flagship shapes ----------------------
     def ff_bwd_ab():

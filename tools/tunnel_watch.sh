@@ -24,6 +24,24 @@ except OSError:
 EOF
 }
 
+# The local relay accepts TCP even when its far side is wedged (observed
+# 2026-07-31: jax.devices() listed the chip, then every op hung) — a TCP-only
+# probe then spends a full 900s hw_check timeout per poll.  Stage 2 runs ONE
+# tiny device op under a short timeout; only a completed op opens the window.
+op_probe() {
+  timeout 90 python - <<'EOF' >/dev/null 2>&1
+import sys
+import jax, jax.numpy as jnp
+from glom_tpu.parallel.mesh import is_tpu_device
+# a CPU fallback (TPU init failing fast) must NOT open the window — the
+# sweep's hw_check would refuse and the attempt budget would burn for nothing
+if not is_tpu_device(jax.devices()[0]):
+    sys.exit(1)
+x = jnp.ones((8, 128))
+(x @ x.T).sum().block_until_ready()
+EOF
+}
+
 note() { echo "$(date -u +%FT%TZ) $*" | tee -a "$LOG"; }
 
 ATTEMPTS=0
@@ -42,6 +60,12 @@ while true; do
     sleep 5
     if ! probe; then
       note "probe flapped — continuing poll"
+      sleep "$POLL_SECS"
+      continue
+    fi
+    if ! op_probe; then
+      # wedged backend: cheap to detect, not a window, not an attempt
+      note "TCP up but device op hung/failed — backend wedged, continuing poll"
       sleep "$POLL_SECS"
       continue
     fi
