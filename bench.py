@@ -20,6 +20,18 @@ import time
 
 NORTH_STAR_IMGS_PER_SEC_PER_CHIP = 2000.0 / 16.0
 
+# Latest flagship rate this code achieved on real hardware — update together
+# with the BASELINE.md round table whenever a window lands a new record.
+# Quoted by the dead-tunnel error line (only for a default-flags invocation,
+# i.e. the configuration the number was actually measured under).
+LAST_MEASURED_FLAGSHIP = {
+    "value": 282.4,
+    "vs_baseline": 2.26,
+    "when": "2026-07-29 round-2 window, TPU v5e (1 chip)",
+    "config": "ff_impl=pallas (bf16, remat=full, batch 32)",
+    "provenance": "BASELINE.md round-2 table",
+}
+
 
 def main():
     p = argparse.ArgumentParser()
@@ -84,13 +96,30 @@ def main():
             raise SystemExit("--data images needs --data-dir")
 
     def _emit_error(msg):
-        print(json.dumps({
+        rec = {
             "metric": metric,
             "value": 0.0,
             "unit": "imgs/sec/chip",
             "vs_baseline": 0.0,
             "error": msg,
-        }), flush=True)
+        }
+        # a dead tunnel zeroes the capture, but the latest number this code
+        # achieved on hardware is on record — carry it (with provenance) so
+        # the error line still points at measured data.  Only for the
+        # default-flags invocation (the driver's `python bench.py`): a sweep
+        # leg with perf flags describes a different configuration than the
+        # record and must not have the pallas number attributed to it.
+        default_flags = (
+            args.config == "flagship" and args.data == "synthetic"
+            and args.ff_impl in ("auto", "pallas") and not args.fp32
+            and not args.no_remat and args.remat_policy == "full"
+            and not args.fuse_ff and args.scan_unroll == 1
+            and not args.fused_ff_bwd and args.batch_size in (0, 32)
+            and args.attention_impl == "dense"
+        )
+        if default_flags:
+            rec["last_measured"] = LAST_MEASURED_FLAGSHIP
+        print(json.dumps(rec), flush=True)
 
     # Device guard (shared with tools/breakdown.py): retry-poll the relay,
     # then watchdog the single init attempt — a dead or wedged tunnel must
