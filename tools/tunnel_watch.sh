@@ -11,7 +11,12 @@ set -u
 cd "$(dirname "$0")/.."
 LOG=tools/tunnel_watch.log
 POLL_SECS=${POLL_SECS:-45}
-DEADLINE_EPOCH=${DEADLINE_EPOCH:-0}   # 0 = no deadline
+DEADLINE_EPOCH=${DEADLINE_EPOCH:-0}   # 0 = no deadline (gates QUICK starts)
+# FULL is hours of single-client tunnel time; a FULL started just before
+# DEADLINE_EPOCH would still hold the tunnel at the driver's round-end bench
+# capture.  Gate FULL starts separately: default = DEADLINE_EPOCH (old
+# behavior); set earlier so start + ~3h sweep ends before the capture.
+FULL_DEADLINE_EPOCH=${FULL_DEADLINE_EPOCH:-$DEADLINE_EPOCH}
 
 probe() {
   python - <<'EOF'
@@ -54,6 +59,13 @@ while true; do
     note "deadline reached — exiting"
     exit 3
   fi
+  if [ "$QUICK_DONE" = "1" ] && [ "$FULL_DEADLINE_EPOCH" -gt 0 ] \
+     && [ "$(date +%s)" -ge "$FULL_DEADLINE_EPOCH" ]; then
+    # nothing left this watcher may start: QUICK is on record and a FULL
+    # sweep can no longer finish before the round-end bench capture
+    note "QUICK on record, FULL window closed — exiting (tunnel left free)"
+    exit 0
+  fi
   if probe; then
     # Debounce: require two probes 5s apart so a flapping relay doesn't
     # start a sweep that immediately walks into a dead backend.
@@ -88,7 +100,18 @@ while true; do
       note "deadline reached after QUICK phase — exiting (tunnel left free)"
       exit 3
     fi
-    if [ "$QUICK_DONE" = "1" ] && probe; then
+    if [ "$QUICK_DONE" = "1" ] && [ "$FULL_DEADLINE_EPOCH" -gt 0 ] \
+       && [ "$(date +%s)" -ge "$FULL_DEADLINE_EPOCH" ]; then
+      note "QUICK on record, FULL window closed — exiting (tunnel left free)"
+      exit 0
+    fi
+    FULL_OK=1
+    if [ "$FULL_DEADLINE_EPOCH" -gt 0 ] && [ "$(date +%s)" -ge "$FULL_DEADLINE_EPOCH" ]; then
+      # QUICK failed and its retry budget continues below; FULL may no
+      # longer start (it could not finish before the round-end capture)
+      FULL_OK=0
+    fi
+    if [ "$QUICK_DONE" = "1" ] && [ "$FULL_OK" = "1" ] && probe; then
       note "starting FULL sweep"
       bash tools/hw_sweep.sh >>"$LOG" 2>&1
       frc=$?
