@@ -340,9 +340,14 @@ def _bwd(interpret, fused_bwd, res, g):
     if fused_bwd:
         return _backward_fused(x, params, g, interpret=interpret)
     # debug fallback: cotangents via the dense XLA formulation (materializes
-    # the (b, n, g, h) hidden in HBM — kept only for A/B verification)
-    _, vjp = jax.vjp(lambda x_, p_: grouped_ff_apply(p_, x_), x, params)
-    return vjp(g)
+    # the (b, n, g, h) hidden in HBM — kept only for A/B verification).
+    # The dense apply promotes mixed inputs (bf16 x, f32 params -> f32 out)
+    # while the pallas forward returns x.dtype, so the cotangent must be cast
+    # to the inner primal's dtype and dx back to x.dtype.
+    y, vjp = jax.vjp(lambda x_, p_: grouped_ff_apply(p_, x_), x, params)
+    dx, dparams = vjp(g.astype(y.dtype))
+    dparams = jax.tree_util.tree_map(lambda d, p: d.astype(p.dtype), dparams, params)
+    return dx.astype(x.dtype), dparams
 
 
 _ff_pallas.defvjp(_fwd, _bwd)

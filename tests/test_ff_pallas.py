@@ -107,3 +107,32 @@ def test_model_with_pallas_ff_matches_dense():
     out_d = glom_model.apply(params, img, config=c_dense, iters=3)
     out_p = glom_model.apply(params, img, config=c_ff, iters=3)
     np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_d), atol=1e-4)
+
+
+def test_ff_pallas_bwd_mixed_dtype_both_paths():
+    """bf16 activations with f32 params — the training dtype mix.  The dense
+    apply promotes its output to f32 while the pallas forward returns
+    x.dtype, so the XLA-fallback backward must cast the bf16 cotangent up to
+    the inner primal dtype and dx back down (regression: the fallback leg of
+    tools/hw_check.py's bf16 A/B raised at trace time, 2026-07-31 window)."""
+    params = grouped_ff_init(jax.random.PRNGKey(10), dim=16, groups=2, mult=4)
+    x = jax.random.normal(jax.random.PRNGKey(11), (1, 8, 2, 16), jnp.bfloat16)
+    g_out = jax.random.normal(jax.random.PRNGKey(12), x.shape, jnp.bfloat16)
+
+    def run(fused):
+        _, vjp = jax.vjp(
+            lambda x_, p_: grouped_ff_pallas(p_, x_, fused_bwd=fused), x, params
+        )
+        return vjp(g_out)
+
+    fused, fallback = run(True), run(False)
+    for got in (fused, fallback):
+        assert got[0].dtype == jnp.bfloat16
+        assert all(got[1][k].dtype == params[k].dtype for k in params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=0.1, rtol=6e-2,  # bf16 cotangents
+        ),
+        fused, fallback,
+    )

@@ -14,7 +14,10 @@ bench the non-fused paths); exit 1 = a baseline path failed.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 

@@ -9,6 +9,10 @@
 # ordered so an interrupt still leaves the essentials on record.
 set -u
 cd "$(dirname "$0")/.."
+# scripts under tools/ and examples/ put THEIR directory (not the repo root)
+# at sys.path[0] when run as `python tools/x.py`; a fresh container has no
+# editable install, so make the in-tree package importable for every leg
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 LOG=tools/hw_sweep.log
 QUICK=${QUICK:-0}
 FAILS=0   # legs that failed after the hw_check gate; non-zero exit so the

@@ -18,7 +18,10 @@ included); pass the hardware-measured rate from bench.py to get MFU.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 # bf16 peak TFLOP/s per chip (one JAX device).  Sources: public TPU spec
