@@ -25,10 +25,10 @@ NORTH_STAR_IMGS_PER_SEC_PER_CHIP = 2000.0 / 16.0
 # Quoted by the dead-tunnel error line (only for a default-flags invocation,
 # i.e. the configuration the number was actually measured under).
 LAST_MEASURED_FLAGSHIP = {
-    "value": 282.4,
-    "when": "2026-07-29 round-2 window, TPU v5e (1 chip)",
-    "config": "ff_impl=pallas (bf16, remat=full, batch 32)",
-    "provenance": "BASELINE.md round-2 table",
+    "value": 288.6,
+    "when": "2026-07-31 round-5 window, TPU v5e (1 chip)",
+    "config": "ff_impl=pallas (bf16, remat=dots, batch 32)",
+    "provenance": "BASELINE.md round-5 table",
 }  # vs_baseline is derived at emit time from NORTH_STAR_IMGS_PER_SEC_PER_CHIP
 
 
@@ -45,7 +45,7 @@ def main():
     p.add_argument("--fp32", action="store_true", help="disable bf16 compute")
     p.add_argument("--no-remat", action="store_true",
                    help="disable scan-body rematerialization (needs small batch)")
-    p.add_argument("--remat-policy", default="full", choices=["full", "dots"])
+    p.add_argument("--remat-policy", default="dots", choices=["full", "dots"])
     p.add_argument("--fuse-ff", action="store_true",
                    help="run bottom_up+top_down as one 2L-1-group call")
     p.add_argument("--scan-unroll", type=int, default=1,
@@ -219,11 +219,18 @@ def main():
         except Exception as e:  # tracing must never cost the number of record
             print(f"# trace failed ({type(e).__name__}: {e})", flush=True)
 
-    t0 = time.time()
-    for _ in range(args.steps):
-        state, metrics = trainer._step(state, next_img())
-    jax.block_until_ready(state.params)
-    dt = time.time() - t0
+    def timed_window():
+        # monotonic, not wall clock: an NTP step during the window corrupts
+        # time.time() deltas (observed 2026-07-31: batch-128 leg printed an
+        # impossible 510k imgs/sec between two sane legs)
+        t0 = time.monotonic()
+        nonlocal_state = state
+        for _ in range(args.steps):
+            nonlocal_state, _m = trainer._step(nonlocal_state, next_img())
+        jax.block_until_ready(nonlocal_state.params)
+        return time.monotonic() - t0, nonlocal_state
+
+    dt, state = timed_window()
 
     imgs_per_sec = batch * args.steps / dt
     per_chip = imgs_per_sec / jax.device_count()
@@ -236,12 +243,22 @@ def main():
 
     flagship_cost = rel_cost(GlomConfig(), 12)
     target = NORTH_STAR_IMGS_PER_SEC_PER_CHIP * flagship_cost / rel_cost(config, iters)
+    if per_chip > 20 * target:
+        # physically implausible (>20x the FLOP-scaled north star): a timing
+        # fault, not a measurement — re-measure once before giving up
+        dt, state = timed_window()
+        imgs_per_sec = batch * args.steps / dt
+        per_chip = imgs_per_sec / jax.device_count()
     result = {
         "metric": metric,
         "value": round(per_chip, 2),
         "unit": "imgs/sec/chip",
         "vs_baseline": round(per_chip / target, 3),
     }
+    if per_chip > 20 * target:
+        result.update(value=0.0, vs_baseline=0.0,
+                      error=f"implausible rate {per_chip:.0f} imgs/s/chip after "
+                            "re-measure (>20x scaled target) — timing fault")
     print(json.dumps(result))
 
 

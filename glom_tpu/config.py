@@ -38,8 +38,12 @@ class GlomConfig:
     remat: bool = False                         # jax.checkpoint the scan body
     # what the scan-body checkpoint SAVES: "full" saves nothing (recompute
     # everything in backward — min memory, max recompute) vs "dots" saves
-    # matmul outputs (recompute only elementwise — more memory, less FLOPs)
-    remat_policy: str = "full"      # "full" | "dots"
+    # matmul outputs (recompute only elementwise — more memory, less FLOPs).
+    # Default "dots": measured best on v5e flagship train (288.6 vs 282.3
+    # imgs/sec/chip, 2026-07-31 window; the offline cost-model rank's #1
+    # pick).  no-remat loses to it (278.7 — the step is HBM-bound; BASELINE.md
+    # round-5).  Use "full" when activation memory is the binding constraint.
+    remat_policy: str = "dots"      # "full" | "dots"
     attention_impl: str = "dense"   # "auto" | "dense" | "pallas" | "ring" | "ulysses"
     # ("auto": pallas on TPU when num_patches > 256 — the measured crossover —
     #  else dense; resolved at make_consensus_fn time)
@@ -52,7 +56,10 @@ class GlomConfig:
     ff_fused_bwd: bool = False
     # run bottom_up and top_down as ONE grouped call of 2L-1 groups per
     # iteration (weights concatenated once per step, outside the scan):
-    # halves the batched-GEMM / pallas dispatches on the FF hot path
+    # halves the batched-GEMM / pallas dispatches on the FF hot path.
+    # Measured LOSS on v5e flagship train (268.6 vs 282.3 imgs/sec/chip,
+    # 2026-07-31 window) — XLA already overlaps the two grouped calls, and
+    # the concat adds copies; stays False on evidence (BASELINE.md round-5)
     fuse_ff: bool = False
     # lax.scan unroll factor for the iteration loop: >1 lets XLA fuse and
     # overlap across iteration boundaries at the cost of a bigger program

@@ -28,7 +28,7 @@ def parse_args(argv=None):
     p.add_argument("--local-consensus-radius", type=int, default=0)
     p.add_argument("--bf16", action="store_true", help="bf16 compute (params stay fp32)")
     p.add_argument("--remat", action="store_true")
-    p.add_argument("--remat-policy", default="full", choices=["full", "dots"],
+    p.add_argument("--remat-policy", default="dots", choices=["full", "dots"],
                    help="what the scan-body checkpoint saves (dots = keep "
                         "matmul outputs, recompute only elementwise)")
     p.add_argument("--attention-impl", default="dense", choices=["auto", "dense", "pallas", "ring", "ulysses"])

@@ -48,6 +48,9 @@ HC_ARGS=""
 [ "$QUICK" = "1" ] && HC_ARGS="--quick"
 hc=$(timeout 900 python tools/hw_check.py $HC_ARGS 2>&1)
 rc=$?
+# full output to its own file — a tail-truncated failure signature cost the
+# 06:38 window the fp32 leg's actual traceback
+printf '%s\n' "$hc" > tools/hw_check_last.txt
 echo "$hc" | tail -3 | tee -a "$LOG"
 FUSED_OK=1
 if [ $rc -eq 3 ]; then
@@ -95,8 +98,9 @@ if [ "$QUICK" = "1" ]; then
   best=$(best_rate)
   if [ -n "${best:-}" ]; then
     python tools/mfu.py --imgs-per-sec "$best" 2>&1 | tee -a "$LOG"
-    if [ "${PIPESTATUS[0]}" -ne 0 ]; then
-      echo "!! mfu rc=${PIPESTATUS[0]}" | tee -a "$LOG"; FAILS=$((FAILS + 1))
+    prc=${PIPESTATUS[0]}   # the [ ] test itself resets PIPESTATUS
+    if [ "$prc" -ne 0 ]; then
+      echo "!! mfu rc=$prc" | tee -a "$LOG"; FAILS=$((FAILS + 1))
     fi
   fi
   echo "=== $(date -u +%FT%TZ) QUICK sweep done (failed legs: $FAILS, fused_ok: $FUSED_OK)" | tee -a "$LOG"
@@ -133,9 +137,10 @@ run --attention-impl auto                                   # auto => dense at n
 # per-generation table in glom_tpu.models.glom.ATTENTION_CROSSOVER_N —
 # the printed row says whether the committed entry needs updating)
 echo "=== $(date -u +%FT%TZ) attention crossover" | tee -a "$LOG"
-timeout 900 python tools/crossover.py 2>&1 | tee -a "$LOG"
-if [ "${PIPESTATUS[0]}" -ne 0 ]; then
-  echo "!! crossover rc=${PIPESTATUS[0]}" | tee -a "$LOG"; FAILS=$((FAILS + 1))
+timeout 2700 python tools/crossover.py 2>&1 | tee -a "$LOG"
+prc=${PIPESTATUS[0]}   # the [ ] test itself resets PIPESTATUS
+if [ "$prc" -ne 0 ]; then
+  echo "!! crossover rc=$prc" | tee -a "$LOG"; FAILS=$((FAILS + 1))
 fi
 
 # real-data input path (VERDICT r2 item 6): generated shapes dataset through
@@ -143,8 +148,9 @@ fi
 # generate() skips existing files, so this is a no-op when already complete
 # and repairs a partially generated dataset.
 python examples/make_shapes_dataset.py --root /tmp/shapes224 --per-class 250 --image-size 224 | tee -a "$LOG"
-if [ "${PIPESTATUS[0]}" -ne 0 ]; then
-  echo "!! make_shapes_dataset rc=${PIPESTATUS[0]}" | tee -a "$LOG"; FAILS=$((FAILS + 1))
+prc=${PIPESTATUS[0]}   # the [ ] test itself resets PIPESTATUS
+if [ "$prc" -ne 0 ]; then
+  echo "!! make_shapes_dataset rc=$prc" | tee -a "$LOG"; FAILS=$((FAILS + 1))
 fi
 run --data images --data-dir /tmp/shapes224
 run --data images --data-dir /tmp/shapes224 --decode python
@@ -161,14 +167,16 @@ timeout 1200 python -m glom_tpu.training.train \
   --ff-impl pallas --checkpoint-dir /tmp/ckpt_shapes224 \
   --checkpoint-every 500 --log-file docs/runs/shapes224_tpu.jsonl \
   2>&1 | tail -4 | tee -a "$LOG"
-if [ "${PIPESTATUS[0]}" -ne 0 ]; then
-  echo "!! flagship SSL leg rc=${PIPESTATUS[0]}" | tee -a "$LOG"; FAILS=$((FAILS + 1))
+prc=${PIPESTATUS[0]}   # the [ ] test itself resets PIPESTATUS
+if [ "$prc" -ne 0 ]; then
+  echo "!! flagship SSL leg rc=$prc" | tee -a "$LOG"; FAILS=$((FAILS + 1))
 fi
 timeout 900 python examples/islands_from_checkpoint.py \
   --checkpoint-dir /tmp/ckpt_shapes224 --data-dir /tmp/shapes224 \
   --out docs/islands_realdata_224.png 2>&1 | tail -2 | tee -a "$LOG"
-if [ "${PIPESTATUS[0]}" -ne 0 ]; then
-  echo "!! islands leg rc=${PIPESTATUS[0]}" | tee -a "$LOG"; FAILS=$((FAILS + 1))
+prc=${PIPESTATUS[0]}   # the [ ] test itself resets PIPESTATUS
+if [ "$prc" -ne 0 ]; then
+  echo "!! islands leg rc=$prc" | tee -a "$LOG"; FAILS=$((FAILS + 1))
 fi
 
 # Profile trace of the best-known config (VERDICT r2 item 4): one bench run
@@ -179,12 +187,14 @@ ls -R /tmp/glom_trace 2>/dev/null | tail -5 | tee -a "$LOG"
 # Component wall-clock breakdown on the chip (the top-time-sinks evidence)
 echo "=== $(date -u +%FT%TZ) breakdown" | tee -a "$LOG"
 timeout 600 python tools/breakdown.py 2>&1 | tee -a "$LOG"
-if [ "${PIPESTATUS[0]}" -ne 0 ]; then
-  echo "!! breakdown rc=${PIPESTATUS[0]}" | tee -a "$LOG"; FAILS=$((FAILS + 1))
+prc=${PIPESTATUS[0]}   # the [ ] test itself resets PIPESTATUS
+if [ "$prc" -ne 0 ]; then
+  echo "!! breakdown rc=$prc" | tee -a "$LOG"; FAILS=$((FAILS + 1))
 fi
 timeout 600 python tools/breakdown.py --ff-impl pallas 2>&1 | tee -a "$LOG"
-if [ "${PIPESTATUS[0]}" -ne 0 ]; then
-  echo "!! breakdown(pallas) rc=${PIPESTATUS[0]}" | tee -a "$LOG"; FAILS=$((FAILS + 1))
+prc=${PIPESTATUS[0]}   # the [ ] test itself resets PIPESTATUS
+if [ "$prc" -ne 0 ]; then
+  echo "!! breakdown(pallas) rc=$prc" | tee -a "$LOG"; FAILS=$((FAILS + 1))
 fi
 
 # Stateful video rollout + train step (BASELINE config 5 refresh) —
@@ -208,8 +218,9 @@ best=$(best_rate)
 if [ -n "${best:-}" ]; then
   echo "=== $(date -u +%FT%TZ) mfu at best rate $best" | tee -a "$LOG"
   python tools/mfu.py --imgs-per-sec "$best" 2>&1 | tee -a "$LOG"
-  if [ "${PIPESTATUS[0]}" -ne 0 ]; then
-    echo "!! mfu rc=${PIPESTATUS[0]}" | tee -a "$LOG"; FAILS=$((FAILS + 1))
+  prc=${PIPESTATUS[0]}   # the [ ] test itself resets PIPESTATUS
+  if [ "$prc" -ne 0 ]; then
+    echo "!! mfu rc=$prc" | tee -a "$LOG"; FAILS=$((FAILS + 1))
   fi
 fi
 echo "=== $(date -u +%FT%TZ) sweep done (failed legs: $FAILS, fused_ok: $FUSED_OK)" | tee -a "$LOG"

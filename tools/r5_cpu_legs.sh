@@ -1,0 +1,37 @@
+#!/bin/bash
+# Round-5 remaining CPU-only evidence legs, serialized on the single host
+# core (nice'd to idle priority so hardware-sweep compiles keep the core):
+#   1. cons_mse to step 600 (the round-4 leg clipped at ~400; the round-4
+#      cons_nce leg is NOT re-run — docs/runs/plateau_winner_s0.jsonl is the
+#      identical recipe+seed run to 600 under the seed-confirmation sweep)
+#   2. shapes128 SSL (VERDICT r4 item 6) at the plateau-leg horizon
+# CPU-only by construction (--platform cpu inside both leg definitions) —
+# never touches the accelerator tunnel.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+. tools/plateau_common.sh
+LOG=tools/plateau_sweep.log
+
+ensure_dataset | tee -a "$LOG" || { echo "!! dataset generation failed" | tee -a "$LOG"; exit 1; }
+
+echo "=== $(date -u +%FT%TZ) r5b leg plateau_cons_mse (to step 600)" | tee -a "$LOG"
+rm -f "$OUT/plateau_cons_mse.jsonl"
+timeout 14000 python -m glom_tpu.training.train \
+  "${PLATEAU_FLAGS[@]}" \
+  --log-file "$OUT/plateau_cons_mse.jsonl" \
+  --lr 3e-4 --consistency mse --consistency-weight 0.1 2>&1 | tail -2 | tee -a "$LOG"
+rc=$?
+fails=0
+if [ $rc -ne 0 ]; then
+  echo "!! r5b cons_mse rc=$rc" | tee -a "$LOG"
+  fails=$((fails + 1))
+fi
+
+STEPS=600 TIMEOUT=30000 bash tools/shapes128_run.sh
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "!! r5b shapes128 rc=$rc" | tee -a "$LOG"
+  fails=$((fails + 1))
+fi
+echo "=== $(date -u +%FT%TZ) r5b legs done ($fails failed)" | tee -a "$LOG"
+exit "$fails"
