@@ -221,8 +221,13 @@ def resolve_locality_mask(config: GlomConfig) -> Optional[jax.Array]:
 # provenance; ``tools/crossover.py`` re-measures and prints the row for the
 # chip it runs on (tools/hw_sweep.sh runs it every full sweep).
 ATTENTION_CROSSOVER_N = {
-    # v5e: BASELINE.md round-2 window (one chip via the axon tunnel) —
-    # n=256: dense 255.6 vs pallas 253.4 imgs/sec/chip; n=576: pallas wins
+    # v5e: re-measured in the 2026-07-31 round-5 window (BASELINE.md round-5
+    # table) — n=256: dense 248.0 vs pallas 240.6 (tools/crossover.py row);
+    # n=576: dense 22.9 vs pallas 22.5-22.8 imgs/sec/chip, i.e. WITHIN NOISE
+    # since the capture-timestep fast path landed (round-2's pallas win at
+    # 576 predates it).  The entry stays at 256 because the flash kernel's
+    # no-n^2 memory still matters as n grows; the n=1024 crossover.py row is
+    # queued to pin where the win returns.
     "v5e": 256,
 }
 # generations with no measured row fall back to the v5e value, with a

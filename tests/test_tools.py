@@ -93,6 +93,19 @@ class TestSweepLogBestRate:
         from tools.sweep_log import best_rate
         assert best_rate(["{bad json", self.FLAGSHIP % "0.0"], None) is None
 
+    def test_error_rows_excluded(self):
+        from tools.sweep_log import best_rate
+        err = ('{"metric": "denoise_ssl_train_imgs_per_sec_per_chip", '
+               '"value": 300.0, "unit": "imgs/sec/chip", "error": "boom"}')
+        assert best_rate([err, self.FLAGSHIP % "150.0"], None) == 150.0
+
+    def test_implausible_rates_excluded(self):
+        from tools.sweep_log import best_rate
+        # the 2026-07-31 wall-clock fault printed 510260.81 imgs/sec with no
+        # error field; a rate like that must never become the session best
+        assert best_rate([self.FLAGSHIP % "510260.81",
+                          self.FLAGSHIP % "288.6"], None) == 288.6
+
     def test_cli_round_trip(self, tmp_path, capsys):
         path = tmp_path / "hw_sweep.log"
         path.write_text("\n".join(self._lines()) + "\n")

@@ -91,7 +91,7 @@ if [ "$QUICK" = "1" ]; then
   # to the cost model — stays on round-2 evidence.
   run                                  # auto: pallas FF fwd on TPU — the record
   run_fused --ff-impl pallas --fused-ff-bwd
-  run_fused_or --remat-policy dots --ff-impl pallas --fused-ff-bwd
+  run --remat-policy full --ff-impl pallas   # old default, A/B continuity
   run --no-remat --ff-impl pallas
   run_fused_or --batch-size 64 --ff-impl pallas --fused-ff-bwd
   run --ff-impl pallas --profile-dir /tmp/glom_trace
@@ -115,8 +115,8 @@ run_fused --ff-impl pallas --fused-ff-bwd
 run --ff-impl pallas --attention-impl pallas
 run --fuse-ff --ff-impl pallas
 run_fused --fuse-ff --ff-impl pallas --fused-ff-bwd
-run --remat-policy dots
-run_fused_or --remat-policy dots --ff-impl pallas --fused-ff-bwd
+run --remat-policy full                    # old default, A/B continuity
+run --remat-policy dots --ff-impl dense    # unmeasured combo (dense+dots)
 run --no-remat
 run --no-remat --ff-impl pallas
 run --batch-size 64

@@ -70,11 +70,7 @@ def main():
 
     peak = args.peak_tflops or PEAK_TFLOPS[args.chip]
 
-    import jax
-
-    if jax.default_backend() not in ("cpu", "tpu"):
-        print(f"note: counting on backend {jax.default_backend()}", file=sys.stderr)
-
+    import jax  # importing alone does not initialize a backend
     import jax.numpy as jnp
 
     from glom_tpu.config import GlomConfig, TrainConfig, bench_preset
@@ -106,7 +102,27 @@ def main():
     if args.skip_compiled:
         return
 
-    # numerator 2: what the compiled step really executes (includes remat)
+    # numerator 2: what the compiled step really executes (includes remat).
+    # This is the first backend touch — on the axon relay a dead/wedged
+    # tunnel blocks device init forever (a sweep hung here on 2026-07-31),
+    # so gate it: skip gracefully when the relay is down, watchdog the
+    # single init attempt when it is nominally up.
+    from glom_tpu import device_guard
+
+    if "axon" in os.environ.get("JAX_PLATFORMS", "") and not device_guard._relay_up():
+        print("compiled-FLOPs pass skipped: accelerator relay unreachable "
+              "(analytic MFU above is complete)", file=sys.stderr)
+        return
+    timer = device_guard.guard_device_init(
+        240.0,
+        lambda m: print(f"compiled-FLOPs pass abandoned: {m}", file=sys.stderr),
+    )
+    backend = jax.default_backend()   # the guarded single init attempt
+    if timer:
+        timer.cancel()                # compile time is not init time
+    if backend not in ("cpu", "tpu"):
+        print(f"note: counting on backend {backend}", file=sys.stderr)
+
     import optax
 
     from glom_tpu.profiling import cost_analysis

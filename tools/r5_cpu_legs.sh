@@ -16,18 +16,22 @@ ensure_dataset | tee -a "$LOG" || { echo "!! dataset generation failed" | tee -a
 
 echo "=== $(date -u +%FT%TZ) r5b leg plateau_cons_mse (to step 600)" | tee -a "$LOG"
 rm -f "$OUT/plateau_cons_mse.jsonl"
-timeout 14000 python -m glom_tpu.training.train \
+# full output preserved (a tail-only pipe truncates crash tracebacks);
+# nice: children must never compete with hardware-sweep compiles
+nice -n 19 timeout 14000 python -m glom_tpu.training.train \
   "${PLATEAU_FLAGS[@]}" \
   --log-file "$OUT/plateau_cons_mse.jsonl" \
-  --lr 3e-4 --consistency mse --consistency-weight 0.1 2>&1 | tail -2 | tee -a "$LOG"
+  --lr 3e-4 --consistency mse --consistency-weight 0.1 \
+  > tools/r5b_cons_mse_out.txt 2>&1
 rc=$?
+tail -2 tools/r5b_cons_mse_out.txt | tee -a "$LOG"
 fails=0
 if [ $rc -ne 0 ]; then
   echo "!! r5b cons_mse rc=$rc" | tee -a "$LOG"
   fails=$((fails + 1))
 fi
 
-STEPS=600 TIMEOUT=30000 bash tools/shapes128_run.sh
+STEPS=600 TIMEOUT=30000 nice -n 19 bash tools/shapes128_run.sh
 rc=$?
 if [ $rc -ne 0 ]; then
   echo "!! r5b shapes128 rc=$rc" | tee -a "$LOG"

@@ -16,10 +16,20 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Iterable, Optional
 
 FLAGSHIP_METRIC = "denoise_ssl_train_imgs_per_sec_per_chip"
+
+
+def _plausibility_cap() -> float:
+    """20x the flagship north-star per-chip rate, single-sourced from
+    bench.py so the two guards cannot diverge."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import NORTH_STAR_IMGS_PER_SEC_PER_CHIP
+
+    return 20.0 * NORTH_STAR_IMGS_PER_SEC_PER_CHIP
 
 
 def best_rate(lines: Iterable[str], session: Optional[str] = None) -> Optional[float]:
@@ -46,9 +56,18 @@ def best_rate(lines: Iterable[str], session: Optional[str] = None) -> Optional[f
             continue
         if row.get("metric") != FLAGSHIP_METRIC:
             continue
+        if "error" in row:
+            # error rows carry value 0.0 now, but old logs hold one bogus
+            # 510k imgs/sec row from a wall-clock fault — never let an
+            # errored or implausible row become "the session's best rate"
+            continue
         try:
             value = float(row["value"])
         except (KeyError, TypeError, ValueError):
+            continue
+        if value > _plausibility_cap():
+            # physically impossible this hardware generation — a timing
+            # fault (same 20x-north-star bound as bench.py's guard)
             continue
         if value > 0 and (best is None or value > best):
             best = value
