@@ -421,3 +421,16 @@ def test_all_perf_knobs_combined_match_baseline():
         ),
         g_got, g_want,
     )
+
+
+def test_measured_performance_defaults_pinned():
+    """The hardware-measured performance defaults (BASELINE.md round-5 lever
+    table, TPU v5e 2026-07-31) — a silent edit to any of these changes the
+    bench-of-record configuration, so they are pinned here with their
+    provenance: remat_policy=dots is the measured best (288.6 vs 282.3
+    imgs/sec/chip); fuse_ff measured at -4.9% stays off; ff_fused_bwd stays
+    off until its hardware A/B passes (tools/hw_check.py)."""
+    c = GlomConfig()
+    assert c.remat_policy == "dots"
+    assert c.fuse_ff is False
+    assert c.ff_fused_bwd is False
