@@ -28,7 +28,10 @@ def hwc():
 
 
 def _exit_code(hwc, failures):
-    hwc.FAILURES[:] = failures
+    # convenience: (name, fused) pairs are padded to the full 4-tuple shape
+    hwc.FAILURES[:] = [
+        f if len(f) == 4 else (*f, "AssertionError", "x") for f in failures
+    ]
     try:
         hwc.finish(quick=False)
         return 0
@@ -57,9 +60,11 @@ class TestFailureClassification:
             raise AssertionError("x")
 
         hwc.check("leg", boom, fused_leg=True)  # must not raise
-        assert hwc.FAILURES == [("leg", True)]
+        # failures carry the exception type + first message line so a
+        # tail-truncated sweep log still shows the signature
+        assert hwc.FAILURES == [("leg", True, "AssertionError", "x")]
         hwc.check("ok-leg", lambda: None)
-        assert hwc.FAILURES == [("leg", True)]
+        assert hwc.FAILURES == [("leg", True, "AssertionError", "x")]
 
 
 class TestScaledTolerance:

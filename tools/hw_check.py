@@ -22,7 +22,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-FAILURES = []  # (name, is_fused_bwd_leg)
+FAILURES = []  # (name, is_fused_bwd_leg, exc_type_name, first_message_line)
 
 
 def assert_close_scaled(a, b, *, rel_fro=2e-3, elem=2e-2):
@@ -59,8 +59,11 @@ def check(name, fn, fused_leg=False):
     except Exception as e:  # noqa: BLE001 — signature goes to the log
         import traceback
         traceback.print_exc()
-        print(f"   FAIL: {type(e).__name__}", flush=True)
-        FAILURES.append((name, fused_leg))
+        # one line that survives any tail-truncation of the sweep log: the
+        # 06:38 window lost the fp32 leg's exception type to a tail -30
+        msg = " ".join(str(e).split())[:160]
+        print(f"   FAIL: {type(e).__name__}: {msg}", flush=True)
+        FAILURES.append((name, fused_leg, type(e).__name__, msg))
         return
     print(f"   ok", flush=True)
 
@@ -70,10 +73,10 @@ def finish(*, quick):
     if not FAILURES:
         print(f"ALL HARDWARE CHECKS PASSED{suffix}", flush=True)
         return
-    for name, fused in FAILURES:
+    for name, fused, etype, emsg in FAILURES:
         kind = "fused-bwd" if fused else "BASELINE"
-        print(f"FAILED [{kind}] {name}", flush=True)
-    if all(fused for _, fused in FAILURES):
+        print(f"FAILED [{kind}] {name} — {etype}: {emsg}", flush=True)
+    if all(f[1] for f in FAILURES):
         # exit 3, not 2: argparse uses 2 for usage errors, and the sweep must
         # never read "bad flag, zero checks ran" as "baseline verified"
         print("only fused-FF-backward legs failed — baseline paths are "

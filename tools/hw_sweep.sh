@@ -126,6 +126,7 @@ run --batch-size 128
 run --scan-unroll 2
 run --scan-unroll 7 --ff-impl pallas
 run --config large
+run --config large --remat-policy full      # every measured large row predates the dots default
 run --config large --ff-impl pallas --attention-impl pallas
 run_fused --config large --ff-impl pallas --attention-impl pallas --fused-ff-bwd
 run --config large --ff-impl pallas --attention-impl pallas --no-remat
