@@ -48,11 +48,11 @@ def time_step(config, steps: int, warmup: int) -> float:
     for _ in range(warmup):
         state, _ = trainer._step(state, img)
     jax.block_until_ready(state.params)
-    t0 = time.time()
+    t0 = time.monotonic()   # wall clock is NTP-adjustable (see bench.py)
     for _ in range(steps):
         state, _ = trainer._step(state, img)
     jax.block_until_ready(state.params)
-    return train.batch_size * steps / (time.time() - t0)
+    return train.batch_size * steps / (time.monotonic() - t0)
 
 
 def main():
@@ -60,7 +60,19 @@ def main():
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--sizes", type=int, nargs="+", default=list(IMAGE_SIZES))
+    p.add_argument("--device-probe-timeout", type=int, default=240,
+                   help="seconds to retry-poll the relay / watchdog the init "
+                        "attempt; <= 0 disables the guard (same knob as "
+                        "bench.py and tools/breakdown.py)")
     args = p.parse_args()
+
+    # a dead/wedged relay must produce a line and an exit, not a hang that
+    # ends in a SIGTERM mid-device-op (the 07:10 wedge trigger)
+    from glom_tpu.device_guard import guard_device_init
+
+    timer = guard_device_init(
+        args.device_probe_timeout,
+        lambda m: print(f"crossover abandoned: {m}", file=sys.stderr))
 
     import jax
     import jax.numpy as jnp
@@ -71,6 +83,8 @@ def main():
     from glom_tpu.parallel.mesh import is_tpu_device, tpu_generation
 
     dev = jax.devices()[0]
+    if timer:
+        timer.cancel()
     if not is_tpu_device(dev):
         raise SystemExit(f"refusing: {dev} is not a TPU — the crossover is a "
                          "hardware property; pltpu kernels do not lower here")
