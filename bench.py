@@ -83,7 +83,13 @@ def main():
     p.add_argument("--device-probe-timeout", type=int, default=240,
                    help="seconds to retry-poll the accelerator relay before "
                         "emitting an error JSON line and exiting; <= 0 "
-                        "disables the guard")
+                        "disables; ignored when --platform forces a local "
+                        "backend")
+    p.add_argument("--platform", default="auto", choices=["auto", "cpu"],
+                   help="cpu = force the local CPU backend via jax.config "
+                        "(relay guard skipped — nothing can hang), making "
+                        "`bench.py --config tiny --platform cpu` a "
+                        "tunnel-free plumbing check of the full bench path")
     args = p.parse_args()
 
     metric = "denoise_ssl_train_imgs_per_sec_per_chip"
@@ -131,11 +137,10 @@ def main():
     # Device guard (shared with tools/breakdown.py): retry-poll the relay,
     # then watchdog the single init attempt — a dead or wedged tunnel must
     # produce a JSON error line, never a silent hang.
-    from glom_tpu.device_guard import guard_device_init
+    from glom_tpu.device_guard import guarded_jax_init
 
-    timer = guard_device_init(args.device_probe_timeout, _emit_error)
-
-    import jax
+    jax, timer = guarded_jax_init(args.platform, args.device_probe_timeout,
+                                  _emit_error)
 
     try:
         # persistent compile cache: a bench run after a prior sweep (or a

@@ -75,3 +75,28 @@ def guard_device_init(
     timer.daemon = True
     timer.start()
     return timer
+
+
+def guarded_jax_init(platform, timeout, emit_error):
+    """Arm the relay guard, import jax, and apply a forced local platform —
+    the one blessed sequence for tools that may run against the relay.
+
+    ``platform='auto'`` uses whatever backend the environment provides
+    (the axon relay on this image) with the hang guard armed;
+    ``platform='cpu'`` forces the local CPU backend via ``jax.config``
+    (the env var alone is overridden by sitecustomize) with no guard —
+    nothing can hang.  Returns ``(jax_module, timer)``; callers cancel the
+    timer right after their first device touch completes.  Other platform
+    values are rejected: an unguarded init against a remote backend is
+    exactly the silent-hang this module exists to prevent."""
+    if platform not in ("auto", "cpu"):
+        raise ValueError(
+            f"platform must be 'auto' or 'cpu', got {platform!r} — forcing a "
+            "non-local backend would skip the relay hang guard")
+    timer = guard_device_init(timeout, emit_error) if platform == "auto" else None
+
+    import jax
+
+    if platform != "auto":
+        jax.config.update("jax_platforms", platform)
+    return jax, timer

@@ -207,3 +207,30 @@ def test_extract_cli_roundtrip(tmp_path, capsys):
     capsys.readouterr()
     z2 = np.load(str(out2), allow_pickle=False)
     assert z2["embeddings"].shape == (8, 2, 16)
+
+
+def test_bench_tiny_cpu_end_to_end():
+    """`python bench.py --config tiny --platform cpu` is the tunnel-free
+    plumbing check of the driver's benchmark of record: it must print exactly
+    one JSON line with the tiny metric, a positive rate, and no error field
+    (exercises the monotonic timed window + plausibility guard + platform
+    forcing added 2026-07-31)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py"),
+         "--config", "tiny", "--platform", "cpu",
+         "--steps", "1", "--warmup", "0"],
+        capture_output=True, text=True, timeout=1200, cwd=root,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "denoise_ssl_train_imgs_per_sec_per_chip_tiny"
+    assert rec["value"] > 0 and "error" not in rec
+    assert rec["unit"] == "imgs/sec/chip"

@@ -58,3 +58,26 @@ def test_live_relay_proceeds_to_watchdog(monkeypatch):
         assert fired == []
     finally:
         srv.close()
+
+
+def test_guarded_jax_init_rejects_non_local_platforms():
+    """An unguarded init against a remote backend is the silent hang the
+    module exists to prevent — only 'auto' (guarded) and 'cpu' (local,
+    nothing to guard) are legal."""
+    import pytest
+
+    from glom_tpu.device_guard import guarded_jax_init
+
+    with pytest.raises(ValueError, match="platform must be"):
+        guarded_jax_init("axon", 240, lambda m: None)
+    with pytest.raises(ValueError, match="platform must be"):
+        guarded_jax_init("tpu", 240, lambda m: None)
+
+
+def test_guarded_jax_init_cpu_skips_guard():
+    from glom_tpu.device_guard import guarded_jax_init
+
+    called = []
+    jax_mod, timer = guarded_jax_init("cpu", 240, called.append)
+    assert timer is None and not called
+    assert jax_mod.default_backend() == "cpu"  # conftest already forces cpu

@@ -58,19 +58,13 @@ def main():
                         "--platform forces a local backend)")
     args = p.parse_args()
 
-    from glom_tpu.device_guard import guard_device_init
+    from glom_tpu.device_guard import guarded_jax_init
 
     def _emit_error(msg):
         print(json.dumps({"error": msg}), flush=True)
 
-    timer = None
-    if args.platform == "auto":
-        timer = guard_device_init(args.device_probe_timeout, _emit_error)
-
-    import jax
-
-    if args.platform != "auto":
-        jax.config.update("jax_platforms", args.platform)
+    jax, timer = guarded_jax_init(args.platform, args.device_probe_timeout,
+                                  _emit_error)
 
     import jax.numpy as jnp
     import optax
