@@ -34,7 +34,11 @@ EOF
 # probe then spends a full 900s hw_check timeout per poll.  Stage 2 runs ONE
 # tiny device op under a short timeout; only a completed op opens the window.
 op_probe() {
-  timeout 90 python - <<'EOF' >/dev/null 2>&1
+  # 180s, not 90: with CPU legs (pytest, shapes SSL) contending for the one
+  # host core, a HEALTHY backend's import+init+op can exceed 90s — a short
+  # timeout here misreads a live window as wedged and skips it.  The cost is
+  # only slower polling against a genuinely wedged relay.
+  timeout 180 python - <<'EOF' >/dev/null 2>&1
 import sys
 import jax, jax.numpy as jnp
 from glom_tpu.parallel.mesh import is_tpu_device
