@@ -214,6 +214,14 @@ def main():
         state, metrics = trainer._step(state, next_img())
     jax.block_until_ready(state.params)
 
+    # recompile guard (glom_tpu.obs): warmup compiled the step once; any
+    # cache growth during the timed window means the window paid a silent
+    # XLA recompile and the rate is not a steady-state measurement
+    from glom_tpu.obs import RecompileMonitor
+
+    recompile_mon = RecompileMonitor(trainer._step)
+    recompile_mon.poll()  # absorb the warmup compile(s)
+
     if args.profile_dir:
         try:
             with jax.profiler.trace(args.profile_dir):
@@ -260,6 +268,11 @@ def main():
         "unit": "imgs/sec/chip",
         "vs_baseline": round(per_chip / target, 3),
     }
+    window_recompiles = recompile_mon.poll()
+    if window_recompiles:
+        # annotate, don't zero: the number is real wall-clock, it just
+        # includes compile time — the reader must know why it is low
+        result["recompiles_in_window"] = window_recompiles
     if per_chip > 20 * target:
         result.update(value=0.0, vs_baseline=0.0,
                       error=f"implausible rate {per_chip:.0f} imgs/s/chip after "

@@ -166,6 +166,20 @@ class TrainConfig:
     checkpoint_every: int = 0            # 0 => disabled
     checkpoint_dir: Optional[str] = None
     checkpoint_backend: str = "npz"      # "npz" | "orbax" | "sharded"
+    # -- observability (glom_tpu.obs) --
+    # in-graph NaN/Inf counts + grad-norm spike flags computed inside the
+    # jitted step (a few reductions on values the step already produced —
+    # no jax_debug_nans re-execution); window-aggregated at log boundaries
+    # (or at the stop-poll cadence when logging is disabled, so a
+    # log_every=0 run still surfaces NaN storms)
+    monitor_numerics: bool = True
+    grad_spike_factor: float = 10.0      # spike = grad_norm > factor * EMA
+    # GLOM-level diagnostics cadence (island agreement, attention entropy,
+    # contribution norm shares) — one extra forward every N steps; 0 = off
+    diag_every: int = 0
+    # additional exporters next to the default stdout/file JSONL
+    metrics_csv: Optional[str] = None    # CSV mirror of every log record
+    prom_textfile: Optional[str] = None  # Prometheus textfile-collector path
     # npz backend only: snapshot to host synchronously (correct under buffer
     # donation), then serialize+write on a background thread so the step
     # loop never stalls on checkpoint IO; at most one write in flight
@@ -241,6 +255,13 @@ class TrainConfig:
         if self.stop_poll_steps < 1:
             raise ValueError(
                 f"stop_poll_steps must be >= 1, got {self.stop_poll_steps}"
+            )
+        if self.diag_every < 0:
+            raise ValueError(f"diag_every must be >= 0, got {self.diag_every}")
+        if self.grad_spike_factor <= 1.0:
+            raise ValueError(
+                f"grad_spike_factor must be > 1 (it multiplies the EMA), "
+                f"got {self.grad_spike_factor}"
             )
         from glom_tpu.models.heads import DECODER_ARCHS
 

@@ -1,0 +1,55 @@
+"""Unified telemetry subsystem.
+
+The observability layer the trainer, parallel stack, and bench harness
+report through.  Four pieces, each usable on its own:
+
+  * :mod:`glom_tpu.obs.registry` — typed metric registry (counters,
+    gauges, histograms, timers) and the string event vocabulary that
+    replaces the old magic-float markers.
+  * :mod:`glom_tpu.obs.timing` — ``PhaseTimer``, the async-aware
+    phase accounting for the step loop (data wait / H2D / step dispatch /
+    eval / checkpoint / stop-poll each get their own bucket; device sync
+    happens only at log boundaries so dispatch pipelining is preserved).
+  * :mod:`glom_tpu.obs.monitors` — runtime health: XLA recompile
+    detection (jit cache-size tracking), device/HBM memory stats, and the
+    in-graph numerics summary (NaN/Inf counts + grad-norm spike flags)
+    that replaces ``jax_debug_nans``'s re-execution cost on the hot path.
+  * :mod:`glom_tpu.obs.diagnostics` — GLOM-level science metrics at low
+    cadence: per-level island agreement, consensus attention entropy, and
+    per-contribution (bottom-up / top-down / attention / prev) norm shares.
+  * :mod:`glom_tpu.obs.exporters` — pluggable sinks: back-compatible
+    JSONL, CSV, and a Prometheus textfile exporter for node-exporter
+    style scraping.
+
+``training/metrics.py``'s ``MetricLogger`` is the facade the Trainer
+logs through; it fans records out to the configured exporters.
+"""
+
+from glom_tpu.obs.registry import (  # noqa: F401
+    EVENT_NAN,
+    EVENT_PREEMPT_STOP,
+    EVENT_RECOMPILE,
+    EVENT_RESUME,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    Timer,
+)
+from glom_tpu.obs.timing import PhaseTimer  # noqa: F401
+from glom_tpu.obs.monitors import (  # noqa: F401
+    MemoryMonitor,
+    NumericsMonitor,
+    RecompileMonitor,
+    numerics_metrics,
+)
+from glom_tpu.obs.diagnostics import (  # noqa: F401
+    flatten_diagnostics,
+    glom_diagnostics,
+    make_diagnostics_fn,
+)
+from glom_tpu.obs.exporters import (  # noqa: F401
+    CsvExporter,
+    JsonlExporter,
+    PrometheusTextfileExporter,
+)

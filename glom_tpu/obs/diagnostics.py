@@ -1,0 +1,173 @@
+"""GLOM-level diagnostics — watching island formation during training.
+
+Hinton's paper defines island formation (neighboring columns agreeing at
+upper levels) as THE emergent behavior of interest; BASELINE.md scores it
+offline via ``models/islands.py``.  This module makes the same math a
+low-cadence training metric, plus two companions that explain *why* the
+state is (or is not) forming islands:
+
+  * per-level island agreement — mean 4-neighbor cosine agreement of the
+    final state (``models/islands.neighbor_agreement``, the one
+    definition);
+  * consensus attention entropy — mean softmax entropy per level of the
+    dense consensus distribution over the final state (high entropy =
+    columns still averaging everyone, low = committed islands);
+  * per-contribution norm shares — relative L2 mass of the four update
+    terms (prev state, bottom-up, top-down, attention) in one extra GLOM
+    iteration from the final state: the paper's "which direction is
+    driving the embedding" question as a number.
+
+Everything runs as ONE jitted function on a single diagnostics batch at a
+cadence the trainer controls (``TrainConfig.diag_every``) — the cost is
+one extra forward every N steps, never per step.
+
+The entropy/contribution math intentionally recomputes the dense
+consensus from the FINAL state rather than instrumenting the scan body:
+the hot path stays untouched (no extra residents in the scan carry), and
+the diagnostics remain implementation-independent — they describe the
+model state, whether the training step ran dense, Pallas, ring, or
+pipelined.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from glom_tpu.config import GlomConfig
+from glom_tpu.models import glom as glom_model
+from glom_tpu.models.islands import neighbor_agreement
+from glom_tpu.ops.consensus import TOKEN_ATTEND_SELF_VALUE, l2_normalize
+
+
+def _attention_entropy(levels: jax.Array, config: GlomConfig) -> jax.Array:
+    """Mean consensus-softmax entropy per level, ``(L,)`` nats.
+
+    Dense recompute of the reference attention logits (soft self-mask,
+    hard locality mask) on the diagnostics batch — O(n^2) once per
+    diagnostics point, not per step.
+    """
+    d = levels.shape[-1]
+    sim = jnp.einsum(
+        "bild,bjld->blij", levels, l2_normalize(levels, axis=-1)
+    ) * (d ** -0.5)
+    if not config.consensus_self:
+        n = levels.shape[1]
+        eye = jnp.eye(n, dtype=bool)
+        sim = jnp.where(eye[None, None], jnp.asarray(TOKEN_ATTEND_SELF_VALUE, sim.dtype), sim)
+    mask = glom_model.resolve_locality_mask(config)
+    if mask is not None:
+        sim = jnp.where(mask[None, None], -jnp.finfo(sim.dtype).max, sim)
+    logp = jax.nn.log_softmax(sim.astype(jnp.float32), axis=-1)
+    ent = -jnp.sum(jnp.exp(logp) * logp, axis=-1)      # (b, L, n)
+    return ent.mean(axis=(0, 2))
+
+
+def _contribution_shares(
+    params, levels: jax.Array, tokens: jax.Array, config: GlomConfig,
+    consensus_fn, ff_fn,
+) -> Dict[str, jax.Array]:
+    """Relative L2 mass of the four update terms in one GLOM iteration
+    from ``levels`` — the same term layout as ``glom._update_step``
+    (fresh tokens at the bottom, pos-embs on the top-down input, zero
+    top-down at the top level)."""
+    pos_embs = params["pos_emb"][None, :, None, :].astype(levels.dtype)
+    bottom = tokens[:, :, None, :]
+    stacked = jnp.concatenate([bottom, levels], axis=-2)
+    bu = ff_fn(params["bottom_up"], stacked[..., :-1, :])
+    td = ff_fn(params["top_down"], stacked[..., 2:, :] + pos_embs)
+    td = jnp.pad(td, ((0, 0), (0, 0), (0, 1), (0, 0)))
+    att = consensus_fn(levels)
+
+    def mass(x):
+        return jnp.sqrt(jnp.sum(x.astype(jnp.float32) ** 2))
+
+    norms = {"prev": mass(levels), "bottom_up": mass(bu),
+             "top_down": mass(td), "attention": mass(att)}
+    total = sum(norms.values()) + 1e-12
+    return {f"contrib_share_{k}": v / total for k, v in norms.items()}
+
+
+def make_diagnostics_fn(
+    config: GlomConfig,
+    *,
+    iters: Optional[int] = None,
+    consensus_fn=None,
+    ff_fn=None,
+    state_sharding=None,
+):
+    """Build the jittable ``(glom_params, img) -> {name: scalar/vector}``
+    diagnostics evaluator.  ``consensus_fn``/``ff_fn``/``state_sharding``
+    thread the trainer's mesh-bound implementations exactly like the eval
+    path, so a ring/pallas run diagnoses without all-gather surprises.
+
+    Returned arrays: ``island_agreement`` (L,), ``attn_entropy`` (L,),
+    and the four ``contrib_share_*`` scalars.
+    """
+    c = config
+    n_iters = iters if iters is not None else c.default_iters
+    if consensus_fn is None:
+        consensus_fn = glom_model.make_consensus_fn(c)
+    if ff_fn is None:
+        ff_fn = glom_model.make_ff_fn(c)
+
+    def diag_fn(glom_params, img):
+        params_c, img_c, compute_dtype = glom_model.cast_for_compute(
+            glom_params, img, c
+        )
+        final = glom_model.apply(
+            glom_params, img, config=c, iters=n_iters,
+            consensus_fn=consensus_fn, ff_fn=ff_fn,
+            state_sharding=state_sharding,
+        )
+        out = {
+            "island_agreement": neighbor_agreement(
+                final, c.num_patches_side
+            ).mean(axis=(0, 2, 3)),
+            "attn_entropy": _attention_entropy(final, c),
+        }
+        tokens, _ = glom_model.embed_inputs(params_c, img_c, c)
+        out.update(_contribution_shares(
+            params_c, final.astype(compute_dtype), tokens, c,
+            consensus_fn, ff_fn,
+        ))
+        return out
+
+    return diag_fn
+
+
+def flatten_diagnostics(diag: Dict[str, jax.Array]) -> Dict[str, float]:
+    """Host-side flattening to JSONL-ready scalars: vectors indexed per
+    level (``island_agreement_L0`` ... plus the ``island_agreement`` mean),
+    scalars passed through."""
+    import numpy as np
+
+    out: Dict[str, float] = {}
+    for k, v in diag.items():
+        arr = np.asarray(jax.device_get(v))
+        if arr.ndim == 0:
+            out[k] = float(arr)
+        else:
+            for i, x in enumerate(arr.ravel()):
+                out[f"{k}_L{i}"] = float(x)
+            out[k] = float(arr.mean())
+    return out
+
+
+def glom_diagnostics(
+    params: dict,
+    img,
+    *,
+    config: GlomConfig,
+    iters: Optional[int] = None,
+    consensus_fn=None,
+    ff_fn=None,
+) -> Dict[str, float]:
+    """One-shot convenience (build + run + flatten); loops should build
+    the fn once via :func:`make_diagnostics_fn` and jit it."""
+    fn = make_diagnostics_fn(
+        config, iters=iters, consensus_fn=consensus_fn, ff_fn=ff_fn
+    )
+    return flatten_diagnostics(fn(params, jnp.asarray(img)))

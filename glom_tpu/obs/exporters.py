@@ -1,0 +1,226 @@
+"""Pluggable metric exporters.
+
+Every exporter consumes the same record shape the trainer logs — a flat
+``{key: scalar-or-string}`` dict per logging boundary — plus (for the
+Prometheus sink) the registry snapshot.  Adding a sink never touches the
+instrumentation sites.
+
+  * :class:`JsonlExporter` — the historical format, byte-compatible with
+    every existing consumer (``tools/plateau_report.py``,
+    ``tools/sweep_log.py``, ``docs/runs/*.jsonl``): one JSON object per
+    line, floats rounded for log compactness.
+  * :class:`CsvExporter` — spreadsheet-ready; the column set grows as new
+    keys appear (the file is rewritten with the widened header — logs are
+    a few KB, correctness beats cleverness here).
+  * :class:`PrometheusTextfileExporter` — the node-exporter textfile
+    collector contract: the CURRENT state of every metric, written
+    atomically (tmp + rename) so a scraper never reads a torn file.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+from typing import IO, Dict, List, Optional
+
+
+def normalize_scalar(v):
+    """The one value-normalization rule for log records: bools and ints
+    pass through (JSON has them), floats round to 6 SIGNIFICANT digits for
+    log compactness (not absolute decimals — a 4e-7 loss must not collapse
+    to 0.0), strings pass through, numpy/jax scalars coerce via float().
+    Anything else is an error at the call site, not a silent str() later."""
+    if isinstance(v, bool) or isinstance(v, int):
+        return v
+    if isinstance(v, str):
+        return v
+    f = float(v)  # numpy/jax scalars, python floats
+    return float(f"{f:.6g}") if math.isfinite(f) else f
+
+
+class JsonlExporter:
+    """One JSON object per line to a stream and/or append-mode file.
+
+    ``close()`` is deterministic and idempotent; a later ``emit`` lazily
+    reopens the file in append mode, so a long-lived exporter survives the
+    owner closing it between fit() calls."""
+
+    def __init__(self, path: Optional[str] = None, stream: Optional[IO] = None):
+        self.path = path
+        self._stream = stream
+        self._file = open(path, "a") if path else None
+
+    def emit(self, record: Dict) -> None:
+        line = json.dumps(record)
+        if self._stream is not None:
+            print(line, file=self._stream, flush=True)
+        if self.path and self._file is None:
+            self._file = open(self.path, "a")
+        if self._file:
+            self._file.write(line + "\n")
+            self._file.flush()
+
+    def close(self) -> None:
+        if self._file:
+            self._file.close()
+            self._file = None
+
+
+class CsvExporter:
+    """CSV with a growing column set.
+
+    Keys are ordered by first appearance; when a record introduces new
+    keys the file is rewritten with the widened header (rows are retained
+    in memory — one small dict per logging boundary).  Missing values are
+    empty cells.  Strings are quoted per csv rules.
+
+    An existing file at ``path`` is loaded on construction, so a resumed
+    run (or a logger reopened after ``close``) keeps appending — a later
+    header widening must rewrite the WHOLE history, never just the rows
+    this process has seen."""
+
+    def __init__(self, path: str):
+        import csv
+
+        self.path = path
+        self._fields: List[str] = []
+        self._rows: List[Dict] = []
+        if os.path.exists(path) and os.path.getsize(path):
+            with open(path, newline="") as f:
+                reader = csv.DictReader(f)
+                self._fields = list(reader.fieldnames or [])
+                self._rows = [
+                    {k: v for k, v in row.items() if v != ""} for row in reader
+                ]
+
+    def emit(self, record: Dict) -> None:
+        new = [k for k in record if k not in self._fields]
+        self._rows.append(dict(record))
+        if new:
+            self._fields.extend(new)
+            self._rewrite()
+        else:
+            with open(self.path, "a", newline="") as f:
+                self._writer(f).writerow(self._rows[-1])
+
+    def _writer(self, f):
+        import csv
+
+        return csv.DictWriter(f, fieldnames=self._fields, restval="")
+
+    def _rewrite(self) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", newline="") as f:
+            w = self._writer(f)
+            w.writeheader()
+            w.writerows(self._rows)
+        os.replace(tmp, self.path)
+
+    def close(self) -> None:
+        # rows stay resident: a post-close emit that widens the header
+        # must rewrite the full history, not just the rows seen since
+        pass
+
+
+_PROM_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prom_name(name: str, prefix: str = "glom_") -> str:
+    """Sanitize to the Prometheus metric-name charset."""
+    name = _PROM_NAME_OK.sub("_", name)
+    if not name or not (name[0].isalpha() or name[0] in "_:"):
+        name = "_" + name
+    return prefix + name
+
+
+class PrometheusTextfileExporter:
+    """Textfile-collector output: the current value of every numeric
+    metric, one family per line group, written atomically on each emit.
+
+    Numeric record keys become gauges; the string ``event`` key becomes
+    per-event counters (``glom_events_total{event="..."}`` can't be
+    expressed without labels in the flat form, so we emit
+    ``glom_event_<name>_total``).  A registry snapshot, when given,
+    contributes its metrics with their declared types."""
+
+    wants_registry = True  # MetricLogger passes its registry snapshot along
+
+    def __init__(self, path: str, prefix: str = "glom_"):
+        self.path = path
+        self.prefix = prefix
+        self._state: Dict[str, float] = {}
+        self._types: Dict[str, str] = {}
+        self._help: Dict[str, str] = {}
+        self._event_counts: Dict[str, int] = {}
+
+    def emit(self, record: Dict, registry=None) -> None:
+        for k, v in record.items():
+            if k == "event" and isinstance(v, str):
+                self._event_counts[v] = self._event_counts.get(v, 0) + 1
+                continue
+            if isinstance(v, str):
+                continue  # free-form strings have no textfile representation
+            name = prom_name(k, self.prefix)
+            self._state[name] = float(v)
+            self._types.setdefault(name, "gauge")
+        if registry is not None:
+            from glom_tpu.obs.registry import Counter, Gauge, Histogram, Timer
+
+            for m in registry:
+                hist = m.hist if isinstance(m, Timer) else m
+                if isinstance(hist, Counter):
+                    suffix = "" if hist.name.endswith("_total") else "_total"
+                    name = prom_name(hist.name + suffix, self.prefix)
+                    self._state[name] = hist.value
+                    self._types[name] = "counter"
+                    if hist.help:
+                        self._help[name] = hist.help
+                elif isinstance(hist, Gauge):
+                    if hist.value is None:
+                        continue
+                    name = prom_name(hist.name, self.prefix)
+                    self._state[name] = hist.value
+                    self._types[name] = "gauge"
+                    if hist.help:
+                        self._help[name] = hist.help
+                elif isinstance(hist, Histogram):
+                    if not hist.count:
+                        continue
+                    base = prom_name(hist.name, self.prefix)
+                    self._state[base + "_count"] = float(hist.count)
+                    self._state[base + "_sum"] = hist.sum
+                    self._types[base + "_count"] = "counter"
+                    self._types[base + "_sum"] = "counter"
+                    if hist.help:
+                        self._help[base + "_count"] = hist.help
+                        self._help[base + "_sum"] = hist.help
+        for ev, n in self._event_counts.items():
+            name = prom_name(f"event_{ev}_total", self.prefix)
+            self._state[name] = float(n)
+            self._types[name] = "counter"
+        self._write()
+
+    @staticmethod
+    def _fmt(v: float) -> str:
+        if math.isnan(v):
+            return "NaN"
+        if math.isinf(v):
+            return "+Inf" if v > 0 else "-Inf"
+        return repr(v) if v != int(v) else str(int(v))
+
+    def _write(self) -> None:
+        lines = []
+        for name in sorted(self._state):
+            if name in self._help:
+                lines.append(f"# HELP {name} {self._help[name]}")
+            lines.append(f"# TYPE {name} {self._types.get(name, 'gauge')}")
+            lines.append(f"{name} {self._fmt(self._state[name])}")
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        os.replace(tmp, self.path)
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,164 @@
+"""Runtime health monitors: recompiles, device memory, numerics.
+
+  * :class:`RecompileMonitor` — XLA recompile detection by polling the
+    jitted step's compile-cache size (a host attribute read, free per
+    step).  The first compile is expected; any later growth means a shape
+    or dtype changed under the jit and the run is silently paying a
+    20-40 s compile — exactly the event the log must surface.
+  * :class:`MemoryMonitor` — live per-device memory stats
+    (``Device.memory_stats()``: bytes_in_use / peak on TPU; ``None`` on
+    backends that don't report, where it degrades to no metrics).
+  * :func:`numerics_metrics` — the IN-GRAPH NaN/Inf summary: computed
+    inside the jitted step from values the step already produced, so it
+    costs a few reductions instead of ``jax_debug_nans``'s re-execution,
+    and it aggregates across hosts for free (grads are already
+    psum-reduced by the sharded step).
+  * :class:`NumericsMonitor` — the host-side window accounting over those
+    per-step summaries: NaN-event detection plus grad-norm spike flags
+    against a running EMA.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class RecompileMonitor:
+    """Track compile-cache growth of one jitted callable.
+
+    ``poll()`` returns the number of NEW compilations since the last poll.
+    ``compiles`` is the lifetime total.  The first compilation is counted
+    but ``recompiles`` (total minus the expected first) is what health
+    checks alarm on.  Falls back to inert (always 0) when the callable
+    does not expose ``_cache_size`` (non-jit callables, future jax)."""
+
+    def __init__(self, fn):
+        self._fn = fn
+        self._size_fn = getattr(fn, "_cache_size", None)
+        self._last = 0
+        self.compiles = 0
+
+    @property
+    def available(self) -> bool:
+        return self._size_fn is not None
+
+    @property
+    def recompiles(self) -> int:
+        return max(0, self.compiles - 1)
+
+    def poll(self) -> int:
+        if self._size_fn is None:
+            return 0
+        size = self._size_fn()
+        new = max(0, size - self._last)
+        self._last = size
+        self.compiles += new
+        return new
+
+
+class MemoryMonitor:
+    """Live device-memory gauges from the first addressable device.
+
+    ``sample()`` returns ``{"mem_bytes_in_use": ..., "mem_peak_bytes": ...}``
+    (whichever keys the backend reports), or ``{}`` where memory_stats is
+    unsupported (CPU) — callers just merge the dict into their record."""
+
+    _KEYS = {"bytes_in_use": "mem_bytes_in_use",
+             "peak_bytes_in_use": "mem_peak_bytes",
+             "bytes_limit": "mem_bytes_limit"}
+
+    def __init__(self, device=None):
+        self._device = device if device is not None else jax.local_devices()[0]
+
+    def sample(self) -> Dict[str, float]:
+        try:
+            stats = self._device.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            return {}
+        return {out: float(stats[k]) for k, out in self._KEYS.items() if k in stats}
+
+
+def numerics_metrics(grads, loss) -> Dict[str, jax.Array]:
+    """In-graph NaN/Inf summary of one step.  Returns device scalars:
+
+      * ``nonfinite_grads`` — count of non-finite gradient ELEMENTS across
+        the whole grad pytree (0 on a healthy step);
+      * ``loss_nonfinite`` — 1.0 when the loss itself is NaN/Inf.
+
+    Runs inside the jitted step on values already produced there, so the
+    cost is one ``isfinite`` + reduce per grad leaf and no re-execution.
+    Counts are exact in fp32 up to 2^24 bad elements — beyond that the
+    flag is still unambiguously nonzero, which is all the monitor needs.
+    """
+    counts = [
+        jnp.sum(~jnp.isfinite(g.astype(jnp.float32))).astype(jnp.float32)
+        for g in jax.tree_util.tree_leaves(grads)
+    ]
+    nonfinite = sum(counts) if counts else jnp.zeros((), jnp.float32)
+    loss_bad = (~jnp.isfinite(loss.astype(jnp.float32))).astype(jnp.float32)
+    return {"nonfinite_grads": nonfinite, "loss_nonfinite": loss_bad}
+
+
+class NumericsMonitor:
+    """Host-side window accounting over the in-graph per-step summaries.
+
+    ``update(per_step)`` consumes a list of already-fetched per-step metric
+    dicts (one logging window) and returns the window summary:
+
+      * ``nonfinite_grads`` — summed bad-element count over the window;
+      * ``loss_nonfinite_steps`` — steps whose loss was NaN/Inf;
+      * ``grad_norm_spike`` — 1.0 when any step's grad norm exceeded
+        ``spike_factor`` x the running EMA of healthy grad norms (the
+        cheap "loss is about to blow up" early warning).
+
+    The EMA ingests finite norms only, and spiking norms enter CLAMPED at
+    ``spike_factor`` x the current baseline: a one-step spike barely moves
+    the baseline, while a sustained legitimate shift (LR change, loss
+    rescale) re-baselines within a few windows instead of flagging every
+    window forever.
+    """
+
+    def __init__(self, spike_factor: float = 10.0, ema_decay: float = 0.95):
+        self.spike_factor = spike_factor
+        self.ema_decay = ema_decay
+        self._ema: Optional[float] = None
+        self.nan_events = 0     # windows that saw any nonfinite value
+        self.spike_events = 0   # windows that saw a grad-norm spike
+
+    def update(self, per_step) -> Dict[str, float]:
+        nonfinite = 0.0
+        loss_bad_steps = 0.0
+        spike = 0.0
+        for m in per_step:
+            nonfinite += float(m.get("nonfinite_grads", 0.0))
+            loss_bad_steps += float(m.get("loss_nonfinite", 0.0))
+            gn = m.get("grad_norm")
+            if gn is None:
+                continue
+            gn = float(gn)
+            if not math.isfinite(gn):
+                continue  # counted via nonfinite_grads; would poison the EMA
+            if self._ema is not None and gn > self.spike_factor * self._ema:
+                spike = 1.0
+                # clamped ingest (see class docstring): the baseline may
+                # grow at most spike_factor-fold per EMA step, so it
+                # tracks sustained shifts without being poisoned by one
+                gn = self.spike_factor * self._ema
+            self._ema = gn if self._ema is None else (
+                self.ema_decay * self._ema + (1.0 - self.ema_decay) * gn
+            )
+        if nonfinite or loss_bad_steps:
+            self.nan_events += 1
+        if spike:
+            self.spike_events += 1
+        return {
+            "nonfinite_grads": nonfinite,
+            "loss_nonfinite_steps": loss_bad_steps,
+            "grad_norm_spike": spike,
+        }

@@ -1,0 +1,184 @@
+"""Typed metric registry.
+
+One ``MetricRegistry`` per run holds every named metric the trainer (or a
+tool) reports: counters for monotone totals, gauges for point-in-time
+values, histograms for distributions (step/phase times), timers as the
+context-manager convenience over a histogram.  ``snapshot()`` flattens the
+whole registry into a scalar dict — the single form every exporter
+consumes, so adding an exporter never touches the instrumentation sites.
+
+Events are plain strings (the ``event`` field of a log record), replacing
+the old magic-float markers (``event=1.0`` resume / ``2.0`` stop).  The
+vocabulary lives here so writers and readers share one definition.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+# -- event vocabulary (the `event` field of JSONL records) ----------------
+EVENT_RESUME = "resume"              # checkpoint auto-resume at fit start
+EVENT_PREEMPT_STOP = "preempt_stop"  # SIGTERM-triggered clean stop
+EVENT_RECOMPILE = "recompile"        # XLA recompiled the step fn mid-run
+EVENT_NAN = "nan"                    # nonfinite grads/loss seen this window
+
+# legacy float markers (pre-obs logs) -> string events, for readers that
+# must keep consuming old JSONL files
+LEGACY_EVENT_FLOATS = {1.0: EVENT_RESUME, 2.0: EVENT_PREEMPT_STOP}
+
+
+class Counter:
+    """Monotone total (events, images, recompiles).  ``inc`` only."""
+
+    def __init__(self, name: str, help: str = "", unit: str = ""):
+        self.name, self.help, self.unit = name, help, unit
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease (inc {amount})")
+        self.value += amount
+
+
+class Gauge:
+    """Point-in-time value (loss, memory bytes, agreement score)."""
+
+    def __init__(self, name: str, help: str = "", unit: str = ""):
+        self.name, self.help, self.unit = name, help, unit
+        self.value: Optional[float] = None
+
+    def set(self, value: float) -> None:
+        self.value = float(value)
+
+
+class Histogram:
+    """Streaming distribution: count/sum/min/max plus a bounded reservoir
+    of recent observations for percentile queries.  No buckets to
+    preconfigure — phase times span 1e-5 s (stop poll) to seconds
+    (checkpoint write), so fixed buckets would mis-bin one end."""
+
+    def __init__(self, name: str, help: str = "", unit: str = "",
+                 reservoir: int = 512):
+        self.name, self.help, self.unit = name, help, unit
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self._reservoir: List[float] = []
+        self._cap = reservoir
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.sum += value
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+        if len(self._reservoir) < self._cap:
+            self._reservoir.append(value)
+        else:
+            # deterministic decimation: overwrite round-robin so the
+            # reservoir always reflects a recent window (no RNG in the
+            # logging path)
+            self._reservoir[self.count % self._cap] = value
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Nearest-rank percentile over the reservoir, ``q`` in [0, 100]."""
+        if not self._reservoir:
+            return None
+        ordered = sorted(self._reservoir)
+        rank = min(len(ordered) - 1, max(0, math.ceil(q / 100.0 * len(ordered)) - 1))
+        return ordered[rank]
+
+    @property
+    def mean(self) -> Optional[float]:
+        return self.sum / self.count if self.count else None
+
+
+class Timer:
+    """Context-manager facade over a Histogram of seconds."""
+
+    def __init__(self, name: str, help: str = "", clock=None):
+        import time
+
+        self.hist = Histogram(name, help, unit="seconds")
+        self._clock = clock or time.monotonic
+        self._t0: Optional[float] = None
+
+    @property
+    def name(self) -> str:
+        return self.hist.name
+
+    def __enter__(self) -> "Timer":
+        self._t0 = self._clock()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.hist.observe(self._clock() - self._t0)
+        self._t0 = None
+
+
+class MetricRegistry:
+    """Namespace of typed metrics.  ``counter``/``gauge``/``histogram``/
+    ``timer`` get-or-create by name; re-registering a name as a different
+    type is an error (it would silently fork the metric)."""
+
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+
+    def _get(self, name: str, cls, **kwargs):
+        m = self._metrics.get(name)
+        if m is None:
+            m = cls(name, **kwargs)
+            self._metrics[name] = m
+        # a Timer aliases its Histogram: histogram() on a timer-registered
+        # name returns the underlying hist, not the Timer wrapper
+        expected = m.hist if isinstance(m, Timer) and cls is Histogram else m
+        if not isinstance(expected, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as "
+                f"{type(m).__name__}, not {cls.__name__}"
+            )
+        return expected
+
+    def counter(self, name: str, help: str = "", unit: str = "") -> Counter:
+        return self._get(name, Counter, help=help, unit=unit)
+
+    def gauge(self, name: str, help: str = "", unit: str = "") -> Gauge:
+        return self._get(name, Gauge, help=help, unit=unit)
+
+    def histogram(self, name: str, help: str = "", unit: str = "") -> Histogram:
+        return self._get(name, Histogram, help=help, unit=unit)
+
+    def timer(self, name: str, help: str = "", clock=None) -> Timer:
+        return self._get(name, Timer, help=help, clock=clock)
+
+    def __iter__(self):
+        return iter(self._metrics.values())
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._metrics
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flatten to ``{name[_suffix]: scalar}`` — counters/gauges by name,
+        histograms as ``<name>_{count,sum,mean,p50,p95,max}``.  Unset gauges
+        and empty histograms are omitted (exporting a None would force every
+        sink to special-case it)."""
+        out: Dict[str, float] = {}
+        for m in self._metrics.values():
+            if isinstance(m, Timer):
+                m = m.hist
+            if isinstance(m, Counter):
+                out[m.name] = m.value
+            elif isinstance(m, Gauge):
+                if m.value is not None:
+                    out[m.name] = m.value
+            elif isinstance(m, Histogram):
+                if m.count:
+                    out[f"{m.name}_count"] = float(m.count)
+                    out[f"{m.name}_sum"] = m.sum
+                    out[f"{m.name}_mean"] = m.mean
+                    out[f"{m.name}_p50"] = m.percentile(50)
+                    out[f"{m.name}_p95"] = m.percentile(95)
+                    out[f"{m.name}_max"] = m.max
+        return out

@@ -231,15 +231,19 @@ def make_pipelined_apply(
             def step(carry, t):
                 cur, out_buf, cap_buf, traj_buf = carry
                 # boundary exchange: my just-finished state goes to stage
-                # s+1; stage 0 receives garbage (overwritten below)
-                recv = jax.lax.ppermute(cur, pipe_axis, fwd_perm) if S > 1 else cur
+                # s+1; stage 0 receives garbage (overwritten below).  The
+                # named scope marks the inter-stage ICI transfer in traces,
+                # distinct from the stage compute it should overlap with.
+                with jax.named_scope("pipeline.boundary_exchange"):
+                    recv = jax.lax.ppermute(cur, pipe_axis, fwd_perm) if S > 1 else cur
                 my_idx = t - s                       # microbatch this stage works on
                 idx = jnp.clip(my_idx, 0, M - 1)
                 toks = jax.lax.dynamic_index_in_dim(
                     tokens_mb, idx, axis=0, keepdims=False
                 )
                 inp = jnp.where(s == 0, init_state, recv)
-                done, cap, ys = stage_chunk(inp, toks)
+                with jax.named_scope("pipeline.stage_chunk"):
+                    done, cap, ys = stage_chunk(inp, toks)
                 active = (my_idx >= 0) & (my_idx < M)
                 cur = jnp.where(active, done, cur)
 

@@ -81,14 +81,20 @@ def _ring_consensus_local(
 
     # local block first (no rotation), then size-1 rotate-and-accumulate
     # steps — exactly size-1 ppermutes, none wasted
-    acc, m, den = block_update(acc0, m0, den0, k0, v0, my_idx)
+    with jax.named_scope("ring_consensus.local_block"):
+        acc, m, den = block_update(acc0, m0, den0, k0, v0, my_idx)
 
     def step(carry, s):
         k, v, acc, m, den = carry
         perm = [(r, (r - 1) % size) for r in range(size)]
-        k = jax.lax.ppermute(k, axis_name, perm)
-        v = jax.lax.ppermute(v, axis_name, perm)
-        acc, m, den = block_update(acc, m, den, k, v, (my_idx + s) % size)
+        # named scopes mark the collective vs compute split in profiler
+        # traces: `rotate` is the ICI ppermute pair, `block` the local
+        # online-softmax update it overlaps with
+        with jax.named_scope("ring_consensus.rotate"):
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+        with jax.named_scope("ring_consensus.block"):
+            acc, m, den = block_update(acc, m, den, k, v, (my_idx + s) % size)
         return (k, v, acc, m, den), None
 
     if size > 1:

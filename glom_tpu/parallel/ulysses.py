@@ -42,14 +42,17 @@ def _ulysses_local(
     """shard_map body.  ``levels``: (b, n_local, L, d); returns same shape."""
     # tiled all_to_all trades the level axis for the column axis:
     # (b, n/S, L, d) -> (b, n, L/S, d) — full columns, local levels
-    x = jax.lax.all_to_all(levels, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    with jax.named_scope("ulysses_consensus.all_to_all_fwd"):
+        x = jax.lax.all_to_all(levels, axis_name, split_axis=2, concat_axis=1, tiled=True)
 
-    out = consensus_attention(
-        x, attend_self=attend_self, non_local_mask=non_local_mask
-    )
+    with jax.named_scope("ulysses_consensus.dense_attention"):
+        out = consensus_attention(
+            x, attend_self=attend_self, non_local_mask=non_local_mask
+        )
 
     # inverse exchange: (b, n, L/S, d) -> (b, n/S, L, d)
-    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    with jax.named_scope("ulysses_consensus.all_to_all_bwd"):
+        return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
 
 def make_ulysses_consensus(

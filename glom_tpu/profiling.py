@@ -58,7 +58,20 @@ def memory_analysis(fn, *args, **kwargs):
     return compiled.memory_analysis()
 
 
+def device_memory_profile(path: str) -> None:
+    """Dump the current device memory profile (pprof format) to ``path`` —
+    the point-in-time companion to the live HBM gauges
+    (``glom_tpu.obs.MemoryMonitor``) the trainer logs each window."""
+    jax.profiler.save_device_memory_profile(path)
+
+
 def debug_nans(enable: bool = True) -> None:
     """Toggle eager NaN detection inside jitted code (re-runs the offending
-    primitive un-jitted and raises with its location)."""
+    primitive un-jitted and raises with its location).
+
+    This is the interactive DEBUGGING tool — it re-executes the offending
+    computation and must stay off on the hot path.  For always-on NaN
+    MONITORING during training use ``TrainConfig.monitor_numerics`` (the
+    in-graph counts from ``glom_tpu.obs.monitors.numerics_metrics``, a few
+    reductions per step with no re-execution)."""
     jax.config.update("jax_debug_nans", enable)

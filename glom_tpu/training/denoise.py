@@ -203,7 +203,15 @@ def make_step_fn(
         params = optax.apply_updates(state.params, updates)
         new_state = DenoiseState(params, opt_state, state.step + 1, rng)
         gnorm = optax.global_norm(grads)
-        return new_state, {"loss": loss, "grad_norm": gnorm}
+        metrics = {"loss": loss, "grad_norm": gnorm}
+        if train.monitor_numerics:
+            # in-graph NaN/Inf summary on the grads the step already holds;
+            # under a mesh the grads are post-psum, so the counts are
+            # host-aggregated for free
+            from glom_tpu.obs.monitors import numerics_metrics
+
+            metrics.update(numerics_metrics(grads, loss))
+        return new_state, metrics
 
     return step_fn
 

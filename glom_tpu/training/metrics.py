@@ -1,40 +1,85 @@
-"""Metrics / logging / observability.
+"""Metrics / logging / observability facade.
 
-Absent from the reference (SURVEY.md §5).  A dependency-free JSONL scalar
-logger: one JSON object per line to stdout and/or a file — loss, imgs/sec,
-step time, grad norm — the metrics of record in BASELINE.md.  Multi-host:
-only process 0 emits.
+``MetricLogger`` is what the Trainer (and CLI) log through: one record per
+logging boundary, fanned out to pluggable exporters
+(``glom_tpu.obs.exporters``).  The default configuration keeps the
+historical format — JSONL to stdout plus an optional append-mode file,
+floats now rounded to 6 significant digits — so every existing consumer
+(``tools/plateau_report.py``, ``tools/sweep_log.py``,
+``docs/runs/*.jsonl``) keeps working unchanged.
+
+Record values: ints and bools pass through, floats are rounded for log
+compactness, strings pass through (the ``event`` field is a string from the
+``glom_tpu.obs.registry`` vocabulary — the old magic floats 1.0/2.0 are
+retired).  Multi-host: only process 0 emits.
+
+Deterministic file lifecycle: ``close()`` flushes and closes every
+exporter's handle (context-manager protocol supported; the Trainer calls
+``close()`` on every fit() exit path).  ``close`` is idempotent, and a
+``log`` after ``close`` transparently reopens file sinks in append mode —
+so a Trainer running fit() twice on one logger keeps appending to the same
+file instead of crashing on a closed handle.
 """
 
 from __future__ import annotations
 
-import json
 import sys
 import time
 from typing import IO, Optional
 
 import jax
 
+from glom_tpu.obs.exporters import JsonlExporter, normalize_scalar
+
 
 class MetricLogger:
-    def __init__(self, path: Optional[str] = None, stream: Optional[IO] = None):
+    def __init__(self, path: Optional[str] = None, stream: Optional[IO] = None,
+                 exporters=None, registry=None):
         self._emit = jax.process_index() == 0
-        self._stream = stream if stream is not None else sys.stdout
-        self._file = open(path, "a") if (path and self._emit) else None
+        self.registry = registry
+        self._exporters = []
+        if self._emit:
+            self._exporters.append(
+                JsonlExporter(path=path, stream=stream if stream is not None else sys.stdout)
+            )
+            if exporters:
+                self._exporters.extend(exporters)
         self._t0 = time.time()
+
+    def add_exporter(self, exporter) -> None:
+        """Attach an additional sink (process-0 only — on other hosts this
+        is a no-op, matching the emit gate).  Attaching a second exporter
+        of the same class on the same path is a no-op too: two Trainers
+        sharing one logger must not double-write (or race rewrites of)
+        the same file."""
+        if not self._emit:
+            return
+        path = getattr(exporter, "path", None)
+        if path is not None and any(
+            type(e) is type(exporter) and getattr(e, "path", None) == path
+            for e in self._exporters
+        ):
+            return
+        self._exporters.append(exporter)
 
     def log(self, step: int, **scalars) -> None:
         if not self._emit:
             return
         rec = {"step": int(step), "time": round(time.time() - self._t0, 3)}
         for k, v in scalars.items():
-            rec[k] = float(v)
-        line = json.dumps(rec)
-        print(line, file=self._stream, flush=True)
-        if self._file:
-            self._file.write(line + "\n")
-            self._file.flush()
+            rec[k] = normalize_scalar(v)
+        for ex in self._exporters:
+            if getattr(ex, "wants_registry", False):
+                ex.emit(rec, registry=self.registry)
+            else:
+                ex.emit(rec)
 
     def close(self) -> None:
-        if self._file:
-            self._file.close()
+        for ex in self._exporters:
+            ex.close()
+
+    def __enter__(self) -> "MetricLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -118,6 +118,22 @@ def parse_args(argv=None):
     p.add_argument("--profile-dir", default=None,
                    help="emit a jax.profiler trace of a 3-step window here")
     p.add_argument("--log-file", default=None)
+    # observability (glom_tpu.obs)
+    p.add_argument("--metrics-csv", default=None,
+                   help="also mirror every log record to this CSV file")
+    p.add_argument("--prom-textfile", default=None,
+                   help="write a Prometheus textfile-collector snapshot "
+                        "here at every log boundary (atomic rename)")
+    p.add_argument("--diag-every", type=int, default=0,
+                   help="GLOM-level diagnostics cadence (island agreement, "
+                        "attention entropy, contribution shares) — one "
+                        "extra forward every N steps; 0 = off")
+    p.add_argument("--no-monitor-numerics", action="store_true",
+                   help="disable the in-graph NaN/Inf + grad-spike monitor "
+                        "(on by default; costs a few reductions per step)")
+    p.add_argument("--grad-spike-factor", type=float, default=10.0,
+                   help="flag a window when grad_norm exceeds this factor "
+                        "times its running EMA")
     # multi-host
     p.add_argument("--coordinator", default=None)
     p.add_argument("--num-processes", type=int, default=None)
@@ -181,6 +197,11 @@ def main(argv=None):
         checkpoint_backend=args.checkpoint_backend,
         async_checkpoint=args.async_checkpoint,
         profile_dir=args.profile_dir,
+        monitor_numerics=not args.no_monitor_numerics,
+        grad_spike_factor=args.grad_spike_factor,
+        diag_every=args.diag_every,
+        metrics_csv=args.metrics_csv,
+        prom_textfile=args.prom_textfile,
         seed=args.seed,
         mesh_shape=tuple(args.mesh) if args.mesh else None,
         param_sharding=args.param_sharding,

@@ -21,6 +21,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from glom_tpu import checkpoint as ckpt_lib
 from glom_tpu.config import GlomConfig, TrainConfig
+from glom_tpu.obs import (
+    EVENT_NAN,
+    EVENT_PREEMPT_STOP,
+    EVENT_RECOMPILE,
+    EVENT_RESUME,
+    MemoryMonitor,
+    MetricRegistry,
+    NumericsMonitor,
+    PhaseTimer,
+    RecompileMonitor,
+    flatten_diagnostics,
+)
 from glom_tpu.parallel.mesh import make_mesh
 from glom_tpu.parallel.placement import state_shardings
 from glom_tpu.parallel.sharding import batch_pspec, param_pspecs
@@ -78,7 +90,30 @@ class Trainer:
                     optax.clip_by_global_norm(train.grad_clip_norm), tx
                 )
         self.tx = tx
+        # ONE registry per trainer+logger pair: every monitor and exporter
+        # reports through it, and the Prometheus textfile (when
+        # configured) is its rendered snapshot.  A caller-supplied
+        # logger's registry is ADOPTED — two registries would split the
+        # metrics between what the trainer instruments and what the
+        # exporters render.
         self.logger = logger or MetricLogger()
+        self.registry = getattr(self.logger, "registry", None) or MetricRegistry()
+        # duck-typed custom loggers only owe log()/close(); the registry
+        # handoff and config-driven exporters apply when they speak the
+        # MetricLogger protocol
+        if getattr(self.logger, "registry", "absent") is None:
+            self.logger.registry = self.registry
+        if hasattr(self.logger, "add_exporter"):
+            if train.metrics_csv:
+                from glom_tpu.obs import CsvExporter
+
+                self.logger.add_exporter(CsvExporter(train.metrics_csv))
+            if train.prom_textfile:
+                from glom_tpu.obs import PrometheusTextfileExporter
+
+                self.logger.add_exporter(
+                    PrometheusTextfileExporter(train.prom_textfile)
+                )
 
         if len(train.mesh_axes) < 2:
             raise ValueError(
@@ -244,6 +279,19 @@ class Trainer:
             out_shardings=(self._state_sh, NamedSharding(self.mesh, P())),
             donate_argnums=(0,) if train.donate else (),
         )
+
+        # -- runtime health monitors (glom_tpu.obs) --
+        self._recompile_mon = RecompileMonitor(self._step)
+        self._mem_mon = MemoryMonitor()
+        self._num_mon = NumericsMonitor(spike_factor=train.grad_spike_factor)
+        self._diag = None
+        if train.diag_every:
+            from glom_tpu.obs import make_diagnostics_fn
+
+            self._diag = jax.jit(make_diagnostics_fn(
+                self.config, iters=train.iters, consensus_fn=consensus_fn,
+                ff_fn=ff_fn, state_sharding=act_sh,
+            ))
 
     def set_eval_suite(self, suite) -> None:
         """Attach/replace the held-out eval suite after construction (the
@@ -502,6 +550,13 @@ class Trainer:
                     + traceback.format_exc(),
                     stacklevel=2,
                 )
+            finally:
+                # deterministic file lifecycle: exporters' handles close on
+                # every exit path (a later log() reopens in append mode)
+                try:
+                    self.logger.close()
+                except OSError:
+                    pass  # a full disk must not mask the original error
 
     def _fit(self, batches: Iterator[np.ndarray], steps: Optional[int] = None) -> dict:
         cfg = self.train_cfg
@@ -518,7 +573,7 @@ class Trainer:
         stateful_stream = hasattr(batches, "state_dict")
         if cfg.checkpoint_dir and ckpt_lib.latest_step(cfg.checkpoint_dir) is not None:
             resumed = self.restore(cfg.checkpoint_dir, batches=batches)
-            self.logger.log(resumed, event=1.0)  # resume marker
+            self.logger.log(resumed, event=EVENT_RESUME)
 
         # Preemption safety (TPU pods get SIGTERM'd): convert the signal to
         # a flag, finish the in-flight step, checkpoint, and return cleanly —
@@ -569,10 +624,90 @@ class Trainer:
         )
         return bool(np.asarray(flags).any())
 
+    # per-step numerics keys: logged as WINDOW aggregates (NumericsMonitor),
+    # never as the last step's raw values
+    _NUMERICS_KEYS = ("nonfinite_grads", "loss_nonfinite")
+
+    def _drain_steps(self, timer) -> None:
+        """Wait out the dispatched step backlog, charging the wait to the
+        ``step`` phase.  Called before every BLOCKING phase (eval /
+        diagnostics / checkpoint): under async dispatch those phases'
+        first device_get would otherwise absorb the queued train compute
+        into their own bucket — and since _log_window subtracts them from
+        train time, imgs_per_sec would inflate by the backlog fraction."""
+        with timer.phase("step"):
+            jax.block_until_ready(self.state.params)
+
+    def _numerics_summary(self, step, fetched) -> dict:
+        """Fold one window of fetched per-step metrics into the numerics
+        monitor; emits the ``nan`` event (and bumps the counter) when the
+        window saw nonfinite values.  Shared by the log boundary and the
+        logging-disabled surveillance path."""
+        num = self._num_mon.update(fetched)
+        if num.get("nonfinite_grads") or num.get("loss_nonfinite_steps"):
+            self.registry.counter(
+                "nan_windows", help="logging windows with nonfinite grads/loss"
+            ).inc()
+            self.logger.log(
+                step, event=EVENT_NAN,
+                nonfinite_grads=num["nonfinite_grads"],
+                loss_nonfinite_steps=num["loss_nonfinite_steps"],
+            )
+        return num
+
+    def _log_window(self, step, timer, window_metrics, window_imgs, cfg):
+        """Cut one logging window: fetch the window's per-step device
+        scalars (the loop's ONLY host sync), fold in the health monitors,
+        and emit the phase-timed record.  Returns the logged step's plain
+        metrics (fit()'s return value contract)."""
+        with timer.phase("log_sync"):
+            fetched = jax.device_get(window_metrics)
+        last = {
+            k: float(v) for k, v in fetched[-1].items()
+            if k not in self._NUMERICS_KEYS
+        }
+        num = self._numerics_summary(step, fetched) if cfg.monitor_numerics else {}
+        mem = self._mem_mon.sample()
+        phases = timer.window()
+        # the throughput of record: images over TRAIN time — eval,
+        # checkpoint, diagnostics, and exporter IO no longer silently
+        # deflate imgs/sec (they are reported as their own phases instead)
+        overhead = sum(
+            phases.get(f"t_{p}", 0.0)
+            for p in ("eval", "checkpoint", "diag", "log_emit")
+        )
+        train_dt = max(phases["t_window"] - overhead, 1e-9)
+        # everything from the window cut to the end of exporter IO is
+        # charged to the next window's log_emit phase
+        t_emit = time.monotonic()
+        self.registry.counter("steps_total", help="train steps completed").inc(
+            phases["window_steps"]
+        )
+        self.registry.counter("imgs_total", help="images consumed").inc(window_imgs)
+        for k in ("loss", "grad_norm"):
+            if k in last:
+                self.registry.gauge(k).set(last[k])
+        for k, v in mem.items():
+            self.registry.gauge(k, unit="bytes").set(v)
+        self.logger.log(
+            step,
+            imgs_per_sec=window_imgs / train_dt,
+            imgs_per_sec_per_chip=window_imgs / train_dt / jax.device_count(),
+            **last, **num, **mem, **phases,
+        )
+        # exporter IO is attributed to the NEXT window's log_emit phase
+        # (the record that pays it is the one being written)
+        timer.add("log_emit", time.monotonic() - t_emit)
+        return last
+
     def _fit_loop(self, batches, steps, cfg, stateful_stream):
         last_metrics = {}
         last_saved = -1
-        window_t0, window_imgs = time.time(), 0
+        window_imgs = 0
+        window_metrics = []   # per-step device-scalar dicts; fetched ONCE
+                              # at the log boundary (no per-step host sync)
+        timer = PhaseTimer(registry=self.registry)
+        emitted_recompiles = self._recompile_mon.recompiles
         start_step = int(jax.device_get(self.state.step))
         profiling = False
         completed = steps
@@ -605,55 +740,102 @@ class Trainer:
                     jax.block_until_ready(self.state.params)
                     jax.profiler.stop_trace()
                     profiling = False
-            img = next(batches)
-            img = jax.device_put(img, self._batch_sh)
+            with timer.phase("data_wait"):
+                img = next(batches)
+            with timer.phase("h2d"):
+                img = jax.device_put(img, self._batch_sh)
             if cfg.eval_every and (i + 1) % cfg.eval_every == 0:
-                if self._eval_suite is not None:
-                    # held-out evaluation: PSNR + linear probe on data the
-                    # step function NEVER consumes
-                    ev = self._eval_suite.run(
-                        self.state.params, jax.random.PRNGKey(cfg.seed + i)
-                    )
-                    self.logger.log(i + 1, **ev)
-                elif self._eval is not None:
-                    # legacy fallback (no suite given): evaluate BEFORE the
-                    # step consumes this batch, so the PSNR reflects params
-                    # that have not trained on these images
-                    psnr = self._eval(
-                        self.state.params, img, jax.random.PRNGKey(cfg.seed + i)
-                    )
-                    self.logger.log(i + 1, psnr_db=float(jax.device_get(psnr)))
-            self.state, metrics = self._step(self.state, img)
+                self._drain_steps(timer)
+                with timer.phase("eval"):
+                    if self._eval_suite is not None:
+                        # held-out evaluation: PSNR + linear probe on data
+                        # the step function NEVER consumes
+                        ev = self._eval_suite.run(
+                            self.state.params, jax.random.PRNGKey(cfg.seed + i)
+                        )
+                        self.logger.log(i + 1, **ev)
+                    elif self._eval is not None:
+                        # legacy fallback (no suite given): evaluate BEFORE
+                        # the step consumes this batch, so the PSNR reflects
+                        # params that have not trained on these images
+                        psnr = self._eval(
+                            self.state.params, img, jax.random.PRNGKey(cfg.seed + i)
+                        )
+                        self.logger.log(i + 1, psnr_db=float(jax.device_get(psnr)))
+            with timer.phase("step"):
+                # dispatch only — under async dispatch the device compute
+                # this enqueues is paid for in `log_sync` at the boundary
+                self.state, metrics = self._step(self.state, img)
+            timer.count_step()
             window_imgs += img.shape[0]
-            if cfg.log_every and (i + 1) % cfg.log_every == 0:
-                metrics = {k: float(jax.device_get(v)) for k, v in metrics.items()}
-                dt = time.time() - window_t0
+            if cfg.log_every or cfg.monitor_numerics:
+                window_metrics.append(metrics)
+            if self._recompile_mon.poll() and (
+                self._recompile_mon.recompiles > emitted_recompiles
+            ):
+                # cache growth past the expected first compile: a shape or
+                # dtype changed under the jit — surface it the moment it
+                # happens, with the step that triggered it
+                emitted_recompiles = self._recompile_mon.recompiles
+                self.registry.counter(
+                    "recompiles", help="XLA recompilations of the train step "
+                    "after the first compile"
+                ).inc()
                 self.logger.log(
-                    i + 1,
-                    imgs_per_sec=window_imgs / dt,
-                    imgs_per_sec_per_chip=window_imgs / dt / jax.device_count(),
-                    **metrics,
+                    i + 1, event=EVENT_RECOMPILE,
+                    compile_count=self._recompile_mon.compiles,
                 )
-                last_metrics = metrics
-                window_t0, window_imgs = time.time(), 0
+            if self._diag is not None and (i + 1) % cfg.diag_every == 0:
+                self._drain_steps(timer)
+                with timer.phase("diag"):
+                    diag = flatten_diagnostics(
+                        self._diag(self.state.params["glom"], img)
+                    )
+                for k in ("island_agreement", "attn_entropy"):
+                    self.registry.gauge(k).set(diag[k])
+                self.logger.log(i + 1, **diag)
+            if cfg.log_every and (i + 1) % cfg.log_every == 0:
+                last_metrics = self._log_window(
+                    i + 1, timer, window_metrics, window_imgs, cfg
+                )
+                window_metrics, window_imgs = [], 0
+            elif not cfg.log_every and cfg.monitor_numerics and (
+                (i + 1) % stop_poll == 0
+            ):
+                # logging disabled: NaN surveillance still runs, at the
+                # stop-poll cadence — bounded accumulation, and only the
+                # nan event record is ever emitted
+                fetched = jax.device_get(window_metrics)
+                window_metrics = []
+                self._numerics_summary(i + 1, fetched)
             if (
                 cfg.checkpoint_every
                 and cfg.checkpoint_dir
                 and (i + 1) % cfg.checkpoint_every == 0
             ):
-                self.save(
-                    cfg.checkpoint_dir,
-                    data_state=batches.state_dict() if stateful_stream else None,
-                )
+                self._drain_steps(timer)
+                with timer.phase("checkpoint"):
+                    self.save(
+                        cfg.checkpoint_dir,
+                        data_state=batches.state_dict() if stateful_stream else None,
+                    )
                 last_saved = i + 1
-            if self._should_stop((i + 1) % stop_poll == 0):
-                self.logger.log(i + 1, event=2.0)  # preemption-stop marker
+            with timer.phase("stop_poll"):
+                stop = self._should_stop((i + 1) % stop_poll == 0)
+            if stop:
+                self.logger.log(i + 1, event=EVENT_PREEMPT_STOP)
                 completed = i + 1
                 stopped = True
                 break
         jax.block_until_ready(self.state.params)
         if profiling:
             jax.profiler.stop_trace()
+        if window_metrics and cfg.monitor_numerics:
+            # tail steps past the last boundary (including the ones right
+            # before a preemption stop — where a diverging run most likely
+            # went nonfinite) still get NaN surveillance; the partial
+            # window's throughput record stays dropped as before
+            self._numerics_summary(completed, jax.device_get(window_metrics))
         # Final/preemption save: periodic saves need checkpoint_every, but a
         # preemption save must happen whenever a checkpoint_dir exists at
         # all — otherwise a checkpoint_every=0 run that catches SIGTERM

@@ -39,6 +39,10 @@ def test_every_train_config_field_has_a_cli_path():
         "consistency", "consistency_weight", "consistency_temperature",
         "consistency_level", "stop_poll_steps", "decoder",
         "decoder_hidden_mult",
+        # observability (--no-monitor-numerics / --grad-spike-factor /
+        # --diag-every / --metrics-csv / --prom-textfile)
+        "monitor_numerics", "grad_spike_factor", "diag_every",
+        "metrics_csv", "prom_textfile",
     }
     # fields intentionally config-only (documented, no flag yet)
     config_only = {"loss_level", "mesh_axes", "donate"}

@@ -1,0 +1,666 @@
+"""Telemetry subsystem tests (glom_tpu.obs + the instrumented Trainer).
+
+Covers the ISSUE-1 acceptance surface: registry types, phase-timer
+accounting under a fake clock, Prometheus textfile format, the in-graph
+numerics monitor flagging an injected NaN step, recompile detection on a
+shape change, exporter back-compat with the existing JSONL consumers, and
+the phase-timed smoke run whose per-phase times must account for the
+window wall-clock.
+"""
+
+import json
+import os
+import re
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from glom_tpu.config import GlomConfig, TrainConfig
+from glom_tpu.training.data import synthetic_batches
+from glom_tpu.training.metrics import MetricLogger
+from glom_tpu.training.trainer import Trainer
+
+TINY = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4)
+
+
+# -- registry -------------------------------------------------------------
+
+class TestRegistry:
+    def test_counter_gauge_histogram_semantics(self):
+        from glom_tpu.obs import MetricRegistry
+
+        reg = MetricRegistry()
+        c = reg.counter("steps", help="h")
+        c.inc()
+        c.inc(4)
+        assert c.value == 5
+        with pytest.raises(ValueError, match="cannot decrease"):
+            c.inc(-1)
+        g = reg.gauge("loss")
+        g.set(0.25)
+        assert g.value == 0.25
+        h = reg.histogram("lat")
+        for v in (1.0, 2.0, 3.0, 10.0):
+            h.observe(v)
+        assert h.count == 4 and h.sum == 16.0 and h.max == 10.0
+        assert h.percentile(50) == 2.0
+        assert h.percentile(100) == 10.0
+        # get-or-create returns the same object; type conflicts are errors
+        assert reg.counter("steps") is c
+        with pytest.raises(TypeError, match="already registered"):
+            reg.gauge("steps")
+
+    def test_timer_aliases_its_histogram(self):
+        """histogram() on a timer-registered name returns the underlying
+        Histogram (observable), not the Timer wrapper."""
+        from glom_tpu.obs import MetricRegistry, Timer
+
+        reg = MetricRegistry()
+        tm = reg.timer("x")
+        h = reg.histogram("x")
+        assert h is tm.hist and not isinstance(h, Timer)
+        h.observe(1.0)
+        assert tm.hist.count == 1
+        with pytest.raises(TypeError, match="already registered"):
+            reg.counter("x")
+
+    def test_timer_uses_injected_clock(self):
+        from glom_tpu.obs import MetricRegistry
+
+        t = [0.0]
+
+        def clock():
+            return t[0]
+
+        reg = MetricRegistry()
+        tm = reg.timer("phase", clock=clock)
+        with tm:
+            t[0] += 1.5
+        assert tm.hist.count == 1 and tm.hist.sum == 1.5
+
+    def test_snapshot_flattening(self):
+        from glom_tpu.obs import MetricRegistry
+
+        reg = MetricRegistry()
+        reg.counter("n").inc(3)
+        reg.gauge("g").set(7.0)
+        reg.gauge("unset")          # never set -> omitted
+        h = reg.histogram("h")
+        h.observe(2.0)
+        snap = reg.snapshot()
+        assert snap["n"] == 3 and snap["g"] == 7.0
+        assert "unset" not in snap
+        assert snap["h_count"] == 1 and snap["h_p50"] == 2.0
+
+
+# -- phase timer ----------------------------------------------------------
+
+class TestPhaseTimer:
+    def test_accounting_under_fake_clock(self):
+        from glom_tpu.obs import PhaseTimer
+
+        t = [100.0]
+
+        def clock():
+            return t[0]
+
+        pt = PhaseTimer(clock=clock)
+        for _ in range(2):
+            with pt.phase("data_wait"):
+                t[0] += 0.25
+            with pt.phase("step"):
+                t[0] += 1.0
+            pt.count_step()
+        pt.add("log_emit", 0.05)
+        w = pt.window()
+        assert w["t_data_wait"] == pytest.approx(0.5)
+        assert w["t_step"] == pytest.approx(2.0)
+        assert w["t_log_emit"] == pytest.approx(0.05)
+        assert w["t_window"] == pytest.approx(2.5)
+        assert w["window_steps"] == 2
+        # window reset: a fresh window starts from zero at the cut time
+        with pt.phase("step"):
+            t[0] += 0.5
+        pt.count_step()
+        w2 = pt.window()
+        assert w2["t_step"] == pytest.approx(0.5)
+        assert w2["t_window"] == pytest.approx(0.5)
+        assert "t_data_wait" not in w2
+
+    def test_nested_phase_rejected(self):
+        from glom_tpu.obs import PhaseTimer
+
+        pt = PhaseTimer()
+        with pytest.raises(RuntimeError, match="must not nest"):
+            with pt.phase("a"):
+                with pt.phase("b"):
+                    pass
+
+    def test_registry_gets_per_step_histograms(self):
+        from glom_tpu.obs import MetricRegistry, PhaseTimer
+
+        t = [0.0]
+        reg = MetricRegistry()
+        pt = PhaseTimer(clock=lambda: t[0], registry=reg)
+        with pt.phase("step"):
+            t[0] += 4.0
+        pt.count_step(2)
+        pt.window()
+        assert reg.histogram("phase_step").mean == pytest.approx(2.0)
+        assert reg.histogram("step_time").count == 1
+
+
+# -- exporters ------------------------------------------------------------
+
+class TestExporters:
+    def test_jsonl_back_compat_with_plateau_report(self, tmp_path, capsys):
+        """Records written through the new exporter stack stay consumable
+        by the oldest reader in the repo."""
+        path = tmp_path / "plateau_demo.jsonl"
+        with MetricLogger(path=str(path)) as logger:
+            for s, psnr, acc in [(200, 17.0, 0.20), (600, 18.0, 0.40)]:
+                logger.log(s, eval_psnr_db=psnr, probe_test_acc=acc)
+            logger.log(600, loss=0.1, event="resume")  # non-eval rows
+        capsys.readouterr()
+        import runpy
+
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        old_argv = sys.argv
+        sys.argv = [os.path.join(tools, "plateau_report.py"), str(path)]
+        try:
+            with pytest.raises(SystemExit) as exc:
+                runpy.run_path(sys.argv[0], run_name="__main__")
+        finally:
+            sys.argv = old_argv
+        assert exc.value.code == 0
+        out = capsys.readouterr().out
+        assert "| demo |" in out and "+1.00" in out
+
+    def test_metric_logger_non_numeric_scalars(self, tmp_path):
+        """ints/bools/strings pass through; floats stay rounded."""
+        path = tmp_path / "log.jsonl"
+        with MetricLogger(path=str(path), stream=open(os.devnull, "w")) as lg:
+            lg.log(3, loss=0.123456789, n_shards=4, healthy=True, event="resume")
+        rec = json.loads(path.read_text())
+        assert rec["loss"] == 0.123457
+        assert rec["n_shards"] == 4 and isinstance(rec["n_shards"], int)
+        assert rec["healthy"] is True
+        assert rec["event"] == "resume"
+
+    def test_normalize_scalar_keeps_tiny_floats(self):
+        """Rounding is significant-digit, not absolute: a 4e-7 loss must
+        not collapse to 0.0 in the log."""
+        from glom_tpu.obs.exporters import normalize_scalar
+
+        assert normalize_scalar(4e-7) == 4e-7
+        assert normalize_scalar(0.123456789) == 0.123457
+        assert normalize_scalar(1234567.89) == 1234570.0
+
+    def test_metric_logger_close_then_log_reopens(self, tmp_path):
+        path = tmp_path / "log.jsonl"
+        lg = MetricLogger(path=str(path), stream=open(os.devnull, "w"))
+        lg.log(1, a=1.0)
+        lg.close()
+        lg.close()  # idempotent
+        lg.log(2, a=2.0)  # reopens in append mode
+        lg.close()
+        steps = [json.loads(l)["step"] for l in path.read_text().splitlines()]
+        assert steps == [1, 2]
+
+    def test_csv_exporter_widens_columns(self, tmp_path):
+        from glom_tpu.obs import CsvExporter
+
+        path = tmp_path / "m.csv"
+        ex = CsvExporter(str(path))
+        ex.emit({"step": 1, "loss": 0.5})
+        ex.emit({"step": 2, "loss": 0.4, "psnr": 11.0})
+        lines = path.read_text().strip().splitlines()
+        assert lines[0] == "step,loss,psnr"
+        assert lines[1].startswith("1,0.5") and lines[2] == "2,0.4,11.0"
+
+    def test_csv_exporter_close_then_widen_keeps_history(self, tmp_path):
+        """A post-close emit that widens the header must rewrite the FULL
+        history — and a fresh exporter on an existing file (resumed run)
+        must append, not truncate."""
+        from glom_tpu.obs import CsvExporter
+
+        path = tmp_path / "m.csv"
+        ex = CsvExporter(str(path))
+        ex.emit({"step": 1, "loss": 0.5})
+        ex.emit({"step": 2, "loss": 0.4})
+        ex.close()
+        ex.emit({"step": 3, "loss": 0.3, "psnr": 11.0})  # widening after close
+        lines = path.read_text().strip().splitlines()
+        assert lines[0] == "step,loss,psnr" and len(lines) == 4
+        assert lines[1].startswith("1,0.5")
+
+        ex2 = CsvExporter(str(path))                      # resumed process
+        ex2.emit({"step": 4, "loss": 0.2, "mem": 7.0})    # widens again
+        lines = path.read_text().strip().splitlines()
+        assert lines[0] == "step,loss,psnr,mem" and len(lines) == 5
+        assert lines[1].startswith("1,0.5") and lines[4].startswith("4,0.2")
+
+    def test_shared_logger_exporters_attach_once(self, tmp_path):
+        """Two Trainers sharing one logger (and config-driven exporter
+        paths) must not double-attach the same sink — double writes and
+        racing CSV rewrites would corrupt the file."""
+        from glom_tpu.obs import CsvExporter
+
+        t = TrainConfig(batch_size=8, iters=2, steps=1, log_every=0,
+                        metrics_csv=str(tmp_path / "m.csv"),
+                        prom_textfile=str(tmp_path / "m.prom"))
+        logger = MetricLogger(stream=open(os.devnull, "w"))
+        Trainer(TINY, t, logger=logger)
+        Trainer(TINY, t, logger=logger)
+        csvs = [e for e in logger._exporters if isinstance(e, CsvExporter)]
+        assert len(csvs) == 1
+        assert len(logger._exporters) == 3  # jsonl + csv + prom
+
+    def test_prometheus_textfile_format(self, tmp_path):
+        """Every line must parse under the textfile-collector grammar."""
+        from glom_tpu.obs import MetricRegistry, PrometheusTextfileExporter
+
+        reg = MetricRegistry()
+        reg.counter("imgs_total", help="images consumed").inc(64)
+        reg.gauge("loss").set(0.25)
+        reg.histogram("step_time").observe(0.5)
+        path = tmp_path / "glom.prom"
+        ex = PrometheusTextfileExporter(str(path))
+        ex.emit({"step": 10, "loss": 0.25, "event": "recompile",
+                 "note": "free-form strings are skipped"}, registry=reg)
+        text = path.read_text()
+        assert text.endswith("\n")
+        sample_re = re.compile(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]* (?:NaN|[+-]Inf|-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)$"
+        )
+        meta_re = re.compile(r"^# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+$")
+        for line in text.strip().splitlines():
+            assert sample_re.match(line) or meta_re.match(line), line
+        assert "glom_imgs_total 64" in text
+        assert "# TYPE glom_imgs_total counter" in text
+        assert "glom_event_recompile_total 1" in text
+        assert "glom_loss 0.25" in text
+
+    def test_prometheus_write_is_atomic(self, tmp_path):
+        from glom_tpu.obs import PrometheusTextfileExporter
+
+        path = tmp_path / "glom.prom"
+        ex = PrometheusTextfileExporter(str(path))
+        ex.emit({"step": 1})
+        ex.emit({"step": 2})
+        assert not (tmp_path / "glom.prom.tmp").exists()
+        assert "glom_step 2" in path.read_text()
+
+
+# -- monitors -------------------------------------------------------------
+
+class TestMonitors:
+    def test_recompile_monitor_counts_cache_growth(self):
+        from glom_tpu.obs import RecompileMonitor
+
+        f = jax.jit(lambda x: x * 2)
+        mon = RecompileMonitor(f)
+        assert mon.available
+        f(jnp.ones((2,)))
+        assert mon.poll() == 1 and mon.recompiles == 0  # first compile
+        f(jnp.ones((2,)))
+        assert mon.poll() == 0
+        f(jnp.ones((3,)))  # shape change
+        assert mon.poll() == 1 and mon.recompiles == 1
+
+    def test_recompile_monitor_inert_without_cache_api(self):
+        from glom_tpu.obs import RecompileMonitor
+
+        mon = RecompileMonitor(lambda x: x)
+        assert not mon.available and mon.poll() == 0
+
+    def test_numerics_metrics_flags_injected_nan(self):
+        """The in-graph summary must count nonfinite grads inside the
+        jitted step when the batch carries a NaN."""
+        from glom_tpu.training import denoise
+
+        t = TrainConfig(batch_size=4, iters=2)
+        tx = optax.adam(1e-3)
+        state = denoise.init_state(jax.random.PRNGKey(0), TINY, tx)
+        step = jax.jit(denoise.make_step_fn(TINY, t, tx))
+        img = jnp.ones((4, 3, 16, 16))
+        _, m = step(state, img)
+        assert float(m["nonfinite_grads"]) == 0.0
+        assert float(m["loss_nonfinite"]) == 0.0
+        bad = img.at[0, 0, 0, 0].set(jnp.nan)
+        _, m_bad = step(state, bad)
+        assert float(m_bad["nonfinite_grads"]) > 0.0
+        assert float(m_bad["loss_nonfinite"]) == 1.0
+
+    def test_numerics_monitor_window_summary_and_spike(self):
+        from glom_tpu.obs import NumericsMonitor
+
+        mon = NumericsMonitor(spike_factor=10.0)
+        # healthy windows build the EMA around 1.0
+        out = mon.update([{"grad_norm": 1.0, "nonfinite_grads": 0.0}] * 5)
+        assert out["grad_norm_spike"] == 0.0 and mon.nan_events == 0
+        # a 50x norm is a spike; a NaN step is a nan event
+        out = mon.update([
+            {"grad_norm": 50.0, "nonfinite_grads": 0.0},
+            {"grad_norm": 1.0, "nonfinite_grads": 3.0, "loss_nonfinite": 1.0},
+        ])
+        assert out["grad_norm_spike"] == 1.0
+        assert out["nonfinite_grads"] == 3.0
+        assert out["loss_nonfinite_steps"] == 1.0
+        assert mon.nan_events == 1 and mon.spike_events == 1
+        # the spike did not poison the EMA baseline
+        out = mon.update([{"grad_norm": 1.2, "nonfinite_grads": 0.0}])
+        assert out["grad_norm_spike"] == 0.0
+
+    def test_numerics_monitor_rebaselines_after_sustained_shift(self):
+        """A legitimate sustained grad-norm shift (LR change, loss
+        rescale) must re-baseline within a few windows instead of
+        flagging every window forever (the EMA-latch failure mode)."""
+        from glom_tpu.obs import NumericsMonitor
+
+        mon = NumericsMonitor(spike_factor=10.0, ema_decay=0.5)
+        mon.update([{"grad_norm": 0.1}] * 5)     # baseline ~0.1
+        flagged = 0
+        for _ in range(12):  # steady 2.0 from here on (20x baseline)
+            out = mon.update([{"grad_norm": 2.0}] * 5)
+            flagged += int(out["grad_norm_spike"])
+        assert flagged < 4          # transient alarms only, then adapted
+        assert out["grad_norm_spike"] == 0.0  # latest window is clean
+
+    def test_memory_monitor_degrades_on_cpu(self):
+        from glom_tpu.obs import MemoryMonitor
+
+        sample = MemoryMonitor().sample()
+        assert isinstance(sample, dict)  # {} on CPU; keys prefixed mem_ on TPU
+        assert all(k.startswith("mem_") for k in sample)
+
+
+# -- GLOM diagnostics -----------------------------------------------------
+
+class TestDiagnostics:
+    def test_diagnostics_shapes_and_ranges(self):
+        from glom_tpu.obs import glom_diagnostics
+
+        params = {"glom": __import__("glom_tpu.models.glom", fromlist=["init"]).init(
+            jax.random.PRNGKey(0), TINY)}
+        img = np.random.default_rng(0).standard_normal((2, 3, 16, 16)).astype(np.float32)
+        d = glom_diagnostics(params["glom"], img, config=TINY, iters=2)
+        L = TINY.levels
+        for i in range(L):
+            assert -1.0 <= d[f"island_agreement_L{i}"] <= 1.0
+            assert 0.0 <= d[f"attn_entropy_L{i}"] <= np.log(TINY.num_patches) + 1e-5
+        shares = [d[f"contrib_share_{k}"]
+                  for k in ("prev", "bottom_up", "top_down", "attention")]
+        assert all(s >= 0 for s in shares)
+        assert sum(shares) == pytest.approx(1.0, abs=1e-5)
+
+    def test_trainer_diag_cadence_logs_island_agreement(self, tmp_path, capsys):
+        t = TrainConfig(batch_size=8, iters=2, steps=4, log_every=0, diag_every=2)
+        trainer = Trainer(TINY, t)
+        trainer.fit(synthetic_batches(8, 16), steps=4)
+        out = capsys.readouterr().out
+        recs = [json.loads(l) for l in out.splitlines() if "island_agreement" in l]
+        assert len(recs) == 2
+        assert all("attn_entropy" in r and "contrib_share_prev" in r for r in recs)
+
+
+# -- instrumented trainer loop --------------------------------------------
+
+class TestTrainerObs:
+    def test_phase_timed_smoke_accounts_for_wall_clock(self, tmp_path):
+        """ISSUE-1 acceptance: per-phase times sum to within 10% of the
+        window wall clock, on a CPU smoke run with eval + checkpointing."""
+        log = tmp_path / "run.jsonl"
+        t = TrainConfig(batch_size=8, iters=2, steps=8, log_every=2,
+                        eval_every=4, checkpoint_every=4,
+                        checkpoint_dir=str(tmp_path / "ckpt"),
+                        prom_textfile=str(tmp_path / "glom.prom"))
+        trainer = Trainer(TINY, t,
+                          logger=MetricLogger(path=str(log),
+                                              stream=open(os.devnull, "w")))
+        trainer.fit(synthetic_batches(8, 16), steps=8)
+        recs = [json.loads(l) for l in log.read_text().splitlines()]
+        windows = [r for r in recs if "t_window" in r]
+        assert len(windows) == 4
+        covered = total = 0.0
+        for w in windows:
+            phases = {k: v for k, v in w.items()
+                      if k.startswith("t_") and k != "t_window"}
+            assert phases["t_step"] > 0 and "t_data_wait" in phases
+            covered += sum(phases.values())
+            total += w["t_window"]
+        assert covered <= total * 1.001
+        assert covered >= 0.9 * total, (covered, total, windows)
+        # eval + checkpoint phases were actually attributed
+        assert any("t_eval" in w for w in windows)
+        assert any("t_checkpoint" in w for w in windows)
+        # the Prometheus textfile landed and carries the registry state
+        prom = (tmp_path / "glom.prom").read_text()
+        assert "glom_steps_total 8" in prom
+        # deterministic close: the exporter's handle is shut on fit exit
+        assert trainer.logger._exporters[0]._file is None
+
+    def test_recompile_event_on_shape_change(self, capsys):
+        """ISSUE-1 acceptance: a shape change under the jitted step emits a
+        recompile event with the compile count."""
+        from glom_tpu.parallel.mesh import make_mesh
+
+        t = TrainConfig(batch_size=8, iters=2, steps=4, log_every=1,
+                        mesh_shape=(1, 1, 1))
+        trainer = Trainer(
+            TINY, t, mesh=make_mesh((1, 1, 1), devices=jax.devices()[:1])
+        )
+
+        def batches():
+            rng = np.random.default_rng(0)
+            for shape in ((8, 3, 16, 16), (8, 3, 16, 16),
+                          (4, 3, 16, 16), (4, 3, 16, 16)):
+                yield rng.standard_normal(shape).astype(np.float32)
+
+        trainer.fit(batches(), steps=4)
+        out = capsys.readouterr().out
+        events = [json.loads(l) for l in out.splitlines() if "recompile" in l]
+        assert events and events[0]["event"] == "recompile"
+        assert events[0]["compile_count"] >= 2
+        assert trainer._recompile_mon.recompiles >= 1
+
+    def test_nan_window_emits_event(self, capsys):
+        """An injected NaN batch surfaces as a window nan event (in-graph
+        count -> host monitor -> JSONL), without jax_debug_nans."""
+        t = TrainConfig(batch_size=8, iters=2, steps=2, log_every=1)
+        trainer = Trainer(TINY, t)
+        stream = synthetic_batches(8, 16)
+
+        def batches():
+            yield next(stream)
+            bad = next(stream)
+            bad[0, 0, 0, 0] = np.nan
+            yield bad
+
+        trainer.fit(batches(), steps=2)
+        out = capsys.readouterr().out
+        nan_events = [json.loads(l) for l in out.splitlines() if '"nan"' in l]
+        assert nan_events and nan_events[0]["nonfinite_grads"] > 0
+        assert trainer._num_mon.nan_events == 1
+        # the window record itself carries the aggregate too
+        recs = [json.loads(l) for l in out.splitlines() if "t_window" in l]
+        assert recs[-1]["nonfinite_grads"] > 0
+
+    def test_nan_surveillance_without_logging(self, capsys):
+        """log_every=0 with monitor_numerics on: NaN storms still surface
+        (at the stop-poll cadence) even though no window records exist."""
+        t = TrainConfig(batch_size=8, iters=2, steps=4, log_every=0,
+                        stop_poll_steps=2)
+        trainer = Trainer(TINY, t)
+        stream = synthetic_batches(8, 16)
+
+        def batches():
+            for k in range(4):
+                b = next(stream)
+                if k == 1:
+                    b[0, 0, 0, 0] = np.nan
+                yield b
+
+        trainer.fit(batches(), steps=4)
+        out = capsys.readouterr().out
+        recs = [json.loads(l) for l in out.splitlines() if l.startswith("{")]
+        assert [r for r in recs if r.get("event") == "nan"]
+        # the NaN propagates into params, so every later window is bad too
+        assert trainer._num_mon.nan_events >= 1
+        assert not [r for r in recs if "t_window" in r]  # logging stayed off
+
+    def test_tail_window_numerics_not_dropped(self, capsys):
+        """Steps past the last log boundary still get NaN surveillance:
+        a NaN in the final partial window must emit the nan event."""
+        t = TrainConfig(batch_size=8, iters=2, steps=3, log_every=2)
+        trainer = Trainer(TINY, t)
+        stream = synthetic_batches(8, 16)
+
+        def batches():
+            for k in range(3):
+                b = next(stream)
+                if k == 2:  # last step, after the step-2 boundary
+                    b[0, 0, 0, 0] = np.nan
+                yield b
+
+        trainer.fit(batches(), steps=3)
+        out = capsys.readouterr().out
+        nan_events = [json.loads(l) for l in out.splitlines()
+                      if '"nan"' in l]
+        assert nan_events and nan_events[-1]["step"] == 3
+
+    def test_caller_registry_is_adopted(self, tmp_path):
+        """A logger constructed with its own registry must end up with the
+        trainer's metrics in THAT registry (no silent two-registry split
+        that would empty the Prometheus snapshot)."""
+        from glom_tpu.obs import MetricRegistry
+
+        reg = MetricRegistry()
+        logger = MetricLogger(stream=open(os.devnull, "w"), registry=reg)
+        t = TrainConfig(batch_size=8, iters=2, steps=2, log_every=1)
+        trainer = Trainer(TINY, t, logger=logger)
+        assert trainer.registry is reg
+        trainer.fit(synthetic_batches(8, 16), steps=2)
+        assert reg.counter("steps_total").value == 2
+
+    def test_monitor_numerics_off_keeps_plain_metrics(self):
+        t = TrainConfig(batch_size=8, iters=2, steps=2, log_every=1,
+                        monitor_numerics=False)
+        trainer = Trainer(TINY, t)
+        metrics = trainer.fit(synthetic_batches(8, 16), steps=2)
+        assert "loss" in metrics and "nonfinite_grads" not in metrics
+
+    def test_throughput_excludes_eval_and_checkpoint_time(self):
+        """The imgs/sec fix: a window with slow eval must not deflate the
+        throughput of record.  Compare against the raw-window rate."""
+        from glom_tpu.obs import PhaseTimer
+
+        t = [0.0]
+        pt = PhaseTimer(clock=lambda: t[0])
+        with pt.phase("step"):
+            t[0] += 1.0
+        with pt.phase("eval"):
+            t[0] += 9.0
+        pt.count_step()
+        w = pt.window()
+        overhead = w.get("t_eval", 0.0) + w.get("t_checkpoint", 0.0)
+        train_dt = w["t_window"] - overhead
+        assert train_dt == pytest.approx(1.0)   # 10 imgs in 1s train time
+        assert w["t_window"] == pytest.approx(10.0)
+
+
+# -- obs_report tool on the golden fixture --------------------------------
+
+def test_obs_report_golden_fixture(capsys):
+    """tools/obs_report.py summarizes the committed golden log: per-phase
+    percentiles, recompile/NaN counts, final island agreement."""
+    import runpy
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    fixture = os.path.join(here, "data", "golden_obs.jsonl")
+    tool = os.path.join(os.path.dirname(here), "tools", "obs_report.py")
+    old_argv = sys.argv
+    sys.argv = [tool, fixture, "--json"]
+    try:
+        with pytest.raises(SystemExit) as exc:
+            runpy.run_path(tool, run_name="__main__")
+    finally:
+        sys.argv = old_argv
+    assert exc.value.code == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["last_step"] == 52
+    assert s["recompiles"] == 1 and s["compile_count"] == 2
+    assert s["nan_windows"] == 1 and s["nonfinite_grads_total"] == 6.0
+    assert s["grad_spike_windows"] == 1
+    assert s["events"] == {"resume": 1, "recompile": 1, "nan": 1,
+                           "preempt_stop": 1}
+    assert s["final_island_agreement"] == pytest.approx(0.9667)
+    phase_names = {p["phase"] for p in s["phases"]}
+    assert {"step", "data_wait", "h2d"} <= phase_names
+    p50 = {p["phase"]: p["p50_ms"] for p in s["phases"]}
+    # step-phase p50 over the three full windows: 437.9, 127.9, 104.3,
+    # 103.3 ms/step -> nearest-rank p50 = 104.3 (per-window, per-step)
+    assert p50["step"] == pytest.approx(104.27, abs=0.1)
+
+
+def test_obs_report_tolerates_legacy_logs(tmp_path, capsys):
+    """Pre-obs JSONL (no t_* keys, float event markers) still summarizes."""
+    import runpy
+
+    p = tmp_path / "legacy.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"step": 5, "time": 1.0, "event": 1.0}) + "\n")
+        f.write(json.dumps({"step": 10, "time": 2.0, "loss": 0.5,
+                            "imgs_per_sec": 100.0}) + "\n")
+        f.write("garbage not json\n")
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "obs_report.py")
+    old_argv = sys.argv
+    sys.argv = [tool, str(p), "--json"]
+    try:
+        with pytest.raises(SystemExit) as exc:
+            runpy.run_path(tool, run_name="__main__")
+    finally:
+        sys.argv = old_argv
+    assert exc.value.code == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["events"] == {"resume": 1}
+    assert s["imgs_per_sec_best"] == 100.0
+    assert s["phases"] == []
+
+
+def test_obs_report_counts_nan_events_without_window_records(tmp_path, capsys):
+    """log_every=0 surveillance runs emit numerics ONLY on nan event
+    records — the report must count them (and not double-count when a
+    window record at the same step exists too)."""
+    import runpy
+
+    p = tmp_path / "surv.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"step": 10, "event": "nan",
+                            "nonfinite_grads": 512.0,
+                            "loss_nonfinite_steps": 3.0}) + "\n")
+        f.write(json.dumps({"step": 20, "nonfinite_grads": 4.0,
+                            "window_steps": 10, "t_window": 1.0,
+                            "t_step": 0.9}) + "\n")
+        f.write(json.dumps({"step": 20, "event": "nan",          # duplicate
+                            "nonfinite_grads": 4.0,
+                            "loss_nonfinite_steps": 0.0}) + "\n")
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "obs_report.py")
+    old_argv = sys.argv
+    sys.argv = [tool, str(p), "--json"]
+    try:
+        with pytest.raises(SystemExit) as exc:
+            runpy.run_path(tool, run_name="__main__")
+    finally:
+        sys.argv = old_argv
+    assert exc.value.code == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["nan_windows"] == 2
+    assert s["nonfinite_grads_total"] == 516.0

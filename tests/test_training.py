@@ -863,7 +863,7 @@ def test_sigterm_checkpoints_and_exits_cleanly(tmp_path):
     import json as _json
 
     events = [_json.loads(l) for l in log.read_text().splitlines()]
-    stop = [e for e in events if e.get("event") == 2.0]
+    stop = [e for e in events if e.get("event") == "preempt_stop"]
     assert stop, events[-3:]
     stop_step = stop[-1]["step"]
     import glom_tpu.checkpoint as ckpt_lib

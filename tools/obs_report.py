@@ -1,0 +1,197 @@
+"""Summarize a training run's phase-timed JSONL into one health report.
+
+  python tools/obs_report.py docs/runs/run.jsonl [--json]
+
+Reads the records the obs-instrumented Trainer emits (phase times
+``t_<phase>`` per logging window, ``window_steps``, string ``event``
+markers, window-aggregated numerics, GLOM diagnostics) and prints:
+
+  * per-phase p50 / p95 / share-of-wall step time (ms/step, normalized by
+    each window's ``window_steps``);
+  * throughput (imgs/sec p50 / best);
+  * recompile count, NaN windows, grad-norm spike windows, resume /
+    preemption events;
+  * final island agreement / attention entropy when diagnostics ran.
+
+Tolerates pre-obs logs (no ``t_*`` keys — phases section is skipped) and
+legacy float event markers (1.0 resume / 2.0 stop), so it runs on every
+JSONL under ``docs/runs/``.  ``--json`` emits the summary as one JSON
+object for machine consumers (CI gates).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _percentile(xs, q):
+    """Nearest-rank percentile, q in [0, 100]."""
+    if not xs:
+        return None
+    import math
+
+    ordered = sorted(xs)
+    rank = min(len(ordered) - 1, max(0, math.ceil(q / 100.0 * len(ordered)) - 1))
+    return ordered[rank]
+
+
+def read_records(path):
+    recs = []
+    with open(path) as f:
+        for line in f:
+            # truncated/garbage lines (timeout-killed runs) must not abort
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                recs.append(json.loads(line))
+            except ValueError:
+                continue
+    return recs
+
+
+# pre-obs logs used float markers; mirrors
+# glom_tpu.obs.registry.LEGACY_EVENT_FLOATS (inlined so this reader runs
+# without importing the jax-backed package)
+LEGACY_EVENT_FLOATS = {1.0: "resume", 2.0: "preempt_stop"}
+
+
+def summarize(recs):
+    phases = {}          # name -> [ms/step per window]
+    window_ms = []
+    rates = []
+    events = {}
+    nan_steps = set()    # steps already counted (a nan EVENT and a window
+                         # record at the same step describe one incident)
+    spike_windows = 0
+    nonfinite_total = 0.0
+    compile_count = None
+    final_diag = {}
+    last_step = 0
+
+    def count_nan(rec):
+        nonlocal nonfinite_total
+        step = rec.get("step", 0)
+        if step in nan_steps:
+            return
+        nan_steps.add(step)
+        nonfinite_total += rec.get("nonfinite_grads", 0) or 0
+
+    for rec in recs:
+        last_step = max(last_step, int(rec.get("step", 0)))
+        ev = rec.get("event")
+        if ev is not None:
+            if isinstance(ev, float):
+                ev = LEGACY_EVENT_FLOATS.get(ev, f"legacy_{ev}")
+            events[ev] = events.get(ev, 0) + 1
+            if ev == "recompile" and "compile_count" in rec:
+                compile_count = rec["compile_count"]
+            if ev == "nan":
+                # logging-disabled runs carry numerics ONLY on the event
+                # record — it must count even without a window record
+                count_nan(rec)
+            continue
+        steps = rec.get("window_steps")
+        if steps:
+            for k, v in rec.items():
+                if k.startswith("t_") and k != "t_window":
+                    phases.setdefault(k[2:], []).append(1e3 * v / steps)
+            if "t_window" in rec:
+                window_ms.append(1e3 * rec["t_window"] / steps)
+        if "imgs_per_sec" in rec:
+            rates.append(rec["imgs_per_sec"])
+        if rec.get("nonfinite_grads") or rec.get("loss_nonfinite_steps"):
+            count_nan(rec)
+        if rec.get("grad_norm_spike"):
+            spike_windows += 1
+        for k in rec:
+            if k.startswith(("island_agreement", "attn_entropy", "contrib_share_")):
+                final_diag[k] = rec[k]
+
+    phase_rows = [
+        {
+            "phase": name,
+            "p50_ms": _percentile(xs, 50),
+            "p95_ms": _percentile(xs, 95),
+            "share": (sum(xs) / sum(window_ms)) if window_ms and sum(window_ms) else None,
+        }
+        for name, xs in sorted(
+            phases.items(), key=lambda kv: -sum(kv[1])
+        )
+    ]
+    return {
+        "records": len(recs),
+        "last_step": last_step,
+        "step_time_ms_p50": _percentile(window_ms, 50),
+        "step_time_ms_p95": _percentile(window_ms, 95),
+        "phases": phase_rows,
+        "imgs_per_sec_p50": _percentile(rates, 50),
+        "imgs_per_sec_best": max(rates) if rates else None,
+        "events": events,
+        "recompiles": events.get("recompile", 0),
+        "compile_count": compile_count,
+        "nan_windows": len(nan_steps),
+        "nonfinite_grads_total": nonfinite_total,
+        "grad_spike_windows": spike_windows,
+        "final_island_agreement": final_diag.get("island_agreement"),
+        "final_attn_entropy": final_diag.get("attn_entropy"),
+    }
+
+
+def _fmt(v, spec=".2f"):
+    return "—" if v is None else format(v, spec)
+
+
+def print_report(s):
+    print(f"records: {s['records']}   last step: {s['last_step']}")
+    if s["step_time_ms_p50"] is not None:
+        print(f"step time: p50 {_fmt(s['step_time_ms_p50'])} ms   "
+              f"p95 {_fmt(s['step_time_ms_p95'])} ms")
+    if s["phases"]:
+        print("\n| phase | p50 ms/step | p95 ms/step | share of wall |")
+        print("|---|---|---|---|")
+        for row in s["phases"]:
+            share = "—" if row["share"] is None else f"{100 * row['share']:.1f}%"
+            print(f"| {row['phase']} | {_fmt(row['p50_ms'])} | "
+                  f"{_fmt(row['p95_ms'])} | {share} |")
+    if s["imgs_per_sec_p50"] is not None:
+        print(f"\nthroughput: p50 {_fmt(s['imgs_per_sec_p50'])} imgs/sec   "
+              f"best {_fmt(s['imgs_per_sec_best'])}")
+    print(f"\nhealth: recompiles={s['recompiles']}"
+          + (f" (compile_count={s['compile_count']})" if s["compile_count"] else "")
+          + f"   nan_windows={s['nan_windows']}"
+          f" (nonfinite elements: {int(s['nonfinite_grads_total'])})"
+          f"   grad_spike_windows={s['grad_spike_windows']}")
+    if s["events"]:
+        print("events: " + ", ".join(f"{k}x{v}" for k, v in sorted(s["events"].items())))
+    if s["final_island_agreement"] is not None:
+        print(f"final island agreement: {s['final_island_agreement']:.4f}   "
+              f"attention entropy: {_fmt(s['final_attn_entropy'], '.3f')} nats")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("jsonl", help="phase-timed training log (MetricLogger JSONL)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the summary as one JSON object")
+    args = p.parse_args(argv)
+    try:
+        recs = read_records(args.jsonl)
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if not recs:
+        print(f"error: no JSON records in {args.jsonl}", file=sys.stderr)
+        return 1
+    s = summarize(recs)
+    if args.json:
+        print(json.dumps(s))
+    else:
+        print_report(s)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
