@@ -223,10 +223,26 @@ def main():
     recompile_mon.poll()  # absorb the warmup compile(s)
 
     if args.profile_dir:
+        # same trace plumbing and phase names as the Trainer's loop
+        # (glom_tpu.profiling.trace + annotate), so a bench trace and a
+        # trainer trace read identically in TensorBoard/Perfetto
+        from glom_tpu.profiling import annotate, trace as profiler_trace
+
         try:
-            with jax.profiler.trace(args.profile_dir):
+            with profiler_trace(args.profile_dir):
                 for _ in range(3):
-                    state, metrics = trainer._step(state, next_img())
+                    if args.data == "images":
+                        # split exactly like the trainer's phases: decode
+                        # stall is data_wait, the transfer is h2d
+                        with annotate("data_wait"):
+                            host = next(batches)
+                        with annotate("h2d"):
+                            img = jax.device_put(host, trainer._batch_sh)
+                    else:
+                        with annotate("data_wait"):
+                            img = next_img()  # resident batch, no H2D
+                    with annotate("step"):
+                        state, metrics = trainer._step(state, img)
                 jax.block_until_ready(state.params)
             print(f"# trace written to {args.profile_dir}", flush=True)
         except Exception as e:  # tracing must never cost the number of record

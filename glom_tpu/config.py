@@ -180,6 +180,25 @@ class TrainConfig:
     # additional exporters next to the default stdout/file JSONL
     metrics_csv: Optional[str] = None    # CSV mirror of every log record
     prom_textfile: Optional[str] = None  # Prometheus textfile-collector path
+    # -- forensics (glom_tpu.obs.forensics / .triggers) --
+    # The flight recorder (a bounded in-memory ring of recent log records)
+    # is ON by default — it costs one host-side dict copy per logging
+    # boundary.  BUNDLES (evidence written to disk when a monitor fires,
+    # the run crashes, or preemption stops it) require forensics_dir.
+    forensics_dir: Optional[str] = None  # bundle root; None = no bundles
+    forensics_ring: int = 256            # flight-recorder capacity; 0 = off
+    forensics_max_captures: int = 3      # global per-run capture budget
+    forensics_debounce_steps: int = 200  # per-trigger re-fire spacing (steps)
+    # >0: each capture also records a jax.profiler trace of this many
+    # subsequent steps into the bundle.  OFF by default (tens of MB per
+    # capture); unlike profile_dir's always-on 3-step window this one is
+    # anomaly-triggered and budget-bounded.  Ignored while profile_dir is
+    # set (two concurrent jax traces cannot coexist).
+    forensics_trace_steps: int = 0
+    forensics_hlo: bool = True           # snapshot HLO + cost/memory analysis
+    # step-time p95 regression trigger: fire when the recent windows' p95
+    # per-step TRAIN time exceeds factor x the rolling baseline p95; 0 = off
+    forensics_step_time_factor: float = 2.0
     # npz backend only: snapshot to host synchronously (correct under buffer
     # donation), then serialize+write on a background thread so the step
     # loop never stalls on checkpoint IO; at most one write in flight
@@ -258,6 +277,34 @@ class TrainConfig:
             )
         if self.diag_every < 0:
             raise ValueError(f"diag_every must be >= 0, got {self.diag_every}")
+        if self.forensics_ring < 0:
+            raise ValueError(
+                f"forensics_ring must be >= 0 (0 disables the flight "
+                f"recorder), got {self.forensics_ring}"
+            )
+        if self.forensics_max_captures < 0:
+            raise ValueError(
+                f"forensics_max_captures must be >= 0, got "
+                f"{self.forensics_max_captures}"
+            )
+        if self.forensics_debounce_steps < 1:
+            raise ValueError(
+                f"forensics_debounce_steps must be >= 1, got "
+                f"{self.forensics_debounce_steps}"
+            )
+        if self.forensics_trace_steps < 0:
+            raise ValueError(
+                f"forensics_trace_steps must be >= 0 (0 disables triggered "
+                f"traces), got {self.forensics_trace_steps}"
+            )
+        if self.forensics_step_time_factor < 0 or (
+            0 < self.forensics_step_time_factor <= 1.0
+        ):
+            raise ValueError(
+                f"forensics_step_time_factor must be 0 (off) or > 1 (it "
+                f"multiplies the baseline p95), got "
+                f"{self.forensics_step_time_factor}"
+            )
         if self.grad_spike_factor <= 1.0:
             raise ValueError(
                 f"grad_spike_factor must be > 1 (it multiplies the EMA), "

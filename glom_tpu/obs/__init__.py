@@ -20,12 +20,19 @@ report through.  Four pieces, each usable on its own:
   * :mod:`glom_tpu.obs.exporters` — pluggable sinks: back-compatible
     JSONL, CSV, and a Prometheus textfile exporter for node-exporter
     style scraping.
+  * :mod:`glom_tpu.obs.triggers` — the anomaly-trigger engine: per-trigger
+    debounce + global capture budget, plus the rolling step-time p95
+    regression detector.
+  * :mod:`glom_tpu.obs.forensics` — triggered evidence capture: the
+    flight-recorder ring, env fingerprint, atomic post-mortem bundles
+    (flight recorder + HLO/cost snapshot + optional bounded trace window).
 
 ``training/metrics.py``'s ``MetricLogger`` is the facade the Trainer
 logs through; it fans records out to the configured exporters.
 """
 
 from glom_tpu.obs.registry import (  # noqa: F401
+    EVENT_FORENSICS,
     EVENT_NAN,
     EVENT_PREEMPT_STOP,
     EVENT_RECOMPILE,
@@ -52,4 +59,15 @@ from glom_tpu.obs.exporters import (  # noqa: F401
     CsvExporter,
     JsonlExporter,
     PrometheusTextfileExporter,
+)
+from glom_tpu.obs.triggers import (  # noqa: F401
+    StepTimeRegressionMonitor,
+    TriggerEngine,
+)
+from glom_tpu.obs.forensics import (  # noqa: F401
+    FlightRecorder,
+    ForensicsManager,
+    env_fingerprint,
+    is_bundle_dir,
+    write_bundle,
 )

@@ -1,0 +1,375 @@
+"""Anomaly-triggered forensics: flight recorder and post-mortem bundles.
+
+When a monitor fires (or the run crashes / gets preempted), the evidence —
+the last N log records, the jitted step's HLO and compiler cost model, the
+environment that produced them — is exactly what a line in a JSONL file
+does NOT preserve.  This module captures it:
+
+  * :class:`FlightRecorder` — a bounded ring of the records the trainer
+    logs (window records with phase timings, event records, diagnostics).
+    Appending is a host-side dict copy at the LOGGING cadence — never a
+    per-step device sync.
+  * :func:`env_fingerprint` — jax/jaxlib versions, backend, devices, mesh
+    shape, git SHA: the "which build on which hardware" half of every
+    post-mortem.
+  * :func:`write_bundle` — atomic bundle publish: files are written into a
+    dot-prefixed staging directory and renamed into place, so a reader
+    (or a crashed writer) can never observe a partial bundle.
+  * :class:`ForensicsManager` — orchestrates one capture: flush the ring,
+    snapshot HLO/cost via a caller-supplied closure, optionally arm a
+    bounded ``jax.profiler`` trace window, and write
+    ``<root>/<trigger>-<step>/``.
+
+``tools/forensics_report.py`` summarizes a bundle.  The trigger policy
+(debounce, budget, the step-time regression detector) lives in
+:mod:`glom_tpu.obs.triggers`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+import warnings
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from glom_tpu.obs.exporters import normalize_scalar
+
+BUNDLE_SCHEMA = 1
+MANIFEST = "manifest.json"
+_STAGING_PREFIX = ".tmp-"
+
+
+def env_fingerprint(mesh=None) -> Dict[str, Any]:
+    """Environment identity for a bundle: versions, backend, topology, git
+    SHA.  Every field degrades to ``None`` rather than raising — a
+    fingerprint must be writable from any crash path."""
+    fp: Dict[str, Any] = {}
+    try:
+        import jax
+
+        fp["jax_version"] = jax.__version__
+        try:
+            import jaxlib
+
+            fp["jaxlib_version"] = jaxlib.__version__
+        except Exception:
+            fp["jaxlib_version"] = None
+        fp["backend"] = jax.default_backend()
+        devs = jax.devices()
+        fp["device_count"] = len(devs)
+        fp["local_device_count"] = jax.local_device_count()
+        fp["device_kind"] = devs[0].device_kind if devs else None
+        fp["process_index"] = jax.process_index()
+        fp["process_count"] = jax.process_count()
+    except Exception:
+        fp.setdefault("jax_version", None)
+    if mesh is not None:
+        try:
+            fp["mesh_shape"] = {str(k): int(v) for k, v in dict(mesh.shape).items()}
+        except Exception:
+            fp["mesh_shape"] = None
+    import platform
+    import sys
+
+    fp["python_version"] = sys.version.split()[0]
+    fp["hostname"] = platform.node()
+    fp["git_sha"] = _git_sha()
+    return fp
+
+
+def _git_sha() -> Optional[str]:
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, timeout=5,
+        )
+        sha = out.stdout.decode().strip()
+        return sha if out.returncode == 0 and sha else None
+    except Exception:
+        return None
+
+
+class FlightRecorder:
+    """Bounded ring of the run's recent log records.
+
+    The trainer tees every record it logs (window records, events,
+    diagnostics) into ``record()``; ``snapshot()`` returns the ring oldest
+    first.  Values are normalized with the exporters' one scalar rule so a
+    flushed ring is byte-identical in shape to the JSONL log it mirrors —
+    readers share one schema.  Recording never raises: a value that won't
+    normalize is stored as ``repr`` (losing a field beats losing the run).
+    """
+
+    def __init__(self, capacity: int = 256):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._ring: deque = deque(maxlen=capacity)
+        self._t0 = time.time()
+        self.recorded = 0  # lifetime total (ring holds min(recorded, capacity))
+
+    def record(self, step: int, scalars: Dict[str, Any]) -> None:
+        rec: Dict[str, Any] = {"step": int(step),
+                               "time": round(time.time() - self._t0, 3)}
+        for k, v in scalars.items():
+            try:
+                rec[k] = normalize_scalar(v)
+            except Exception:
+                rec[k] = repr(v)
+        self._ring.append(rec)
+        self.recorded += 1
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        return list(self._ring)
+
+    def to_jsonl(self) -> str:
+        return "".join(json.dumps(r) + "\n" for r in self._ring)
+
+
+def write_bundle(root: str, name: str, files: Dict[str, Any]) -> str:
+    """Atomically publish ``{filename: content}`` as ``<root>/<name>/``.
+
+    Contents are str (text) or bytes; dicts/lists are JSON-encoded.  All
+    files land in a ``.tmp-`` staging directory first and the directory is
+    renamed into place — a crashed writer leaves only a dot-prefixed
+    staging dir (cleaned on the next attempt, ignored by readers), never a
+    partial bundle.  If ``name`` already exists a ``-<k>`` suffix is
+    appended rather than clobbering earlier evidence."""
+    os.makedirs(root, exist_ok=True)
+    final = os.path.join(root, name)
+    k = 1
+    while os.path.exists(final):
+        k += 1
+        final = os.path.join(root, f"{name}-{k}")
+    staging = os.path.join(root, f"{_STAGING_PREFIX}{os.path.basename(final)}-{os.getpid()}")
+    if os.path.exists(staging):
+        shutil.rmtree(staging, ignore_errors=True)
+    os.makedirs(staging)
+    try:
+        for fname, content in files.items():
+            if isinstance(content, (dict, list)):
+                content = json.dumps(content, indent=2, default=repr)
+            mode = "wb" if isinstance(content, bytes) else "w"
+            with open(os.path.join(staging, fname), mode) as f:
+                f.write(content)
+        os.replace(staging, final)
+    except BaseException:
+        shutil.rmtree(staging, ignore_errors=True)
+        raise
+    return final
+
+
+def is_bundle_dir(path: str) -> bool:
+    """A published bundle: has a manifest and is not a staging leftover."""
+    return (os.path.isdir(path)
+            and not os.path.basename(path).startswith(_STAGING_PREFIX)
+            and not os.path.basename(path).startswith(".")
+            and os.path.exists(os.path.join(path, MANIFEST)))
+
+
+class ForensicsManager:
+    """One capture pipeline: ring flush + env/config + step snapshot +
+    optional bounded trace window, written as an atomic bundle.
+
+    ``snapshot_fn`` is a zero-arg closure returning
+    ``{"hlo": str, "cost_analysis": dict, "memory_analysis": dict}`` (the
+    trainer binds it to its jitted step via
+    ``glom_tpu.profiling.compile_snapshot``); it may be None (no HLO in
+    bundles) and any exception it raises is recorded in the manifest
+    instead of propagating — forensics must never kill the run it is
+    documenting.
+
+    Trace windows: with ``trace_steps > 0`` a capture starts a
+    ``jax.profiler`` trace into ``<bundle>/trace`` and the step loop calls
+    :meth:`trace_due` / :meth:`stop_trace` to end it ``trace_steps`` steps
+    later.  At most one trace is in flight; a capture that finds one
+    running simply skips tracing.
+    """
+
+    def __init__(self, root: str, *, recorder: Optional[FlightRecorder] = None,
+                 config: Optional[Dict[str, Any]] = None, mesh=None,
+                 trace_steps: int = 0,
+                 snapshot_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+                 registry=None):
+        if trace_steps < 0:
+            raise ValueError(f"trace_steps must be >= 0, got {trace_steps}")
+        self.root = root
+        self.recorder = recorder
+        self._config = config
+        self._mesh = mesh
+        self.trace_steps = trace_steps
+        self._snapshot_fn = snapshot_fn
+        self._registry = registry
+        self._env: Optional[Dict[str, Any]] = None
+        self._trace_stop_step: Optional[int] = None
+        self._trace_bundle: Optional[str] = None
+        self._fh_file = None
+        self.bundles: List[str] = []
+
+    # -- capture ----------------------------------------------------------
+    def capture(self, trigger: str, step: int,
+                detail: Optional[Dict[str, Any]] = None, *,
+                snapshot: bool = True, trace: bool = True) -> Optional[str]:
+        """Write one bundle; returns its path, or None on failure (warned,
+        never raised).  ``snapshot=False`` skips the HLO/cost snapshot
+        (preemption grace windows cannot afford a possible recompile);
+        ``trace=False`` skips arming the trace window."""
+        try:
+            return self._capture(trigger, step, detail or {},
+                                 snapshot=snapshot, trace=trace)
+        except Exception as e:
+            warnings.warn(
+                f"forensics capture {trigger!r} at step {step} failed "
+                f"({type(e).__name__}: {e}) — training continues",
+                stacklevel=2,
+            )
+            return None
+
+    def _capture(self, trigger, step, detail, *, snapshot, trace):
+        if self._env is None:
+            self._env = env_fingerprint(self._mesh)
+        files: Dict[str, Any] = {"env.json": self._env}
+        if self._config is not None:
+            files["config.json"] = self._config
+        if self.recorder is not None:
+            files["flight_recorder.jsonl"] = self.recorder.to_jsonl()
+        if self._registry is not None:
+            files["metrics.json"] = self._registry.snapshot()
+        manifest: Dict[str, Any] = {
+            "schema": BUNDLE_SCHEMA,
+            "trigger": trigger,
+            "step": int(step),
+            "detail": detail,
+            "created_unix": time.time(),
+            "ring_records": len(self.recorder.snapshot()) if self.recorder else 0,
+        }
+        if snapshot and self._snapshot_fn is not None:
+            try:
+                snap = self._snapshot_fn() or {}
+            except Exception as e:
+                manifest["snapshot_error"] = f"{type(e).__name__}: {e}"
+            else:
+                if snap.get("hlo"):
+                    files["hlo.txt"] = snap["hlo"]
+                if snap.get("cost_analysis") is not None:
+                    files["cost_analysis.json"] = snap["cost_analysis"]
+                if snap.get("memory_analysis") is not None:
+                    files["memory_analysis.json"] = snap["memory_analysis"]
+        want_trace = trace and self.trace_steps > 0 and not self.trace_active
+        # the manifest never promises a trace before one actually starts:
+        # it publishes with trace=None and is atomically rewritten to
+        # "recording" on start_trace success, then "complete" on stop —
+        # a start failure leaves no dead reference
+        manifest["trace"] = None
+        manifest["files"] = sorted(files) + [MANIFEST]
+        files[MANIFEST] = manifest
+        path = write_bundle(self.root, f"{trigger}-{int(step)}", files)
+        self.bundles.append(path)
+        if self._registry is not None:
+            self._registry.counter(
+                "forensics_bundles", help="forensics bundles written"
+            ).inc()
+        if want_trace and self._start_trace(path, step):
+            self._update_manifest(path, trace="trace/", trace_state="recording")
+        return path
+
+    @staticmethod
+    def _update_manifest(bundle_dir: str, **fields) -> None:
+        """Atomically patch a published bundle's manifest (tmp + rename —
+        a reader never sees a torn manifest).  Best-effort: manifest drift
+        must never take down the run."""
+        path = os.path.join(bundle_dir, MANIFEST)
+        try:
+            with open(path) as f:
+                manifest = json.load(f)
+            manifest.update(fields)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(manifest, f, indent=2, default=repr)
+            os.replace(tmp, path)
+        except Exception as e:
+            warnings.warn(
+                f"forensics manifest update failed ({type(e).__name__}: {e})",
+                stacklevel=2,
+            )
+
+    # -- bounded trace window ---------------------------------------------
+    @property
+    def trace_active(self) -> bool:
+        return self._trace_stop_step is not None
+
+    def trace_due(self, step: int) -> bool:
+        return (self._trace_stop_step is not None
+                and step >= self._trace_stop_step)
+
+    def _start_trace(self, bundle_dir: str, step: int) -> bool:
+        import jax
+
+        try:
+            jax.profiler.start_trace(os.path.join(bundle_dir, "trace"))
+        except Exception as e:
+            warnings.warn(
+                f"forensics trace failed to start ({type(e).__name__}: {e})",
+                stacklevel=2,
+            )
+            return False
+        self._trace_stop_step = step + self.trace_steps
+        self._trace_bundle = bundle_dir
+        return True
+
+    def stop_trace(self) -> None:
+        """End the in-flight trace window (idempotent).  The caller drains
+        dispatched device work FIRST so the trace holds the steps it
+        promises (the trainer charges that drain to the ``step`` phase)."""
+        if self._trace_stop_step is None:
+            return
+        self._trace_stop_step = None
+        bundle = self._trace_bundle
+        self._trace_bundle = None
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            warnings.warn(
+                f"forensics trace failed to stop ({type(e).__name__}: {e})",
+                stacklevel=2,
+            )
+            return
+        if bundle is not None:
+            self._update_manifest(bundle, trace_state="complete")
+
+    # -- crash instrumentation --------------------------------------------
+    def arm_faulthandler(self) -> bool:
+        """Point ``faulthandler`` at ``<root>/faulthandler.log`` so a hard
+        crash (segfault in a C extension, deadlocked runtime killed by
+        SIGABRT) still leaves stack evidence next to the bundles.  No-op
+        (returns False) when the user already enabled faulthandler."""
+        import faulthandler
+
+        if faulthandler.is_enabled():
+            return False
+        try:
+            os.makedirs(self.root, exist_ok=True)
+            self._fh_file = open(os.path.join(self.root, "faulthandler.log"), "a")
+            faulthandler.enable(file=self._fh_file)
+            return True
+        except Exception:
+            self._fh_file = None
+            return False
+
+    def disarm_faulthandler(self) -> None:
+        import faulthandler
+
+        if self._fh_file is not None:
+            try:
+                faulthandler.disable()
+            finally:
+                self._fh_file.close()
+                self._fh_file = None

@@ -22,6 +22,7 @@ EVENT_RESUME = "resume"              # checkpoint auto-resume at fit start
 EVENT_PREEMPT_STOP = "preempt_stop"  # SIGTERM-triggered clean stop
 EVENT_RECOMPILE = "recompile"        # XLA recompiled the step fn mid-run
 EVENT_NAN = "nan"                    # nonfinite grads/loss seen this window
+EVENT_FORENSICS = "forensics"        # a forensics bundle was captured
 
 # legacy float markers (pre-obs logs) -> string events, for readers that
 # must keep consuming old JSONL files

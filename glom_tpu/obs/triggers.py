@@ -1,0 +1,159 @@
+"""Anomaly-trigger engine: turns monitor signals into capture decisions.
+
+PR 1's monitors *detect* (recompiles, NaN storms, grad spikes); this module
+decides when a detection is worth an evidence capture
+(:mod:`glom_tpu.obs.forensics`).  Two pieces:
+
+  * :class:`TriggerEngine` — per-trigger debounce plus a global capture
+    budget, so a NaN storm produces ONE bundle (not one per window) and a
+    pathological run cannot fill the disk with traces.
+  * :class:`StepTimeRegressionMonitor` — the one NEW detector this layer
+    adds: a rolling-window step-time p95 regression check (the "the run
+    silently got 2x slower" signal that loss curves never show).
+
+Both are plain host-side bookkeeping — no device work, no syncs.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Dict, Optional
+
+# canonical trigger names (bundle directories are `<trigger>-<step>/`)
+TRIGGER_NAN = "nan"
+TRIGGER_RECOMPILE = "recompile"
+TRIGGER_GRAD_SPIKE = "grad_spike"
+TRIGGER_STEP_TIME = "step_time_regression"
+# terminal paths write bundles DIRECTLY (no debounce/budget — they fire at
+# most once per run by construction); named here so readers share the names
+TRIGGER_CRASH = "crash"
+TRIGGER_PREEMPT = "preempt"
+
+
+class TriggerEngine:
+    """Capture gatekeeper: ``fire(name, step)`` returns True when a capture
+    should proceed.
+
+    A firing is accepted unless (a) the same trigger already captured
+    within ``debounce_steps`` steps (storm suppression: the FIRST window of
+    a NaN storm is the evidence; the next hundred are the same incident),
+    or (b) the run already spent its global ``max_captures`` budget
+    (captures are expensive — an HLO snapshot may recompile, a trace window
+    writes tens of MB).  Suppressed firings are still counted (and exported
+    via the registry) so the log shows how big the storm was.
+    """
+
+    def __init__(self, *, debounce_steps: int = 200, max_captures: int = 3,
+                 registry=None):
+        if debounce_steps < 1:
+            raise ValueError(f"debounce_steps must be >= 1, got {debounce_steps}")
+        if max_captures < 0:
+            raise ValueError(f"max_captures must be >= 0, got {max_captures}")
+        self.debounce_steps = debounce_steps
+        self.max_captures = max_captures
+        self._registry = registry
+        self._last_fired: Dict[str, int] = {}
+        self.captures = 0      # accepted firings (global, all triggers)
+        self.suppressed = 0    # rejected firings (debounce or budget)
+
+    def fire(self, name: str, step: int) -> bool:
+        last = self._last_fired.get(name)
+        debounced = last is not None and step - last < self.debounce_steps
+        if debounced or self.captures >= self.max_captures:
+            self.suppressed += 1
+            if self._registry is not None:
+                self._registry.counter(
+                    "forensics_suppressed",
+                    help="trigger firings suppressed by debounce/budget",
+                ).inc()
+            return False
+        self._last_fired[name] = step
+        self.captures += 1
+        if self._registry is not None:
+            self._registry.counter(
+                "forensics_captures", help="accepted forensics captures"
+            ).inc()
+        return True
+
+    def refund(self, name: str, step: int) -> None:
+        """Give back the budget slot of a ``fire`` acceptance whose capture
+        FAILED (unwritable disk, bundle error): the global budget must not
+        be burned on evidence that never hit disk — a later genuine anomaly
+        still deserves its bundle.  The debounce timestamp is kept: a
+        persistently failing disk must not turn every storm window into a
+        retry (and a warning), only one per debounce horizon."""
+        if self._last_fired.get(name) == step and self.captures > 0:
+            self.captures -= 1
+            if self._registry is not None:
+                self._registry.counter(
+                    "forensics_capture_failures",
+                    help="accepted firings whose bundle write failed",
+                ).inc()
+
+
+def _p95(xs) -> float:
+    """Nearest-rank p95 (the registry Histogram's rule, inlined — these
+    windows are tiny deques, not Histograms)."""
+    ordered = sorted(xs)
+    rank = min(len(ordered) - 1, max(0, math.ceil(0.95 * len(ordered)) - 1))
+    return ordered[rank]
+
+
+class StepTimeRegressionMonitor:
+    """Rolling step-time p95 regression detector.
+
+    ``update(per_step_seconds)`` consumes one logging window's mean
+    per-step TRAIN time (the trainer already excludes eval/checkpoint/diag
+    overhead from it) and returns a detail dict when the p95 of the most
+    recent ``recent`` windows exceeds ``factor`` x the p95 of the
+    ``baseline`` windows behind them — else None.
+
+    The recent head must be FULL and the baseline must hold at least
+    ``min_baseline`` samples before anything can fire, so the first
+    windows of a run (compile tail, cache warmup) can never alarm.  On
+    firing, the recent head is folded into the baseline: a sustained
+    legitimate shift (bigger batch, new data mix) re-baselines instead of
+    alarming every window until the budget is gone.
+    """
+
+    def __init__(self, factor: float = 2.0, recent: int = 4,
+                 baseline: int = 16, min_baseline: int = 8):
+        if factor <= 1.0:
+            raise ValueError(f"factor must be > 1 (it multiplies the "
+                             f"baseline p95), got {factor}")
+        if recent < 1 or baseline < min_baseline or min_baseline < 2:
+            raise ValueError(
+                f"need recent >= 1, baseline >= min_baseline >= 2; got "
+                f"recent={recent} baseline={baseline} min_baseline={min_baseline}"
+            )
+        self.factor = factor
+        self._recent_cap = recent
+        self._min_baseline = min_baseline
+        self._recent: deque = deque()
+        self._baseline: deque = deque(maxlen=baseline)
+        self.regressions = 0
+
+    def update(self, per_step_seconds: float) -> Optional[Dict[str, float]]:
+        x = float(per_step_seconds)
+        if not math.isfinite(x) or x < 0:
+            return None
+        if len(self._recent) == self._recent_cap:
+            self._baseline.append(self._recent.popleft())
+        self._recent.append(x)
+        if (len(self._recent) < self._recent_cap
+                or len(self._baseline) < self._min_baseline):
+            return None
+        base = _p95(self._baseline)
+        head = _p95(self._recent)
+        if base <= 0 or head <= self.factor * base:
+            return None
+        self.regressions += 1
+        # re-baseline: the regressed level becomes the new normal
+        self._baseline.extend(self._recent)
+        self._recent.clear()
+        return {
+            "step_time_p95": head,
+            "baseline_p95": base,
+            "ratio": head / base,
+        }

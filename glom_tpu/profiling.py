@@ -8,7 +8,12 @@ torch.profiler).  TPU-native equivalents:
     ``TrainConfig``-level ``profile_dir`` wiring in ``fit``).
   * ``cost_analysis(fn, *args)`` — XLA's compiler cost model for a jitted
     callable: FLOPs, bytes accessed, peak memory — usable because the whole
-    forward is one ``lax.scan`` graph.
+    forward is one ``lax.scan`` graph.  Returns ``{}`` (with a warning)
+    on backends that don't report, never raises.
+  * ``compile_snapshot(fn, *args)`` — HLO text + cost/memory analyses in
+    one JSON-able dict; accepts ``ShapeDtypeStruct`` args (no device
+    data).  The forensics bundle's step snapshot
+    (``glom_tpu.obs.forensics``).
   * ``debug_nans(enable)`` — global NaN checking (``jax_debug_nans``); the
     functional-core replacement for a race/sanitizer story: there is no
     shared mutable state to race on, numerics are the failure mode that
@@ -18,6 +23,7 @@ torch.profiler).  TPU-native equivalents:
 from __future__ import annotations
 
 import contextlib
+import warnings
 from typing import Any, Dict
 
 import jax
@@ -39,23 +45,101 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
+def _jit(fn):
+    return fn if hasattr(fn, "lower") else jax.jit(fn)
+
+
+def compiled_cost_analysis(compiled) -> Dict[str, Any]:
+    """XLA cost analysis of an already-compiled executable as a plain dict.
+    Backends may return ``None``, ``[dict]``, or raise (CPU builds without
+    the cost model) — all of those degrade to ``{}`` with a warning, never
+    an exception: analysis consumers (forensics bundles, tools) must not
+    die on the backend's reporting shape."""
+    try:
+        analysis = compiled.cost_analysis()
+    except Exception as e:
+        warnings.warn(f"cost_analysis unavailable on this backend "
+                      f"({type(e).__name__}: {e})", stacklevel=2)
+        return {}
+    if isinstance(analysis, (list, tuple)):  # some backends return [dict]
+        analysis = analysis[0] if analysis else None
+    if analysis is None:
+        warnings.warn("cost_analysis returned None on this backend",
+                      stacklevel=2)
+        return {}
+    try:
+        return dict(analysis)
+    except (TypeError, ValueError):
+        warnings.warn(f"cost_analysis returned an unconvertible "
+                      f"{type(analysis).__name__}", stacklevel=2)
+        return {}
+
+
+def compiled_memory_analysis(compiled) -> Dict[str, Any]:
+    """Compiled memory footprint as a plain ``{field: bytes}`` dict (the
+    ``*_size_in_bytes`` fields of ``CompiledMemoryStats``).  ``None`` /
+    missing / raising backends degrade to ``{}`` with a warning."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception as e:
+        warnings.warn(f"memory_analysis unavailable on this backend "
+                      f"({type(e).__name__}: {e})", stacklevel=2)
+        return {}
+    if mem is None:
+        warnings.warn("memory_analysis returned None on this backend",
+                      stacklevel=2)
+        return {}
+    if isinstance(mem, dict):
+        return dict(mem)
+    out: Dict[str, Any] = {}
+    for k in dir(mem):
+        if k.endswith("_in_bytes"):
+            try:
+                out[k] = int(getattr(mem, k))
+            except (TypeError, ValueError, AttributeError):
+                continue
+    if not out:
+        warnings.warn(f"memory_analysis returned an unconvertible "
+                      f"{type(mem).__name__}", stacklevel=2)
+    return out
+
+
 def cost_analysis(fn, *args, **kwargs) -> Dict[str, Any]:
     """Compile ``fn`` for the current backend and return XLA's cost analysis
-    (flops, bytes accessed, ...).  ``fn`` must be jit-wrapped or jittable."""
-    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
-    lowered = jitted.lower(*args, **kwargs)
+    (flops, bytes accessed, ...) as a dict — ``{}`` (with a warning) where
+    the backend doesn't report.  ``fn`` must be jit-wrapped or jittable."""
+    compiled = _jit(fn).lower(*args, **kwargs).compile()
+    return compiled_cost_analysis(compiled)
+
+
+def memory_analysis(fn, *args, **kwargs) -> Dict[str, Any]:
+    """Compiled memory footprint summary (argument/output/temp/generated) as
+    a ``{field: bytes}`` dict — ``{}`` (with a warning) where the backend
+    doesn't report."""
+    compiled = _jit(fn).lower(*args, **kwargs).compile()
+    return compiled_memory_analysis(compiled)
+
+
+def compile_snapshot(fn, *args, **kwargs) -> Dict[str, Any]:
+    """One forensics-grade snapshot of a jitted callable: optimized HLO
+    text plus the compiler's cost/memory analyses, all JSON-able.
+
+    Accepts ``jax.ShapeDtypeStruct`` arguments, so snapshotting touches no
+    device data (and cannot trip over donated buffers).  May pay a compile
+    when the (fn, shapes) pair misses jit's C++ fast-path cache — callers
+    bound that with a capture budget.  The HLO falls back to the lowered
+    StableHLO text when the backend won't render the optimized module."""
+    lowered = _jit(fn).lower(*args, **kwargs)
     compiled = lowered.compile()
-    analysis = compiled.cost_analysis()
-    if isinstance(analysis, list):  # some backends return [dict]
-        analysis = analysis[0]
-    return dict(analysis)
-
-
-def memory_analysis(fn, *args, **kwargs):
-    """Compiled memory footprint summary (argument/output/temp/generated)."""
-    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
-    compiled = jitted.lower(*args, **kwargs).compile()
-    return compiled.memory_analysis()
+    try:
+        hlo = compiled.as_text()
+    except Exception:
+        hlo = lowered.as_text()
+    return {
+        "hlo": hlo,
+        "cost_analysis": compiled_cost_analysis(compiled),
+        "memory_analysis": compiled_memory_analysis(compiled),
+    }
 
 
 def device_memory_profile(path: str) -> None:

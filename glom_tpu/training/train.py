@@ -134,6 +134,31 @@ def parse_args(argv=None):
     p.add_argument("--grad-spike-factor", type=float, default=10.0,
                    help="flag a window when grad_norm exceeds this factor "
                         "times its running EMA")
+    # forensics (glom_tpu.obs.forensics): anomaly-triggered evidence capture
+    p.add_argument("--forensics-dir", default=None,
+                   help="write post-mortem bundles (flight-recorder ring, "
+                        "env fingerprint, HLO/cost snapshot) here when a "
+                        "monitor fires, the run crashes, or preemption "
+                        "stops it; None = no bundles (the in-memory "
+                        "flight recorder still records)")
+    p.add_argument("--forensics-ring", type=int, default=256,
+                   help="flight-recorder capacity in log records (0 = off)")
+    p.add_argument("--forensics-max-captures", type=int, default=3,
+                   help="global per-run budget of triggered captures")
+    p.add_argument("--forensics-debounce-steps", type=int, default=200,
+                   help="per-trigger re-fire spacing: a NaN storm inside "
+                        "this many steps is one bundle, not one per window")
+    p.add_argument("--forensics-trace-steps", type=int, default=0,
+                   help="also record a jax.profiler trace of N steps after "
+                        "each capture (0 = off; tens of MB per capture; "
+                        "ignored while --profile-dir is set)")
+    p.add_argument("--no-forensics-hlo", action="store_true",
+                   help="skip the HLO + cost/memory-analysis snapshot in "
+                        "bundles (it may pay a compile at capture time)")
+    p.add_argument("--forensics-step-time-factor", type=float, default=2.0,
+                   help="fire the step-time regression trigger when recent "
+                        "windows' p95 per-step train time exceeds this "
+                        "factor times the rolling baseline p95 (0 = off)")
     # multi-host
     p.add_argument("--coordinator", default=None)
     p.add_argument("--num-processes", type=int, default=None)
@@ -200,6 +225,13 @@ def main(argv=None):
         monitor_numerics=not args.no_monitor_numerics,
         grad_spike_factor=args.grad_spike_factor,
         diag_every=args.diag_every,
+        forensics_dir=args.forensics_dir,
+        forensics_ring=args.forensics_ring,
+        forensics_max_captures=args.forensics_max_captures,
+        forensics_debounce_steps=args.forensics_debounce_steps,
+        forensics_trace_steps=args.forensics_trace_steps,
+        forensics_hlo=not args.no_forensics_hlo,
+        forensics_step_time_factor=args.forensics_step_time_factor,
         metrics_csv=args.metrics_csv,
         prom_textfile=args.prom_textfile,
         seed=args.seed,

@@ -22,6 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from glom_tpu import checkpoint as ckpt_lib
 from glom_tpu.config import GlomConfig, TrainConfig
 from glom_tpu.obs import (
+    EVENT_FORENSICS,
     EVENT_NAN,
     EVENT_PREEMPT_STOP,
     EVENT_RECOMPILE,
@@ -32,6 +33,14 @@ from glom_tpu.obs import (
     PhaseTimer,
     RecompileMonitor,
     flatten_diagnostics,
+)
+from glom_tpu.obs.triggers import (
+    TRIGGER_CRASH,
+    TRIGGER_GRAD_SPIKE,
+    TRIGGER_NAN,
+    TRIGGER_PREEMPT,
+    TRIGGER_RECOMPILE,
+    TRIGGER_STEP_TIME,
 )
 from glom_tpu.parallel.mesh import make_mesh
 from glom_tpu.parallel.placement import state_shardings
@@ -284,6 +293,50 @@ class Trainer:
         self._recompile_mon = RecompileMonitor(self._step)
         self._mem_mon = MemoryMonitor()
         self._num_mon = NumericsMonitor(spike_factor=train.grad_spike_factor)
+
+        # -- anomaly-triggered forensics (glom_tpu.obs.forensics) --
+        # The flight recorder tees every logged record into a bounded ring
+        # (host-side dict copies at the LOGGING cadence — no per-step
+        # device sync).  Bundles, triggers, and the step-time regression
+        # detector only exist when forensics_dir is set; bundle writing is
+        # leader-only, matching the logging gate.
+        self._recorder = None
+        self._forensics = None
+        self._triggers = None
+        self._steptime_mon = None
+        self._last_batch_spec = None
+        if train.forensics_ring:
+            from glom_tpu.obs import FlightRecorder
+
+            self._recorder = FlightRecorder(capacity=train.forensics_ring)
+        if train.forensics_dir and jax.process_index() == 0:
+            from glom_tpu.obs import (
+                ForensicsManager,
+                StepTimeRegressionMonitor,
+                TriggerEngine,
+            )
+
+            self._triggers = TriggerEngine(
+                debounce_steps=train.forensics_debounce_steps,
+                max_captures=train.forensics_max_captures,
+                registry=self.registry,
+            )
+            self._forensics = ForensicsManager(
+                train.forensics_dir,
+                recorder=self._recorder,
+                config={"glom": self.config.to_json_dict(),
+                        "train": train.to_json_dict()},
+                mesh=self.mesh,
+                # profile_dir's always-on trace owns the profiler: two
+                # concurrent jax traces cannot coexist
+                trace_steps=0 if train.profile_dir else train.forensics_trace_steps,
+                snapshot_fn=self._forensics_snapshot if train.forensics_hlo else None,
+                registry=self.registry,
+            )
+            if train.forensics_step_time_factor:
+                self._steptime_mon = StepTimeRegressionMonitor(
+                    factor=train.forensics_step_time_factor
+                )
         self._diag = None
         if train.diag_every:
             from glom_tpu.obs import make_diagnostics_fn
@@ -298,6 +351,68 @@ class Trainer:
         CLI builds the suite with this trainer's mesh-bound consensus/FF
         fns, which only exist once the trainer does)."""
         self._eval_suite = suite
+
+    # -- forensics --------------------------------------------------------
+    def _log(self, step, **scalars) -> None:
+        """Log one record AND tee it into the flight-recorder ring, so a
+        later bundle flush carries the records leading up to the anomaly.
+        Every trainer log site goes through here."""
+        if self._recorder is not None:
+            self._recorder.record(step, scalars)
+        self.logger.log(step, **scalars)
+
+    def _forensics_snapshot(self) -> dict:
+        """HLO text + compiler cost/memory analyses of the jitted step,
+        from abstract args only (ShapeDtypeStructs — no device data, no
+        interaction with donated buffers).  May pay a compile on a jit
+        cache miss; the capture budget bounds how often."""
+        from glom_tpu import profiling
+
+        if self._last_batch_spec is None:
+            return {}
+        abstract_state = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self.state
+        )
+        return profiling.compile_snapshot(
+            self._step, abstract_state, self._last_batch_spec
+        )
+
+    def _maybe_capture(self, trigger: str, step: int, detail: dict) -> None:
+        """Route one monitor firing through the trigger engine (debounce +
+        budget) and, when accepted, write a forensics bundle.  Never
+        raises."""
+        if self._forensics is None:
+            return
+        if self._triggers is not None and not self._triggers.fire(trigger, step):
+            return
+        path = self._forensics.capture(trigger, step, detail)
+        if path:
+            self._log(step, event=EVENT_FORENSICS, trigger=trigger, bundle=path)
+        elif self._triggers is not None:
+            # the capture failed (warned by the manager): give the budget
+            # slot back so a later genuine anomaly can still be captured
+            self._triggers.refund(trigger, step)
+
+    def _crash_capture(self, exc: BaseException) -> None:
+        """Terminal-path bundle for an unhandled fit() exception: bypasses
+        the trigger engine (a crash fires once by construction) but keeps
+        every guard — the bundle is best-effort, the original exception is
+        what must surface."""
+        if self._forensics is None:
+            return
+        self._forensics.stop_trace()  # a triggered trace must not leak
+        import traceback
+
+        try:
+            step = int(jax.device_get(self.state.step))
+        except Exception:
+            step = -1
+        self._forensics.capture(
+            TRIGGER_CRASH, step,
+            {"error": f"{type(exc).__name__}: {exc}",
+             "traceback": "".join(traceback.format_exception(
+                 type(exc), exc, exc.__traceback__))},
+        )
 
     # -- checkpointing ----------------------------------------------------
     def finish_saves(self) -> None:
@@ -530,10 +645,22 @@ class Trainer:
         the loop auto-resumes from the latest step — so a ``steps`` at or
         below the checkpointed step is a no-op by design.  Drains the async
         checkpoint writer on every exit path, including exceptions — an
-        in-flight write must never be stranded by a failing data iterator."""
+        in-flight write must never be stranded by a failing data iterator.
+
+        Crash forensics: with ``forensics_dir`` set, an unhandled exception
+        dumps a ``crash-<step>`` bundle (flight-recorder ring, env
+        fingerprint, HLO/cost snapshot) before re-raising, and
+        ``faulthandler`` is armed to ``<forensics_dir>/faulthandler.log``
+        for the crashes Python never sees (segfaults, SIGABRT)."""
+        armed = self._forensics is not None and self._forensics.arm_faulthandler()
         try:
             return self._fit(batches, steps)
+        except Exception as e:
+            self._crash_capture(e)
+            raise
         finally:
+            if armed:
+                self._forensics.disarm_faulthandler()
             try:
                 self.finish_saves()
             except Exception:
@@ -573,7 +700,7 @@ class Trainer:
         stateful_stream = hasattr(batches, "state_dict")
         if cfg.checkpoint_dir and ckpt_lib.latest_step(cfg.checkpoint_dir) is not None:
             resumed = self.restore(cfg.checkpoint_dir, batches=batches)
-            self.logger.log(resumed, event=EVENT_RESUME)
+            self._log(resumed, event=EVENT_RESUME)
 
         # Preemption safety (TPU pods get SIGTERM'd): convert the signal to
         # a flag, finish the in-flight step, checkpoint, and return cleanly —
@@ -648,11 +775,17 @@ class Trainer:
             self.registry.counter(
                 "nan_windows", help="logging windows with nonfinite grads/loss"
             ).inc()
-            self.logger.log(
+            self._log(
                 step, event=EVENT_NAN,
                 nonfinite_grads=num["nonfinite_grads"],
                 loss_nonfinite_steps=num["loss_nonfinite_steps"],
             )
+            # a NaN storm is ONE incident: the trigger engine's debounce
+            # collapses the per-window firings into a single bundle
+            self._maybe_capture(TRIGGER_NAN, step, {
+                "nonfinite_grads": num["nonfinite_grads"],
+                "loss_nonfinite_steps": num["loss_nonfinite_steps"],
+            })
         return num
 
     def _log_window(self, step, timer, window_metrics, window_imgs, cfg):
@@ -689,12 +822,22 @@ class Trainer:
                 self.registry.gauge(k).set(last[k])
         for k, v in mem.items():
             self.registry.gauge(k, unit="bytes").set(v)
-        self.logger.log(
+        self._log(
             step,
             imgs_per_sec=window_imgs / train_dt,
             imgs_per_sec_per_chip=window_imgs / train_dt / jax.device_count(),
             **last, **num, **mem, **phases,
         )
+        if num.get("grad_norm_spike"):
+            self._maybe_capture(TRIGGER_GRAD_SPIKE, step, {
+                "grad_norm": last.get("grad_norm"),
+            })
+        if self._steptime_mon is not None and phases["window_steps"]:
+            regression = self._steptime_mon.update(
+                train_dt / phases["window_steps"]
+            )
+            if regression is not None:
+                self._maybe_capture(TRIGGER_STEP_TIME, step, regression)
         # exporter IO is attributed to the NEXT window's log_emit phase
         # (the record that pays it is the one being written)
         timer.add("log_emit", time.monotonic() - t_emit)
@@ -744,6 +887,10 @@ class Trainer:
                 img = next(batches)
             with timer.phase("h2d"):
                 img = jax.device_put(img, self._batch_sh)
+            if self._forensics is not None:
+                # abstract spec only (a tiny host object, no sync): the
+                # HLO snapshot lowers against the shapes the step last saw
+                self._last_batch_spec = jax.ShapeDtypeStruct(img.shape, img.dtype)
             if cfg.eval_every and (i + 1) % cfg.eval_every == 0:
                 self._drain_steps(timer)
                 with timer.phase("eval"):
@@ -753,7 +900,7 @@ class Trainer:
                         ev = self._eval_suite.run(
                             self.state.params, jax.random.PRNGKey(cfg.seed + i)
                         )
-                        self.logger.log(i + 1, **ev)
+                        self._log(i + 1, **ev)
                     elif self._eval is not None:
                         # legacy fallback (no suite given): evaluate BEFORE
                         # the step consumes this batch, so the PSNR reflects
@@ -761,13 +908,19 @@ class Trainer:
                         psnr = self._eval(
                             self.state.params, img, jax.random.PRNGKey(cfg.seed + i)
                         )
-                        self.logger.log(i + 1, psnr_db=float(jax.device_get(psnr)))
+                        self._log(i + 1, psnr_db=float(jax.device_get(psnr)))
             with timer.phase("step"):
                 # dispatch only — under async dispatch the device compute
                 # this enqueues is paid for in `log_sync` at the boundary
                 self.state, metrics = self._step(self.state, img)
             timer.count_step()
             window_imgs += img.shape[0]
+            if self._forensics is not None and self._forensics.trace_due(i + 1):
+                # end the triggered trace window: drain the dispatched
+                # backlog first (charged to `step`, like every blocking
+                # phase) so the trace holds the steps it promises
+                self._drain_steps(timer)
+                self._forensics.stop_trace()
             if cfg.log_every or cfg.monitor_numerics:
                 window_metrics.append(metrics)
             if self._recompile_mon.poll() and (
@@ -781,10 +934,14 @@ class Trainer:
                     "recompiles", help="XLA recompilations of the train step "
                     "after the first compile"
                 ).inc()
-                self.logger.log(
+                self._log(
                     i + 1, event=EVENT_RECOMPILE,
                     compile_count=self._recompile_mon.compiles,
                 )
+                self._maybe_capture(TRIGGER_RECOMPILE, i + 1, {
+                    "compile_count": self._recompile_mon.compiles,
+                    "recompiles": self._recompile_mon.recompiles,
+                })
             if self._diag is not None and (i + 1) % cfg.diag_every == 0:
                 self._drain_steps(timer)
                 with timer.phase("diag"):
@@ -793,7 +950,7 @@ class Trainer:
                     )
                 for k in ("island_agreement", "attn_entropy"):
                     self.registry.gauge(k).set(diag[k])
-                self.logger.log(i + 1, **diag)
+                self._log(i + 1, **diag)
             if cfg.log_every and (i + 1) % cfg.log_every == 0:
                 last_metrics = self._log_window(
                     i + 1, timer, window_metrics, window_imgs, cfg
@@ -823,13 +980,25 @@ class Trainer:
             with timer.phase("stop_poll"):
                 stop = self._should_stop((i + 1) % stop_poll == 0)
             if stop:
-                self.logger.log(i + 1, event=EVENT_PREEMPT_STOP)
+                self._log(i + 1, event=EVENT_PREEMPT_STOP)
+                if self._forensics is not None:
+                    # terminal path, engine bypassed (fires once).  NO HLO
+                    # snapshot and no trace: a possible recompile inside
+                    # the preemption grace window could cost the final
+                    # checkpoint this stop exists to write.
+                    self._forensics.stop_trace()
+                    self._forensics.capture(
+                        TRIGGER_PREEMPT, i + 1, {"reason": "SIGTERM"},
+                        snapshot=False, trace=False,
+                    )
                 completed = i + 1
                 stopped = True
                 break
         jax.block_until_ready(self.state.params)
         if profiling:
             jax.profiler.stop_trace()
+        if self._forensics is not None:
+            self._forensics.stop_trace()  # a trace window outliving the loop
         if window_metrics and cfg.monitor_numerics:
             # tail steps past the last boundary (including the ones right
             # before a preemption stop — where a diverging run most likely

@@ -43,6 +43,10 @@ def test_every_train_config_field_has_a_cli_path():
         # --diag-every / --metrics-csv / --prom-textfile)
         "monitor_numerics", "grad_spike_factor", "diag_every",
         "metrics_csv", "prom_textfile",
+        # forensics (--forensics-* / --no-forensics-hlo)
+        "forensics_dir", "forensics_ring", "forensics_max_captures",
+        "forensics_debounce_steps", "forensics_trace_steps",
+        "forensics_hlo", "forensics_step_time_factor",
     }
     # fields intentionally config-only (documented, no flag yet)
     config_only = {"loss_level", "mesh_axes", "donate"}

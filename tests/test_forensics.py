@@ -1,0 +1,538 @@
+"""Anomaly-triggered forensics tests (glom_tpu.obs.{triggers,forensics} +
+the instrumented Trainer + tools/forensics_report.py).
+
+Covers the ISSUE-2 acceptance surface: trigger debounce (a NaN storm is
+ONE bundle), the global capture budget, bundle-write atomicity under a
+crashed writer, the step-time p95 regression detector, the end-to-end
+CPU run whose injected NaN yields exactly one self-describing bundle
+that both report tools parse, and the crash/preemption terminal paths.
+"""
+
+import json
+import os
+import runpy
+import sys
+
+import numpy as np
+import jax
+import pytest
+
+from glom_tpu.config import GlomConfig, TrainConfig
+from glom_tpu.training.data import synthetic_batches
+from glom_tpu.training.metrics import MetricLogger
+from glom_tpu.training.trainer import Trainer
+
+TINY = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4)
+HERE = os.path.dirname(os.path.abspath(__file__))
+TOOLS = os.path.join(os.path.dirname(HERE), "tools")
+
+
+def _run_tool(tool, argv, capsys):
+    old_argv = sys.argv
+    sys.argv = [tool] + argv
+    try:
+        with pytest.raises(SystemExit) as exc:
+            runpy.run_path(tool, run_name="__main__")
+    finally:
+        sys.argv = old_argv
+    out = capsys.readouterr().out
+    return exc.value.code, out
+
+
+# -- trigger engine -------------------------------------------------------
+
+class TestTriggerEngine:
+    def test_debounce_collapses_a_storm(self):
+        from glom_tpu.obs import TriggerEngine
+
+        eng = TriggerEngine(debounce_steps=100, max_captures=10)
+        assert eng.fire("nan", 10)
+        # the storm: every window refires inside the debounce horizon
+        assert not eng.fire("nan", 20)
+        assert not eng.fire("nan", 109)
+        assert eng.fire("nan", 110)        # horizon passed
+        assert eng.captures == 2 and eng.suppressed == 2
+
+    def test_debounce_is_per_trigger(self):
+        from glom_tpu.obs import TriggerEngine
+
+        eng = TriggerEngine(debounce_steps=100, max_captures=10)
+        assert eng.fire("nan", 10)
+        assert eng.fire("recompile", 11)   # different trigger, not debounced
+
+    def test_global_budget_caps_all_triggers(self):
+        from glom_tpu.obs import TriggerEngine
+
+        eng = TriggerEngine(debounce_steps=1, max_captures=2)
+        assert eng.fire("nan", 1)
+        assert eng.fire("recompile", 2)
+        assert not eng.fire("grad_spike", 3)   # budget spent
+        assert not eng.fire("nan", 500)        # even past the debounce
+        assert eng.captures == 2 and eng.suppressed == 2
+
+    def test_registry_counters(self):
+        from glom_tpu.obs import MetricRegistry, TriggerEngine
+
+        reg = MetricRegistry()
+        eng = TriggerEngine(debounce_steps=100, max_captures=1, registry=reg)
+        eng.fire("nan", 1)
+        eng.fire("nan", 2)
+        assert reg.counter("forensics_captures").value == 1
+        assert reg.counter("forensics_suppressed").value == 1
+
+    def test_refund_returns_budget_but_keeps_debounce(self):
+        """A failed capture must not burn the global budget — but the
+        trigger stays debounced so a broken disk isn't retried (and
+        warned about) every storm window."""
+        from glom_tpu.obs import TriggerEngine
+
+        eng = TriggerEngine(debounce_steps=100, max_captures=1)
+        assert eng.fire("nan", 10)
+        eng.refund("nan", 10)              # the bundle write failed
+        assert eng.captures == 0
+        assert not eng.fire("nan", 20)     # still debounced
+        assert eng.fire("recompile", 21)   # budget is back for others
+        # refunding a (name, step) that was never accepted is a no-op
+        eng.refund("grad_spike", 5)
+        assert eng.captures == 1
+
+
+# -- step-time regression detector ----------------------------------------
+
+class TestStepTimeRegression:
+    def test_steady_state_never_fires(self):
+        from glom_tpu.obs import StepTimeRegressionMonitor
+
+        mon = StepTimeRegressionMonitor(factor=2.0, recent=4, baseline=16,
+                                        min_baseline=8)
+        for _ in range(40):
+            assert mon.update(0.1) is None
+        assert mon.regressions == 0
+
+    def test_compile_tail_at_start_never_fires(self):
+        """The first windows of a run are slow (compile, cache warmup) —
+        with no full baseline yet, nothing can alarm."""
+        from glom_tpu.obs import StepTimeRegressionMonitor
+
+        mon = StepTimeRegressionMonitor(factor=2.0, recent=2, baseline=8,
+                                        min_baseline=4)
+        for x in (30.0, 5.0, 0.1, 0.1, 0.1):
+            assert mon.update(x) is None
+
+    def test_regression_fires_once_then_rebaselines(self):
+        from glom_tpu.obs import StepTimeRegressionMonitor
+
+        mon = StepTimeRegressionMonitor(factor=2.0, recent=2, baseline=8,
+                                        min_baseline=4)
+        for _ in range(10):
+            assert mon.update(0.1) is None
+        out = [mon.update(0.3) for _ in range(6)]   # sustained 3x slowdown
+        fired = [d for d in out if d is not None]
+        assert len(fired) == 1
+        assert fired[0]["ratio"] == pytest.approx(3.0)
+        assert fired[0]["baseline_p95"] == pytest.approx(0.1)
+        # after re-baselining, the new level is the new normal
+        assert mon.update(0.3) is None
+
+    def test_nonfinite_samples_ignored(self):
+        from glom_tpu.obs import StepTimeRegressionMonitor
+
+        mon = StepTimeRegressionMonitor(factor=2.0, recent=2, baseline=8,
+                                        min_baseline=4)
+        for _ in range(10):
+            mon.update(0.1)
+        assert mon.update(float("nan")) is None
+        assert mon.update(float("inf")) is None
+        assert mon.regressions == 0
+
+
+# -- flight recorder ------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_ring_bound_and_order(self):
+        from glom_tpu.obs import FlightRecorder
+
+        rec = FlightRecorder(capacity=3)
+        for s in range(5):
+            rec.record(s, {"loss": float(s)})
+        snap = rec.snapshot()
+        assert [r["step"] for r in snap] == [2, 3, 4]   # oldest first
+        assert rec.recorded == 5
+
+    def test_normalization_and_jsonl_roundtrip(self):
+        from glom_tpu.obs import FlightRecorder
+
+        rec = FlightRecorder(capacity=4)
+        rec.record(1, {"loss": 0.123456789, "event": "nan", "n": 3,
+                       "weird": object()})
+        lines = rec.to_jsonl().splitlines()
+        r = json.loads(lines[0])
+        assert r["loss"] == 0.123457 and r["event"] == "nan" and r["n"] == 3
+        assert r["weird"].startswith("<object")   # repr fallback, not a crash
+
+
+# -- bundle writing -------------------------------------------------------
+
+class TestBundles:
+    def test_write_bundle_contents_and_collision_suffix(self, tmp_path):
+        from glom_tpu.obs import write_bundle
+
+        root = str(tmp_path / "forensics")
+        p1 = write_bundle(root, "nan-5", {"manifest.json": {"a": 1},
+                                          "note.txt": "hello"})
+        assert os.path.basename(p1) == "nan-5"
+        assert json.load(open(os.path.join(p1, "manifest.json"))) == {"a": 1}
+        p2 = write_bundle(root, "nan-5", {"manifest.json": {"a": 2}})
+        assert os.path.basename(p2) == "nan-5-2"   # earlier evidence kept
+
+    def test_crashed_writer_leaves_no_partial_bundle(self, tmp_path):
+        """Atomicity: a writer that dies mid-bundle must not publish a
+        half-written directory, and must not leave staging junk behind."""
+        from glom_tpu.obs import is_bundle_dir, write_bundle
+
+        root = str(tmp_path / "forensics")
+
+        class Boom:
+            pass  # not str/bytes/dict -> open(...).write raises TypeError
+
+        with pytest.raises(TypeError):
+            write_bundle(root, "crash-9", {"manifest.json": {"ok": 1},
+                                           "bad.bin": Boom()})
+        leftovers = os.listdir(root)
+        assert leftovers == []   # no partial bundle, no staging dir
+        # and a reader never mistakes a staging dir for a bundle
+        staged = tmp_path / "forensics" / ".tmp-x-1"
+        staged.mkdir()
+        (staged / "manifest.json").write_text("{}")
+        assert not is_bundle_dir(str(staged))
+
+    def test_manager_capture_survives_snapshot_failure(self, tmp_path):
+        from glom_tpu.obs import FlightRecorder, ForensicsManager
+
+        def bad_snapshot():
+            raise RuntimeError("lowering exploded")
+
+        rec = FlightRecorder(capacity=4)
+        rec.record(1, {"loss": 0.5})
+        mgr = ForensicsManager(str(tmp_path / "f"), recorder=rec,
+                               config={"glom": {}, "train": {}},
+                               snapshot_fn=bad_snapshot)
+        path = mgr.capture("nan", 7, {"x": 1.0})
+        assert path is not None
+        manifest = json.load(open(os.path.join(path, "manifest.json")))
+        assert "lowering exploded" in manifest["snapshot_error"]
+        assert not os.path.exists(os.path.join(path, "hlo.txt"))
+        # the ring and env still made it
+        assert os.path.exists(os.path.join(path, "flight_recorder.jsonl"))
+        assert json.load(open(os.path.join(path, "env.json")))["jax_version"]
+
+    def test_manager_capture_never_raises(self, tmp_path, recwarn):
+        from glom_tpu.obs import ForensicsManager
+
+        target = tmp_path / "not-a-dir"
+        target.write_text("a FILE where the bundle root should be")
+        mgr = ForensicsManager(str(target))
+        assert mgr.capture("nan", 1) is None
+        assert any("forensics capture" in str(w.message) for w in recwarn.list)
+
+    def test_env_fingerprint_fields(self):
+        from glom_tpu.obs import env_fingerprint
+        from glom_tpu.parallel.mesh import make_mesh
+
+        fp = env_fingerprint(make_mesh((1, 1, 1), ("data", "model", "seq"),
+                                       devices=jax.devices()[:1]))
+        assert fp["jax_version"] == jax.__version__
+        assert fp["backend"] == "cpu"
+        assert fp["mesh_shape"] == {"data": 1, "model": 1, "seq": 1}
+        assert fp["python_version"].count(".") == 2
+        # git SHA resolves in this repo (None would also be legal elsewhere)
+        assert fp["git_sha"] is None or len(fp["git_sha"]) == 40
+
+
+# -- instrumented trainer: triggered capture end to end -------------------
+
+class TestTrainerForensics:
+    def test_nan_storm_yields_exactly_one_bundle(self, tmp_path, capsys):
+        """ISSUE-2 acceptance: an injected NaN produces ONE bundle (the
+        debounce collapses the storm) holding the flight-recorder ring,
+        env fingerprint, and HLO/cost snapshot — and both report tools
+        parse the outputs (the tier-1 smoke of the CI satellite)."""
+        fdir = tmp_path / "forensics"
+        log = tmp_path / "run.jsonl"
+        t = TrainConfig(batch_size=8, iters=2, steps=4, log_every=1,
+                        forensics_dir=str(fdir))
+        trainer = Trainer(TINY, t,
+                          logger=MetricLogger(path=str(log),
+                                              stream=open(os.devnull, "w")))
+        stream = synthetic_batches(8, 16)
+
+        def batches():
+            for k in range(4):
+                b = next(stream)
+                if k == 1:   # NaN propagates into params: steps 2..4 all bad
+                    b[0, 0, 0, 0] = np.nan
+                yield b
+
+        trainer.fit(batches(), steps=4)
+        bundles = [d for d in os.listdir(fdir)
+                   if os.path.isdir(fdir / d) and not d.startswith(".")]
+        assert bundles == ["nan-2"]
+        bundle = fdir / "nan-2"
+        manifest = json.load(open(bundle / "manifest.json"))
+        assert manifest["trigger"] == "nan" and manifest["step"] == 2
+        assert manifest["detail"]["nonfinite_grads"] > 0
+        env = json.load(open(bundle / "env.json"))
+        assert env["jax_version"] == jax.__version__
+        ring = [json.loads(l) for l in
+                open(bundle / "flight_recorder.jsonl")]
+        assert ring and ring[-1]["event"] == "nan"   # the incident itself
+        assert any("t_window" in r for r in ring)    # phase-timed records
+        hlo = (bundle / "hlo.txt").read_text()
+        assert hlo and ("HloModule" in hlo or "module" in hlo)
+        cost = json.load(open(bundle / "cost_analysis.json"))
+        assert isinstance(cost, dict)
+        # the suppressed refires were counted, and the run logged the event
+        assert trainer._triggers.suppressed >= 1
+        recs = [json.loads(l) for l in log.read_text().splitlines()]
+        fev = [r for r in recs if r.get("event") == "forensics"]
+        assert len(fev) == 1 and fev[0]["trigger"] == "nan"
+
+        # both report tools must parse this run's outputs (--format json)
+        code, out = _run_tool(os.path.join(TOOLS, "forensics_report.py"),
+                              [str(fdir), "--format", "json"], capsys)
+        assert code == 0
+        s = json.loads(out)
+        assert s["trigger"] == "nan" and s["step"] == 2
+        assert s["ring_records"] == len(ring) and s["has_hlo"]
+        code, out = _run_tool(os.path.join(TOOLS, "obs_report.py"),
+                              [str(log), "--format", "json"], capsys)
+        assert code == 0
+        s = json.loads(out)
+        assert s["events"]["nan"] >= 1 and s["events"]["forensics"] == 1
+        assert s["nan_windows"] >= 1
+
+    def test_flight_recorder_on_by_default_bundles_off(self, tmp_path):
+        """Default config: the ring records, but nothing is written to
+        disk (no forensics_dir) and no trigger machinery exists."""
+        t = TrainConfig(batch_size=8, iters=2, steps=2, log_every=1)
+        trainer = Trainer(TINY, t,
+                          logger=MetricLogger(stream=open(os.devnull, "w")))
+        trainer.fit(synthetic_batches(8, 16), steps=2)
+        assert trainer._forensics is None and trainer._triggers is None
+        assert trainer._recorder is not None
+        assert len(trainer._recorder.snapshot()) == 2   # one per window
+
+    def test_crash_path_dumps_bundle_and_reraises(self, tmp_path):
+        import faulthandler
+
+        fdir = tmp_path / "forensics"
+        t = TrainConfig(batch_size=8, iters=2, steps=8, log_every=2,
+                        forensics_dir=str(fdir), forensics_hlo=False)
+        trainer = Trainer(TINY, t,
+                          logger=MetricLogger(stream=open(os.devnull, "w")))
+        stream = synthetic_batches(8, 16)
+
+        def batches():
+            yield next(stream)
+            yield next(stream)
+            yield next(stream)
+            raise RuntimeError("data pipeline died")
+
+        # pytest's own faulthandler plugin usually holds the handler; the
+        # trainer must only arm when nobody else did — release it here to
+        # observe the trainer-armed path, restore after
+        was_enabled = faulthandler.is_enabled()
+        if was_enabled:
+            faulthandler.disable()
+        try:
+            with pytest.raises(RuntimeError, match="data pipeline died"):
+                trainer.fit(batches(), steps=8)
+            # armed to the forensics root for the run, disarmed after
+            assert (fdir / "faulthandler.log").exists()
+            assert not faulthandler.is_enabled()
+        finally:
+            if was_enabled:
+                faulthandler.enable()
+        bundles = [d for d in os.listdir(fdir)
+                   if os.path.isdir(fdir / d) and not d.startswith(".")]
+        assert len(bundles) == 1 and bundles[0].startswith("crash-")
+        manifest = json.load(open(fdir / bundles[0] / "manifest.json"))
+        assert "data pipeline died" in manifest["detail"]["error"]
+        assert "RuntimeError" in manifest["detail"]["traceback"]
+
+    def test_capture_budget_limits_bundles_in_run(self, tmp_path):
+        """Debounce=1 makes every NaN window fire; the global budget must
+        still cap the bundles written."""
+        fdir = tmp_path / "forensics"
+        t = TrainConfig(batch_size=8, iters=2, steps=5, log_every=1,
+                        forensics_dir=str(fdir), forensics_hlo=False,
+                        forensics_debounce_steps=1, forensics_max_captures=2)
+        trainer = Trainer(TINY, t,
+                          logger=MetricLogger(stream=open(os.devnull, "w")))
+        stream = synthetic_batches(8, 16)
+
+        def batches():
+            for k in range(5):
+                b = next(stream)
+                if k >= 1:
+                    b[0, 0, 0, 0] = np.nan
+                yield b
+
+        trainer.fit(batches(), steps=5)
+        bundles = [d for d in os.listdir(fdir)
+                   if os.path.isdir(fdir / d) and not d.startswith(".")]
+        assert sorted(bundles) == ["nan-2", "nan-3"]
+        assert trainer._triggers.suppressed >= 2
+
+    def test_failed_capture_refunds_budget_in_run(self, tmp_path):
+        """An unwritable bundle root must not exhaust the capture budget:
+        the engine's slot is refunded (capture warns, training goes on)."""
+        target = tmp_path / "not-a-dir"
+        target.write_text("a FILE where the bundle root should be")
+        t = TrainConfig(batch_size=8, iters=2, steps=2, log_every=1,
+                        forensics_dir=str(target), forensics_hlo=False,
+                        forensics_max_captures=1)
+        trainer = Trainer(TINY, t,
+                          logger=MetricLogger(stream=open(os.devnull, "w")))
+        stream = synthetic_batches(8, 16)
+
+        def batches():
+            for k in range(2):
+                b = next(stream)
+                if k == 1:
+                    b[0, 0, 0, 0] = np.nan
+                yield b
+
+        with pytest.warns(UserWarning, match="forensics capture"):
+            trainer.fit(batches(), steps=2)
+        assert trainer._triggers.captures == 0   # slot given back
+
+    def test_triggered_trace_manifest_lifecycle(self, tmp_path):
+        """With forensics_trace_steps > 0 the bundle publishes with
+        trace=None, flips to recording when the profiler starts, and to
+        complete when the bounded window ends — never a dead reference."""
+        fdir = tmp_path / "forensics"
+        t = TrainConfig(batch_size=8, iters=2, steps=5, log_every=1,
+                        forensics_dir=str(fdir), forensics_hlo=False,
+                        forensics_trace_steps=2)
+        trainer = Trainer(TINY, t,
+                          logger=MetricLogger(stream=open(os.devnull, "w")))
+        stream = synthetic_batches(8, 16)
+
+        def batches():
+            for k in range(5):
+                b = next(stream)
+                if k == 1:
+                    b[0, 0, 0, 0] = np.nan
+                yield b
+
+        trainer.fit(batches(), steps=5)
+        bundle = fdir / "nan-2"
+        manifest = json.load(open(bundle / "manifest.json"))
+        assert manifest["trace"] == "trace/"
+        assert manifest["trace_state"] == "complete"
+        found = []
+        for root, _, files in os.walk(bundle / "trace"):
+            found += [f for f in files if f.endswith(".xplane.pb")]
+        assert found, "no trace artifacts in the bundle"
+        assert not trainer._forensics.trace_active
+
+    def test_preempt_stop_writes_terminal_bundle(self, tmp_path):
+        fdir = tmp_path / "forensics"
+        t = TrainConfig(batch_size=8, iters=2, steps=50, log_every=2,
+                        forensics_dir=str(fdir), forensics_hlo=False)
+        trainer = Trainer(TINY, t,
+                          logger=MetricLogger(stream=open(os.devnull, "w")))
+        stream = synthetic_batches(8, 16)
+
+        def batches():
+            yield next(stream)
+            yield next(stream)
+            trainer._stop_requested = True   # what the SIGTERM handler sets
+            yield next(stream)
+
+        trainer.fit(batches(), steps=50)
+        bundles = [d for d in os.listdir(fdir)
+                   if os.path.isdir(fdir / d) and not d.startswith(".")]
+        assert len(bundles) == 1 and bundles[0].startswith("preempt-")
+        manifest = json.load(open(fdir / bundles[0] / "manifest.json"))
+        assert manifest["detail"]["reason"] == "SIGTERM"
+        # the grace window is never spent on an HLO compile
+        assert not os.path.exists(fdir / bundles[0] / "hlo.txt")
+
+
+# -- forensics_report on the golden bundle --------------------------------
+
+def test_forensics_report_golden_bundle(capsys):
+    fixture = os.path.join(HERE, "data", "golden_bundle",
+                           "step_time_regression-48")
+    code, out = _run_tool(os.path.join(TOOLS, "forensics_report.py"),
+                          [fixture, "--format", "json"], capsys)
+    assert code == 0
+    s = json.loads(out)
+    assert s["trigger"] == "step_time_regression" and s["step"] == 48
+    assert s["detail"]["ratio"] == pytest.approx(2.4)
+    assert s["env"]["backend"] == "tpu" and s["env"]["device_count"] == 16
+    assert s["ring_records"] == 6 and s["windows_before_trigger"] == 4
+    assert s["events"] == {"recompile": 1}
+    p = {row["phase"]: row for row in s["phases"]}
+    # before-trigger t_step ms/step: [50, 52, 48, 50] -> p50 50, p95 52;
+    # the at-trigger window ran 960ms/8 steps = 120 ms/step (2.4x)
+    assert p["step"]["before_p50_ms"] == pytest.approx(50.0)
+    assert p["step"]["before_p95_ms"] == pytest.approx(52.0)
+    assert p["step"]["at_trigger_ms"] == pytest.approx(120.0)
+    assert p["step"]["ratio"] == pytest.approx(2.4)
+    cost = {row["key"]: row["value"] for row in s["cost"]}
+    assert cost["bytes accessed"] == pytest.approx(2.14e9)
+    assert s["memory"]["temp_size_in_bytes"] == 310824960
+    assert not s["has_hlo"]
+
+    # the human-readable rendering works on the same bundle
+    code, out = _run_tool(os.path.join(TOOLS, "forensics_report.py"),
+                          [fixture], capsys)
+    assert code == 0
+    assert "step_time_regression" in out and "| step |" in out
+    assert "2.40x" in out
+
+
+def test_forensics_report_compare_mode(tmp_path, capsys):
+    """--compare reports cost deltas between two bundles, sorted by
+    relative change."""
+    from glom_tpu.obs import write_bundle
+
+    a = write_bundle(str(tmp_path), "recompile-10", {
+        "manifest.json": {"schema": 1, "trigger": "recompile", "step": 10,
+                          "created_unix": 2.0},
+        "cost_analysis.json": {"flops": 2.0e9, "bytes accessed": 1.0e9},
+    })
+    b = write_bundle(str(tmp_path), "recompile-5", {
+        "manifest.json": {"schema": 1, "trigger": "recompile", "step": 5,
+                          "created_unix": 1.0},
+        "cost_analysis.json": {"flops": 1.0e9, "bytes accessed": 1.0e9},
+    })
+    code, out = _run_tool(os.path.join(TOOLS, "forensics_report.py"),
+                          [a, "--compare", b, "--format", "json"], capsys)
+    assert code == 0
+    s = json.loads(out)
+    assert s["cost"][0]["key"] == "flops"        # biggest relative delta first
+    assert s["cost"][0]["rel"] == pytest.approx(1.0)
+    assert s["compared_to"].endswith("recompile-5")
+
+
+def test_forensics_report_resolves_latest_and_ignores_staging(tmp_path, capsys):
+    from glom_tpu.obs import write_bundle
+
+    write_bundle(str(tmp_path), "nan-3", {
+        "manifest.json": {"schema": 1, "trigger": "nan", "step": 3,
+                          "created_unix": 1.0}})
+    write_bundle(str(tmp_path), "crash-9", {
+        "manifest.json": {"schema": 1, "trigger": "crash", "step": 9,
+                          "created_unix": 2.0}})
+    staged = tmp_path / ".tmp-nan-99-123"
+    staged.mkdir()
+    (staged / "manifest.json").write_text(
+        json.dumps({"trigger": "nan", "step": 99, "created_unix": 9.0}))
+    code, out = _run_tool(os.path.join(TOOLS, "forensics_report.py"),
+                          [str(tmp_path), "--format", "json"], capsys)
+    assert code == 0
+    assert json.loads(out)["trigger"] == "crash"   # newest REAL bundle

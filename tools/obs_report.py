@@ -1,6 +1,6 @@
 """Summarize a training run's phase-timed JSONL into one health report.
 
-  python tools/obs_report.py docs/runs/run.jsonl [--json]
+  python tools/obs_report.py docs/runs/run.jsonl [--format json]
 
 Reads the records the obs-instrumented Trainer emits (phase times
 ``t_<phase>`` per logging window, ``window_steps``, string ``event``
@@ -15,8 +15,9 @@ markers, window-aggregated numerics, GLOM diagnostics) and prints:
 
 Tolerates pre-obs logs (no ``t_*`` keys — phases section is skipped) and
 legacy float event markers (1.0 resume / 2.0 stop), so it runs on every
-JSONL under ``docs/runs/``.  ``--json`` emits the summary as one JSON
-object for machine consumers (CI gates).
+JSONL under ``docs/runs/``.  ``--format json`` emits the summary as one
+JSON object for machine consumers (CI gates); ``--json`` remains as a
+deprecated alias.
 """
 
 from __future__ import annotations
@@ -174,8 +175,11 @@ def print_report(s):
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("jsonl", help="phase-timed training log (MetricLogger JSONL)")
+    p.add_argument("--format", choices=["text", "json"], default="text",
+                   help="json = emit the summary as one machine-readable "
+                        "JSON object (CI gates)")
     p.add_argument("--json", action="store_true",
-                   help="emit the summary as one JSON object")
+                   help="deprecated alias for --format json")
     args = p.parse_args(argv)
     try:
         recs = read_records(args.jsonl)
@@ -186,7 +190,7 @@ def main(argv=None) -> int:
         print(f"error: no JSON records in {args.jsonl}", file=sys.stderr)
         return 1
     s = summarize(recs)
-    if args.json:
+    if args.json or args.format == "json":
         print(json.dumps(s))
     else:
         print_report(s)
