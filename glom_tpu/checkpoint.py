@@ -307,12 +307,45 @@ def _prune(directory: str, keep: int, *, protect: Optional[int] = None) -> None:
             os.remove(os.path.join(directory, f))
 
 
-def latest_step(directory: str) -> Optional[int]:
+def latest_step(directory: str, *, strict: bool = False) -> Optional[int]:
+    """Step the manifest points at, or None when the directory holds no
+    finalized checkpoint.
+
+    Robust to half-written checkpoint state: a directory with artifacts but
+    no manifest yet (a writer crashed before the final atomic rename), or a
+    manifest that is unreadable/garbled (foreign writer, transient IO
+    error), reads as "no checkpoint" — with a warning — instead of raising.
+    The serving hot-reload watcher polls this on a timer; a crash here
+    would kill the watcher thread and silently freeze params on every
+    later checkpoint.
+
+    ``strict=True`` keeps the unreadable-manifest case an ERROR (a missing
+    manifest is still None — that's a legitimate fresh start).  The
+    trainer's auto-resume uses this: if it treated a garbled manifest as
+    "no checkpoint" it would silently restart from step 0 and overwrite a
+    long run's progress on the next save."""
     manifest = os.path.join(directory, "manifest.json")
-    if not os.path.exists(manifest):
+    try:
+        with open(manifest) as f:
+            return int(json.load(f)["latest_step"])
+    except FileNotFoundError:
         return None
-    with open(manifest) as f:
-        return json.load(f)["latest_step"]
+    except (json.JSONDecodeError, KeyError, TypeError, ValueError, OSError) as e:
+        if strict:
+            raise ValueError(
+                f"unreadable checkpoint manifest {manifest} "
+                f"({type(e).__name__}: {e}); refusing to treat {directory} "
+                f"as fresh — fix or remove the manifest to proceed"
+            ) from e
+        import warnings
+
+        warnings.warn(
+            f"unreadable checkpoint manifest {manifest} "
+            f"({type(e).__name__}: {e}); treating {directory} as having no "
+            f"finalized checkpoint",
+            stacklevel=2,
+        )
+        return None
 
 
 def _load_arrays(directory: str, step: int) -> dict:

@@ -59,8 +59,10 @@ from glom_tpu.obs.exporters import (  # noqa: F401
     CsvExporter,
     JsonlExporter,
     PrometheusTextfileExporter,
+    prometheus_lines,
 )
 from glom_tpu.obs.triggers import (  # noqa: F401
+    QueueSaturationMonitor,
     StepTimeRegressionMonitor,
     TriggerEngine,
 )

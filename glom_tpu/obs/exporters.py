@@ -135,6 +135,75 @@ def prom_name(name: str, prefix: str = "glom_") -> str:
     return prefix + name
 
 
+def registry_families(registry, prefix: str = "glom_"):
+    """Flatten a :class:`~glom_tpu.obs.registry.MetricRegistry` into the
+    Prometheus family form ``(state, types, help)`` — sanitized metric name
+    to value, declared type, and help string.  The ONE registry->Prometheus
+    mapping, shared by :class:`PrometheusTextfileExporter` (node-exporter
+    textfile contract) and the serving subsystem's live ``/metrics``
+    endpoint so the two outputs can never drift."""
+    from glom_tpu.obs.registry import Counter, Gauge, Histogram, Timer
+
+    state: Dict[str, float] = {}
+    types: Dict[str, str] = {}
+    help_: Dict[str, str] = {}
+    for m in registry:
+        hist = m.hist if isinstance(m, Timer) else m
+        if isinstance(hist, Counter):
+            suffix = "" if hist.name.endswith("_total") else "_total"
+            name = prom_name(hist.name + suffix, prefix)
+            state[name] = hist.value
+            types[name] = "counter"
+            if hist.help:
+                help_[name] = hist.help
+        elif isinstance(hist, Gauge):
+            if hist.value is None:
+                continue
+            name = prom_name(hist.name, prefix)
+            state[name] = hist.value
+            types[name] = "gauge"
+            if hist.help:
+                help_[name] = hist.help
+        elif isinstance(hist, Histogram):
+            if not hist.count:
+                continue
+            base = prom_name(hist.name, prefix)
+            state[base + "_count"] = float(hist.count)
+            state[base + "_sum"] = hist.sum
+            types[base + "_count"] = "counter"
+            types[base + "_sum"] = "counter"
+            if hist.help:
+                help_[base + "_count"] = hist.help
+                help_[base + "_sum"] = hist.help
+    return state, types, help_
+
+
+def _prom_fmt(v: float) -> str:
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(v) if v != int(v) else str(int(v))
+
+
+def _prom_render(state: Dict[str, float], types: Dict[str, str],
+                 help_: Dict[str, str]) -> str:
+    lines = []
+    for name in sorted(state):
+        if name in help_:
+            lines.append(f"# HELP {name} {help_[name]}")
+        lines.append(f"# TYPE {name} {types.get(name, 'gauge')}")
+        lines.append(f"{name} {_prom_fmt(state[name])}")
+    return "\n".join(lines) + "\n"
+
+
+def prometheus_lines(registry, prefix: str = "glom_") -> str:
+    """Render the registry's CURRENT state in Prometheus exposition format
+    (the live-scrape companion to :class:`PrometheusTextfileExporter` —
+    same families, no file)."""
+    return _prom_render(*registry_families(registry, prefix))
+
+
 class PrometheusTextfileExporter:
     """Textfile-collector output: the current value of every numeric
     metric, one family per line group, written atomically on each emit.
@@ -166,60 +235,20 @@ class PrometheusTextfileExporter:
             self._state[name] = float(v)
             self._types.setdefault(name, "gauge")
         if registry is not None:
-            from glom_tpu.obs.registry import Counter, Gauge, Histogram, Timer
-
-            for m in registry:
-                hist = m.hist if isinstance(m, Timer) else m
-                if isinstance(hist, Counter):
-                    suffix = "" if hist.name.endswith("_total") else "_total"
-                    name = prom_name(hist.name + suffix, self.prefix)
-                    self._state[name] = hist.value
-                    self._types[name] = "counter"
-                    if hist.help:
-                        self._help[name] = hist.help
-                elif isinstance(hist, Gauge):
-                    if hist.value is None:
-                        continue
-                    name = prom_name(hist.name, self.prefix)
-                    self._state[name] = hist.value
-                    self._types[name] = "gauge"
-                    if hist.help:
-                        self._help[name] = hist.help
-                elif isinstance(hist, Histogram):
-                    if not hist.count:
-                        continue
-                    base = prom_name(hist.name, self.prefix)
-                    self._state[base + "_count"] = float(hist.count)
-                    self._state[base + "_sum"] = hist.sum
-                    self._types[base + "_count"] = "counter"
-                    self._types[base + "_sum"] = "counter"
-                    if hist.help:
-                        self._help[base + "_count"] = hist.help
-                        self._help[base + "_sum"] = hist.help
+            state, types, help_ = registry_families(registry, self.prefix)
+            self._state.update(state)
+            self._types.update(types)
+            self._help.update(help_)
         for ev, n in self._event_counts.items():
             name = prom_name(f"event_{ev}_total", self.prefix)
             self._state[name] = float(n)
             self._types[name] = "counter"
         self._write()
 
-    @staticmethod
-    def _fmt(v: float) -> str:
-        if math.isnan(v):
-            return "NaN"
-        if math.isinf(v):
-            return "+Inf" if v > 0 else "-Inf"
-        return repr(v) if v != int(v) else str(int(v))
-
     def _write(self) -> None:
-        lines = []
-        for name in sorted(self._state):
-            if name in self._help:
-                lines.append(f"# HELP {name} {self._help[name]}")
-            lines.append(f"# TYPE {name} {self._types.get(name, 'gauge')}")
-            lines.append(f"{name} {self._fmt(self._state[name])}")
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
-            f.write("\n".join(lines) + "\n")
+            f.write(_prom_render(self._state, self._types, self._help))
         os.replace(tmp, self.path)
 
     def close(self) -> None:

@@ -25,6 +25,8 @@ TRIGGER_NAN = "nan"
 TRIGGER_RECOMPILE = "recompile"
 TRIGGER_GRAD_SPIKE = "grad_spike"
 TRIGGER_STEP_TIME = "step_time_regression"
+# serving-side: sustained request-queue overload (glom_tpu.serving)
+TRIGGER_QUEUE_SATURATION = "queue_saturation"
 # terminal paths write bundles DIRECTLY (no debounce/budget — they fire at
 # most once per run by construction); named here so readers share the names
 TRIGGER_CRASH = "crash"
@@ -98,6 +100,69 @@ def _p95(xs) -> float:
     ordered = sorted(xs)
     rank = min(len(ordered) - 1, max(0, math.ceil(0.95 * len(ordered)) - 1))
     return ordered[rank]
+
+
+class QueueSaturationMonitor:
+    """Sustained-overload detector for a bounded request queue (the serving
+    analogue of :class:`StepTimeRegressionMonitor`: a detector whose firings
+    the :class:`TriggerEngine` gates into bundle captures).
+
+    ``update(depth, capacity, shed_delta)`` consumes one observation — the
+    queue depth at an admission or flush boundary, the queue's capacity, and
+    how many requests were load-shed since the previous observation — and
+    returns a detail dict when the queue has been saturated (depth at or
+    above ``threshold`` x capacity, or any shedding) for ``sustained``
+    CONSECUTIVE observations, else None.  A single full-queue blip is normal
+    burst absorption — exactly what the queue is for — so one observation
+    never fires; sustained saturation means offered load exceeds service
+    rate and the operator needs the evidence bundle.
+
+    On firing the streak resets, so a persistent overload re-fires only
+    after another full ``sustained`` run — the TriggerEngine's debounce and
+    budget bound it further.  Host-side bookkeeping only.
+    """
+
+    def __init__(self, threshold: float = 0.9, sustained: int = 3):
+        if not 0.0 < threshold <= 1.0:
+            raise ValueError(
+                f"threshold must be in (0, 1] (a fraction of queue "
+                f"capacity), got {threshold}"
+            )
+        if sustained < 1:
+            raise ValueError(f"sustained must be >= 1, got {sustained}")
+        self.threshold = threshold
+        self.sustained = sustained
+        self._streak = 0
+        self._peak_depth = 0
+        self._shed_in_streak = 0
+        self.saturation_events = 0
+
+    def update(self, depth: int, capacity: int,
+               shed_delta: int = 0) -> Optional[Dict[str, float]]:
+        saturated = shed_delta > 0 or (
+            capacity > 0 and depth >= self.threshold * capacity
+        )
+        if not saturated:
+            self._streak = 0
+            self._peak_depth = 0
+            self._shed_in_streak = 0
+            return None
+        self._streak += 1
+        self._peak_depth = max(self._peak_depth, int(depth))
+        self._shed_in_streak += int(shed_delta)
+        if self._streak < self.sustained:
+            return None
+        detail = {
+            "observations": float(self._streak),
+            "peak_queue_depth": float(self._peak_depth),
+            "queue_capacity": float(capacity),
+            "shed_requests": float(self._shed_in_streak),
+        }
+        self.saturation_events += 1
+        self._streak = 0
+        self._peak_depth = 0
+        self._shed_in_streak = 0
+        return detail
 
 
 class StepTimeRegressionMonitor:

@@ -13,10 +13,27 @@ import functools
 from typing import Optional
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from glom_tpu.config import GlomConfig
 from glom_tpu.models import glom as glom_model
+
+
+def pad_batch(imgs, target: int) -> "np.ndarray":
+    """Zero-pad the batch axis up to ``target`` images (no-op when already
+    there).  THE batch-padding rule — shared with the serving compile
+    cache's bucket padding so slicing semantics can't drift.  Host-side
+    numpy: the padded array is what crosses H2D."""
+    imgs = np.asarray(imgs)
+    b = imgs.shape[0]
+    if b > target:
+        raise ValueError(f"batch {b} exceeds pad target {target}")
+    if b == target:
+        return imgs
+    return np.concatenate(
+        [imgs, np.zeros((target - b,) + imgs.shape[1:], imgs.dtype)]
+    )
 
 
 def make_data_parallel_forward(
@@ -29,8 +46,13 @@ def make_data_parallel_forward(
     consensus_fn=None,
 ):
     """Build ``fn(params, imgs) -> states`` with params replicated and the
-    batch sharded over ``data_axis``.  Batch size must divide the data-axis
-    extent."""
+    batch sharded over ``data_axis``.  Batches that don't divide the
+    data-axis extent are zero-padded up to the next multiple and the output
+    sliced back — per-image results are independent of the padding rows, so
+    callers (the serving subsystem feeds arbitrary request-sized batches)
+    see exactly the unpadded forward.  Each distinct PADDED size compiles
+    once; callers that care about compile count bound their input sizes
+    (the serving compile cache buckets before calling)."""
     batch_sh = NamedSharding(mesh, P(data_axis))
     replicated = NamedSharding(mesh, P())
     # output batch axis position depends on return_all (time axis leads)
@@ -46,11 +68,16 @@ def make_data_parallel_forward(
         )
 
     def wrapped(params, imgs):
+        b = imgs.shape[0]
+        if b == 0:
+            raise ValueError("cannot run the forward on an empty batch")
         n_data = mesh.shape[data_axis]
-        if imgs.shape[0] % n_data != 0:
-            raise ValueError(
-                f"batch {imgs.shape[0]} not divisible by data-axis size {n_data}"
-            )
-        return fn(params, imgs)
+        pad = (-b) % n_data
+        if pad:
+            imgs = pad_batch(imgs, b + pad)
+        out = fn(params, imgs)
+        if pad:
+            out = out[:, :b] if return_all else out[:b]
+        return out
 
     return wrapped

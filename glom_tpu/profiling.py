@@ -120,6 +120,23 @@ def memory_analysis(fn, *args, **kwargs) -> Dict[str, Any]:
     return compiled_memory_analysis(compiled)
 
 
+def snapshot_from_compiled(lowered, compiled) -> Dict[str, Any]:
+    """Build the :func:`compile_snapshot` dict from an ALREADY lowered +
+    compiled pair — no recompile.  The serving compile cache records one of
+    these per warmed bucket (it holds the lowered/compiled objects anyway);
+    ``lowered`` supplies the StableHLO fallback text when the backend won't
+    render the optimized module."""
+    try:
+        hlo = compiled.as_text()
+    except Exception:
+        hlo = lowered.as_text()
+    return {
+        "hlo": hlo,
+        "cost_analysis": compiled_cost_analysis(compiled),
+        "memory_analysis": compiled_memory_analysis(compiled),
+    }
+
+
 def compile_snapshot(fn, *args, **kwargs) -> Dict[str, Any]:
     """One forensics-grade snapshot of a jitted callable: optimized HLO
     text plus the compiler's cost/memory analyses, all JSON-able.
@@ -130,16 +147,7 @@ def compile_snapshot(fn, *args, **kwargs) -> Dict[str, Any]:
     bound that with a capture budget.  The HLO falls back to the lowered
     StableHLO text when the backend won't render the optimized module."""
     lowered = _jit(fn).lower(*args, **kwargs)
-    compiled = lowered.compile()
-    try:
-        hlo = compiled.as_text()
-    except Exception:
-        hlo = lowered.as_text()
-    return {
-        "hlo": hlo,
-        "cost_analysis": compiled_cost_analysis(compiled),
-        "memory_analysis": compiled_memory_analysis(compiled),
-    }
+    return snapshot_from_compiled(lowered, lowered.compile())
 
 
 def device_memory_profile(path: str) -> None:

@@ -1,0 +1,44 @@
+"""Online serving subsystem: the inference half of the north star.
+
+Turns a trained checkpoint into a service — the first consumer of the
+model outside the trainer, and the first subsystem exercising the
+telemetry/forensics stack (PRs 1-2) on the request path:
+
+  * :mod:`glom_tpu.serving.batcher` — bounded request queue, deadline-
+    aware dynamic micro-batching (flush on ``max_batch`` or
+    ``max_wait_ms``), load-shedding admission control;
+  * :mod:`glom_tpu.serving.compile_cache` — shape-bucketed padded
+    batching with ahead-of-time compilation of every bucket at startup
+    (``jax.jit(...).lower(...).compile()``), so the request path never
+    triggers an XLA compile;
+  * :mod:`glom_tpu.serving.engine` — model lifecycle: load from the
+    newest finalized checkpoint, hot-reload watcher that atomically swaps
+    params when a newer one lands, graceful drain on shutdown, and the
+    ``queue_saturation`` forensics trigger;
+  * :mod:`glom_tpu.serving.server` — stdlib ``ThreadingHTTPServer``
+    front: ``/embed``, ``/reconstruct``, ``/healthz``, ``/metrics``.
+
+``tools/loadgen.py`` drives it (closed/open loop, p50/p95/p99 report);
+``docs/SERVING.md`` documents tuning.  Quickstart::
+
+    python -m glom_tpu.serving.server --checkpoint-dir /ckpt --port 8000
+"""
+
+from glom_tpu.serving.batcher import (  # noqa: F401
+    Closed,
+    DynamicBatcher,
+    Overloaded,
+)
+from glom_tpu.serving.compile_cache import (  # noqa: F401
+    BucketedCompileCache,
+    pad_to_bucket,
+    pick_bucket,
+)
+from glom_tpu.serving.engine import (  # noqa: F401
+    ServingEngine,
+    make_demo_checkpoint,
+)
+
+# glom_tpu.serving.server is intentionally NOT imported here: the package
+# runs as `python -m glom_tpu.serving.server`, and importing the submodule
+# from its own package __init__ would make runpy warn about re-execution.

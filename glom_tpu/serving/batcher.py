@@ -1,0 +1,222 @@
+"""Deadline-aware dynamic micro-batcher with admission control.
+
+The request path's first stage: callers :meth:`~DynamicBatcher.submit`
+payloads (image arrays) and get a ``concurrent.futures.Future``; a worker
+thread pulls flushed batches with :meth:`~DynamicBatcher.next_batch` and
+resolves the futures.  Two flush rules, whichever fires first:
+
+  * **size** — the queued image count reaches ``max_batch`` (a full device
+    batch is waiting; adding latency buys nothing);
+  * **deadline** — the OLDEST queued item has waited ``max_wait_ms`` (the
+    batching gain is bounded, the latency cost is not — flush partial).
+
+Admission control is load shedding, not unbounded queueing: when the
+queue already holds ``max_queue`` images, ``submit`` raises
+:class:`Overloaded` immediately and the server turns it into a structured
+503 — a client that can see "overloaded" can back off; a client stuck
+behind an unbounded queue just times out and retries, making the overload
+worse (the PAPERS.md serving lesson: shed early, never queue unboundedly).
+
+Time is injectable (``clock``) and the flush decision is a pure function
+of queue state + clock (:meth:`next_batch` with ``block=False`` never
+sleeps), so every semantics test runs deterministically with a fake clock
+— no real sleeps, no flaky timing.  The blocking form used by the real
+worker thread layers a condition-variable wait on top of the same
+decision.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+
+class Overloaded(RuntimeError):
+    """Queue at capacity: the request was shed, not enqueued."""
+
+
+class Closed(RuntimeError):
+    """Submitted after shutdown began: the request was not enqueued."""
+
+
+@dataclass
+class _Item:
+    payload: Any
+    size: int
+    enqueued_at: float
+    future: Future = field(default_factory=Future)
+
+
+class BatcherStats:
+    """Host-side counters the engine mirrors into its metric registry."""
+
+    def __init__(self):
+        self.submitted = 0       # accepted submissions (items, not images)
+        self.shed = 0            # rejected-at-capacity submissions
+        self.flush_full = 0      # batches flushed by the size rule
+        self.flush_deadline = 0  # batches flushed by the deadline rule
+        self.flush_drain = 0     # batches flushed by shutdown drain
+
+
+class DynamicBatcher:
+    """Bounded queue + the two flush rules; see module docstring.
+
+    ``max_batch``/``max_queue`` count IMAGES (an item may carry several),
+    so a device-batch budget holds regardless of how clients group their
+    requests.  An item larger than ``max_batch`` can never flush and is
+    rejected at submit (ValueError — caller bug, not load)."""
+
+    def __init__(self, *, max_batch: int = 8, max_wait_ms: float = 5.0,
+                 max_queue: int = 64, clock=None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_wait_ms < 0:
+            raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
+        if max_queue < max_batch:
+            raise ValueError(
+                f"max_queue ({max_queue}) must be >= max_batch "
+                f"({max_batch}) or a full batch could never queue"
+            )
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_ms / 1000.0
+        self.max_queue = max_queue
+        self._clock = clock if clock is not None else time.monotonic
+        self._cond = threading.Condition()
+        self._queue: deque = deque()
+        self._queued = 0          # images currently queued
+        self._closed = False
+        self._draining = False
+        self.stats = BatcherStats()
+
+    # -- admission ---------------------------------------------------------
+    @property
+    def depth(self) -> int:
+        """Queued image count (the queue-depth gauge's source)."""
+        with self._cond:
+            return self._queued
+
+    def submit(self, payload: Any, size: int = 1) -> Future:
+        """Enqueue ``payload`` (``size`` images); returns the Future the
+        worker resolves.  Raises :class:`Overloaded` at capacity (shed) or
+        :class:`Closed` after shutdown began."""
+        if size < 1:
+            raise ValueError(f"size must be >= 1, got {size}")
+        if size > self.max_batch:
+            raise ValueError(
+                f"item of {size} images exceeds max_batch {self.max_batch}; "
+                f"split the request client-side"
+            )
+        with self._cond:
+            if self._closed:
+                raise Closed("batcher is shut down")
+            if self._queued + size > self.max_queue:
+                self.stats.shed += 1
+                raise Overloaded(
+                    f"queue at capacity ({self._queued}/{self.max_queue} "
+                    f"images); request shed"
+                )
+            item = _Item(payload=payload, size=size,
+                         enqueued_at=self._clock())
+            self._queue.append(item)
+            self._queued += size
+            self.stats.submitted += 1
+            self._cond.notify_all()
+            return item.future
+
+    # -- flush decision ----------------------------------------------------
+    def _flush_reason(self, now: float) -> Optional[str]:
+        """Why the head of the queue should flush NOW, or None.  Caller
+        holds the lock."""
+        if not self._queue:
+            return None
+        if self._queued >= self.max_batch:
+            return "full"
+        if self._draining:
+            return "drain"
+        if now - self._queue[0].enqueued_at >= self.max_wait_s:
+            return "deadline"
+        return None
+
+    def _take_batch(self, reason: str) -> List[_Item]:
+        """Pop items from the head until the next item would overflow
+        ``max_batch``.  Caller holds the lock."""
+        batch: List[_Item] = []
+        total = 0
+        while self._queue and total + self._queue[0].size <= self.max_batch:
+            item = self._queue.popleft()
+            total += item.size
+            batch.append(item)
+        self._queued -= total
+        counter = {"full": "flush_full", "deadline": "flush_deadline",
+                   "drain": "flush_drain"}[reason]
+        setattr(self.stats, counter, getattr(self.stats, counter) + 1)
+        return batch
+
+    def next_batch(self, *, block: bool = True,
+                   timeout: Optional[float] = None) -> Optional[List[_Item]]:
+        """The worker's pull: a non-empty list of items when a flush rule
+        fired, or None.
+
+        ``block=False`` (the deterministic test form) evaluates the flush
+        rules against the injected clock and returns immediately.
+        ``block=True`` waits on the condition variable until a rule fires,
+        shutdown drains the queue dry (returns None — the worker exits), or
+        ``timeout`` elapses."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cond:
+            while True:
+                reason = self._flush_reason(self._clock())
+                if reason is not None:
+                    return self._take_batch(reason)
+                if self._closed and not self._queue:
+                    return None  # drained dry: worker exits
+                if not block:
+                    return None
+                # wait until: new submission, shutdown, or the head item's
+                # deadline — whichever is nearest.  An EMPTY queue has no
+                # deadline to honor, so it waits on the condition alone
+                # (a timed wait there would busy-poll at max_wait_ms=0)
+                wait = None
+                if self._queue:
+                    wait = max(
+                        0.0,
+                        self._queue[0].enqueued_at + self.max_wait_s
+                        - self._clock(),
+                    )
+                if deadline is not None:
+                    remaining = deadline - self._clock()
+                    if remaining <= 0:
+                        return None
+                    wait = remaining if wait is None else min(wait, remaining)
+                self._cond.wait(
+                    timeout=None if wait is None else max(wait, 1e-4))
+
+    # -- shutdown ----------------------------------------------------------
+    def close(self, *, drain: bool = True) -> None:
+        """Stop admitting.  ``drain=True`` (graceful): queued items keep
+        flushing (ignoring the deadline — there is no later batch to merge
+        with) until the queue is dry, then ``next_batch`` returns None.
+        ``drain=False`` (abort): pending futures fail with
+        :class:`Closed` so no client hangs on a result that will never
+        come.  Idempotent."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            if drain:
+                self._draining = True
+            else:
+                for item in self._queue:
+                    item.future.set_exception(Closed("batcher shut down"))
+                self._queue.clear()
+                self._queued = 0
+            self._cond.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed

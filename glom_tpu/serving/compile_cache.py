@@ -1,0 +1,138 @@
+"""Shape-bucketed padded batching with an ahead-of-time compile cache.
+
+Serving latency on XLA hardware is won by never compiling on the request
+path (PAPERS.md: compiler-first inference; a cold jit cache miss costs
+seconds against a millisecond forward).  The contract here:
+
+  * a fixed, configurable set of batch-size **buckets**;
+  * every incoming batch is zero-**padded up** to the nearest bucket and
+    the output **sliced back** (per-image results are independent of the
+    padding rows — GLOM's forward has no cross-batch reductions, so the
+    sliced result is bit-identical to the unpadded forward);
+  * every bucket is **AOT-compiled at startup** via
+    ``jax.jit(...).lower(...).compile()`` from ``ShapeDtypeStruct``
+    arguments (no device data needed), and the request path calls the
+    stored executables directly — the jit dispatch path, whose cache-size
+    growth is exactly what :class:`~glom_tpu.obs.monitors.RecompileMonitor`
+    detects, is never entered;
+  * warmup records a :func:`glom_tpu.profiling.snapshot_from_compiled`
+    per bucket (HLO text + compiler cost/memory model) so the operator can
+    see what each shape costs before traffic arrives.
+
+The attached :class:`RecompileMonitor` is the tripwire for the invariant,
+not a bookkeeping nicety: any code path that falls back to calling the
+jitted function with an un-warmed shape shows up as jit cache growth, and
+the engine exports it as ``serving_xla_compiles`` — the acceptance signal
+"zero XLA recompiles after startup" is asserted against it.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from glom_tpu import profiling
+from glom_tpu.obs.monitors import RecompileMonitor
+
+
+def pick_bucket(buckets: Sequence[int], n: int) -> Optional[int]:
+    """Smallest bucket >= ``n``, or None when ``n`` exceeds every bucket
+    (``buckets`` must be sorted ascending)."""
+    if n < 1:
+        raise ValueError(f"batch size must be >= 1, got {n}")
+    i = bisect.bisect_left(buckets, n)
+    return buckets[i] if i < len(buckets) else None
+
+
+def pad_to_bucket(imgs: np.ndarray, bucket: int) -> np.ndarray:
+    """Zero-pad the batch axis up to ``bucket`` — the one padding rule,
+    shared with the data-parallel forward (``parallel.inference.pad_batch``)."""
+    b = imgs.shape[0]
+    if b > bucket:
+        raise ValueError(f"batch {b} exceeds bucket {bucket}")
+    from glom_tpu.parallel.inference import pad_batch
+
+    return pad_batch(imgs, bucket)
+
+
+class BucketedCompileCache:
+    """AOT-compiled executables of one forward fn, keyed by batch bucket.
+
+    ``fn(params, imgs)`` is the raw (un-jitted) forward; the cache owns the
+    single ``jax.jit`` wrapping so the recompile monitor has exactly one
+    dispatch cache to watch.  :meth:`warmup` compiles every bucket;
+    :meth:`__call__` pads, runs the bucket's executable, and slices.
+    """
+
+    def __init__(self, fn: Callable, buckets: Sequence[int], *, name: str = "forward"):
+        buckets = sorted(set(int(b) for b in buckets))
+        if not buckets:
+            raise ValueError("need at least one bucket size")
+        if buckets[0] < 1:
+            raise ValueError(f"bucket sizes must be >= 1, got {buckets[0]}")
+        self.name = name
+        self.buckets: Tuple[int, ...] = tuple(buckets)
+        self._jit_fn = jax.jit(fn)
+        self._compiled: Dict[int, Any] = {}
+        self.monitor = RecompileMonitor(self._jit_fn)
+        self.snapshots: Dict[int, Dict[str, Any]] = {}
+        self.warmed = False
+
+    @property
+    def max_bucket(self) -> int:
+        return self.buckets[-1]
+
+    def pick(self, n: int) -> Optional[int]:
+        return pick_bucket(self.buckets, n)
+
+    # -- warmup ------------------------------------------------------------
+    def warmup(self, params, img_struct_fn: Callable[[int], jax.ShapeDtypeStruct],
+               *, keep_hlo: bool = True) -> None:
+        """AOT-compile every bucket.  ``params`` may be real arrays or a
+        matching pytree of ``ShapeDtypeStruct`` — only shapes/dtypes reach
+        the lowering; ``img_struct_fn(bucket)`` supplies the batch aval.
+
+        Idempotent per bucket; records a compile snapshot (HLO optional via
+        ``keep_hlo`` — it can run to MBs for big models) for each."""
+        params_struct = jax.tree_util.tree_map(
+            lambda p: jax.ShapeDtypeStruct(np.shape(p), p.dtype), params
+        )
+        for bucket in self.buckets:
+            if bucket in self._compiled:
+                continue
+            lowered = self._jit_fn.lower(params_struct, img_struct_fn(bucket))
+            compiled = lowered.compile()
+            self._compiled[bucket] = compiled
+            snap = profiling.snapshot_from_compiled(lowered, compiled)
+            if not keep_hlo:
+                snap.pop("hlo", None)
+            self.snapshots[bucket] = snap
+        # baseline the monitor AFTER warmup: AOT lower/compile never touches
+        # the jit dispatch cache, but a zero poll here makes that explicit —
+        # every later nonzero poll is a request-path compile
+        self.monitor.poll()
+        self.warmed = True
+
+    # -- request path ------------------------------------------------------
+    def __call__(self, params, imgs: np.ndarray):
+        """Pad ``imgs`` to its bucket, run, slice the batch axis back.
+
+        A batch over the largest bucket falls back to the jit dispatch path
+        (correct, but it may compile — the monitor and the engine's
+        ``serving_xla_compiles`` counter record it).  Engines prevent this
+        by capping the batcher's ``max_batch`` at the largest bucket."""
+        b = imgs.shape[0]
+        bucket = self.pick(b)
+        if bucket is None or bucket not in self._compiled:
+            out = self._jit_fn(params, imgs)
+        else:
+            out = self._compiled[bucket](params, pad_to_bucket(imgs, bucket))
+        return out[:b] if out.shape[0] != b else out
+
+    def poll_compiles(self) -> int:
+        """New jit-dispatch compiles since the last poll — nonzero after
+        warmup means the no-compile-on-request-path invariant broke."""
+        return self.monitor.poll()

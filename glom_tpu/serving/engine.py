@@ -1,0 +1,495 @@
+"""Serving engine: model lifecycle + batched execution.
+
+Ties the request path together:
+
+  request -> :class:`~glom_tpu.serving.batcher.DynamicBatcher` (one per
+  endpoint) -> worker thread -> bucket-padded AOT executable
+  (:class:`~glom_tpu.serving.compile_cache.BucketedCompileCache`) ->
+  sliced per-request results resolved onto the callers' futures.
+
+Model lifecycle:
+
+  * **load** — params come from the newest finalized checkpoint
+    (``checkpoint.latest_step`` + the shared
+    ``training.denoise.load_checkpoint_state`` read path), templates are
+    built once and reused for every later reload;
+  * **hot reload** — a watcher polls ``latest_step`` on a timer; when a
+    newer step lands, the new params are restored OFF the request path and
+    swapped in atomically (one reference assignment).  In-flight batches
+    captured the old reference before the swap and finish on the old
+    params — no request ever sees a half-updated tree.  A reload that
+    fails (half-written artifact, torn manifest, shape drift) warns and
+    keeps serving the old params;
+  * **drain** — :meth:`ServingEngine.shutdown` with ``drain=True`` (the
+    server's SIGTERM path, mirroring the trainer's preemption handling)
+    stops admission, lets queued work flush, and joins the workers before
+    returning.
+
+Observability rides the existing ``glom_tpu.obs`` registry: latency
+histograms, queue-depth / batch-occupancy metrics, shed + compile + reload
+counters — all visible through the server's ``/metrics`` endpoint.  A
+:class:`~glom_tpu.obs.triggers.QueueSaturationMonitor` watches sustained
+overload and, gated by the shared
+:class:`~glom_tpu.obs.triggers.TriggerEngine`, dumps a forensics bundle
+exactly like the trainer's anomaly path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from glom_tpu import checkpoint as ckpt_lib
+from glom_tpu.config import GlomConfig, TrainConfig
+from glom_tpu.models import glom as glom_model
+from glom_tpu.models.heads import decoder_apply
+from glom_tpu.obs import MetricRegistry
+from glom_tpu.obs.forensics import ForensicsManager
+from glom_tpu.obs.triggers import (
+    TRIGGER_QUEUE_SATURATION,
+    QueueSaturationMonitor,
+    TriggerEngine,
+)
+from glom_tpu.serving.batcher import Closed, DynamicBatcher, Overloaded  # noqa: F401
+from glom_tpu.serving.compile_cache import BucketedCompileCache
+from glom_tpu.training import denoise
+
+ENDPOINTS = ("embed", "reconstruct")
+
+DEMO_CONFIG = GlomConfig(dim=16, levels=3, image_size=16, patch_size=8)
+
+
+def make_demo_checkpoint(directory: str, *, config: Optional[GlomConfig] = None,
+                         train: Optional[TrainConfig] = None, seed: int = 0) -> int:
+    """Write a tiny untrained-but-servable checkpoint (step 0) in the
+    Trainer's self-describing layout — the zero-setup path for smoke tests
+    and ``tools/loadgen.py --smoke``.  Returns the step written."""
+    import json
+    import os
+
+    import optax
+
+    config = config if config is not None else DEMO_CONFIG
+    train = train if train is not None else TrainConfig(batch_size=2, steps=0)
+    state = denoise.init_state(
+        jax.random.PRNGKey(seed), config, optax.sgd(0.0),
+        decoder=train.decoder, decoder_hidden_mult=train.decoder_hidden_mult,
+    )
+    os.makedirs(directory, exist_ok=True)
+    payload = json.dumps(
+        {"glom": config.to_json_dict(), "train": train.to_json_dict()},
+        indent=2,
+    ).encode()
+    ckpt_lib._atomic_write(directory, "config.json", lambda f: f.write(payload))
+    ckpt_lib.save(directory, 0, {"params": jax.device_get(state.params)})
+    return 0
+
+
+def _make_embed_fn(config: GlomConfig, iters: Optional[int]):
+    """``(params, imgs) -> (b, L, d)`` mean-pooled per-level embeddings —
+    the per-level artifact GLOM exposes downstream (PAPER.md levels;
+    ``training/extract.py``'s pooling, compiled for serving).  All levels
+    are always computed; the endpoint slices one host-side, so one compiled
+    graph per bucket serves every ``level=`` query."""
+
+    def f(params, imgs):
+        out = glom_model.apply(params["glom"], imgs, config=config, iters=iters)
+        return jnp.mean(out, axis=1)
+
+    return f
+
+
+def _make_reconstruct_fn(config: GlomConfig, train_cfg: TrainConfig,
+                         iters: Optional[int]):
+    """``(params, imgs) -> (b, c, H, W)`` denoising forward: the state at
+    the TRAINING loss timestep decoded through the trained head — the
+    decode path the decoder was optimized for, not an arbitrary final-state
+    decode."""
+    resolved_iters = iters if iters is not None else (
+        train_cfg.iters if train_cfg.iters is not None else config.default_iters
+    )
+    timestep = denoise.resolve_loss_timestep(train_cfg, resolved_iters)
+
+    def f(params, imgs):
+        _, captured = glom_model.apply(
+            params["glom"], imgs, config=config, iters=resolved_iters,
+            capture_timestep=timestep,
+        )
+        return decoder_apply(
+            params["decoder"], captured, config,
+            arch=train_cfg.decoder, level=train_cfg.loss_level,
+        )
+
+    return f
+
+
+class ServingEngine:
+    """One loaded model + per-endpoint batchers, workers, and caches.
+
+    ``clock`` is injectable (tests drive batching deterministically);
+    ``start(workers=False)`` skips the worker/watcher threads so tests can
+    pump :meth:`process_once` by hand.
+    """
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        *,
+        buckets: Sequence[int] = (1, 2, 4, 8),
+        iters: Optional[int] = None,
+        max_wait_ms: float = 5.0,
+        max_queue: int = 64,
+        registry: Optional[MetricRegistry] = None,
+        reload_poll_s: float = 2.0,
+        warmup: bool = True,
+        warmup_dir: Optional[str] = None,
+        forensics_dir: Optional[str] = None,
+        saturation_threshold: float = 0.9,
+        saturation_sustained: int = 3,
+        saturation_debounce: int = 200,
+        max_captures: int = 3,
+        clock=None,
+    ):
+        self.checkpoint_dir = checkpoint_dir
+        self.registry = registry if registry is not None else MetricRegistry()
+        self._clock = clock if clock is not None else time.monotonic
+        self._reload_poll_s = reload_poll_s
+        self._warmup_dir = warmup_dir
+
+        step = ckpt_lib.latest_step(checkpoint_dir)
+        if step is None:
+            raise FileNotFoundError(
+                f"no finalized checkpoint in {checkpoint_dir!r} — the engine "
+                f"needs a manifest to serve from (train first, or "
+                f"make_demo_checkpoint for a smoke run)"
+            )
+        step, self.config, self.train_cfg, host_params = (
+            denoise.load_checkpoint_state(checkpoint_dir, step=step)
+        )
+        # template for every later reload: restore() places leaves onto the
+        # template's dtypes/shardings, so reloads land where the originals did
+        self._template = host_params
+        self._params = jax.device_put(host_params)
+        self.step = step
+        self.iters = iters
+
+        # -- compiled forward per endpoint ---------------------------------
+        self.caches: Dict[str, BucketedCompileCache] = {
+            "embed": BucketedCompileCache(
+                _make_embed_fn(self.config, iters), buckets, name="embed"),
+            "reconstruct": BucketedCompileCache(
+                _make_reconstruct_fn(self.config, self.train_cfg, iters),
+                buckets, name="reconstruct"),
+        }
+        max_bucket = self.caches["embed"].max_bucket
+
+        # -- batchers (admission control) ----------------------------------
+        self.batchers: Dict[str, DynamicBatcher] = {
+            ep: DynamicBatcher(
+                max_batch=max_bucket, max_wait_ms=max_wait_ms,
+                max_queue=max_queue, clock=self._clock,
+            )
+            for ep in ENDPOINTS
+        }
+
+        # -- overload forensics --------------------------------------------
+        # per endpoint: each endpoint has its own queue, and observations
+        # of one must not reset (or double-count sheds into) the other's
+        # saturation streak
+        self._saturation = {
+            ep: QueueSaturationMonitor(
+                threshold=saturation_threshold, sustained=saturation_sustained,
+            )
+            for ep in ENDPOINTS
+        }
+        self._triggers = TriggerEngine(
+            debounce_steps=saturation_debounce, max_captures=max_captures,
+            registry=self.registry,
+        )
+        self._forensics: Optional[ForensicsManager] = None
+        if forensics_dir:
+            # snapshot_fn reuses the warmup record for the largest bucket —
+            # an overload capture must never pay (or risk) a compile
+            self._forensics = ForensicsManager(
+                forensics_dir,
+                config={"checkpoint_dir": checkpoint_dir,
+                        "buckets": list(self.caches["embed"].buckets),
+                        "max_queue": max_queue, "max_wait_ms": max_wait_ms,
+                        "glom": self.config.to_json_dict()},
+                snapshot_fn=lambda: self.caches["embed"].snapshots.get(max_bucket),
+                registry=self.registry,
+            )
+
+        self._lock = threading.Lock()  # params swap + counters + saturation
+        self._threads: list = []
+        self._stop = threading.Event()
+        self._started = False
+        self._shed_seen = {ep: 0 for ep in ENDPOINTS}
+        self.request_count = 0  # the serving analogue of the trainer's step
+
+        if warmup:
+            self.warm()
+
+    # -- warmup ------------------------------------------------------------
+    def warm(self) -> None:
+        """AOT-compile every (endpoint, bucket) pair and record the per-
+        bucket compile snapshots (written under ``warmup_dir`` when set).
+        The request path never compiles after this returns."""
+        c = self.config
+        t0 = self._clock()
+        for ep, cache in self.caches.items():
+            if cache.warmed:
+                continue
+            # float32 MUST match what submit() feeds the executables (AOT
+            # calls are aval-strict — a bf16-compiled executable given f32
+            # images raises, it doesn't cast); the model itself casts to
+            # its compute dtype in-graph (glom.cast_for_compute)
+            cache.warmup(
+                self._params,
+                lambda b: jax.ShapeDtypeStruct(
+                    (b, c.channels, c.image_size, c.image_size), np.float32,
+                ),
+            )
+            if self._warmup_dir:
+                self._write_warmup_snapshots(ep, cache)
+        self.registry.gauge(
+            "serving_warmup_seconds",
+            help="wall time of the startup AOT compile pass", unit="seconds",
+        ).set(self._clock() - t0)
+
+    def _write_warmup_snapshots(self, endpoint: str, cache) -> None:
+        from glom_tpu.obs.forensics import write_bundle
+
+        for bucket, snap in cache.snapshots.items():
+            files = {"manifest.json": {
+                "endpoint": endpoint, "bucket": bucket,
+                "cost_analysis": snap.get("cost_analysis", {}),
+                "memory_analysis": snap.get("memory_analysis", {}),
+            }}
+            if snap.get("hlo"):
+                files["hlo.txt"] = snap["hlo"]
+            try:
+                write_bundle(self._warmup_dir, f"{endpoint}-b{bucket}", files)
+            except OSError as e:
+                warnings.warn(f"warmup snapshot write failed ({e})", stacklevel=2)
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def params(self):
+        return self._params  # reference read is atomic; swap happens whole
+
+    def start(self, *, workers: bool = True, watch: Optional[bool] = None) -> None:
+        """Spin up one worker thread per endpoint plus the hot-reload
+        watcher (``watch`` defaults to ``reload_poll_s > 0``).  Tests pass
+        ``workers=False`` and pump :meth:`process_once` / call
+        :meth:`check_reload` directly."""
+        if self._started:
+            return
+        self._started = True
+        if watch is None:
+            watch = self._reload_poll_s > 0
+        if workers:
+            for ep in ENDPOINTS:
+                t = threading.Thread(
+                    target=self._worker_loop, args=(ep,),
+                    name=f"glom-serving-{ep}", daemon=True,
+                )
+                t.start()
+                self._threads.append(t)
+        if watch:
+            t = threading.Thread(
+                target=self._watch_loop, name="glom-serving-reload", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    def shutdown(self, *, drain: bool = True, timeout: float = 30.0) -> None:
+        """Graceful stop (the server's SIGTERM path): close admission,
+        drain queued work (``drain=True``) or fail it fast, stop the
+        watcher, join the threads.  Idempotent."""
+        for batcher in self.batchers.values():
+            batcher.close(drain=drain)
+        self._stop.set()
+        deadline = time.monotonic() + timeout
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        self._threads = []
+
+    # -- hot reload --------------------------------------------------------
+    def check_reload(self) -> bool:
+        """One watcher poll: load + swap when a newer finalized checkpoint
+        landed.  Returns True on a successful swap.  Never raises — a
+        half-written checkpoint (skipped by the hardened ``latest_step``)
+        or a failing restore leaves the old params serving."""
+        try:
+            newest = ckpt_lib.latest_step(self.checkpoint_dir)
+        except Exception as e:  # latest_step is hardened; belt and braces
+            warnings.warn(f"reload poll failed ({type(e).__name__}: {e})",
+                          stacklevel=2)
+            return False
+        if newest is None or newest <= self.step:
+            return False
+        try:
+            _, trees = ckpt_lib.restore(
+                self.checkpoint_dir, {"params": self._template}, step=newest,
+            )
+            new_params = jax.device_put(trees["params"])
+            # block before the swap: a reload must never make the first
+            # request after it pay the H2D transfer
+            jax.block_until_ready(jax.tree_util.tree_leaves(new_params)[0])
+        except Exception as e:
+            warnings.warn(
+                f"hot reload of step {newest} failed ({type(e).__name__}: "
+                f"{e}); continuing to serve step {self.step}",
+                stacklevel=2,
+            )
+            return False
+        with self._lock:
+            self._params = new_params
+            self.step = newest
+        self.registry.counter(
+            "serving_param_reloads", help="successful checkpoint hot reloads",
+        ).inc()
+        self.registry.gauge(
+            "serving_checkpoint_step", help="step of the params being served",
+        ).set(newest)
+        return True
+
+    def _watch_loop(self) -> None:
+        while not self._stop.wait(self._reload_poll_s):
+            self.check_reload()
+
+    # -- request path ------------------------------------------------------
+    def submit(self, endpoint: str, imgs: np.ndarray):
+        """Enqueue a ``(k, c, H, W)`` batch for ``endpoint``; returns the
+        Future resolving to the endpoint's output for those ``k`` images.
+        Raises :class:`Overloaded` (shed) or :class:`Closed` (shutting
+        down) — the server maps both to structured 503s."""
+        batcher = self.batchers[endpoint]
+        try:
+            future = batcher.submit(np.ascontiguousarray(imgs, dtype=np.float32),
+                                    size=imgs.shape[0])
+        except Overloaded:
+            self.registry.counter(
+                "serving_shed_total", help="requests shed at queue capacity",
+            ).inc()
+            self._observe_saturation(endpoint)
+            raise
+        self._observe_saturation(endpoint)
+        return future
+
+    def process_once(self, endpoint: str, *, block: bool = False,
+                     timeout: Optional[float] = None) -> int:
+        """Pull one batch (if a flush rule fired) and run it; returns the
+        number of images served.  The worker thread loops the blocking
+        form; tests call the non-blocking form directly."""
+        batcher = self.batchers[endpoint]
+        batch = batcher.next_batch(block=block, timeout=timeout)
+        if not batch:
+            return 0
+        cache = self.caches[endpoint]
+        params = self.params  # snapshot: in-flight work finishes on these
+        arrays = [item.payload for item in batch]
+        imgs = arrays[0] if len(arrays) == 1 else np.concatenate(arrays)
+        n = imgs.shape[0]
+        t0 = time.monotonic()
+        try:
+            out = np.asarray(cache(params, imgs))
+        except Exception as e:
+            for item in batch:
+                if not item.future.done():
+                    item.future.set_exception(e)
+            return 0
+        batch_s = time.monotonic() - t0
+        offset = 0
+        for item in batch:
+            item.future.set_result(out[offset:offset + item.size])
+            offset += item.size
+        self._account_batch(endpoint, cache, n, batch_s)
+        return n
+
+    def _worker_loop(self, endpoint: str) -> None:
+        batcher = self.batchers[endpoint]
+        while True:
+            served = self.process_once(endpoint, block=True, timeout=0.25)
+            if served == 0 and batcher.closed and batcher.depth == 0:
+                return
+
+    # -- accounting / overload forensics -----------------------------------
+    def _account_batch(self, endpoint, cache, n, batch_s) -> None:
+        reg = self.registry
+        with self._lock:
+            self.request_count += n
+        reg.counter("serving_requests_total",
+                    help="images served across endpoints").inc(n)
+        reg.histogram(f"serving_batch_seconds_{endpoint}",
+                      help="device batch execution time",
+                      unit="seconds").observe(batch_s)
+        bucket = cache.pick(n) or n
+        reg.histogram("serving_batch_occupancy",
+                      help="real images / bucket size per executed batch"
+                      ).observe(n / bucket)
+        reg.gauge("serving_queue_depth", help="queued images"
+                  ).set(self.batchers[endpoint].depth)
+        new_compiles = cache.poll_compiles()
+        if new_compiles:
+            reg.counter(
+                "serving_xla_compiles",
+                help="request-path XLA compiles after warmup (must stay 0)",
+            ).inc(new_compiles)
+
+    def _observe_saturation(self, endpoint: str) -> None:
+        batcher = self.batchers[endpoint]
+        # the whole observe-decide-capture path runs under the lock:
+        # handler threads race through here, and both the monitor's streak
+        # arithmetic and the trigger engine's budget check are
+        # read-modify-write (two racing threads could overshoot the
+        # capture budget).  Captures are rare and the bundle write is
+        # small, so holding the lock across it is fine.
+        with self._lock:
+            shed_total = batcher.stats.shed
+            shed_delta = shed_total - self._shed_seen[endpoint]
+            self._shed_seen[endpoint] = shed_total
+            count = self.request_count
+            detail = self._saturation[endpoint].update(
+                batcher.depth, batcher.max_queue, shed_delta,
+            )
+            if detail is not None:
+                self.registry.counter(
+                    "serving_queue_saturation_events",
+                    help="sustained-overload detections",
+                ).inc()
+                detail["endpoint"] = endpoint
+                if self._forensics is not None and self._triggers.fire(
+                    TRIGGER_QUEUE_SATURATION, count
+                ):
+                    path = self._forensics.capture(
+                        TRIGGER_QUEUE_SATURATION, count, detail, trace=False,
+                    )
+                    if path is None:
+                        self._triggers.refund(TRIGGER_QUEUE_SATURATION, count)
+        self.registry.gauge("serving_queue_depth", help="queued images"
+                            ).set(batcher.depth)
+
+    # -- health ------------------------------------------------------------
+    def health(self) -> dict:
+        """The ``/healthz`` payload: liveness plus the config a client
+        (loadgen) needs to build valid requests."""
+        c = self.config
+        return {
+            "status": "ok",
+            "step": int(self.step),
+            "warm": all(cache.warmed for cache in self.caches.values()),
+            "queue_depth": {ep: b.depth for ep, b in self.batchers.items()},
+            "buckets": list(self.caches["embed"].buckets),
+            "image_size": c.image_size,
+            "channels": c.channels,
+            "levels": c.levels,
+            "dim": c.dim,
+        }

@@ -1,0 +1,267 @@
+"""Stdlib-only HTTP front for the serving engine.
+
+``python -m glom_tpu.serving.server --checkpoint-dir /ckpt`` exposes:
+
+  * ``POST /embed`` — ``{"images": [...]}`` (one ``(c,H,W)`` image or a
+    ``(k,c,H,W)`` batch as nested lists) -> mean-pooled per-level
+    embeddings ``(k, levels, dim)`` (optionally one level via
+    ``"level"``);
+  * ``POST /reconstruct`` — same request shape -> the denoising forward's
+    reconstruction ``(k, c, H, W)``;
+  * ``GET /healthz`` — liveness + the model's input contract (loadgen
+    reads it to build valid payloads);
+  * ``GET /metrics`` — the shared ``glom_tpu.obs`` registry in Prometheus
+    exposition format (same families the trainer's textfile exporter
+    writes).
+
+``ThreadingHTTPServer`` gives one handler thread per connection; handlers
+only parse JSON and park on the engine's future, so the thread count
+bounds concurrent WAITERS, not device work — the device sees only the
+micro-batched worker.  Overload surfaces as a structured 503
+(``{"error": "overloaded"}``) from the batcher's admission control, and
+SIGTERM drains in-flight work before exit, mirroring the trainer's
+preemption path.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from glom_tpu.obs.exporters import prometheus_lines
+from glom_tpu.serving.batcher import Closed, Overloaded
+from glom_tpu.serving.engine import ServingEngine
+
+_MAX_BODY = 256 * 1024 * 1024  # refuse absurd payloads before np.asarray
+
+
+class ServingHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True   # handler threads must not block process exit
+    allow_reuse_address = True
+
+    def __init__(self, addr, handler, engine: ServingEngine, *, quiet: bool = True):
+        super().__init__(addr, handler)
+        self.engine = engine
+        self.quiet = quiet
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "glom-serving"
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ----------------------------------------------------------
+    def log_message(self, fmt, *args):
+        if not getattr(self.server, "quiet", True):
+            super().log_message(fmt, *args)
+
+    def _reply(self, code: int, payload, content_type="application/json") -> None:
+        body = (json.dumps(payload) if isinstance(payload, (dict, list))
+                else payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self) -> Optional[dict]:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length <= 0 or length > _MAX_BODY:
+            self._reply(400, {"error": f"bad Content-Length {length}"})
+            return None
+        try:
+            return json.loads(self.rfile.read(length))
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            self._reply(400, {"error": f"invalid JSON: {e}"})
+            return None
+
+    def _parse_images(self, payload: dict) -> Optional[np.ndarray]:
+        cfg = self.server.engine.config
+        try:
+            imgs = np.asarray(payload["images"], dtype=np.float32)
+        except (KeyError, TypeError, ValueError) as e:
+            self._reply(400, {"error": f"bad 'images' field: {e}"})
+            return None
+        if imgs.ndim == 3:
+            imgs = imgs[None]
+        expected = (cfg.channels, cfg.image_size, cfg.image_size)
+        if imgs.ndim != 4 or imgs.shape[1:] != expected or imgs.shape[0] == 0:
+            self._reply(400, {"error": (
+                f"images must be (k,)+{expected} (or one {expected} image), "
+                f"got {tuple(imgs.shape)}"
+            )})
+            return None
+        return imgs
+
+    # -- routes ------------------------------------------------------------
+    def do_GET(self):  # noqa: N802 (http.server contract)
+        engine = self.server.engine
+        if self.path == "/healthz":
+            self._reply(200, engine.health())
+        elif self.path == "/metrics":
+            self._reply(200, prometheus_lines(engine.registry),
+                        content_type="text/plain; version=0.0.4")
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):  # noqa: N802
+        if self.path not in ("/embed", "/reconstruct"):
+            self._reply(404, {"error": f"no route {self.path}"})
+            return
+        endpoint = self.path[1:]
+        payload = self._read_json()
+        if payload is None:
+            return
+        imgs = self._parse_images(payload)
+        if imgs is None:
+            return
+        engine = self.server.engine
+        import time as _time
+
+        t0 = _time.monotonic()
+        try:
+            future = engine.submit(endpoint, imgs)
+            out = future.result(timeout=60.0)
+        except Overloaded:
+            self._reply(503, {"error": "overloaded",
+                              "detail": "queue at capacity; retry with backoff"})
+            return
+        except Closed:
+            self._reply(503, {"error": "shutting_down",
+                              "detail": "server is draining; retry elsewhere"})
+            return
+        except ValueError as e:  # e.g. request larger than max_batch
+            self._reply(400, {"error": str(e)})
+            return
+        except Exception as e:
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        latency = _time.monotonic() - t0
+        engine.registry.histogram(
+            f"serving_latency_seconds_{endpoint}",
+            help="request latency, admission to response", unit="seconds",
+        ).observe(latency)
+
+        resp = {"step": int(engine.step),
+                "latency_ms": round(latency * 1e3, 3)}
+        if endpoint == "embed":
+            level = payload.get("level")
+            if level is not None:
+                try:
+                    out = out[:, int(level)]
+                except (IndexError, TypeError, ValueError):
+                    self._reply(400, {"error": (
+                        f"level {level!r} outside this model's "
+                        f"{engine.config.levels} levels"
+                    )})
+                    return
+            resp["embeddings"] = out.tolist()
+        else:
+            resp["images"] = out.tolist()
+        self._reply(200, resp)
+
+
+def make_server(engine: ServingEngine, host: str = "127.0.0.1",
+                port: int = 0, *, quiet: bool = True) -> ServingHTTPServer:
+    """Bind (port 0 = ephemeral — tests read ``server.server_address``);
+    the caller starts ``serve_forever`` on its own thread."""
+    return ServingHTTPServer((host, port), _Handler, engine, quiet=quiet)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="GLOM online serving: dynamic batching + bucketed AOT "
+                    "compile cache + checkpoint hot-reload",
+    )
+    p.add_argument("--checkpoint-dir", required=True,
+                   help="Trainer checkpoint dir (reads its config.json)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--buckets", default="1,2,4,8",
+                   help="comma-separated batch buckets, padded up to")
+    p.add_argument("--max-wait-ms", type=float, default=5.0,
+                   help="micro-batch deadline: flush a partial batch after this")
+    p.add_argument("--max-queue", type=int, default=64,
+                   help="queued-image bound; beyond it requests shed (503)")
+    p.add_argument("--iters", type=int, default=None,
+                   help="GLOM iterations (default: the model's)")
+    p.add_argument("--reload-poll-s", type=float, default=2.0,
+                   help="checkpoint hot-reload poll period; 0 disables")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip the startup AOT compile pass (first requests "
+                        "per bucket then pay the compile)")
+    p.add_argument("--warmup-dir", default=None,
+                   help="write per-bucket HLO/cost snapshots here at warmup")
+    p.add_argument("--forensics-dir", default=None,
+                   help="bundle root for queue_saturation captures")
+    p.add_argument("--demo", action="store_true",
+                   help="write a tiny demo checkpoint into --checkpoint-dir "
+                        "if it has none (smoke runs)")
+    p.add_argument("--platform", default="auto",
+                   help="force a JAX platform (e.g. 'cpu')")
+    p.add_argument("--verbose", action="store_true", help="per-request access log")
+    args = p.parse_args(argv)
+
+    if args.platform != "auto":
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from glom_tpu import checkpoint as ckpt_lib
+
+    if args.demo and ckpt_lib.latest_step(args.checkpoint_dir) is None:
+        from glom_tpu.serving.engine import make_demo_checkpoint
+
+        make_demo_checkpoint(args.checkpoint_dir)
+        print(json.dumps({"event": "demo_checkpoint", "dir": args.checkpoint_dir}))
+
+    engine = ServingEngine(
+        args.checkpoint_dir,
+        buckets=tuple(int(b) for b in args.buckets.split(",")),
+        iters=args.iters,
+        max_wait_ms=args.max_wait_ms,
+        max_queue=args.max_queue,
+        reload_poll_s=args.reload_poll_s,
+        warmup=not args.no_warmup,
+        warmup_dir=args.warmup_dir,
+        forensics_dir=args.forensics_dir,
+    )
+    engine.start()
+    server = make_server(engine, args.host, args.port, quiet=not args.verbose)
+
+    # SIGTERM/SIGINT -> graceful drain, mirroring the trainer's preemption
+    # path: stop admission, flush queued batches, then stop accepting
+    stop_once = threading.Event()
+
+    def _graceful(signum, frame):
+        if stop_once.is_set():
+            return
+        stop_once.set()
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+
+    host, port = server.server_address[:2]
+    print(json.dumps({
+        "event": "serving", "host": host, "port": port,
+        "step": int(engine.step), "buckets": engine.health()["buckets"],
+        "warm": engine.health()["warm"],
+    }), flush=True)
+    try:
+        server.serve_forever(poll_interval=0.2)
+    finally:
+        engine.shutdown(drain=True)
+        server.server_close()
+        print(json.dumps({"event": "drained", "step": int(engine.step)}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -14,7 +14,7 @@ and XLA emits the grad psum over ICI; there is no separate DDP wrapper.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Tuple
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -230,16 +230,24 @@ def make_train_step(
     )
 
 
-def load_checkpoint_params(directory: str):
-    """``(step, config, glom_params)`` from a self-describing Trainer
-    checkpoint dir (its ``config.json`` names the architecture; the state
-    template comes from a throwaway init).  The ONE loader shared by every
-    inference-side consumer (``training.extract``, the islands example) so
-    the checkpoint layout has a single read path."""
+def load_checkpoint_state(directory: str, *, step: Optional[int] = None):
+    """``(step, config, train_cfg, params)`` from a self-describing Trainer
+    checkpoint dir — the FULL param tree ``{"glom": ..., "decoder": ...}``
+    plus the recorded :class:`TrainConfig` (decoder arch, loss timestep /
+    level — everything an inference consumer needs to reproduce the
+    training-time decode path).  The ONE loader behind every inference-side
+    consumer (``training.extract``, the serving engine, the islands
+    example) so the checkpoint layout has a single read path.
+
+    The recorded train dict is filtered to the fields THIS build knows:
+    a checkpoint written by a newer build with extra knobs still loads
+    (those knobs can't matter to a build that doesn't implement them)."""
+    import dataclasses as _dc
     import json
     import os
 
     from glom_tpu import checkpoint as ckpt_lib
+    from glom_tpu.config import TrainConfig
 
     with open(os.path.join(directory, "config.json")) as f:
         payload = json.load(f)
@@ -247,11 +255,24 @@ def load_checkpoint_params(directory: str):
     # the decoder arch changes the saved param tree — the template must
     # match what the trainer actually wrote (train config is informational
     # but authoritative for this)
-    tcfg = payload.get("train") or {}
+    tcfg_dict = payload.get("train") or {}
+    known = {f.name for f in _dc.fields(TrainConfig)}
+    train_cfg = TrainConfig.from_json_dict(
+        {k: v for k, v in tcfg_dict.items() if k in known}
+    )
     template = init_state(
         jax.random.PRNGKey(0), config, optax.sgd(0.0),
-        decoder=tcfg.get("decoder", "linear"),
-        decoder_hidden_mult=tcfg.get("decoder_hidden_mult", 2),
+        decoder=train_cfg.decoder,
+        decoder_hidden_mult=train_cfg.decoder_hidden_mult,
     )
-    step, trees = ckpt_lib.restore(directory, {"params": template.params})
-    return step, config, trees["params"]["glom"]
+    step, trees = ckpt_lib.restore(directory, {"params": template.params},
+                                   step=step)
+    return step, config, train_cfg, trees["params"]
+
+
+def load_checkpoint_params(directory: str):
+    """``(step, config, glom_params)`` — the backbone-only convenience over
+    :func:`load_checkpoint_state` (embedding extraction and the islands
+    example never touch the decoder head)."""
+    step, config, _train_cfg, params = load_checkpoint_state(directory)
+    return step, config, params["glom"]

@@ -698,7 +698,11 @@ class Trainer:
                 stacklevel=2,
             )
         stateful_stream = hasattr(batches, "state_dict")
-        if cfg.checkpoint_dir and ckpt_lib.latest_step(cfg.checkpoint_dir) is not None:
+        # strict: a garbled manifest must abort the resume, not silently
+        # restart from step 0 (the lenient form is for the serving watcher)
+        if cfg.checkpoint_dir and ckpt_lib.latest_step(
+            cfg.checkpoint_dir, strict=True
+        ) is not None:
             resumed = self.restore(cfg.checkpoint_dir, batches=batches)
             self._log(resumed, event=EVENT_RESUME)
 

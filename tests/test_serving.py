@@ -1,0 +1,643 @@
+"""Serving subsystem tests (glom_tpu/serving/ + tools/loadgen.py).
+
+Tier-1 (CPU): batcher semantics run against an injectable fake clock (no
+real sleeps), the compile cache's AOT/zero-recompile invariant is asserted
+via the jit cache-size recompile monitor, and the HTTP front is exercised
+end-to-end in-process on an ephemeral port.  The loadgen soak run is
+marked ``slow``.
+"""
+
+import json
+import os
+import threading
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from glom_tpu import checkpoint as ckpt_lib
+from glom_tpu.serving.batcher import Closed, DynamicBatcher, Overloaded
+from glom_tpu.serving.compile_cache import (
+    BucketedCompileCache, pad_to_bucket, pick_bucket,
+)
+from glom_tpu.serving.engine import DEMO_CONFIG, ServingEngine, make_demo_checkpoint
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, seconds):
+        self.t += seconds
+
+
+# ---------------------------------------------------------------------------
+# dynamic batcher — deterministic, fake clock, no sleeps
+# ---------------------------------------------------------------------------
+class TestDynamicBatcher:
+    def _batcher(self, **kw):
+        clock = FakeClock()
+        kw.setdefault("max_batch", 4)
+        kw.setdefault("max_wait_ms", 5.0)
+        kw.setdefault("max_queue", 8)
+        return DynamicBatcher(clock=clock, **kw), clock
+
+    def test_flush_on_max_batch(self):
+        b, _ = self._batcher()
+        futs = [b.submit(i) for i in range(4)]
+        batch = b.next_batch(block=False)
+        assert [it.payload for it in batch] == [0, 1, 2, 3]
+        assert b.stats.flush_full == 1 and b.stats.flush_deadline == 0
+        assert all(not f.done() for f in futs)  # worker resolves, not batcher
+
+    def test_no_flush_before_deadline(self):
+        b, clock = self._batcher()
+        b.submit("x")
+        clock.advance(0.004)  # under the 5 ms deadline
+        assert b.next_batch(block=False) is None
+
+    def test_flush_on_deadline(self):
+        b, clock = self._batcher()
+        b.submit("x")
+        b.submit("y")
+        clock.advance(0.005)
+        batch = b.next_batch(block=False)
+        assert [it.payload for it in batch] == ["x", "y"]
+        assert b.stats.flush_deadline == 1
+
+    def test_deadline_counts_from_oldest_item(self):
+        b, clock = self._batcher()
+        b.submit("old")
+        clock.advance(0.004)
+        b.submit("new")  # must not reset the head's deadline
+        clock.advance(0.001)
+        assert len(b.next_batch(block=False)) == 2
+
+    def test_sizes_count_images_not_items(self):
+        b, _ = self._batcher()
+        b.submit("a", size=2)
+        assert b.next_batch(block=False) is None
+        b.submit("b", size=2)
+        batch = b.next_batch(block=False)  # 4 images = max_batch
+        assert [it.size for it in batch] == [2, 2]
+
+    def test_batch_never_exceeds_max(self):
+        b, _ = self._batcher()
+        for name in ("a", "b", "c"):
+            b.submit(name, size=2)  # 6 images queued, max_batch 4
+        batch = b.next_batch(block=False)
+        assert sum(it.size for it in batch) == 4
+        assert b.depth == 2  # "c" still queued
+
+    def test_oversize_item_rejected(self):
+        b, _ = self._batcher()
+        with pytest.raises(ValueError, match="exceeds max_batch"):
+            b.submit("big", size=5)
+
+    def test_load_shed_at_capacity(self):
+        b, _ = self._batcher(max_queue=4)
+        for i in range(4):
+            b.submit(i)
+        with pytest.raises(Overloaded, match="shed"):
+            b.submit("extra")
+        assert b.stats.shed == 1 and b.stats.submitted == 4
+        assert b.depth == 4  # the shed request never entered the queue
+
+    def test_drain_on_shutdown(self):
+        b, _ = self._batcher()
+        b.submit("x")
+        b.submit("y")
+        b.close(drain=True)
+        batch = b.next_batch(block=False)  # deadline ignored: drain flushes
+        assert [it.payload for it in batch] == ["x", "y"]
+        assert b.stats.flush_drain == 1
+        assert b.next_batch(block=False) is None  # dry: worker exits
+        with pytest.raises(Closed):
+            b.submit("late")
+
+    def test_abort_shutdown_fails_pending_futures(self):
+        b, _ = self._batcher()
+        fut = b.submit("x")
+        b.close(drain=False)
+        with pytest.raises(Closed):
+            fut.result(timeout=0)
+        assert b.next_batch(block=False) is None
+
+    def test_close_idempotent(self):
+        b, _ = self._batcher()
+        b.close()
+        b.close()
+        assert b.closed
+
+    def test_blocking_pull_wakes_on_submit(self):
+        """The real worker's path: a blocking next_batch parked on the
+        condition variable wakes when a full batch lands."""
+        b = DynamicBatcher(max_batch=2, max_wait_ms=1000.0, max_queue=8)
+        out = []
+        t = threading.Thread(
+            target=lambda: out.append(b.next_batch(block=True, timeout=10.0)))
+        t.start()
+        b.submit("x")
+        b.submit("y")
+        t.join(timeout=10.0)
+        assert not t.is_alive() and len(out[0]) == 2
+
+
+# ---------------------------------------------------------------------------
+# bucketed AOT compile cache
+# ---------------------------------------------------------------------------
+class TestBuckets:
+    def test_pick_bucket(self):
+        assert pick_bucket((1, 2, 4), 1) == 1
+        assert pick_bucket((1, 2, 4), 3) == 4
+        assert pick_bucket((1, 2, 4), 4) == 4
+        assert pick_bucket((1, 2, 4), 5) is None
+        with pytest.raises(ValueError):
+            pick_bucket((1, 2), 0)
+
+    def test_pad_to_bucket(self):
+        x = np.ones((3, 2), np.float32)
+        padded = pad_to_bucket(x, 4)
+        assert padded.shape == (4, 2)
+        assert np.array_equal(padded[:3], x) and not padded[3].any()
+        assert pad_to_bucket(x, 3) is x
+        with pytest.raises(ValueError, match="exceeds bucket"):
+            pad_to_bucket(x, 2)
+
+    def test_warmup_compiles_every_bucket_and_snapshots(self):
+        cache = BucketedCompileCache(
+            lambda params, x: x * params["w"], (2, 4), name="toy")
+        params = {"w": np.float32(3.0)}
+        cache.warmup(params, lambda b: jax.ShapeDtypeStruct((b, 2), np.float32))
+        assert cache.warmed and sorted(cache.snapshots) == [2, 4]
+        snap = cache.snapshots[2]
+        assert isinstance(snap["hlo"], str) and snap["hlo"]
+        assert isinstance(snap["cost_analysis"], dict)
+
+    def test_request_path_pads_slices_and_never_compiles(self):
+        cache = BucketedCompileCache(
+            lambda params, x: x * params["w"], (2, 4), name="toy")
+        params = {"w": np.float32(3.0)}
+        cache.warmup(params, lambda b: jax.ShapeDtypeStruct((b, 2), np.float32))
+        for n in (1, 2, 3, 4):
+            x = np.arange(n * 2, dtype=np.float32).reshape(n, 2)
+            out = np.asarray(cache(params, x))
+            assert out.shape == (n, 2)
+            np.testing.assert_array_equal(out, x * 3.0)
+        assert cache.poll_compiles() == 0  # the AOT invariant
+
+    def test_fallback_over_max_bucket_is_detected(self):
+        cache = BucketedCompileCache(
+            lambda params, x: x * params["w"], (2,), name="toy")
+        params = {"w": np.float32(2.0)}
+        cache.warmup(params, lambda b: jax.ShapeDtypeStruct((b, 2), np.float32))
+        out = np.asarray(cache(params, np.ones((3, 2), np.float32)))
+        assert out.shape == (3, 2)
+        assert cache.poll_compiles() >= 1  # jit dispatch path compiled
+
+
+# ---------------------------------------------------------------------------
+# checkpoint hardening (hot-reload watcher must survive torn state)
+# ---------------------------------------------------------------------------
+class TestCheckpointHardening:
+    def test_latest_step_garbled_manifest_reads_as_absent(self, tmp_path):
+        (tmp_path / "manifest.json").write_bytes(b'{"latest_st')  # torn copy
+        with pytest.warns(UserWarning, match="unreadable checkpoint manifest"):
+            assert ckpt_lib.latest_step(str(tmp_path)) is None
+
+    def test_latest_step_wrong_schema_reads_as_absent(self, tmp_path):
+        (tmp_path / "manifest.json").write_text('{"something": "else"}')
+        with pytest.warns(UserWarning, match="unreadable checkpoint manifest"):
+            assert ckpt_lib.latest_step(str(tmp_path)) is None
+
+    def test_latest_step_artifacts_without_manifest_read_as_absent(self, tmp_path):
+        """A writer that crashed before the final atomic manifest rename
+        leaves artifacts but no manifest: not a finalized checkpoint."""
+        np.savez(tmp_path / "ckpt_7.npz", w=np.zeros(2))
+        assert ckpt_lib.latest_step(str(tmp_path)) is None
+
+    def test_valid_manifest_still_reads(self, tmp_path):
+        ckpt_lib.save(str(tmp_path), 3, {"params": {"w": np.ones(2)}})
+        assert ckpt_lib.latest_step(str(tmp_path)) == 3
+
+    def test_strict_mode_raises_on_garbled_manifest(self, tmp_path):
+        """The trainer's resume path: a garbled manifest must ABORT, not
+        silently restart from step 0 and overwrite the run's progress."""
+        (tmp_path / "manifest.json").write_bytes(b"garbage")
+        with pytest.raises(ValueError, match="refusing to treat"):
+            ckpt_lib.latest_step(str(tmp_path), strict=True)
+        # a genuinely missing manifest is still a legitimate fresh start
+        os.remove(tmp_path / "manifest.json")
+        assert ckpt_lib.latest_step(str(tmp_path), strict=True) is None
+
+    def test_restore_missing_artifact_raises_cleanly(self, tmp_path):
+        ckpt_lib.save(str(tmp_path), 3, {"params": {"w": np.ones(2)}})
+        os.remove(tmp_path / "ckpt_3.npz")
+        with pytest.raises(FileNotFoundError, match="no checkpoint artifact"):
+            ckpt_lib.restore(str(tmp_path), {"params": {"w": np.ones(2)}})
+
+
+# ---------------------------------------------------------------------------
+# serving engine
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def demo_ckpt(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("serve_ckpt"))
+    make_demo_checkpoint(d)
+    return d
+
+
+@pytest.fixture(scope="module")
+def engine(demo_ckpt):
+    """Warmed engine, no threads: tests pump process_once by hand."""
+    eng = ServingEngine(demo_ckpt, buckets=(1, 2, 4), max_wait_ms=0.0,
+                        warmup=True, reload_poll_s=0)
+    yield eng
+    eng.shutdown(drain=False)
+
+
+def _imgs(n, seed=0):
+    c = DEMO_CONFIG
+    return np.random.RandomState(seed).randn(
+        n, c.channels, c.image_size, c.image_size).astype(np.float32)
+
+
+class TestServingEngine:
+    def test_requires_finalized_checkpoint(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="no finalized checkpoint"):
+            ServingEngine(str(tmp_path), warmup=False, reload_poll_s=0)
+
+    def test_embed_bit_identical_to_unpadded_forward(self, engine):
+        """Acceptance: a non-bucket-aligned request count (3 -> bucket 4)
+        returns exactly the unpadded forward's values."""
+        from glom_tpu.models import glom as glom_model
+
+        imgs = _imgs(3)
+        fut = engine.submit("embed", imgs)
+        assert engine.process_once("embed") == 3
+        direct = np.asarray(jax.jit(
+            lambda p, x: glom_model.apply(
+                p, x, config=engine.config, iters=engine.iters).mean(axis=1)
+        )(engine.params["glom"], imgs))
+        got = fut.result(timeout=0)
+        assert got.shape == (3, DEMO_CONFIG.levels, DEMO_CONFIG.dim)
+        np.testing.assert_array_equal(got, direct)
+
+    def test_reconstruct_shape(self, engine):
+        c = DEMO_CONFIG
+        fut = engine.submit("reconstruct", _imgs(2))
+        assert engine.process_once("reconstruct") == 2
+        assert fut.result(timeout=0).shape == (
+            2, c.channels, c.image_size, c.image_size)
+
+    def test_mixed_sizes_zero_recompiles_after_warmup(self, engine):
+        """Acceptance: mixed request sizes never touch the jit dispatch
+        path once every bucket is AOT-warmed."""
+        for n in (1, 2, 3, 4, 1, 3):
+            engine.submit("embed", _imgs(n, seed=n))
+            engine.process_once("embed")
+        for cache in engine.caches.values():
+            assert cache.poll_compiles() == 0
+        assert "serving_xla_compiles" not in engine.registry.snapshot()
+
+    def test_requests_coalesce_into_one_batch(self, engine):
+        f1 = engine.submit("embed", _imgs(2, seed=1))
+        f2 = engine.submit("embed", _imgs(2, seed=2))
+        assert engine.process_once("embed") == 4  # one flush served both
+        assert f1.result(timeout=0).shape[0] == 2
+        assert f2.result(timeout=0).shape[0] == 2
+
+    def test_bf16_checkpoint_serves_float32_requests(self, tmp_path):
+        """Warmup must compile for the float32 images the request path
+        feeds (the model casts to its compute dtype in-graph); a bf16
+        model's executables compiled for bf16 avals would reject every
+        request."""
+        import jax.numpy as jnp
+
+        from glom_tpu.config import GlomConfig
+
+        cfg = GlomConfig(dim=16, levels=3, image_size=16, patch_size=8,
+                         compute_dtype=jnp.bfloat16)
+        d = str(tmp_path)
+        make_demo_checkpoint(d, config=cfg)
+        eng = ServingEngine(d, buckets=(1, 2), max_wait_ms=0.0,
+                            warmup=True, reload_poll_s=0)
+        fut = eng.submit("embed", _imgs(1))
+        assert eng.process_once("embed") == 1
+        assert fut.result(timeout=0).shape == (1, cfg.levels, cfg.dim)
+        assert eng.caches["embed"].poll_compiles() == 0
+
+    def test_drain_completes_pending_work(self, demo_ckpt):
+        eng = ServingEngine(demo_ckpt, buckets=(1, 2, 4), max_wait_ms=1.0,
+                            warmup=True, reload_poll_s=0)
+        eng.start(workers=True, watch=False)
+        futs = [eng.submit("embed", _imgs(1, seed=i)) for i in range(3)]
+        eng.shutdown(drain=True)
+        for f in futs:
+            assert f.result(timeout=0).shape[0] == 1  # resolved before join
+        with pytest.raises(Closed):
+            eng.submit("embed", _imgs(1))
+
+
+class TestHotReload:
+    def _engine(self, ckpt):
+        return ServingEngine(ckpt, buckets=(1,), max_wait_ms=0.0,
+                             warmup=False, reload_poll_s=0)
+
+    def test_swaps_on_newer_checkpoint(self, tmp_path):
+        import optax
+
+        from glom_tpu.training import denoise
+
+        d = str(tmp_path)
+        make_demo_checkpoint(d)
+        eng = self._engine(d)
+        before = np.asarray(
+            jax.tree_util.tree_leaves(eng.params["glom"])[0])
+
+        newer = denoise.init_state(
+            jax.random.PRNGKey(99), DEMO_CONFIG, optax.sgd(0.0))
+        ckpt_lib.save(d, 5, {"params": jax.device_get(newer.params)})
+        assert eng.check_reload() is True
+        assert eng.step == 5
+        after = np.asarray(jax.tree_util.tree_leaves(eng.params["glom"])[0])
+        assert not np.array_equal(before, after)
+        assert eng.registry.snapshot()["serving_param_reloads"] == 1.0
+        # no-op when nothing newer
+        assert eng.check_reload() is False
+
+    def test_skips_torn_manifest_and_keeps_serving(self, tmp_path):
+        d = str(tmp_path)
+        make_demo_checkpoint(d)
+        eng = self._engine(d)
+        (tmp_path / "manifest.json").write_bytes(b"not json at all")
+        with pytest.warns(UserWarning, match="unreadable checkpoint manifest"):
+            assert eng.check_reload() is False
+        assert eng.step == 0  # old params still serving
+
+    def test_survives_manifest_pointing_at_missing_artifact(self, tmp_path):
+        d = str(tmp_path)
+        make_demo_checkpoint(d)
+        eng = self._engine(d)
+        (tmp_path / "manifest.json").write_text(
+            json.dumps({"latest_step": 9, "path": "ckpt_9.npz"}))
+        with pytest.warns(UserWarning, match="hot reload of step 9 failed"):
+            assert eng.check_reload() is False
+        assert eng.step == 0
+
+
+class TestQueueSaturationTrigger:
+    def test_monitor_semantics(self):
+        from glom_tpu.obs.triggers import QueueSaturationMonitor
+
+        mon = QueueSaturationMonitor(threshold=0.9, sustained=3)
+        assert mon.update(10, 10) is None       # 1st saturated obs
+        assert mon.update(9, 10) is None        # 2nd (>= 0.9 * cap)
+        detail = mon.update(8, 10, shed_delta=2)  # shed counts as saturated
+        assert detail is not None
+        assert detail["peak_queue_depth"] == 10.0
+        assert detail["shed_requests"] == 2.0
+        assert mon.update(10, 10) is None       # streak reset after firing
+        assert mon.update(0, 10) is None        # healthy obs resets
+        assert mon.saturation_events == 1
+
+    def test_sustained_overload_dumps_forensics_bundle(self, tmp_path):
+        from glom_tpu.obs.forensics import is_bundle_dir
+
+        ckpt = str(tmp_path / "ckpt")
+        fdir = str(tmp_path / "forensics")
+        make_demo_checkpoint(ckpt)
+        eng = ServingEngine(
+            ckpt, buckets=(1,), max_wait_ms=1e6, max_queue=1,
+            warmup=False, reload_poll_s=0, forensics_dir=fdir,
+            saturation_threshold=0.9, saturation_sustained=2,
+        )
+        eng.submit("embed", _imgs(1))          # queue full: saturated obs 1
+        for _ in range(2):
+            with pytest.raises(Overloaded):
+                eng.submit("embed", _imgs(1))  # shed: obs 2 -> fires
+        bundles = [p for p in os.listdir(fdir)
+                   if is_bundle_dir(os.path.join(fdir, p))]
+        assert len(bundles) == 1 and bundles[0].startswith("queue_saturation-")
+        with open(os.path.join(fdir, bundles[0], "manifest.json")) as f:
+            manifest = json.load(f)
+        assert manifest["trigger"] == "queue_saturation"
+        assert manifest["detail"]["shed_requests"] >= 1
+        snap = eng.registry.snapshot()
+        assert snap["serving_queue_saturation_events"] >= 1
+        assert snap["forensics_captures"] == 1.0
+
+    def test_endpoints_do_not_cross_contaminate_shed_accounting(self, tmp_path):
+        """A shed on one endpoint must not be re-counted as fresh overload
+        by observations on the OTHER endpoint's healthy queue."""
+        ckpt = str(tmp_path / "ckpt")
+        make_demo_checkpoint(ckpt)
+        eng = ServingEngine(
+            ckpt, buckets=(1,), max_wait_ms=1e6, max_queue=4,
+            warmup=False, reload_poll_s=0,
+            saturation_threshold=1.0, saturation_sustained=3,
+        )
+        for _ in range(4):
+            eng.submit("embed", _imgs(1))  # fill embed's queue
+        with pytest.raises(Overloaded):
+            eng.submit("embed", _imgs(1))  # embed: shed, streak 2 of 3
+        # healthy reconstruct traffic: its own monitor must stay clean (no
+        # re-counting of embed's shed as fresh overload), and it must not
+        # advance embed's streak to "sustained"
+        for i in range(4):
+            f = eng.submit("reconstruct", _imgs(1))
+            eng.process_once("reconstruct")
+            f.result(timeout=0)
+        assert "serving_queue_saturation_events" not in eng.registry.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# HTTP front (in-process, ephemeral port)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def served(demo_ckpt):
+    from glom_tpu.serving.server import make_server
+
+    eng = ServingEngine(demo_ckpt, buckets=(1, 2, 4), max_wait_ms=1.0,
+                        warmup=True, reload_poll_s=0)
+    eng.start(workers=True, watch=False)
+    server = make_server(eng)
+    host, port = server.server_address[:2]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://{host}:{port}", eng
+    server.shutdown()
+    eng.shutdown(drain=True)
+    server.server_close()
+
+
+def _get(url, path):
+    with urllib.request.urlopen(url + path, timeout=30) as r:
+        body = r.read()
+        return r.status, body
+
+
+def _post(url, path, payload, timeout=30):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestHTTPServer:
+    def test_healthz(self, served):
+        url, eng = served
+        status, body = _get(url, "/healthz")
+        health = json.loads(body)
+        assert status == 200 and health["status"] == "ok"
+        assert health["warm"] is True
+        assert health["image_size"] == DEMO_CONFIG.image_size
+
+    def test_embed_roundtrip(self, served):
+        url, eng = served
+        status, resp = _post(url, "/embed", {"images": _imgs(2).tolist()})
+        assert status == 200
+        emb = np.asarray(resp["embeddings"])
+        assert emb.shape == (2, DEMO_CONFIG.levels, DEMO_CONFIG.dim)
+        assert resp["step"] == eng.step and resp["latency_ms"] > 0
+
+    def test_embed_single_image_and_level_slice(self, served):
+        url, _ = served
+        status, resp = _post(
+            url, "/embed",
+            {"images": _imgs(1)[0].tolist(), "level": -1})
+        assert status == 200
+        assert np.asarray(resp["embeddings"]).shape == (1, DEMO_CONFIG.dim)
+
+    def test_reconstruct_roundtrip(self, served):
+        url, _ = served
+        status, resp = _post(url, "/reconstruct", {"images": _imgs(2).tolist()})
+        c = DEMO_CONFIG
+        assert status == 200
+        assert np.asarray(resp["images"]).shape == (
+            2, c.channels, c.image_size, c.image_size)
+
+    def test_metrics_exposes_serving_families(self, served):
+        url, _ = served
+        _post(url, "/embed", {"images": _imgs(1).tolist()})
+        status, body = _get(url, "/metrics")
+        text = body.decode()
+        assert status == 200
+        assert "glom_serving_requests_total" in text
+        assert "glom_serving_latency_seconds_embed_count" in text
+        assert "glom_serving_warmup_seconds" in text
+
+    def test_non_numeric_level_is_400(self, served):
+        url, _ = served
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(url, "/embed",
+                  {"images": _imgs(1).tolist(), "level": [0]})
+        assert exc.value.code == 400
+        assert "level" in json.loads(exc.value.read())["error"]
+
+    def test_bad_shape_is_400(self, served):
+        url, _ = served
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(url, "/embed", {"images": [[1.0, 2.0]]})
+        assert exc.value.code == 400
+        assert "error" in json.loads(exc.value.read())
+
+    def test_unknown_route_is_404(self, served):
+        url, _ = served
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(url, "/nope")
+        assert exc.value.code == 404
+
+    def test_overload_is_structured_503(self, served, monkeypatch):
+        url, eng = served
+
+        def _shed(payload, size=1):
+            raise Overloaded("queue at capacity")
+
+        monkeypatch.setattr(eng.batchers["embed"], "submit", _shed)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(url, "/embed", {"images": _imgs(1).tolist()})
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["error"] == "overloaded"
+
+    def test_draining_is_structured_503(self, served, monkeypatch):
+        url, eng = served
+
+        def _closed(payload, size=1):
+            raise Closed("shut down")
+
+        monkeypatch.setattr(eng.batchers["embed"], "submit", _closed)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(url, "/embed", {"images": _imgs(1).tolist()})
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["error"] == "shutting_down"
+
+
+# ---------------------------------------------------------------------------
+# loadgen (tools/loadgen.py)
+# ---------------------------------------------------------------------------
+import urllib.error  # noqa: E402  (used above; explicit for clarity)
+
+TOOLS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+
+
+def _loadgen():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "loadgen", os.path.join(TOOLS, "loadgen.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestLoadgen:
+    def test_percentile_nearest_rank(self):
+        lg = _loadgen()
+        xs = [1.0, 2.0, 3.0, 4.0]
+        assert lg.percentile(xs, 50) == 2.0
+        assert lg.percentile(xs, 99) == 4.0
+        assert lg.percentile([], 50) is None
+
+    def test_smoke_roundtrip(self):
+        """The CI hook: one in-process request through its own server."""
+        lg = _loadgen()
+        assert lg.run_smoke() == 0
+
+    def test_acceptance_mixed_loadgen_zero_recompiles(self, served, capsys):
+        """Acceptance: a closed-loop loadgen run with MIXED batch sizes
+        against the warmed in-process server triggers zero XLA recompiles
+        (jit cache-size recompile monitor) after startup."""
+        url, eng = served
+        lg = _loadgen()
+        rc = lg.main([
+            "--url", url, "--requests", "12", "--concurrency", "3",
+            "--batch-sizes", "1,3,4,2",
+        ])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0 and out["requests_ok"] == 12
+        assert out["requests_error"] == 0
+        assert out["latency_ms"]["p99"] is not None
+        for cache in eng.caches.values():
+            assert cache.poll_compiles() == 0
+        assert "serving_xla_compiles" not in eng.registry.snapshot()
+
+    @pytest.mark.slow
+    def test_soak_closed_loop(self, served, capsys):
+        url, eng = served
+        lg = _loadgen()
+        rc = lg.main([
+            "--url", url, "--requests", "80", "--concurrency", "8",
+            "--batch-sizes", "1,2,3,4",
+        ])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0 and out["requests_error"] == 0
+        assert out["throughput_req_per_s"] > 0
+        for cache in eng.caches.values():
+            assert cache.poll_compiles() == 0

@@ -32,9 +32,22 @@ def test_data_parallel_forward_matches_single_device():
     want = np.asarray(glom_model.apply(params, imgs, config=c, iters=3, return_all=True))
     np.testing.assert_allclose(got, want, atol=1e-5)
 
+    # non-divisible batches pad up to the data-axis multiple and slice the
+    # output back (the serving subsystem feeds arbitrary request sizes) —
+    # per-image results are unchanged by the padding rows
+    got3 = np.asarray(fwd(params, imgs[:3]))
+    assert got3.shape[1] == 3  # return_all: (iters+1, b, n, L, d)
+    np.testing.assert_allclose(got3, want[:, :3], atol=1e-5)
+
+    fwd_final = make_data_parallel_forward(mesh, c, iters=3)
+    got5 = np.asarray(fwd_final(params, imgs[:5]))
+    assert got5.shape[0] == 5
+    want_final = np.asarray(glom_model.apply(params, imgs[:5], config=c, iters=3))
+    np.testing.assert_allclose(got5, want_final, atol=1e-5)
+
     import pytest
-    with pytest.raises(ValueError, match="not divisible"):
-        fwd(params, imgs[:3])
+    with pytest.raises(ValueError, match="empty batch"):
+        fwd(params, imgs[:0])
 
 
 class TestLevelShardedPspecs:

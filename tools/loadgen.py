@@ -1,0 +1,289 @@
+#!/usr/bin/env python
+"""Load generator for the serving subsystem (stdlib HTTP, JSON report).
+
+Two driving modes against a running ``glom_tpu.serving.server``:
+
+  * **closed loop** (default): ``--concurrency`` workers each keep exactly
+    one request in flight — measures the server's sustainable throughput
+    and the latency AT that throughput;
+  * **open loop** (``--rate R``): requests fire on a fixed arrival
+    schedule regardless of completions — measures latency under a target
+    offered load, including the queueing/shedding behavior a closed loop
+    hides (a closed loop slows its offered load down to whatever the
+    server sustains; real traffic doesn't).
+
+Batch sizes cycle through ``--batch-sizes`` so bucket padding and mixed
+shapes are exercised; the image contract (size/channels) is read from
+``/healthz`` so the tool needs no model flags.  The report is one JSON
+object: p50/p95/p99/mean/max latency (ms), throughput (requests and
+images per second), and error/shed counts.
+
+``--smoke`` skips the network entirely: it builds a demo checkpoint in a
+temp dir, starts an in-process server on an ephemeral port, round-trips
+one ``/embed`` request, and exits 0 on success — the CI hook that keeps
+this tool and the server importable and signature-compatible.
+
+Examples::
+
+  python tools/loadgen.py --url http://127.0.0.1:8000 --requests 200 \\
+      --concurrency 8 --batch-sizes 1,3,5
+  python tools/loadgen.py --url http://127.0.0.1:8000 --rate 50 --duration 10
+  python tools/loadgen.py --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+# runnable straight from a checkout, like every tools/ script
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="GLOM serving load generator")
+    p.add_argument("--url", default="http://127.0.0.1:8000")
+    p.add_argument("--endpoint", default="embed",
+                   choices=["embed", "reconstruct"])
+    p.add_argument("--requests", type=int, default=100,
+                   help="closed loop: total requests to send")
+    p.add_argument("--concurrency", type=int, default=4,
+                   help="closed loop: in-flight requests")
+    p.add_argument("--rate", type=float, default=0.0,
+                   help="open loop: requests/sec arrival rate (0 = closed loop)")
+    p.add_argument("--duration", type=float, default=5.0,
+                   help="open loop: seconds to run")
+    p.add_argument("--batch-sizes", default="1,2,3",
+                   help="per-request image counts, cycled")
+    p.add_argument("--timeout", type=float, default=60.0,
+                   help="per-request HTTP timeout (seconds)")
+    p.add_argument("--smoke", action="store_true",
+                   help="in-process one-request round trip; no --url needed")
+    return p.parse_args(argv)
+
+
+def percentile(xs, q):
+    """Nearest-rank percentile (the obs registry's rule)."""
+    if not xs:
+        return None
+    ordered = sorted(xs)
+    rank = min(len(ordered) - 1, max(0, math.ceil(q / 100.0 * len(ordered)) - 1))
+    return ordered[rank]
+
+
+def _fetch_health(url, timeout):
+    with urllib.request.urlopen(f"{url}/healthz", timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _make_payloads(health, batch_sizes):
+    """One JSON-encoded request body per batch size (built once — the
+    loadgen must spend its time in the network path, not json.dumps)."""
+    import numpy as np
+
+    c, s = health["channels"], health["image_size"]
+    rng = np.random.RandomState(0)
+    return {
+        b: json.dumps(
+            {"images": rng.randn(b, c, s, s).astype("float32").tolist()}
+        ).encode()
+        for b in batch_sizes
+    }
+
+
+class _Results:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latencies_ms = []
+        self.images_ok = 0
+        self.ok = 0
+        self.shed = 0
+        self.errors = 0
+
+    def record(self, latency_ms=None, images=0, shed=False, error=False):
+        with self.lock:
+            if shed:
+                self.shed += 1
+            elif error:
+                self.errors += 1
+            else:
+                self.ok += 1
+                self.images_ok += images
+                self.latencies_ms.append(latency_ms)
+
+
+def run_closed(url, endpoint, payloads, batch_sizes, n_requests, concurrency,
+               timeout, results):
+    idx_lock = threading.Lock()
+    counter = [0]
+
+    def worker():
+        while True:
+            with idx_lock:
+                i = counter[0]
+                if i >= n_requests:
+                    return
+                counter[0] += 1
+            b = batch_sizes[i % len(batch_sizes)]
+            t0 = time.monotonic()
+            _send(url, endpoint, payloads[b], b, timeout, results, t0)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.monotonic() - t_start
+
+
+def run_open(url, endpoint, payloads, batch_sizes, rate, duration, timeout,
+             results):
+    """Fixed arrival schedule: request i fires at ``i / rate`` seconds
+    whether or not earlier ones finished (one thread per in-flight
+    request; the OS scheduler is the arrival clock)."""
+    n = max(1, int(rate * duration))
+    threads = []
+    t_start = time.monotonic()
+    for i in range(n):
+        target = t_start + i / rate
+        delay = target - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        b = batch_sizes[i % len(batch_sizes)]
+        t = threading.Thread(
+            target=_send,
+            args=(url, endpoint, payloads[b], b, timeout, results,
+                  time.monotonic()),
+            daemon=True,
+        )
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=timeout)
+    return time.monotonic() - t_start
+
+
+def _send(url, endpoint, body, n_images, timeout, results, t0):
+    req = urllib.request.Request(
+        f"{url}/{endpoint}", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        e.read()
+        results.record(shed=(e.code == 503), error=(e.code != 503))
+        return
+    except Exception:
+        results.record(error=True)
+        return
+    results.record(latency_ms=(time.monotonic() - t0) * 1e3, images=n_images)
+
+
+def report(results, wall_s, mode):
+    lat = results.latencies_ms
+    out = {
+        "mode": mode,
+        "requests_ok": results.ok,
+        "requests_shed": results.shed,
+        "requests_error": results.errors,
+        "images_ok": results.images_ok,
+        "wall_seconds": round(wall_s, 3),
+        "throughput_req_per_s": round(results.ok / wall_s, 2) if wall_s else None,
+        "throughput_imgs_per_s": (
+            round(results.images_ok / wall_s, 2) if wall_s else None
+        ),
+        "latency_ms": {
+            "p50": round(percentile(lat, 50), 3) if lat else None,
+            "p95": round(percentile(lat, 95), 3) if lat else None,
+            "p99": round(percentile(lat, 99), 3) if lat else None,
+            "mean": round(sum(lat) / len(lat), 3) if lat else None,
+            "max": round(max(lat), 3) if lat else None,
+        },
+    }
+    return out
+
+
+def run_smoke() -> int:
+    """In-process round trip: demo checkpoint -> engine -> HTTP server ->
+    one /embed request.  Exit status is the CI signal."""
+    import tempfile
+
+    import numpy as np
+
+    from glom_tpu.serving.engine import ServingEngine, make_demo_checkpoint
+    from glom_tpu.serving.server import make_server
+
+    with tempfile.TemporaryDirectory() as d:
+        make_demo_checkpoint(d)
+        engine = ServingEngine(d, buckets=(1, 2), max_wait_ms=1.0,
+                               warmup=True, reload_poll_s=0)
+        engine.start()
+        server = make_server(engine)
+        host, port = server.server_address[:2]
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            health = _fetch_health(f"http://{host}:{port}", timeout=10)
+            payloads = _make_payloads(health, [1])
+            results = _Results()
+            t0 = time.monotonic()
+            _send(f"http://{host}:{port}", "embed", payloads[1], 1, 30.0,
+                  results, t0)
+            ok = results.ok == 1 and results.errors == 0
+            print(json.dumps({
+                "smoke": "ok" if ok else "FAILED",
+                "health": health,
+                **report(results, time.monotonic() - t0, "smoke"),
+            }, indent=2))
+            if not ok:
+                return 1
+            emb = np.asarray(json.loads(urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://{host}:{port}/embed",
+                    data=payloads[1],
+                    headers={"Content-Type": "application/json"},
+                ), timeout=30,
+            ).read())["embeddings"])
+            assert emb.shape == (1, health["levels"], health["dim"]), emb.shape
+            return 0
+        finally:
+            server.shutdown()
+            engine.shutdown()
+            server.server_close()
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.smoke:
+        return run_smoke()
+
+    batch_sizes = [int(b) for b in args.batch_sizes.split(",")]
+    health = _fetch_health(args.url, args.timeout)
+    payloads = _make_payloads(health, batch_sizes)
+    results = _Results()
+    if args.rate > 0:
+        wall = run_open(args.url, args.endpoint, payloads, batch_sizes,
+                        args.rate, args.duration, args.timeout, results)
+        mode = f"open({args.rate}/s)"
+    else:
+        wall = run_closed(args.url, args.endpoint, payloads, batch_sizes,
+                          args.requests, args.concurrency, args.timeout,
+                          results)
+        mode = f"closed(c={args.concurrency})"
+    print(json.dumps(report(results, wall, mode), indent=2))
+    return 0 if results.errors == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
