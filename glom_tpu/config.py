@@ -204,6 +204,11 @@ class TrainConfig:
     # loop never stalls on checkpoint IO; at most one write in flight
     async_checkpoint: bool = False
     profile_dir: Optional[str] = None    # jax.profiler trace of a 3-step window
+    # end-to-end span tracing (glom_tpu.obs.tracing): the step loop always
+    # records phase spans into a bounded in-memory sink; with trace_dir set
+    # fit() also writes them as a Perfetto-loadable trace-event JSON file
+    # (<trace_dir>/train_trace.json — open in ui.perfetto.dev)
+    trace_dir: Optional[str] = None
     seed: int = 0
     # mesh axes: data-parallel x model(tensor)-parallel x sequence(column)-parallel
     # None => all devices on the data axis (the north-star pure-DP layout)

@@ -26,6 +26,13 @@ report through.  Four pieces, each usable on its own:
   * :mod:`glom_tpu.obs.forensics` — triggered evidence capture: the
     flight-recorder ring, env fingerprint, atomic post-mortem bundles
     (flight recorder + HLO/cost snapshot + optional bounded trace window).
+  * :mod:`glom_tpu.obs.tracing` — end-to-end request/step spans: trace
+    context (W3C traceparent / X-Request-Id), thread-safe bounded sink,
+    span-duration histograms, Perfetto trace-event export, per-trace
+    JSONL feed (``tools/trace_report.py`` reads it).
+  * :mod:`glom_tpu.obs.slo` — declarative SLO targets with multi-window
+    burn-rate evaluation, fired through the trigger engine (``slo_burn``)
+    into forensics bundles naming the offending trace IDs.
 
 ``training/metrics.py``'s ``MetricLogger`` is the facade the Trainer
 logs through; it fans records out to the configured exporters.
@@ -65,6 +72,23 @@ from glom_tpu.obs.triggers import (  # noqa: F401
     QueueSaturationMonitor,
     StepTimeRegressionMonitor,
     TriggerEngine,
+)
+from glom_tpu.obs.tracing import (  # noqa: F401
+    Span,
+    TraceExporter,
+    TraceSink,
+    Tracer,
+    find_root,
+    format_traceparent,
+    parse_traceparent,
+    span_coverage,
+    to_perfetto,
+)
+from glom_tpu.obs.slo import (  # noqa: F401
+    SLO,
+    BurnRateEvaluator,
+    SloManager,
+    parse_slo,
 )
 from glom_tpu.obs.forensics import (  # noqa: F401
     FlightRecorder,

@@ -168,13 +168,20 @@ def registry_families(registry, prefix: str = "glom_"):
             if not hist.count:
                 continue
             base = prom_name(hist.name, prefix)
-            state[base + "_count"] = float(hist.count)
-            state[base + "_sum"] = hist.sum
-            types[base + "_count"] = "counter"
-            types[base + "_sum"] = "counter"
+            # full histogram family: cumulative _bucket{le=...} lines plus
+            # _sum/_count — the shape SLO burn-rate math needs from a
+            # scrape (rate() over bucket counters; a reservoir percentile
+            # cannot be aggregated across scrapes).  TYPE is declared once
+            # on the family name; the renderer groups the samples.
+            types[base] = "histogram"
             if hist.help:
-                help_[base + "_count"] = hist.help
-                help_[base + "_sum"] = hist.help
+                help_[base] = hist.help
+            for bound, cum in zip(hist.bucket_bounds,
+                                  hist.bucket_cumulative()):
+                state[f'{base}_bucket{{le="{_prom_fmt(bound)}"}}'] = float(cum)
+            state[f'{base}_bucket{{le="+Inf"}}'] = float(hist.count)
+            state[base + "_sum"] = hist.sum
+            state[base + "_count"] = float(hist.count)
     return state, types, help_
 
 
@@ -186,13 +193,36 @@ def _prom_fmt(v: float) -> str:
     return repr(v) if v != int(v) else str(int(v))
 
 
+_BUCKET_SAMPLE = re.compile(r'^(.+)_bucket\{le="([^"]+)"\}$')
+
+
+def _family_key(name: str, types: Dict[str, str]):
+    """Map a sample name to ``(family, intra-order, le)``: histogram
+    samples (``_bucket{le=...}``/``_sum``/``_count`` under a declared
+    ``histogram`` family) group under their base name with buckets in
+    ascending ``le``; everything else is its own family."""
+    m = _BUCKET_SAMPLE.match(name)
+    if m and types.get(m.group(1)) == "histogram":
+        le = m.group(2)
+        return m.group(1), 0, math.inf if le == "+Inf" else float(le)
+    for suffix, order in (("_sum", 1), ("_count", 2)):
+        if name.endswith(suffix) and types.get(name[: -len(suffix)]) == "histogram":
+            return name[: -len(suffix)], order, 0.0
+    return name, 0, 0.0
+
+
 def _prom_render(state: Dict[str, float], types: Dict[str, str],
                  help_: Dict[str, str]) -> str:
+    keys = {name: _family_key(name, types) for name in state}
     lines = []
-    for name in sorted(state):
-        if name in help_:
-            lines.append(f"# HELP {name} {help_[name]}")
-        lines.append(f"# TYPE {name} {types.get(name, 'gauge')}")
+    declared = set()
+    for name in sorted(state, key=lambda n: (keys[n][0], keys[n][1], keys[n][2])):
+        family = keys[name][0]
+        if family not in declared:
+            declared.add(family)
+            if family in help_:
+                lines.append(f"# HELP {family} {help_[family]}")
+            lines.append(f"# TYPE {family} {types.get(family, 'gauge')}")
         lines.append(f"{name} {_prom_fmt(state[name])}")
     return "\n".join(lines) + "\n"
 

@@ -215,14 +215,19 @@ class ForensicsManager:
     # -- capture ----------------------------------------------------------
     def capture(self, trigger: str, step: int,
                 detail: Optional[Dict[str, Any]] = None, *,
-                snapshot: bool = True, trace: bool = True) -> Optional[str]:
+                snapshot: bool = True, trace: bool = True,
+                extra_files: Optional[Dict[str, Any]] = None) -> Optional[str]:
         """Write one bundle; returns its path, or None on failure (warned,
         never raised).  ``snapshot=False`` skips the HLO/cost snapshot
         (preemption grace windows cannot afford a possible recompile);
-        ``trace=False`` skips arming the trace window."""
+        ``trace=False`` skips arming the trace window.  ``extra_files``
+        (``{filename: str|bytes|dict}``) lets the trigger site attach its
+        own evidence — e.g. the SLO burn trigger ships the offending
+        traces' spans as ``slo_traces.json``."""
         try:
             return self._capture(trigger, step, detail or {},
-                                 snapshot=snapshot, trace=trace)
+                                 snapshot=snapshot, trace=trace,
+                                 extra_files=extra_files)
         except Exception as e:
             warnings.warn(
                 f"forensics capture {trigger!r} at step {step} failed "
@@ -231,10 +236,13 @@ class ForensicsManager:
             )
             return None
 
-    def _capture(self, trigger, step, detail, *, snapshot, trace):
+    def _capture(self, trigger, step, detail, *, snapshot, trace,
+                 extra_files=None):
         if self._env is None:
             self._env = env_fingerprint(self._mesh)
         files: Dict[str, Any] = {"env.json": self._env}
+        if extra_files:
+            files.update(extra_files)
         if self._config is not None:
             files["config.json"] = self._config
         if self.recorder is not None:

@@ -14,7 +14,9 @@ vocabulary lives here so writers and readers share one definition.
 
 from __future__ import annotations
 
+import bisect
 import math
+import threading
 from typing import Dict, List, Optional
 
 # -- event vocabulary (the `event` field of JSONL records) ----------------
@@ -53,14 +55,27 @@ class Gauge:
         self.value = float(value)
 
 
+# Prometheus export needs FIXED cumulative buckets (reservoir percentiles
+# can't be aggregated across scrapes/instances, and SLO burn-rate math on
+# scraped metrics is rate(_bucket) arithmetic).  One wide log-spaced
+# ladder serves both unit regimes this registry holds — seconds (phase
+# times, 1e-5 s stop-poll .. multi-second checkpoints) and milliseconds
+# (span durations): 1-2.5-5 decades across 1e-4 .. 1e4.
+DEFAULT_BUCKETS = tuple(
+    m * (10.0 ** e) for e in range(-4, 4) for m in (1.0, 2.5, 5.0)
+) + (1e4,)
+
+
 class Histogram:
-    """Streaming distribution: count/sum/min/max plus a bounded reservoir
-    of recent observations for percentile queries.  No buckets to
-    preconfigure — phase times span 1e-5 s (stop poll) to seconds
-    (checkpoint write), so fixed buckets would mis-bin one end."""
+    """Streaming distribution: count/sum/min/max, a bounded reservoir of
+    recent observations for percentile queries, and exact cumulative
+    counts over a fixed bucket ladder (``DEFAULT_BUCKETS``) for the
+    Prometheus ``_bucket{le=...}`` exposition — the reservoir answers
+    "what is p95 right now", the buckets let a scraper do rate() math
+    over time."""
 
     def __init__(self, name: str, help: str = "", unit: str = "",
-                 reservoir: int = 512):
+                 reservoir: int = 512, buckets=DEFAULT_BUCKETS):
         self.name, self.help, self.unit = name, help, unit
         self.count = 0
         self.sum = 0.0
@@ -68,6 +83,9 @@ class Histogram:
         self.max = -math.inf
         self._reservoir: List[float] = []
         self._cap = reservoir
+        self.bucket_bounds = tuple(sorted(float(b) for b in buckets))
+        # per-bin counts (NOT cumulative; exporters cumsum at render time)
+        self._bucket_counts = [0] * len(self.bucket_bounds)
 
     def observe(self, value: float) -> None:
         value = float(value)
@@ -75,6 +93,10 @@ class Histogram:
         self.sum += value
         self.min = min(self.min, value)
         self.max = max(self.max, value)
+        i = bisect.bisect_left(self.bucket_bounds, value)
+        if i < len(self._bucket_counts):
+            self._bucket_counts[i] += 1
+        # values past the last bound live only in the implicit +Inf bucket
         if len(self._reservoir) < self._cap:
             self._reservoir.append(value)
         else:
@@ -82,6 +104,15 @@ class Histogram:
             # reservoir always reflects a recent window (no RNG in the
             # logging path)
             self._reservoir[self.count % self._cap] = value
+
+    def bucket_cumulative(self) -> List[int]:
+        """Cumulative count at each bound (the ``le`` semantics); the
+        implicit ``+Inf`` bucket is ``self.count``."""
+        out, total = [], 0
+        for c in self._bucket_counts:
+            total += c
+            out.append(total)
+        return out
 
     def percentile(self, q: float) -> Optional[float]:
         """Nearest-rank percentile over the reservoir, ``q`` in [0, 100]."""
@@ -122,16 +153,25 @@ class Timer:
 class MetricRegistry:
     """Namespace of typed metrics.  ``counter``/``gauge``/``histogram``/
     ``timer`` get-or-create by name; re-registering a name as a different
-    type is an error (it would silently fork the metric)."""
+    type is an error (it would silently fork the metric).
+
+    Creation and iteration are locked: the serving path lazily creates
+    metrics on request threads (first 4xx reply, first execution of a
+    bucket) while ``/metrics`` scrapes iterate — an unlocked dict there
+    dies with "dictionary changed size during iteration" mid-scrape.
+    Individual metric updates stay unlocked (GIL-atomic enough for
+    telemetry; a lock per ``observe`` would tax the hot path)."""
 
     def __init__(self):
         self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
 
     def _get(self, name: str, cls, **kwargs):
-        m = self._metrics.get(name)
-        if m is None:
-            m = cls(name, **kwargs)
-            self._metrics[name] = m
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, **kwargs)
+                self._metrics[name] = m
         # a Timer aliases its Histogram: histogram() on a timer-registered
         # name returns the underlying hist, not the Timer wrapper
         expected = m.hist if isinstance(m, Timer) and cls is Histogram else m
@@ -155,10 +195,12 @@ class MetricRegistry:
         return self._get(name, Timer, help=help, clock=clock)
 
     def __iter__(self):
-        return iter(self._metrics.values())
+        with self._lock:  # snapshot copy: scrapes race lazy creation
+            return iter(list(self._metrics.values()))
 
     def __contains__(self, name: str) -> bool:
-        return name in self._metrics
+        with self._lock:
+            return name in self._metrics
 
     def snapshot(self) -> Dict[str, float]:
         """Flatten to ``{name[_suffix]: scalar}`` — counters/gauges by name,
@@ -166,7 +208,9 @@ class MetricRegistry:
         and empty histograms are omitted (exporting a None would force every
         sink to special-case it)."""
         out: Dict[str, float] = {}
-        for m in self._metrics.values():
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
             if isinstance(m, Timer):
                 m = m.hist
             if isinstance(m, Counter):

@@ -54,13 +54,23 @@ class PhaseTimer:
     per-step time without re-deriving the cadence.
     """
 
-    def __init__(self, clock=None, registry=None):
+    def __init__(self, clock=None, registry=None, tracer=None):
         self._clock = clock or time.monotonic
         self._registry = registry
         self._totals: Dict[str, float] = {}
         self._steps = 0
         self._window_t0 = self._clock()
         self._open: Optional[str] = None
+        # -- optional tracing (glom_tpu.obs.tracing): each logging window
+        # is one trace (root span `train_window`), each phase() interval a
+        # child span — the trainer's analogue of the serving request
+        # trace, same span format, same Perfetto export path
+        self._tracer = tracer
+        self._window_index = 0
+        self._window_span = None
+        if tracer is not None:
+            self._window_span = tracer.start_trace(
+                "train_window", attrs={"window": 0})
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -74,7 +84,11 @@ class PhaseTimer:
         try:
             yield
         finally:
-            self.add(name, self._clock() - t0)
+            t1 = self._clock()
+            self.add(name, t1 - t0)
+            if self._tracer is not None and self._window_span is not None:
+                self._tracer.record(name, self._window_span, t0, t1,
+                                    observe=False)
             self._open = None
 
     def add(self, name: str, seconds: float) -> None:
@@ -108,6 +122,22 @@ class PhaseTimer:
                 help="wall-clock window time per step (all phases)",
             ).observe(dt / self._steps)
         self._totals = {}
-        self._steps = 0
         self._window_t0 = now
+        if self._tracer is not None and self._window_span is not None:
+            self._tracer.end(self._window_span,
+                             attrs={"steps": self._steps})
+            self._window_index += 1
+            self._window_span = self._tracer.start_trace(
+                "train_window", attrs={"window": self._window_index})
+        self._steps = 0
         return out
+
+    def close(self) -> None:
+        """End the open window span without rotating (the loop's exit
+        path): the TAIL window past the last log boundary — or the whole
+        run when it never reached one — must still export with a closed
+        root, or its phase spans render parentless and coverage math has
+        no basis.  Idempotent; phases after close are not traced."""
+        if self._tracer is not None and self._window_span is not None:
+            self._tracer.end(self._window_span, attrs={"steps": self._steps})
+            self._window_span = None

@@ -27,6 +27,8 @@ TRIGGER_GRAD_SPIKE = "grad_spike"
 TRIGGER_STEP_TIME = "step_time_regression"
 # serving-side: sustained request-queue overload (glom_tpu.serving)
 TRIGGER_QUEUE_SATURATION = "queue_saturation"
+# serving-side: multi-window SLO burn-rate breach (glom_tpu.obs.slo)
+TRIGGER_SLO_BURN = "slo_burn"
 # terminal paths write bundles DIRECTLY (no debounce/budget — they fire at
 # most once per run by construction); named here so readers share the names
 TRIGGER_CRASH = "crash"

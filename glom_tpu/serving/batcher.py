@@ -49,6 +49,10 @@ class _Item:
     size: int
     enqueued_at: float
     future: Future = field(default_factory=Future)
+    # -- tracing (glom_tpu.obs.tracing) --
+    ctx: Any = None          # the request's span context (root span)
+    queue_span: Any = None   # open queue_wait span, closed at batch take
+    batch_span: Any = None   # the batch-level span this item flushed into
 
 
 class BatcherStats:
@@ -71,7 +75,7 @@ class DynamicBatcher:
     rejected at submit (ValueError — caller bug, not load)."""
 
     def __init__(self, *, max_batch: int = 8, max_wait_ms: float = 5.0,
-                 max_queue: int = 64, clock=None):
+                 max_queue: int = 64, clock=None, tracer=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_wait_ms < 0:
@@ -85,6 +89,7 @@ class DynamicBatcher:
         self.max_wait_s = max_wait_ms / 1000.0
         self.max_queue = max_queue
         self._clock = clock if clock is not None else time.monotonic
+        self._tracer = tracer
         self._cond = threading.Condition()
         self._queue: deque = deque()
         self._queued = 0          # images currently queued
@@ -99,10 +104,12 @@ class DynamicBatcher:
         with self._cond:
             return self._queued
 
-    def submit(self, payload: Any, size: int = 1) -> Future:
+    def submit(self, payload: Any, size: int = 1, *, ctx=None) -> Future:
         """Enqueue ``payload`` (``size`` images); returns the Future the
         worker resolves.  Raises :class:`Overloaded` at capacity (shed) or
-        :class:`Closed` after shutdown began."""
+        :class:`Closed` after shutdown began.  ``ctx`` (a span context
+        from :mod:`glom_tpu.obs.tracing`) opens a ``queue_wait`` span
+        under the request's trace, closed when the batch is taken."""
         if size < 1:
             raise ValueError(f"size must be >= 1, got {size}")
         if size > self.max_batch:
@@ -120,7 +127,13 @@ class DynamicBatcher:
                     f"images); request shed"
                 )
             item = _Item(payload=payload, size=size,
-                         enqueued_at=self._clock())
+                         enqueued_at=self._clock(), ctx=ctx)
+            if self._tracer is not None and ctx is not None:
+                from glom_tpu.obs.tracing import SPAN_QUEUE_WAIT
+
+                item.queue_span = self._tracer.start_span(
+                    SPAN_QUEUE_WAIT, ctx, attrs={"images": size},
+                )
             self._queue.append(item)
             self._queued += size
             self.stats.submitted += 1
@@ -154,6 +167,25 @@ class DynamicBatcher:
         counter = {"full": "flush_full", "deadline": "flush_deadline",
                    "drain": "flush_drain"}[reason]
         setattr(self.stats, counter, getattr(self.stats, counter) + 1)
+        if self._tracer is not None and any(
+            it.queue_span is not None for it in batch
+        ):
+            from glom_tpu.obs.tracing import SPAN_BATCH
+
+            # one batch-level span (its own trace) LINKS the member
+            # request spans — a multi-parent span doesn't exist, links do
+            batch_span = self._tracer.start_trace(SPAN_BATCH, attrs={
+                "flush_reason": reason,
+                "items": len(batch),
+                "images": total,
+                "links": [f"{it.ctx.trace_id}:{it.ctx.span_id}"
+                          for it in batch if it.ctx is not None],
+            })
+            for it in batch:
+                it.batch_span = batch_span
+                if it.queue_span is not None:
+                    self._tracer.end(it.queue_span,
+                                     attrs={"flush_reason": reason})
         return batch
 
     def next_batch(self, *, block: bool = True,

@@ -117,20 +117,70 @@ class BucketedCompileCache:
         self.warmed = True
 
     # -- request path ------------------------------------------------------
-    def __call__(self, params, imgs: np.ndarray):
+    def __call__(self, params, imgs: np.ndarray, *, tracer=None,
+                 contexts: Sequence = ()):
         """Pad ``imgs`` to its bucket, run, slice the batch axis back.
 
         A batch over the largest bucket falls back to the jit dispatch path
         (correct, but it may compile — the monitor and the engine's
         ``serving_xla_compiles`` counter record it).  Engines prevent this
-        by capping the batcher's ``max_batch`` at the largest bucket."""
+        by capping the batcher's ``max_batch`` at the largest bucket.
+
+        With a ``tracer``, records ``bucket_select`` / ``pad`` /
+        ``execute`` spans — annotated with the bucket shape and padding
+        waste — under every span context in ``contexts`` (the batch-level
+        span first, then each member request: one physical operation
+        fans into every trace that paid for it; only the first context
+        feeds the duration histograms).  Tracing makes ``execute`` block
+        until the device result is ready — the span must hold device
+        time, not dispatch time; the untraced path keeps async dispatch."""
         b = imgs.shape[0]
         bucket = self.pick(b)
-        if bucket is None or bucket not in self._compiled:
-            out = self._jit_fn(params, imgs)
+        if tracer is None or not contexts:
+            if bucket is None or bucket not in self._compiled:
+                out = self._jit_fn(params, imgs)
+            else:
+                out = self._compiled[bucket](params, pad_to_bucket(imgs, bucket))
+            return out[:b] if out.shape[0] != b else out
+
+        clock = tracer.clock
+        t0 = clock()          # bucket already picked above: charge ~0
+        aot = bucket is not None and bucket in self._compiled
+        if aot:
+            padded = pad_to_bucket(imgs, bucket)
+            t_pad = clock()
+            out = self._compiled[bucket](params, padded)
         else:
-            out = self._compiled[bucket](params, pad_to_bucket(imgs, bucket))
-        return out[:b] if out.shape[0] != b else out
+            t_pad = t0
+            out = self._jit_fn(params, imgs)
+        # slice INSIDE the execute span: the batch-axis slice is a jax op
+        # (it pays a one-off compile per new output shape) and the span
+        # must hold everything between padded input and usable result
+        if out.shape[0] != b:
+            out = out[:b]
+        jax.block_until_ready(out)
+        t_done = clock()
+        # a jit-dispatch fallback has NO bucket: labeling it with the raw
+        # batch size would mint one serving_execute_ms_b<n> metric per
+        # distinct fallback size (unbounded cardinality) and fake rows in
+        # the per-bucket padding-waste table
+        attrs = {"images": b, "aot": aot, "endpoint": self.name}
+        if aot:
+            attrs["bucket"] = bucket
+            attrs["padding_waste"] = round((bucket - b) / bucket, 4)
+        from glom_tpu.obs.tracing import SPAN_BUCKET_SELECT, SPAN_EXECUTE, SPAN_PAD
+
+        for i, ctx in enumerate(contexts):
+            observe = i == 0
+            tracer.record(SPAN_BUCKET_SELECT, ctx, t0, t0,
+                          attrs={"bucket": bucket if aot else None,
+                                 "aot": aot},
+                          observe=observe)
+            tracer.record(SPAN_PAD, ctx, t0, t_pad, attrs=dict(attrs),
+                          observe=observe)
+            tracer.record(SPAN_EXECUTE, ctx, t_pad, t_done, attrs=dict(attrs),
+                          observe=observe)
+        return out
 
     def poll_compiles(self) -> int:
         """New jit-dispatch compiles since the last poll — nonzero after

@@ -32,6 +32,15 @@ counters — all visible through the server's ``/metrics`` endpoint.  A
 overload and, gated by the shared
 :class:`~glom_tpu.obs.triggers.TriggerEngine`, dumps a forensics bundle
 exactly like the trainer's anomaly path.
+
+Every request is traced end-to-end (:mod:`glom_tpu.obs.tracing`): the
+server mints the request span, the batcher/executor record queue-wait,
+assembly, pad, and execute spans under it, ``reload_swap`` spans time the
+hot-reload path, and ``trace_log`` emits one JSONL record per completed
+trace.  Declarative SLOs (``slos``; :mod:`glom_tpu.obs.slo`) evaluate
+request outcomes with multi-window burn-rate math and fire the
+``slo_burn`` trigger into a forensics bundle naming the offending trace
+IDs.
 """
 
 from __future__ import annotations
@@ -51,6 +60,13 @@ from glom_tpu.models import glom as glom_model
 from glom_tpu.models.heads import decoder_apply
 from glom_tpu.obs import MetricRegistry
 from glom_tpu.obs.forensics import ForensicsManager
+from glom_tpu.obs.slo import SLO, SloManager, parse_slo
+from glom_tpu.obs.tracing import (
+    SPAN_BATCH_ASSEMBLY,
+    SPAN_RELOAD,
+    TraceSink,
+    Tracer,
+)
 from glom_tpu.obs.triggers import (
     TRIGGER_QUEUE_SATURATION,
     QueueSaturationMonitor,
@@ -155,10 +171,29 @@ class ServingEngine:
         saturation_debounce: int = 200,
         max_captures: int = 3,
         clock=None,
+        trace_log: Optional[str] = None,
+        trace_max_traces: int = 256,
+        slos: Optional[Sequence] = None,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.registry = registry if registry is not None else MetricRegistry()
         self._clock = clock if clock is not None else time.monotonic
+
+        # -- end-to-end tracing (glom_tpu.obs.tracing) ---------------------
+        # Always on: spans are host-side dict bookkeeping in a bounded
+        # sink.  With trace_log set, every completed request trace is also
+        # emitted as one JSONL record (tools/trace_report.py reads it).
+        trace_exporter = None
+        if trace_log:
+            from glom_tpu.obs.exporters import JsonlExporter
+
+            trace_exporter = JsonlExporter(path=trace_log)
+        self.tracer = Tracer(
+            clock=self._clock,
+            sink=TraceSink(max_traces=trace_max_traces),
+            registry=self.registry,
+            exporter=trace_exporter,
+        )
         self._reload_poll_s = reload_poll_s
         self._warmup_dir = warmup_dir
 
@@ -193,7 +228,7 @@ class ServingEngine:
         self.batchers: Dict[str, DynamicBatcher] = {
             ep: DynamicBatcher(
                 max_batch=max_bucket, max_wait_ms=max_wait_ms,
-                max_queue=max_queue, clock=self._clock,
+                max_queue=max_queue, clock=self._clock, tracer=self.tracer,
             )
             for ep in ENDPOINTS
         }
@@ -224,6 +259,30 @@ class ServingEngine:
                         "glom": self.config.to_json_dict()},
                 snapshot_fn=lambda: self.caches["embed"].snapshots.get(max_bucket),
                 registry=self.registry,
+            )
+
+        # -- SLO burn-rate alerting (glom_tpu.obs.slo) ---------------------
+        # Declarative targets ("embed:p95<250ms", "errors<1%" or SLO
+        # objects); burn fires the shared TriggerEngine's slo_burn trigger
+        # into a forensics bundle naming the offending trace IDs.
+        self._slo: Optional[SloManager] = None
+        self._slo_lock = threading.Lock()
+        if slos:
+            parsed = [s if isinstance(s, SLO) else parse_slo(s) for s in slos]
+            for s in parsed:
+                # fail loud at startup: a typoed endpoint would be
+                # accepted and then silently never evaluate — the worst
+                # failure mode for an alerting layer
+                if s.endpoint is not None and s.endpoint not in ENDPOINTS:
+                    raise ValueError(
+                        f"SLO {s.name!r} names unknown endpoint "
+                        f"{s.endpoint!r}; valid endpoints: {ENDPOINTS}"
+                    )
+            self._slo = SloManager(
+                parsed,
+                clock=self._clock, registry=self.registry,
+                triggers=self._triggers, forensics=self._forensics,
+                tracer=self.tracer,
             )
 
         self._lock = threading.Lock()  # params swap + counters + saturation
@@ -320,6 +379,10 @@ class ServingEngine:
         for t in self._threads:
             t.join(timeout=max(0.0, deadline - time.monotonic()))
         self._threads = []
+        if self.tracer.exporter is not None:
+            # deterministic trace-log lifecycle (a later emit reopens in
+            # append mode, matching the MetricLogger contract)
+            self.tracer.exporter.close()
 
     # -- hot reload --------------------------------------------------------
     def check_reload(self) -> bool:
@@ -335,6 +398,10 @@ class ServingEngine:
             return False
         if newest is None or newest <= self.step:
             return False
+        reload_span = self.tracer.start_trace(
+            SPAN_RELOAD, attrs={"from_step": int(self.step),
+                                "to_step": int(newest)},
+        )
         try:
             _, trees = ckpt_lib.restore(
                 self.checkpoint_dir, {"params": self._template}, step=newest,
@@ -344,6 +411,7 @@ class ServingEngine:
             # request after it pay the H2D transfer
             jax.block_until_ready(jax.tree_util.tree_leaves(new_params)[0])
         except Exception as e:
+            self.tracer.end(reload_span, attrs={"error": repr(e)})
             warnings.warn(
                 f"hot reload of step {newest} failed ({type(e).__name__}: "
                 f"{e}); continuing to serve step {self.step}",
@@ -353,6 +421,7 @@ class ServingEngine:
         with self._lock:
             self._params = new_params
             self.step = newest
+        self.tracer.end(reload_span)
         self.registry.counter(
             "serving_param_reloads", help="successful checkpoint hot reloads",
         ).inc()
@@ -366,15 +435,17 @@ class ServingEngine:
             self.check_reload()
 
     # -- request path ------------------------------------------------------
-    def submit(self, endpoint: str, imgs: np.ndarray):
+    def submit(self, endpoint: str, imgs: np.ndarray, *, ctx=None):
         """Enqueue a ``(k, c, H, W)`` batch for ``endpoint``; returns the
         Future resolving to the endpoint's output for those ``k`` images.
         Raises :class:`Overloaded` (shed) or :class:`Closed` (shutting
-        down) — the server maps both to structured 503s."""
+        down) — the server maps both to structured 503s.  ``ctx`` (the
+        request's root span) threads the trace through the batcher and
+        executor."""
         batcher = self.batchers[endpoint]
         try:
             future = batcher.submit(np.ascontiguousarray(imgs, dtype=np.float32),
-                                    size=imgs.shape[0])
+                                    size=imgs.shape[0], ctx=ctx)
         except Overloaded:
             self.registry.counter(
                 "serving_shed_total", help="requests shed at queue capacity",
@@ -396,21 +467,42 @@ class ServingEngine:
         cache = self.caches[endpoint]
         params = self.params  # snapshot: in-flight work finishes on these
         arrays = [item.payload for item in batch]
+        # span contexts this batch reports under: the batch-level span
+        # (created at take, carries the links) first — it feeds the
+        # duration histograms — then each member request's root span (the
+        # same physical pad/execute mirrored into every trace that paid
+        # for it)
+        batch_span = batch[0].batch_span
+        member_ctxs = [it.ctx for it in batch if it.ctx is not None]
+        contexts = ([batch_span] if batch_span is not None else []) + member_ctxs
+        t_asm0 = self.tracer.clock()
         imgs = arrays[0] if len(arrays) == 1 else np.concatenate(arrays)
         n = imgs.shape[0]
+        if contexts:
+            t_asm1 = self.tracer.clock()
+            for i, ctx in enumerate(contexts):
+                self.tracer.record(
+                    SPAN_BATCH_ASSEMBLY, ctx, t_asm0, t_asm1,
+                    attrs={"items": len(batch), "images": n}, observe=i == 0,
+                )
         t0 = time.monotonic()
         try:
-            out = np.asarray(cache(params, imgs))
+            out = np.asarray(cache(params, imgs, tracer=self.tracer,
+                                   contexts=contexts))
         except Exception as e:
             for item in batch:
                 if not item.future.done():
                     item.future.set_exception(e)
+            if batch_span is not None:
+                self.tracer.end(batch_span, attrs={"error": repr(e)})
             return 0
         batch_s = time.monotonic() - t0
         offset = 0
         for item in batch:
             item.future.set_result(out[offset:offset + item.size])
             offset += item.size
+        if batch_span is not None:
+            self.tracer.end(batch_span)
         self._account_batch(endpoint, cache, n, batch_s)
         return n
 
@@ -476,6 +568,23 @@ class ServingEngine:
                         self._triggers.refund(TRIGGER_QUEUE_SATURATION, count)
         self.registry.gauge("serving_queue_depth", help="queued images"
                             ).set(batcher.depth)
+
+    def observe_outcome(self, endpoint: str, latency_ms: Optional[float],
+                        error: bool, trace_id: Optional[str] = None) -> None:
+        """One request's terminal outcome, fed to the SLO burn-rate
+        evaluators (the server calls this for successes AND errors —
+        sheds burn the error budget too).  No-op without configured SLOs.
+        Serialized under its OWN lock (the evaluators and the trigger
+        engine's budget arithmetic are read-modify-write, and handler
+        threads race through here), NOT the engine lock: a burn capture's
+        bundle write must never stall the batch worker's accounting or
+        the hot-reload param swap.  ``request_count`` is read unlocked —
+        the debounce step only needs to be roughly current."""
+        if self._slo is None:
+            return
+        with self._slo_lock:
+            self._slo.observe(endpoint, latency_ms, error,
+                              trace_id=trace_id, step=self.request_count)
 
     # -- health ------------------------------------------------------------
     def health(self) -> dict:

@@ -21,11 +21,20 @@ micro-batched worker.  Overload surfaces as a structured 503
 (``{"error": "overloaded"}``) from the batcher's admission control, and
 SIGTERM drains in-flight work before exit, mirroring the trainer's
 preemption path.
+
+Every inference request gets an end-to-end trace
+(:mod:`glom_tpu.obs.tracing`): an inbound ``X-Request-Id`` or W3C
+``traceparent`` joins the client's trace, a fresh id is minted otherwise,
+and the identity is echoed back on every reply (``X-Request-Id`` +
+``traceparent`` headers, ``request_id`` in the body).  Error replies
+count into ``serving_errors_<class>xx``; request outcomes feed the
+engine's SLO burn-rate evaluators (``--slo``).
 """
 
 from __future__ import annotations
 
 import json
+import re
 import signal
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -34,10 +43,20 @@ from typing import Optional
 import numpy as np
 
 from glom_tpu.obs.exporters import prometheus_lines
+from glom_tpu.obs.tracing import (
+    SPAN_DISPATCH_WAIT,
+    SPAN_PARSE,
+    SPAN_REQUEST,
+    SPAN_RESPOND,
+    format_traceparent,
+    parse_traceparent,
+    request_trace_id,
+)
 from glom_tpu.serving.batcher import Closed, Overloaded
 from glom_tpu.serving.engine import ServingEngine
 
 _MAX_BODY = 256 * 1024 * 1024  # refuse absurd payloads before np.asarray
+_HEX_ID = re.compile(r"[0-9a-f]{1,32}")
 
 
 class ServingHTTPServer(ThreadingHTTPServer):
@@ -60,11 +79,30 @@ class _Handler(BaseHTTPRequestHandler):
             super().log_message(fmt, *args)
 
     def _reply(self, code: int, payload, content_type="application/json") -> None:
+        if code >= 400:
+            # status-class error accounting: the SLO error-rate objective
+            # (and any dashboard) needs a real input, including sheds
+            self.server.engine.registry.counter(
+                f"serving_errors_{code // 100}xx",
+                help=f"requests answered with a {code // 100}xx status",
+            ).inc()
         body = (json.dumps(payload) if isinstance(payload, (dict, list))
                 else payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        # every reply after trace minting echoes the request's identity so
+        # a client (or proxy log) can join its traces to ours
+        rid = getattr(self, "_request_id", None)
+        if rid is not None:
+            self.send_header("X-Request-Id", rid)
+            tid = self._trace_root.trace_id
+            # traceparent requires canonical lowercase hex (int(x, 16)
+            # would also accept '-1f'/'0x2a'/'1_2' and emit a malformed
+            # header); arbitrary X-Request-Ids still echo above
+            if _HEX_ID.fullmatch(tid):
+                self.send_header("traceparent", format_traceparent(
+                    tid, self._trace_root.span_id))
         self.end_headers()
         self.wfile.write(body)
 
@@ -99,6 +137,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- routes ------------------------------------------------------------
     def do_GET(self):  # noqa: N802 (http.server contract)
+        # keep-alive reuses the handler across requests on one connection:
+        # a GET must not echo the PREVIOUS request's trace identity
+        self._request_id = None
         engine = self.server.engine
         if self.path == "/healthz":
             self._reply(200, engine.health())
@@ -109,36 +150,74 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": f"no route {self.path}"})
 
     def do_POST(self):  # noqa: N802
+        self._request_id = None  # reset before routing (keep-alive reuse)
         if self.path not in ("/embed", "/reconstruct"):
             self._reply(404, {"error": f"no route {self.path}"})
             return
         endpoint = self.path[1:]
-        payload = self._read_json()
-        if payload is None:
-            return
-        imgs = self._parse_images(payload)
-        if imgs is None:
-            return
         engine = self.server.engine
+        tracer = engine.tracer
+
+        # -- trace context: join the client's trace or mint a fresh one.
+        # X-Request-Id wins (operators grep their own ids); a W3C
+        # traceparent supplies trace + remote parent; either way the
+        # identity is echoed back on EVERY reply (see _reply).
+        rid_header = request_trace_id(self.headers.get("X-Request-Id"))
+        remote = parse_traceparent(self.headers.get("traceparent"))
+        root = tracer.start_trace(
+            SPAN_REQUEST,
+            trace_id=rid_header or (remote[0] if remote else None),
+            parent_id=remote[1] if remote else None,
+            attrs={"endpoint": endpoint},
+        )
+        self._trace_root = root
+        self._request_id = rid_header or root.trace_id
+
+        def _finish(status: int, latency_ms=None, at=None):
+            tracer.end(root, attrs={"status": status}, at=at)
+            engine.observe_outcome(endpoint, latency_ms, status >= 500,
+                                   trace_id=root.trace_id)
+
+        # The handler's own phases — parse / dispatch_wait / respond — are
+        # recorded with SHARED edges (explicit timestamps) so they TILE
+        # the request span: no instrumentation gap between them, and the
+        # trace explains the whole handler wall time.  dispatch_wait
+        # (parked on the result future) deliberately OVERLAPS the
+        # pipeline's queue_wait/execute spans; union-based coverage
+        # dedupes the overlap, and it holds the scheduling gaps (worker
+        # wake, future wake) no pipeline stage can see.
+        payload = self._read_json()
+        imgs = self._parse_images(payload) if payload is not None else None
+        t_parsed = tracer.clock()
+        tracer.record(SPAN_PARSE, root, root.start, t_parsed)
+        if imgs is None:
+            _finish(400)
+            return
         import time as _time
 
         t0 = _time.monotonic()
         try:
-            future = engine.submit(endpoint, imgs)
+            future = engine.submit(endpoint, imgs, ctx=root)
             out = future.result(timeout=60.0)
-        except Overloaded:
-            self._reply(503, {"error": "overloaded",
-                              "detail": "queue at capacity; retry with backoff"})
-            return
-        except Closed:
-            self._reply(503, {"error": "shutting_down",
-                              "detail": "server is draining; retry elsewhere"})
-            return
+        except Overloaded as e:
+            error, code, body = e, 503, {
+                "error": "overloaded",
+                "detail": "queue at capacity; retry with backoff"}
+        except Closed as e:
+            error, code, body = e, 503, {
+                "error": "shutting_down",
+                "detail": "server is draining; retry elsewhere"}
         except ValueError as e:  # e.g. request larger than max_batch
-            self._reply(400, {"error": str(e)})
-            return
+            error, code, body = e, 400, {"error": str(e)}
         except Exception as e:
-            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            error, code, body = e, 500, {"error": f"{type(e).__name__}: {e}"}
+        else:
+            error = None
+        t_done = tracer.clock()
+        tracer.record(SPAN_DISPATCH_WAIT, root, t_parsed, t_done)
+        if error is not None:
+            self._reply(code, body)
+            _finish(code)
             return
         latency = _time.monotonic() - t0
         engine.registry.histogram(
@@ -147,7 +226,8 @@ class _Handler(BaseHTTPRequestHandler):
         ).observe(latency)
 
         resp = {"step": int(engine.step),
-                "latency_ms": round(latency * 1e3, 3)}
+                "latency_ms": round(latency * 1e3, 3),
+                "request_id": self._request_id}
         if endpoint == "embed":
             level = payload.get("level")
             if level is not None:
@@ -158,11 +238,19 @@ class _Handler(BaseHTTPRequestHandler):
                         f"level {level!r} outside this model's "
                         f"{engine.config.levels} levels"
                     )})
+                    t_end = tracer.clock()
+                    tracer.record(SPAN_RESPOND, root, t_done, t_end)
+                    _finish(400, at=t_end)
                     return
             resp["embeddings"] = out.tolist()
         else:
             resp["images"] = out.tolist()
         self._reply(200, resp)
+        # root end SHARES the respond span's end edge: a preemption
+        # between two separate clock reads would leak uncovered wall time
+        t_end = tracer.clock()
+        tracer.record(SPAN_RESPOND, root, t_done, t_end)
+        _finish(200, latency_ms=latency * 1e3, at=t_end)
 
 
 def make_server(engine: ServingEngine, host: str = "127.0.0.1",
@@ -199,7 +287,14 @@ def main(argv=None) -> int:
     p.add_argument("--warmup-dir", default=None,
                    help="write per-bucket HLO/cost snapshots here at warmup")
     p.add_argument("--forensics-dir", default=None,
-                   help="bundle root for queue_saturation captures")
+                   help="bundle root for queue_saturation/slo_burn captures")
+    p.add_argument("--trace-log", default=None,
+                   help="JSONL file receiving one record per completed "
+                        "request trace (tools/trace_report.py reads it)")
+    p.add_argument("--slo", action="append", default=None, metavar="SPEC",
+                   help="declarative SLO target, repeatable: 'embed:p95<250ms' "
+                        "(latency) or 'errors<1%%' (error rate); burn fires "
+                        "the slo_burn forensics trigger")
     p.add_argument("--demo", action="store_true",
                    help="write a tiny demo checkpoint into --checkpoint-dir "
                         "if it has none (smoke runs)")
@@ -231,6 +326,8 @@ def main(argv=None) -> int:
         warmup=not args.no_warmup,
         warmup_dir=args.warmup_dir,
         forensics_dir=args.forensics_dir,
+        trace_log=args.trace_log,
+        slos=args.slo,
     )
     engine.start()
     server = make_server(engine, args.host, args.port, quiet=not args.verbose)

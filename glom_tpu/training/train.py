@@ -117,6 +117,9 @@ def parse_args(argv=None):
                         "thread (host snapshot stays synchronous)")
     p.add_argument("--profile-dir", default=None,
                    help="emit a jax.profiler trace of a 3-step window here")
+    p.add_argument("--trace-dir", default=None,
+                   help="write the step loop's phase spans as a Perfetto-"
+                        "loadable trace-event JSON here at fit() end")
     p.add_argument("--log-file", default=None)
     # observability (glom_tpu.obs)
     p.add_argument("--metrics-csv", default=None,
@@ -222,6 +225,7 @@ def main(argv=None):
         checkpoint_backend=args.checkpoint_backend,
         async_checkpoint=args.async_checkpoint,
         profile_dir=args.profile_dir,
+        trace_dir=args.trace_dir,
         monitor_numerics=not args.no_monitor_numerics,
         grad_spike_factor=args.grad_spike_factor,
         diag_every=args.diag_every,

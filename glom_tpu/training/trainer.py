@@ -294,6 +294,20 @@ class Trainer:
         self._mem_mon = MemoryMonitor()
         self._num_mon = NumericsMonitor(spike_factor=train.grad_spike_factor)
 
+        # -- step-scoped span tracing (glom_tpu.obs.tracing) --
+        # The PhaseTimer records each phase interval as a span under a
+        # per-window `train_window` trace — the same span format the
+        # serving path emits, so one Perfetto viewer (and one
+        # tools/trace_report.py) reads both.  Host-side dicts in a bounded
+        # sink; no device syncs.
+        from glom_tpu.obs import TraceSink, Tracer
+
+        # one window trace holds ~9 phase spans per step for log_every
+        # steps; the default 512-span cap would silently truncate windows
+        # past ~60 steps
+        self.tracer = Tracer(registry=self.registry, sink=TraceSink(
+            max_spans=max(512, 12 * (train.log_every or 1) + 16)))
+
         # -- anomaly-triggered forensics (glom_tpu.obs.forensics) --
         # The flight recorder tees every logged record into a bounded ring
         # (host-side dict copies at the LOGGING cadence — no per-step
@@ -853,7 +867,7 @@ class Trainer:
         window_imgs = 0
         window_metrics = []   # per-step device-scalar dicts; fetched ONCE
                               # at the log boundary (no per-step host sync)
-        timer = PhaseTimer(registry=self.registry)
+        timer = PhaseTimer(registry=self.registry, tracer=self.tracer)
         emitted_recompiles = self._recompile_mon.recompiles
         start_step = int(jax.device_get(self.state.step))
         profiling = False
@@ -1020,4 +1034,20 @@ class Trainer:
                 data_state=batches.state_dict() if stateful_stream else None,
             )
         self.finish_saves()  # fit returns only once the checkpoint is durable
+        timer.close()  # the tail window's root span must close before export
+        if cfg.trace_dir and jax.process_index() == 0:
+            # Perfetto-loadable export of the run's phase spans (best
+            # effort — an unwritable dir must not fail a finished fit)
+            import os
+
+            from glom_tpu.obs import TraceExporter
+
+            try:
+                os.makedirs(cfg.trace_dir, exist_ok=True)
+                TraceExporter(self.tracer.sink).write(
+                    os.path.join(cfg.trace_dir, "train_trace.json"))
+            except OSError as e:
+                import warnings
+
+                warnings.warn(f"trace export failed ({e})", stacklevel=2)
         return last_metrics

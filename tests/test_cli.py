@@ -47,6 +47,8 @@ def test_every_train_config_field_has_a_cli_path():
         "forensics_dir", "forensics_ring", "forensics_max_captures",
         "forensics_debounce_steps", "forensics_trace_steps",
         "forensics_hlo", "forensics_step_time_factor",
+        # tracing (--trace-dir)
+        "trace_dir",
     }
     # fields intentionally config-only (documented, no flag yet)
     config_only = {"loss_level", "mesh_axes", "donate"}

@@ -276,13 +276,16 @@ class TestExporters:
         text = path.read_text()
         assert text.endswith("\n")
         sample_re = re.compile(
-            r"^[a-zA-Z_:][a-zA-Z0-9_:]* (?:NaN|[+-]Inf|-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)$"
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{le="[^"]+"\})? '
+            r"(?:NaN|[+-]Inf|-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)$"
         )
         meta_re = re.compile(r"^# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+$")
         for line in text.strip().splitlines():
             assert sample_re.match(line) or meta_re.match(line), line
         assert "glom_imgs_total 64" in text
         assert "# TYPE glom_imgs_total counter" in text
+        assert "# TYPE glom_step_time histogram" in text
+        assert 'glom_step_time_bucket{le="+Inf"} 1' in text
         assert "glom_event_recompile_total 1" in text
         assert "glom_loss 0.25" in text
 

@@ -541,11 +541,13 @@ class TestHTTPServer:
         assert "level" in json.loads(exc.value.read())["error"]
 
     def test_bad_shape_is_400(self, served):
-        url, _ = served
+        url, eng = served
+        before = eng.registry.snapshot().get("serving_errors_4xx", 0.0)
         with pytest.raises(urllib.error.HTTPError) as exc:
             _post(url, "/embed", {"images": [[1.0, 2.0]]})
         assert exc.value.code == 400
         assert "error" in json.loads(exc.value.read())
+        assert eng.registry.snapshot()["serving_errors_4xx"] == before + 1
 
     def test_unknown_route_is_404(self, served):
         url, _ = served
@@ -556,19 +558,23 @@ class TestHTTPServer:
     def test_overload_is_structured_503(self, served, monkeypatch):
         url, eng = served
 
-        def _shed(payload, size=1):
+        def _shed(payload, size=1, ctx=None):
             raise Overloaded("queue at capacity")
 
         monkeypatch.setattr(eng.batchers["embed"], "submit", _shed)
+        errors_before = eng.registry.snapshot().get("serving_errors_5xx", 0.0)
         with pytest.raises(urllib.error.HTTPError) as exc:
             _post(url, "/embed", {"images": _imgs(1).tolist()})
         assert exc.value.code == 503
         assert json.loads(exc.value.read())["error"] == "overloaded"
+        # regression: a shed request must land in the status-class error
+        # counter (the SLO error-rate objective's input)
+        assert eng.registry.snapshot()["serving_errors_5xx"] == errors_before + 1
 
     def test_draining_is_structured_503(self, served, monkeypatch):
         url, eng = served
 
-        def _closed(payload, size=1):
+        def _closed(payload, size=1, ctx=None):
             raise Closed("shut down")
 
         monkeypatch.setattr(eng.batchers["embed"], "submit", _closed)

@@ -1,0 +1,922 @@
+"""End-to-end tracing + SLO burn-rate tests (glom_tpu/obs/tracing.py,
+glom_tpu/obs/slo.py, the serving propagation path, tools/trace_report.py).
+
+Tier-1 (CPU): span lifecycle and burn-rate math run against injectable
+fake clocks (no real sleeps); trace-id propagation is exercised through an
+in-process server -> batcher -> engine round trip on an ephemeral port;
+the Perfetto export is validated as trace-event JSON; the golden trace
+fixture keeps tools/trace_report.py honest as span fields evolve.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from glom_tpu.obs.registry import MetricRegistry
+from glom_tpu.obs.tracing import (
+    SPAN_EXECUTE,
+    SPAN_QUEUE_WAIT,
+    TraceExporter,
+    TraceSink,
+    Tracer,
+    format_traceparent,
+    parse_traceparent,
+    request_trace_id,
+    span_coverage,
+    to_perfetto,
+)
+
+TOOLS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, seconds):
+        self.t += seconds
+
+
+# ---------------------------------------------------------------------------
+# span lifecycle / context / sink
+# ---------------------------------------------------------------------------
+class TestSpanLifecycle:
+    def test_parent_child_nesting_with_fake_clock(self):
+        clock = FakeClock()
+        tracer = Tracer(clock=clock)
+        root = tracer.start_trace("request", attrs={"endpoint": "embed"})
+        clock.advance(0.001)
+        child = tracer.start_span("queue_wait", root)
+        clock.advance(0.004)
+        tracer.end(child)
+        grandchild = tracer.start_span("execute", child)
+        clock.advance(0.010)
+        tracer.end(grandchild)
+        tracer.end(root)
+
+        assert child.trace_id == root.trace_id == grandchild.trace_id
+        assert child.parent_id == root.span_id
+        assert grandchild.parent_id == child.span_id
+        assert root.parent_id is None
+        assert child.duration_ms == pytest.approx(4.0)
+        assert grandchild.duration_ms == pytest.approx(10.0)
+        assert root.duration_ms == pytest.approx(15.0)
+        assert len(tracer.sink.trace(root.trace_id)) == 3
+
+    def test_end_is_idempotent_and_merges_attrs(self):
+        clock = FakeClock()
+        tracer = Tracer(clock=clock)
+        span = tracer.start_trace("request")
+        clock.advance(0.002)
+        tracer.end(span, attrs={"status": 200})
+        first_end = span.end
+        clock.advance(1.0)
+        tracer.end(span)  # double end keeps the first edge
+        assert span.end == first_end
+        assert span.attrs["status"] == 200
+
+    def test_record_explicit_timestamps(self):
+        tracer = Tracer(clock=FakeClock())
+        root = tracer.start_trace("request")
+        span = tracer.record("execute", root, 10.0, 10.5,
+                             attrs={"bucket": 4})
+        assert span.duration_ms == pytest.approx(500.0)
+        assert span.parent_id == root.span_id
+
+    def test_span_context_manager(self):
+        clock = FakeClock()
+        tracer = Tracer(clock=clock)
+        root = tracer.start_trace("request")
+        with tracer.span("parse", root) as s:
+            clock.advance(0.003)
+        assert s.end is not None and s.duration_ms == pytest.approx(3.0)
+
+    def test_sink_evicts_oldest_trace_whole(self):
+        sink = TraceSink(max_traces=2)
+        tracer = Tracer(clock=FakeClock(), sink=sink)
+        spans = [tracer.start_trace("request", trace_id=f"t{i}")
+                 for i in range(3)]
+        assert sink.trace("t0") == []  # evicted whole
+        assert len(sink.trace("t1")) == 1 and len(sink.trace("t2")) == 1
+        assert sink.evicted_traces == 1
+        assert spans[0].trace_id == "t0"
+
+    def test_evicted_trace_does_not_regrow_from_late_spans(self):
+        """A slow in-flight request whose trace was evicted must not
+        re-enter the sink as only its tail — that partial trace would
+        report a fake critical path."""
+        sink = TraceSink(max_traces=2)
+        tracer = Tracer(clock=FakeClock(), sink=sink)
+        slow_root = tracer.start_trace("request", trace_id="slow")
+        tracer.start_trace("request", trace_id="t1")
+        tracer.start_trace("request", trace_id="t2")  # evicts "slow" whole
+        assert sink.trace("slow") == []
+        tracer.start_span("execute", slow_root)  # late pipeline span
+        tracer.end(slow_root)
+        assert sink.trace("slow") == []  # dropped, not regrown
+        assert sink.dropped_spans == 1
+
+    def test_sink_caps_spans_per_trace(self):
+        sink = TraceSink(max_traces=4, max_spans=3)
+        tracer = Tracer(clock=FakeClock(), sink=sink)
+        root = tracer.start_trace("request")
+        for _ in range(5):
+            tracer.start_span("x", root)
+        assert len(sink.trace(root.trace_id)) == 3
+        assert sink.dropped_spans == 3
+
+    def test_span_histograms_feed_registry(self):
+        clock = FakeClock()
+        reg = MetricRegistry()
+        tracer = Tracer(clock=clock, registry=reg)
+        root = tracer.start_trace("request")
+        q = tracer.start_span(SPAN_QUEUE_WAIT, root)
+        clock.advance(0.005)
+        tracer.end(q)
+        tracer.record(SPAN_EXECUTE, root, clock.t, clock.t + 0.020,
+                      attrs={"bucket": 4})
+        snap = reg.snapshot()
+        assert snap["serving_queue_wait_ms_p50"] == pytest.approx(5.0)
+        assert snap["serving_execute_ms_p50"] == pytest.approx(20.0)
+        # per-bucket labels ride a name suffix (the registry is flat)
+        assert snap["serving_execute_ms_b4_count"] == 1.0
+
+    def test_mirrored_record_observe_false_feeds_no_histogram(self):
+        reg = MetricRegistry()
+        tracer = Tracer(clock=FakeClock(), registry=reg)
+        root = tracer.start_trace("request")
+        tracer.record(SPAN_EXECUTE, root, 0.0, 1.0, observe=False)
+        assert "serving_execute_ms_count" not in reg.snapshot()
+
+
+class TestContextPropagationHelpers:
+    def test_traceparent_round_trip(self):
+        hdr = format_traceparent("ab" * 16, "cd" * 8)
+        parsed = parse_traceparent(hdr)
+        assert parsed == ("ab" * 16, "cd" * 8)
+
+    def test_traceparent_pads_short_hex_ids(self):
+        hdr = format_traceparent("deadbeefdeadbeef", "cafe")
+        trace_id, parent = parse_traceparent(hdr)
+        assert trace_id.endswith("deadbeefdeadbeef") and len(trace_id) == 32
+        assert parent == "000000000000cafe"
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage", "00-zz-cc-01", "00-" + "0" * 32 + "-" + "0" * 16 + "-01",
+        "00-" + "a" * 31 + "-" + "b" * 16 + "-01",
+    ])
+    def test_traceparent_malformed_is_none(self, bad):
+        assert parse_traceparent(bad) is None
+
+    def test_request_id_sanitization(self):
+        assert request_trace_id("my-req-42") == "my-req-42"
+        assert request_trace_id("  padded  ") == "padded"
+        assert request_trace_id(None) is None
+        assert request_trace_id("") is None
+        assert request_trace_id("x" * 200) is None
+        assert request_trace_id("evil\nheader") is None
+        # printable but non-ASCII: http.server encodes response headers
+        # latin-1 strict — echoing this back would crash the reply
+        assert request_trace_id("sn☃w") is None
+
+
+class TestPerfettoExport:
+    def _spans(self):
+        clock = FakeClock()
+        tracer = Tracer(clock=clock)
+        root = tracer.start_trace("request", trace_id="tr1")
+        clock.advance(0.002)
+        child = tracer.start_span("execute", root, attrs={"bucket": 2})
+        clock.advance(0.003)
+        tracer.end(child)
+        tracer.end(root)
+        open_span = tracer.start_span("dangling", root)  # never ended
+        return tracer.sink.all_spans(), open_span
+
+    def test_valid_trace_event_json(self, tmp_path):
+        spans, open_span = self._spans()
+        path = str(tmp_path / "trace.json")
+        TraceExporter().write(path, spans)
+        with open(path) as f:
+            doc = json.load(f)  # must be valid JSON at all
+        events = doc["traceEvents"]
+        assert isinstance(events, list) and events
+        complete = [e for e in events if e["ph"] == "X"]
+        assert len(complete) == 2  # the open span is skipped
+        for e in complete:
+            assert set(e) >= {"name", "ph", "ts", "dur", "pid", "tid", "args"}
+            assert e["dur"] >= 0 and isinstance(e["ts"], float)
+        # microsecond units: the 3 ms execute span
+        ex = next(e for e in complete if e["name"] == "execute")
+        assert ex["dur"] == pytest.approx(3000.0)
+        assert ex["args"]["bucket"] == 2
+
+    def test_exporter_defaults_to_sink(self, tmp_path):
+        clock = FakeClock()
+        sink = TraceSink()
+        tracer = Tracer(clock=clock, sink=sink)
+        span = tracer.start_trace("request")
+        clock.advance(0.001)
+        tracer.end(span)
+        path = TraceExporter(sink).write(str(tmp_path / "t.json"))
+        assert json.load(open(path))["traceEvents"]
+
+
+class TestSpanCoverage:
+    def test_full_coverage(self):
+        spans = [
+            {"name": "request", "parent_id": None, "start": 0.0, "end": 1.0},
+            {"name": "a", "parent_id": "r", "start": 0.0, "end": 0.6},
+            {"name": "b", "parent_id": "r", "start": 0.4, "end": 1.0},
+        ]
+        assert span_coverage(spans) == pytest.approx(1.0)
+
+    def test_gap_reduces_coverage(self):
+        spans = [
+            {"name": "request", "parent_id": None, "start": 0.0, "end": 1.0},
+            {"name": "a", "parent_id": "r", "start": 0.0, "end": 0.25},
+            {"name": "b", "parent_id": "r", "start": 0.75, "end": 1.0},
+        ]
+        assert span_coverage(spans) == pytest.approx(0.5)
+
+    def test_no_closed_root_is_none(self):
+        # the only root candidate is still OPEN: no basis for coverage
+        assert span_coverage([{"name": "x", "span_id": "s",
+                               "parent_id": None,
+                               "start": 0.0, "end": None}]) is None
+        assert span_coverage([]) is None
+
+    def test_remote_parented_root_still_found(self):
+        """A root joined from a W3C traceparent carries the REMOTE span as
+        parent_id — root detection must not conflate root-ness with
+        parent_id None."""
+        spans = [
+            {"name": "request", "span_id": "s1", "parent_id": "remote",
+             "root_span": True, "start": 0.0, "end": 1.0},
+            {"name": "a", "span_id": "s2", "parent_id": "s1",
+             "start": 0.0, "end": 1.0},
+        ]
+        assert span_coverage(spans) == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# propagation: batcher -> engine (direct), then the full HTTP round trip
+# ---------------------------------------------------------------------------
+class TestBatcherSpans:
+    def test_queue_wait_and_batch_link_spans(self):
+        from glom_tpu.serving.batcher import DynamicBatcher
+
+        clock = FakeClock()
+        tracer = Tracer(clock=clock)
+        b = DynamicBatcher(max_batch=2, max_wait_ms=5.0, max_queue=8,
+                           clock=clock, tracer=tracer)
+        r1 = tracer.start_trace("request", trace_id="req-1")
+        r2 = tracer.start_trace("request", trace_id="req-2")
+        b.submit("x", ctx=r1)
+        clock.advance(0.003)
+        b.submit("y", ctx=r2)
+        batch = b.next_batch(block=False)  # size rule: 2 images
+        assert len(batch) == 2
+
+        q1 = next(s for s in tracer.sink.trace("req-1")
+                  if s.name == "queue_wait")
+        assert q1.end is not None
+        assert q1.duration_ms == pytest.approx(3.0)
+        assert q1.attrs["flush_reason"] == "full"
+        assert q1.parent_id == r1.span_id
+
+        batch_span = batch[0].batch_span
+        assert batch_span is not None and batch_span.parent_id is None
+        assert batch_span.trace_id not in ("req-1", "req-2")
+        assert set(batch_span.attrs["links"]) == {
+            f"req-1:{r1.span_id}", f"req-2:{r2.span_id}"}
+
+    def test_untraced_submit_still_works(self):
+        from glom_tpu.serving.batcher import DynamicBatcher
+
+        b = DynamicBatcher(max_batch=1, max_wait_ms=0.0, max_queue=4,
+                           clock=FakeClock())
+        b.submit("x")
+        batch = b.next_batch(block=False)
+        assert batch[0].queue_span is None and batch[0].batch_span is None
+
+
+@pytest.fixture(scope="module")
+def demo_ckpt(tmp_path_factory):
+    from glom_tpu.serving.engine import make_demo_checkpoint
+
+    d = str(tmp_path_factory.mktemp("trace_ckpt"))
+    make_demo_checkpoint(d)
+    return d
+
+
+@pytest.fixture(scope="module")
+def served(demo_ckpt, tmp_path_factory):
+    from glom_tpu.serving.engine import ServingEngine
+    from glom_tpu.serving.server import make_server
+
+    trace_log = str(tmp_path_factory.mktemp("trace_log") / "traces.jsonl")
+    eng = ServingEngine(demo_ckpt, buckets=(1, 2, 4), max_wait_ms=1.0,
+                        warmup=True, reload_poll_s=0, trace_log=trace_log)
+    eng.start(workers=True, watch=False)
+    server = make_server(eng)
+    host, port = server.server_address[:2]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://{host}:{port}", eng, trace_log
+    server.shutdown()
+    eng.shutdown(drain=True)
+    server.server_close()
+
+
+def _imgs(n, seed=0):
+    from glom_tpu.serving.engine import DEMO_CONFIG as c
+
+    return np.random.RandomState(seed).randn(
+        n, c.channels, c.image_size, c.image_size).astype(np.float32)
+
+
+def _post(url, path, payload, headers=None):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, dict(r.headers), json.loads(r.read())
+
+
+def _wait_trace(eng, trace_id, timeout=5.0):
+    """The server closes the root span AFTER writing the reply; poll for
+    the closed root instead of racing the handler thread."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        spans = eng.tracer.sink.trace(trace_id)
+        root = next((s for s in spans if s.root), None)
+        if root is not None and root.end is not None:
+            return spans
+        time.sleep(0.01)
+    return eng.tracer.sink.trace(trace_id)
+
+
+class TestHTTPTracePropagation:
+    def test_request_id_round_trips_and_keys_the_trace(self, served):
+        url, eng, _ = served
+        status, headers, resp = _post(
+            url, "/embed", {"images": _imgs(1).tolist()},
+            headers={"X-Request-Id": "cust-42"})
+        assert status == 200
+        assert headers["X-Request-Id"] == "cust-42"
+        assert resp["request_id"] == "cust-42"
+
+        spans = _wait_trace(eng, "cust-42")
+        names = {s.name for s in spans}
+        assert {"request", "parse", "queue_wait", "batch_assembly", "pad",
+                "execute", "respond"} <= names
+        root = next(s for s in spans if s.parent_id is None)
+        assert root.name == "request" and root.attrs["status"] == 200
+        for s in spans:
+            assert s.trace_id == "cust-42"
+            if s is not root:
+                assert s.parent_id == root.span_id
+        ex = next(s for s in spans if s.name == "execute")
+        assert ex.attrs["bucket"] == 1 and ex.attrs["padding_waste"] == 0.0
+
+    def test_spans_cover_request_wall(self, served):
+        """Acceptance: one request's trace explains >= 95% of its request
+        span's wall time (queue_wait + batch_assembly + pad + execute +
+        respond + parse)."""
+        url, eng, _ = served
+        _post(url, "/embed", {"images": _imgs(3).tolist()},
+              headers={"X-Request-Id": "cov-1"})
+        spans = [s.to_dict() for s in _wait_trace(eng, "cov-1")]
+        assert span_coverage(spans) >= 0.95
+
+    def test_traceparent_joins_remote_trace(self, served):
+        url, eng, trace_log = served
+        tp = f"00-{'ab' * 16}-{'cd' * 8}-01"
+        status, headers, resp = _post(
+            url, "/embed", {"images": _imgs(1).tolist()},
+            headers={"traceparent": tp})
+        assert status == 200
+        assert resp["request_id"] == "ab" * 16
+        root = next(s for s in _wait_trace(eng, "ab" * 16)
+                    if s.name == "request")
+        assert root.parent_id == "cd" * 8  # chained under the remote span
+        assert root.root  # remote parent does NOT unmake the local root
+        assert headers["traceparent"].split("-")[1] == "ab" * 16
+        # the joined trace still reaches the JSONL feed (root detection
+        # must not conflate root-ness with parent_id None) with a
+        # computable coverage
+        with open(trace_log) as f:
+            recs = [json.loads(line) for line in f if line.strip()]
+        mine = [r for r in recs if r["trace_id"] == "ab" * 16]
+        assert len(mine) == 1 and mine[0]["root"] == "request"
+        assert span_coverage(mine[0]["spans"]) is not None
+
+    def test_non_hex_request_id_echoes_without_traceparent(self, served):
+        url, _, _ = served
+        status, headers, resp = _post(
+            url, "/embed", {"images": _imgs(1).tolist()},
+            headers={"X-Request-Id": "0x2a"})  # int(x,16)-parseable, not hex
+        assert status == 200
+        assert headers["X-Request-Id"] == "0x2a"
+        assert "traceparent" not in headers  # never emit a malformed header
+
+    def test_fresh_trace_minted_without_headers(self, served):
+        url, eng, _ = served
+        status, headers, resp = _post(url, "/embed",
+                                      {"images": _imgs(1).tolist()})
+        assert status == 200
+        rid = resp["request_id"]
+        assert headers["X-Request-Id"] == rid
+        assert _wait_trace(eng, rid)
+
+    def test_padding_waste_annotated_on_non_aligned_batch(self, served):
+        url, eng, _ = served
+        _post(url, "/embed", {"images": _imgs(3).tolist()},
+              headers={"X-Request-Id": "pad-3"})
+        ex = next(s for s in _wait_trace(eng, "pad-3")
+                  if s.name == "execute")
+        assert ex.attrs["bucket"] == 4 and ex.attrs["images"] == 3
+        assert ex.attrs["padding_waste"] == pytest.approx(0.25)
+
+    def test_trace_log_jsonl_feed(self, served):
+        url, eng, trace_log = served
+        _post(url, "/embed", {"images": _imgs(1).tolist()},
+              headers={"X-Request-Id": "feed-1"})
+        with open(trace_log) as f:
+            recs = [json.loads(line) for line in f if line.strip()]
+        mine = [r for r in recs if r["trace_id"] == "feed-1"]
+        assert len(mine) == 1
+        assert mine[0]["root"] == "request"
+        assert mine[0]["duration_ms"] > 0
+        assert {s["name"] for s in mine[0]["spans"]} >= {
+            "request", "queue_wait", "execute"}
+
+    def test_trace_report_reads_the_live_feed(self, served, capsys):
+        url, _, trace_log = served
+        _post(url, "/embed", {"images": _imgs(2).tolist()},
+              headers={"X-Request-Id": "rep-1"})
+        rc = _trace_report_main([trace_log, "--format", "json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["requests"] >= 1
+        assert any(r["span"] == "execute" for r in out["spans"])
+        rc = _trace_report_main([trace_log, "--trace", "rep-1"])
+        assert rc == 0
+        assert "rep-1" in capsys.readouterr().out
+
+    def test_metrics_expose_span_histograms(self, served):
+        url, _, _ = served
+        _post(url, "/embed", {"images": _imgs(1).tolist()})
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+            text = r.read().decode()
+        assert "glom_serving_queue_wait_ms_count" in text
+        assert "glom_serving_execute_ms_count" in text
+        assert 'glom_serving_execute_ms_bucket{le="' in text  # histogram family
+
+    def test_engine_reload_swap_span(self, demo_ckpt, tmp_path):
+        import jax
+        import optax
+
+        from glom_tpu import checkpoint as ckpt_lib
+        from glom_tpu.serving.engine import (
+            DEMO_CONFIG, ServingEngine, make_demo_checkpoint,
+        )
+        from glom_tpu.training import denoise
+
+        d = str(tmp_path)
+        make_demo_checkpoint(d)
+        eng = ServingEngine(d, buckets=(1,), max_wait_ms=0.0,
+                            warmup=False, reload_poll_s=0)
+        newer = denoise.init_state(
+            jax.random.PRNGKey(7), DEMO_CONFIG, optax.sgd(0.0))
+        ckpt_lib.save(d, 5, {"params": jax.device_get(newer.params)})
+        assert eng.check_reload() is True
+        reloads = [s for s in eng.tracer.sink.all_spans()
+                   if s.name == "reload_swap"]
+        assert len(reloads) == 1
+        assert reloads[0].end is not None
+        assert reloads[0].attrs == {"from_step": 0, "to_step": 5}
+        snap = eng.registry.snapshot()
+        assert snap["serving_reload_swap_ms_count"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# PhaseTimer -> train-window spans (trainer and serving share one format)
+# ---------------------------------------------------------------------------
+class TestTrainWindowSpans:
+    def test_phase_spans_under_window_trace(self):
+        from glom_tpu.obs.timing import PhaseTimer
+
+        clock = FakeClock()
+        tracer = Tracer(clock=clock)
+        pt = PhaseTimer(clock=clock, tracer=tracer)
+        with pt.phase("data_wait"):
+            clock.advance(0.002)
+        with pt.phase("step"):
+            clock.advance(0.010)
+        pt.count_step()
+        pt.window()
+
+        windows = [s for s in tracer.sink.all_spans()
+                   if s.name == "train_window"]
+        assert len(windows) == 2  # closed window 0 + freshly opened window 1
+        closed = next(w for w in windows if w.end is not None)
+        assert closed.attrs == {"window": 0, "steps": 1}
+        phases = tracer.sink.trace(closed.trace_id)
+        names = {s.name for s in phases}
+        assert {"train_window", "data_wait", "step"} <= names
+        step = next(s for s in phases if s.name == "step")
+        assert step.duration_ms == pytest.approx(10.0)
+        assert step.parent_id == closed.span_id
+
+    def test_close_ends_the_tail_window(self):
+        """The window past the last log boundary (or a run that never
+        reached one) must still export with a CLOSED root span."""
+        from glom_tpu.obs.timing import PhaseTimer
+
+        clock = FakeClock()
+        tracer = Tracer(clock=clock)
+        pt = PhaseTimer(clock=clock, tracer=tracer)
+        with pt.phase("step"):
+            clock.advance(0.010)
+        pt.count_step()
+        pt.close()
+        pt.close()  # idempotent
+        windows = [s for s in tracer.sink.all_spans()
+                   if s.name == "train_window"]
+        assert len(windows) == 1
+        assert windows[0].end is not None
+        assert windows[0].attrs["steps"] == 1
+        with pt.phase("data_wait"):  # phases after close are not traced
+            clock.advance(0.001)
+        assert len(tracer.sink.trace(windows[0].trace_id)) == 2
+
+
+class TestTrainerTraceExport:
+    def test_fit_writes_perfetto_train_trace(self, tmp_path):
+        from glom_tpu.config import GlomConfig, TrainConfig
+        from glom_tpu.training.data import synthetic_batches
+        from glom_tpu.training.metrics import MetricLogger
+        from glom_tpu.training.trainer import Trainer
+
+        tiny = GlomConfig(dim=16, levels=3, image_size=16, patch_size=4)
+        cfg = TrainConfig(batch_size=8, iters=2, steps=2, log_every=1,
+                          trace_dir=str(tmp_path / "tr"))
+        logger = MetricLogger(stream=open(os.devnull, "w"))
+        trainer = Trainer(tiny, cfg, logger=logger)
+        trainer.fit(synthetic_batches(8, tiny.image_size, seed=0))
+        with open(tmp_path / "tr" / "train_trace.json") as f:
+            doc = json.load(f)
+        names = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+        assert "train_window" in names  # window roots
+        assert "step" in names and "data_wait" in names  # phase spans
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate evaluation
+# ---------------------------------------------------------------------------
+class TestSloParsing:
+    def test_parse_latency(self):
+        from glom_tpu.obs.slo import parse_slo
+
+        slo = parse_slo("embed:p95<250ms")
+        assert slo.kind == "latency" and slo.endpoint == "embed"
+        assert slo.objective == pytest.approx(0.95)
+        assert slo.threshold_ms == 250.0
+
+    def test_parse_error_rate(self):
+        from glom_tpu.obs.slo import parse_slo
+
+        slo = parse_slo("errors<1%")
+        assert slo.kind == "error_rate" and slo.endpoint is None
+        assert slo.objective == pytest.approx(0.99)
+
+    @pytest.mark.parametrize("bad", ["", "p95>250ms", "embed:p95<250",
+                                     "errors<200%", "nonsense"])
+    def test_parse_rejects_garbage(self, bad):
+        from glom_tpu.obs.slo import parse_slo
+
+        with pytest.raises(ValueError):
+            parse_slo(bad)
+
+    def test_slo_validation(self):
+        from glom_tpu.obs.slo import SLO
+
+        with pytest.raises(ValueError, match="kind"):
+            SLO(name="x", kind="wat", objective=0.9)
+        with pytest.raises(ValueError, match="threshold_ms"):
+            SLO(name="x", kind="latency", objective=0.9)
+        with pytest.raises(ValueError, match="objective"):
+            SLO(name="x", kind="error_rate", objective=1.5)
+
+
+class TestBurnRateEvaluator:
+    def _slo(self, **kw):
+        from glom_tpu.obs.slo import SLO
+
+        kw.setdefault("name", "p95")
+        kw.setdefault("kind", "latency")
+        kw.setdefault("objective", 0.95)
+        kw.setdefault("threshold_ms", 100.0)
+        kw.setdefault("short_window_s", 10.0)
+        kw.setdefault("long_window_s", 30.0)
+        kw.setdefault("burn_threshold", 2.0)
+        kw.setdefault("min_events", 5)
+        return SLO(**kw)
+
+    def test_quiet_until_min_events(self):
+        from glom_tpu.obs.slo import BurnRateEvaluator
+
+        clock = FakeClock()
+        ev = BurnRateEvaluator(self._slo(), clock=clock)
+        for _ in range(4):
+            ev.observe(bad=True)
+            clock.advance(0.1)
+        assert ev.evaluate() is None  # 4 < min_events
+
+    def test_healthy_traffic_never_fires(self):
+        from glom_tpu.obs.slo import BurnRateEvaluator
+
+        clock = FakeClock()
+        ev = BurnRateEvaluator(self._slo(), clock=clock)
+        for _ in range(100):
+            ev.observe(bad=False)
+            clock.advance(0.1)
+        assert ev.evaluate() is None
+
+    def test_short_spike_alone_does_not_fire(self):
+        """The long window is the flap guard: a burst of bad events inside
+        an otherwise long healthy history must not page."""
+        from glom_tpu.obs.slo import BurnRateEvaluator
+
+        clock = FakeClock()
+        slo = self._slo(objective=0.5, burn_threshold=1.9)  # budget 0.5
+        ev = BurnRateEvaluator(slo, clock=clock)
+        for _ in range(200):  # 20 s of good traffic at 10/s
+            ev.observe(bad=False)
+            clock.advance(0.1)
+        for _ in range(8):    # 0.8 s of pure badness
+            ev.observe(bad=True)
+            clock.advance(0.1)
+        # short window: 8 bad / ~100 events -> burn 0.16/0.5 << 1.9
+        assert ev.evaluate() is None
+
+    def test_sustained_regression_fires_with_offenders(self):
+        from glom_tpu.obs.slo import BurnRateEvaluator
+
+        clock = FakeClock()
+        ev = BurnRateEvaluator(self._slo(), clock=clock)
+        for i in range(20):
+            ev.observe(bad=False, trace_id=f"good-{i}")
+            clock.advance(0.2)
+        for i in range(20):
+            ev.observe(bad=True, trace_id=f"bad-{i}")
+            clock.advance(0.2)
+        detail = ev.evaluate()
+        assert detail is not None
+        assert detail["burn_rate_short"] >= 2.0
+        assert detail["burn_rate_long"] >= 2.0
+        assert "bad-19" in detail["trace_ids"]
+        assert not any(t.startswith("good") for t in detail["trace_ids"])
+
+    def test_events_age_out_of_the_windows(self):
+        from glom_tpu.obs.slo import BurnRateEvaluator
+
+        clock = FakeClock()
+        ev = BurnRateEvaluator(self._slo(), clock=clock)
+        for _ in range(20):
+            ev.observe(bad=True)
+            clock.advance(0.1)
+        clock.advance(100.0)  # everything ages past the long window
+        for _ in range(20):
+            ev.observe(bad=False)
+            clock.advance(0.1)
+        assert ev.evaluate() is None
+
+
+class TestSloBurnTrigger:
+    def _engine(self, tmp_path, clock, **slo_kw):
+        from glom_tpu.obs.slo import SLO
+        from glom_tpu.serving.engine import ServingEngine, make_demo_checkpoint
+
+        ckpt = str(tmp_path / "ckpt")
+        fdir = str(tmp_path / "forensics")
+        make_demo_checkpoint(ckpt)
+        slo_kw.setdefault("name", "embed_p95")
+        slo_kw.setdefault("kind", "latency")
+        slo_kw.setdefault("objective", 0.95)
+        slo_kw.setdefault("threshold_ms", 100.0)
+        slo_kw.setdefault("endpoint", "embed")
+        slo_kw.setdefault("short_window_s", 10.0)
+        slo_kw.setdefault("long_window_s", 30.0)
+        slo_kw.setdefault("burn_threshold", 2.0)
+        slo_kw.setdefault("min_events", 5)
+        eng = ServingEngine(
+            ckpt, buckets=(1,), max_wait_ms=0.0, warmup=False,
+            reload_poll_s=0, clock=clock, forensics_dir=fdir,
+            saturation_debounce=50, slos=[SLO(**slo_kw)],
+        )
+        return eng, fdir
+
+    def _drive(self, eng, clock, n, latency_ms, tag):
+        """One traced request per iteration through the REAL batcher with
+        the fake clock injecting the latency regression."""
+        for i in range(n):
+            root = eng.tracer.start_trace("request", trace_id=f"{tag}-{i}")
+            fut = eng.submit("embed", _imgs(1), ctx=root)
+            clock.advance(latency_ms / 1e3)  # the synthetic queue delay
+            assert eng.process_once("embed") == 1
+            fut.result(timeout=5)
+            eng.tracer.end(root)
+            eng.observe_outcome("embed", latency_ms, False,
+                                trace_id=root.trace_id)
+
+    def test_regression_fires_once_per_debounce_window(self, tmp_path):
+        """Acceptance: a synthetic p95 regression (fake clock) fires
+        slo_burn, the bundle names the offending trace IDs (and their
+        spans), and the trigger fires exactly once per debounce window."""
+        from glom_tpu.obs.forensics import is_bundle_dir
+
+        clock = FakeClock()
+        eng, fdir = self._engine(tmp_path, clock)
+        self._drive(eng, clock, 10, latency_ms=10.0, tag="fast")
+        assert "slo_burn_events" not in eng.registry.snapshot()
+
+        self._drive(eng, clock, 10, latency_ms=400.0, tag="slow")
+        snap = eng.registry.snapshot()
+        assert snap["slo_burn_events"] >= 1
+
+        bundles = sorted(p for p in os.listdir(fdir)
+                         if is_bundle_dir(os.path.join(fdir, p)))
+        assert len(bundles) == 1 and bundles[0].startswith("slo_burn-")
+        with open(os.path.join(fdir, bundles[0], "manifest.json")) as f:
+            manifest = json.load(f)
+        offenders = manifest["detail"]["trace_ids"]
+        assert offenders and all(t.startswith("slow-") for t in offenders)
+        with open(os.path.join(fdir, bundles[0], "slo_traces.json")) as f:
+            slo_traces = json.load(f)
+        some = slo_traces[offenders[0]]
+        assert {s["name"] for s in some} >= {"request", "queue_wait"}
+
+        # still regressed, same debounce window (request_count has not
+        # advanced past the debounce): no second bundle
+        self._drive(eng, clock, 5, latency_ms=400.0, tag="still")
+        bundles2 = [p for p in os.listdir(fdir)
+                    if is_bundle_dir(os.path.join(fdir, p))]
+        assert len(bundles2) == 1
+
+        # a new debounce window (served-images counter advanced past it):
+        # the persisting regression earns exactly one more bundle
+        with eng._lock:
+            eng.request_count += 100
+        self._drive(eng, clock, 5, latency_ms=400.0, tag="later")
+        bundles3 = [p for p in os.listdir(fdir)
+                    if is_bundle_dir(os.path.join(fdir, p))]
+        assert len(bundles3) == 2
+
+    def test_error_rate_slo_counts_5xx(self, tmp_path):
+        clock = FakeClock()
+        eng, fdir = self._engine(
+            tmp_path, clock, name="errors", kind="error_rate",
+            objective=0.9, threshold_ms=None, endpoint=None)
+        for i in range(10):
+            eng.observe_outcome("embed", None, True, trace_id=f"err-{i}")
+            clock.advance(0.5)
+        assert eng.registry.snapshot()["slo_burn_events"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# tools/trace_report.py — golden fixture round trip
+# ---------------------------------------------------------------------------
+def _trace_report_main(argv):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(TOOLS, "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main(argv)
+
+
+def _trace_report():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(TOOLS, "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestTraceReportGolden:
+    GOLDEN = os.path.join(DATA, "golden_trace.jsonl")
+
+    def test_summary_numbers(self):
+        tr = _trace_report()
+        s = tr.summarize(tr.read_traces(self.GOLDEN))
+        assert s["traces"] == 3 and s["requests"] == 2
+        assert s["request_ms_p50"] == 10.0
+        assert s["request_ms_p95"] == 20.0
+        assert s["coverage_p50"] == pytest.approx(1.0)
+        execute = next(r for r in s["spans"] if r["span"] == "execute")
+        assert execute["count"] == 2
+        assert execute["share"] == pytest.approx(16.0 / 30.0, abs=1e-3)
+        assert s["slowest"][0]["trace_id"] == "req-aaaa"
+        assert s["slowest"][0]["breakdown_ms"]["execute"] == 10.0
+
+    def test_bucket_padding_waste_table_dedupes_mirrored_spans(self):
+        """The batch trace mirrors req-aaaa's execute span (same bucket,
+        same start edge): the waste table must count the batch ONCE."""
+        tr = _trace_report()
+        s = tr.summarize(tr.read_traces(self.GOLDEN))
+        rows = {r["bucket"]: r for r in s["buckets"]}
+        assert rows[4]["batches"] == 1 and rows[4]["images"] == 2
+        assert rows[4]["mean_padding_waste"] == pytest.approx(0.5)
+        assert rows[1]["batches"] == 1
+        assert rows[1]["mean_padding_waste"] == 0.0
+
+    def test_cli_text_and_json(self, capsys):
+        assert _trace_report_main([self.GOLDEN]) == 0
+        out = capsys.readouterr().out
+        assert "| span |" in out and "execute" in out and "req-aaaa" in out
+        assert _trace_report_main([self.GOLDEN, "--format", "json"]) == 0
+        json.loads(capsys.readouterr().out)
+
+    def test_cli_single_trace_view(self, capsys):
+        assert _trace_report_main([self.GOLDEN, "--trace", "req-aaaa"]) == 0
+        out = capsys.readouterr().out
+        assert "queue_wait" in out and "bucket=4" in out
+        assert _trace_report_main([self.GOLDEN, "--trace", "nope"]) == 1
+
+    def test_garbage_lines_skipped(self, tmp_path, capsys):
+        p = tmp_path / "feed.jsonl"
+        with open(self.GOLDEN) as f:
+            golden = f.read()
+        p.write_text("not json\n{truncated\n" + golden)
+        tr = _trace_report()
+        assert tr.summarize(tr.read_traces(str(p)))["traces"] == 3
+
+
+# ---------------------------------------------------------------------------
+# exporters: histogram bucket families (the SLO-math satellite)
+# ---------------------------------------------------------------------------
+class TestHistogramExposition:
+    def test_bucket_lines_cumulative(self):
+        from glom_tpu.obs.exporters import prometheus_lines
+
+        reg = MetricRegistry()
+        h = reg.histogram("lat", help="latency", unit="ms")
+        for v in (0.3, 0.4, 2.0, 999.0):
+            h.observe(v)
+        text = prometheus_lines(reg)
+        assert "# TYPE glom_lat histogram" in text
+        assert 'glom_lat_bucket{le="0.5"} 2' in text
+        assert 'glom_lat_bucket{le="2.5"} 3' in text
+        assert 'glom_lat_bucket{le="1000"} 4' in text
+        assert 'glom_lat_bucket{le="+Inf"} 4' in text
+        assert "glom_lat_sum" in text and "glom_lat_count 4" in text
+
+    def test_value_above_last_bound_only_in_inf(self):
+        from glom_tpu.obs.exporters import prometheus_lines
+
+        reg = MetricRegistry()
+        reg.histogram("big").observe(1e6)
+        text = prometheus_lines(reg)
+        assert 'glom_big_bucket{le="10000"} 0' in text
+        assert 'glom_big_bucket{le="+Inf"} 1' in text
+
+    def test_bucket_order_is_ascending_le(self):
+        from glom_tpu.obs.exporters import prometheus_lines
+
+        reg = MetricRegistry()
+        reg.histogram("lat").observe(1.0)
+        lines = [line for line in prometheus_lines(reg).splitlines()
+                 if line.startswith("glom_lat_bucket")]
+        les = [line.split('le="')[1].split('"')[0] for line in lines]
+        nums = [float("inf") if x == "+Inf" else float(x) for x in les]
+        assert nums == sorted(nums)
+
+    def test_textfile_exporter_renders_histogram_family(self, tmp_path):
+        from glom_tpu.obs.exporters import PrometheusTextfileExporter
+
+        reg = MetricRegistry()
+        reg.histogram("step_time").observe(0.5)
+        path = tmp_path / "glom.prom"
+        ex = PrometheusTextfileExporter(str(path))
+        ex.emit({"step": 1}, registry=reg)
+        text = path.read_text()
+        assert "# TYPE glom_step_time histogram" in text
+        assert 'glom_step_time_bucket{le="+Inf"} 1' in text

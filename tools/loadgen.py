@@ -18,6 +18,12 @@ shapes are exercised; the image contract (size/channels) is read from
 object: p50/p95/p99/mean/max latency (ms), throughput (requests and
 images per second), and error/shed counts.
 
+Every request carries an ``X-Request-Id`` (``lg-<pid>-<seq>``) which the
+server adopts as the trace id and must echo back — a missing echo counts
+as ``request_id_mismatches`` (nonzero fails the run).  ``--slow-n N``
+lists the N slowest request IDs so they can be looked up in the server's
+trace feed with ``tools/trace_report.py --trace <id>``.
+
 ``--smoke`` skips the network entirely: it builds a demo checkpoint in a
 temp dir, starts an in-process server on an ephemeral port, round-trips
 one ``/embed`` request, and exits 0 on success — the CI hook that keeps
@@ -64,6 +70,9 @@ def parse_args(argv=None):
                    help="per-request image counts, cycled")
     p.add_argument("--timeout", type=float, default=60.0,
                    help="per-request HTTP timeout (seconds)")
+    p.add_argument("--slow-n", type=int, default=0,
+                   help="print the N slowest request IDs (look them up with "
+                        "tools/trace_report.py --trace <id>)")
     p.add_argument("--smoke", action="store_true",
                    help="in-process one-request round trip; no --url needed")
     return p.parse_args(argv)
@@ -102,13 +111,18 @@ class _Results:
     def __init__(self):
         self.lock = threading.Lock()
         self.latencies_ms = []
+        self.samples = []        # (latency_ms, request_id) for --slow-n
         self.images_ok = 0
         self.ok = 0
         self.shed = 0
         self.errors = 0
+        self.id_mismatches = 0   # X-Request-Id failed to round-trip
 
-    def record(self, latency_ms=None, images=0, shed=False, error=False):
+    def record(self, latency_ms=None, images=0, shed=False, error=False,
+               request_id=None, id_mismatch=False):
         with self.lock:
+            if id_mismatch:
+                self.id_mismatches += 1
             if shed:
                 self.shed += 1
             elif error:
@@ -117,6 +131,12 @@ class _Results:
                 self.ok += 1
                 self.images_ok += images
                 self.latencies_ms.append(latency_ms)
+                if request_id is not None:
+                    self.samples.append((latency_ms, request_id))
+
+    def slowest(self, n):
+        with self.lock:
+            return sorted(self.samples, reverse=True)[:n]
 
 
 def run_closed(url, endpoint, payloads, batch_sizes, n_requests, concurrency,
@@ -133,7 +153,8 @@ def run_closed(url, endpoint, payloads, batch_sizes, n_requests, concurrency,
                 counter[0] += 1
             b = batch_sizes[i % len(batch_sizes)]
             t0 = time.monotonic()
-            _send(url, endpoint, payloads[b], b, timeout, results, t0)
+            _send(url, endpoint, payloads[b], b, timeout, results, t0,
+                  request_id=f"lg-{os.getpid()}-{i}")
 
     threads = [threading.Thread(target=worker, daemon=True)
                for _ in range(concurrency)]
@@ -163,6 +184,7 @@ def run_open(url, endpoint, payloads, batch_sizes, rate, duration, timeout,
             target=_send,
             args=(url, endpoint, payloads[b], b, timeout, results,
                   time.monotonic()),
+            kwargs={"request_id": f"lg-{os.getpid()}-{i}"},
             daemon=True,
         )
         t.start()
@@ -172,31 +194,45 @@ def run_open(url, endpoint, payloads, batch_sizes, rate, duration, timeout,
     return time.monotonic() - t_start
 
 
-def _send(url, endpoint, body, n_images, timeout, results, t0):
-    req = urllib.request.Request(
-        f"{url}/{endpoint}", data=body,
-        headers={"Content-Type": "application/json"},
-    )
+def _send(url, endpoint, body, n_images, timeout, results, t0,
+          request_id=None):
+    headers = {"Content-Type": "application/json"}
+    if request_id is not None:
+        # the trace identity: the server adopts it as the trace_id and
+        # must echo it back — a missing/different echo is a broken
+        # propagation path, counted as id_mismatch
+        headers["X-Request-Id"] = request_id
+    req = urllib.request.Request(f"{url}/{endpoint}", data=body,
+                                 headers=headers)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
+            echoed = r.headers.get("X-Request-Id")
             json.loads(r.read())
     except urllib.error.HTTPError as e:
+        echoed = e.headers.get("X-Request-Id")
         e.read()
-        results.record(shed=(e.code == 503), error=(e.code != 503))
+        results.record(shed=(e.code == 503), error=(e.code != 503),
+                       id_mismatch=(request_id is not None
+                                    and echoed != request_id))
         return
     except Exception:
         results.record(error=True)
         return
-    results.record(latency_ms=(time.monotonic() - t0) * 1e3, images=n_images)
+    results.record(
+        latency_ms=(time.monotonic() - t0) * 1e3, images=n_images,
+        request_id=request_id,
+        id_mismatch=(request_id is not None and echoed != request_id),
+    )
 
 
-def report(results, wall_s, mode):
+def report(results, wall_s, mode, slow_n=0):
     lat = results.latencies_ms
     out = {
         "mode": mode,
         "requests_ok": results.ok,
         "requests_shed": results.shed,
         "requests_error": results.errors,
+        "request_id_mismatches": results.id_mismatches,
         "images_ok": results.images_ok,
         "wall_seconds": round(wall_s, 3),
         "throughput_req_per_s": round(results.ok / wall_s, 2) if wall_s else None,
@@ -211,16 +247,26 @@ def report(results, wall_s, mode):
             "max": round(max(lat), 3) if lat else None,
         },
     }
+    if slow_n:
+        out["slowest"] = [
+            {"request_id": rid, "latency_ms": round(ms, 3)}
+            for ms, rid in results.slowest(slow_n)
+        ]
     return out
 
 
 def run_smoke() -> int:
     """In-process round trip: demo checkpoint -> engine -> HTTP server ->
-    one /embed request.  Exit status is the CI signal."""
+    one /embed request, with the tracing acceptance checks: the request's
+    trace (keyed by the X-Request-Id we sent) must explain >= 95% of the
+    request span's wall time, and the spans must export as a
+    Perfetto-loadable trace-event JSON file.  Exit status is the CI
+    signal."""
     import tempfile
 
     import numpy as np
 
+    from glom_tpu.obs.tracing import TraceExporter, span_coverage
     from glom_tpu.serving.engine import ServingEngine, make_demo_checkpoint
     from glom_tpu.serving.server import make_server
 
@@ -233,18 +279,59 @@ def run_smoke() -> int:
         host, port = server.server_address[:2]
         thread = threading.Thread(target=server.serve_forever, daemon=True)
         thread.start()
+        request_id = f"smoke-{os.getpid()}"
         try:
             health = _fetch_health(f"http://{host}:{port}", timeout=10)
             payloads = _make_payloads(health, [1])
             results = _Results()
             t0 = time.monotonic()
             _send(f"http://{host}:{port}", "embed", payloads[1], 1, 30.0,
-                  results, t0)
-            ok = results.ok == 1 and results.errors == 0
+                  results, t0, request_id=request_id)
+            wall = time.monotonic() - t0
+
+            # -- trace acceptance: one trace under OUR request id, its
+            # spans explaining the request span's wall time.  The server
+            # closes the root span AFTER writing the reply, so the client
+            # can get here before the handler thread records it — poll
+            # briefly instead of racing it.
+            spans = []
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                spans = [s.to_dict()
+                         for s in engine.tracer.sink.trace(request_id)]
+                root = next((s for s in spans if s.get("root_span")), None)
+                if root is not None and root.get("end") is not None:
+                    break
+                time.sleep(0.01)
+            coverage = span_coverage(spans)
+            perfetto_path = os.path.join(
+                tempfile.gettempdir(), "glom_smoke_trace.json")
+            TraceExporter(engine.tracer.sink).write(perfetto_path)
+            with open(perfetto_path) as f:
+                perfetto = json.load(f)
+            perfetto_ok = (
+                isinstance(perfetto.get("traceEvents"), list)
+                and any(e.get("ph") == "X" for e in perfetto["traceEvents"])
+            )
+            span_names = {s["name"] for s in spans}
+            ok = (
+                results.ok == 1 and results.errors == 0
+                and results.id_mismatches == 0
+                and coverage is not None and coverage >= 0.95
+                and perfetto_ok
+                and {"request", "queue_wait", "batch_assembly", "pad",
+                     "execute", "respond"} <= span_names
+            )
             print(json.dumps({
                 "smoke": "ok" if ok else "FAILED",
                 "health": health,
-                **report(results, time.monotonic() - t0, "smoke"),
+                "request_id": request_id,
+                "trace_span_names": sorted(span_names),
+                "trace_coverage": (None if coverage is None
+                                   else round(coverage, 4)),
+                "perfetto_file": perfetto_path,
+                "perfetto_events": len(perfetto.get("traceEvents", [])),
+                **report(results, wall, "smoke"),
             }, indent=2))
             if not ok:
                 return 1
@@ -281,8 +368,9 @@ def main(argv=None) -> int:
                           args.requests, args.concurrency, args.timeout,
                           results)
         mode = f"closed(c={args.concurrency})"
-    print(json.dumps(report(results, wall, mode), indent=2))
-    return 0 if results.errors == 0 else 1
+    print(json.dumps(report(results, wall, mode, slow_n=args.slow_n),
+                     indent=2))
+    return 0 if results.errors == 0 and results.id_mismatches == 0 else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,304 @@
+"""Summarize a serving trace feed (glom_tpu.obs.tracing JSONL).
+
+  python tools/trace_report.py traces.jsonl [--format json]
+  python tools/trace_report.py traces.jsonl --slowest 10
+  python tools/trace_report.py traces.jsonl --trace <request-id>
+
+Reads the per-trace JSONL the serving engine emits (``--trace-log``: one
+JSON object per COMPLETED trace — ``trace_id``, root span name, duration,
+and the span list) and prints:
+
+  * per-span-kind p50 / p95 ms and share of request wall time — the
+    critical-path breakdown ("where do slow requests spend their time:
+    queue, padding, device?");
+  * the slowest-N request traces with per-span breakdown and coverage
+    (fraction of the root span explained by child spans — low coverage
+    means the instrumentation is missing a stage);
+  * per-bucket padding-waste table from ``execute`` span annotations
+    (which compiled batch shapes burn compute on zeros);
+  * ``--trace <id>`` — one trace's spans, indented by parentage (the
+    lookup target for ``tools/loadgen.py --slow-n`` output).
+
+Stdlib-only on purpose (like obs_report.py / forensics_report.py): it
+must run on a machine with no jax, straight off a scp'd trace log.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+
+def _percentile(xs, q):
+    """Nearest-rank percentile (the obs registry's rule)."""
+    if not xs:
+        return None
+    ordered = sorted(xs)
+    rank = min(len(ordered) - 1, max(0, math.ceil(q / 100.0 * len(ordered)) - 1))
+    return ordered[rank]
+
+
+def read_traces(path):
+    """One dict per line; truncated/garbage lines are skipped (a killed
+    server must not make its own evidence unreadable)."""
+    traces = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("spans"):
+                traces.append(rec)
+    return traces
+
+
+def find_root(spans):
+    """The trace's local root: the ``root_span``-flagged span, else a
+    parentless span, else one whose parent is not in the trace (a root
+    joined from a remote traceparent)."""
+    ids = {s.get("span_id") for s in spans}
+    for pred in (lambda s: s.get("root_span"),
+                 lambda s: s.get("parent_id") is None,
+                 lambda s: s.get("parent_id") not in ids):
+        root = next((s for s in spans if pred(s)), None)
+        if root is not None:
+            return root
+    return None
+
+
+def coverage(spans):
+    """Union of child-span intervals over the root span's wall time
+    (mirrors glom_tpu.obs.tracing.span_coverage — inlined: this tool must
+    import nothing jax-backed)."""
+    root = find_root(spans)
+    if root is None or root.get("end") is None:
+        return None
+    t0, t1 = root["start"], root["end"]
+    if t1 <= t0:
+        return 1.0
+    ivs = sorted(
+        (max(s["start"], t0), min(s["end"], t1))
+        for s in spans
+        if s is not root and s.get("end") is not None
+        and s["end"] > t0 and s["start"] < t1
+    )
+    covered, cur_a, cur_b = 0.0, None, None
+    for a, b in ivs:
+        if cur_b is None or a > cur_b:
+            if cur_b is not None:
+                covered += cur_b - cur_a
+            cur_a, cur_b = a, b
+        else:
+            cur_b = max(cur_b, b)
+    if cur_b is not None:
+        covered += cur_b - cur_a
+    return covered / (t1 - t0)
+
+
+# synthetic overlap span: dispatch_wait covers the handler's whole parked
+# interval ON TOP of the pipeline spans (queue_wait/pad/execute) — it
+# exists so union-based COVERAGE has no scheduling gaps, but summing it
+# into a share-of-wall table would double-count the pipeline and always
+# "win" the breakdown
+_OVERLAP_SPANS = {"dispatch_wait"}
+
+
+def _breakdown(spans):
+    """Per-span-name total ms within one trace (mirrored batch spans
+    appear once per trace by construction; overlap spans excluded)."""
+    root = find_root(spans)
+    out = {}
+    for s in spans:
+        if (s is root or s.get("duration_ms") is None
+                or s["name"] in _OVERLAP_SPANS):
+            continue
+        out[s["name"]] = out.get(s["name"], 0.0) + s["duration_ms"]
+    return out
+
+
+def summarize(traces, slowest=5):
+    requests = [t for t in traces if t.get("root") == "request"
+                and t.get("duration_ms") is not None]
+    durations = [t["duration_ms"] for t in requests]
+    coverages = [c for t in requests
+                 if (c := coverage(t["spans"])) is not None]
+
+    span_ms = {}       # name -> [ms per request trace]
+    for t in requests:
+        for name, ms in _breakdown(t["spans"]).items():
+            span_ms.setdefault(name, []).append(ms)
+    wall = sum(durations)
+    span_rows = [
+        {
+            "span": name,
+            "count": len(xs),
+            "p50_ms": round(_percentile(xs, 50), 3),
+            "p95_ms": round(_percentile(xs, 95), 3),
+            "share": round(sum(xs) / wall, 4) if wall else None,
+        }
+        for name, xs in sorted(span_ms.items(), key=lambda kv: -sum(kv[1]))
+    ]
+
+    slow_rows = [
+        {
+            "trace_id": t["trace_id"],
+            "duration_ms": round(t["duration_ms"], 3),
+            "coverage": (round(c, 4) if (c := coverage(t["spans"])) is not None
+                         else None),
+            "breakdown_ms": {k: round(v, 3)
+                             for k, v in sorted(_breakdown(t["spans"]).items(),
+                                                key=lambda kv: -kv[1])},
+        }
+        for t in sorted(requests, key=lambda t: -t["duration_ms"])[:slowest]
+    ]
+
+    # per-bucket padding waste, from execute-span annotations.  Every
+    # member trace mirrors its batch's execute span, so per-REQUEST rows
+    # would overcount batches; dedupe by span_id-free identity: count only
+    # one execute span per (bucket, start) edge.
+    seen = set()
+    buckets = {}
+    for t in traces:
+        for s in t["spans"]:
+            if s["name"] != "execute":
+                continue
+            attrs = s.get("attrs") or {}
+            if "bucket" not in attrs:
+                continue
+            key = (attrs["bucket"], s["start"])
+            if key in seen:
+                continue
+            seen.add(key)
+            b = buckets.setdefault(attrs["bucket"], {
+                "batches": 0, "images": 0, "waste": [], "exec_ms": []})
+            b["batches"] += 1
+            b["images"] += attrs.get("images", 0)
+            b["waste"].append(attrs.get("padding_waste", 0.0))
+            if s.get("duration_ms") is not None:
+                b["exec_ms"].append(s["duration_ms"])
+    bucket_rows = [
+        {
+            "bucket": k,
+            "batches": v["batches"],
+            "images": v["images"],
+            "mean_padding_waste": round(sum(v["waste"]) / len(v["waste"]), 4),
+            "p95_execute_ms": (round(_percentile(v["exec_ms"], 95), 3)
+                               if v["exec_ms"] else None),
+        }
+        for k, v in sorted(buckets.items())
+    ]
+
+    return {
+        "traces": len(traces),
+        "requests": len(requests),
+        "request_ms_p50": _percentile(durations, 50),
+        "request_ms_p95": _percentile(durations, 95),
+        "request_ms_max": max(durations) if durations else None,
+        "coverage_p50": (round(_percentile(coverages, 50), 4)
+                         if coverages else None),
+        "spans": span_rows,
+        "slowest": slow_rows,
+        "buckets": bucket_rows,
+    }
+
+
+def _fmt(v, spec=".2f"):
+    return "—" if v is None else format(v, spec)
+
+
+def print_report(s):
+    print(f"traces: {s['traces']}   request traces: {s['requests']}")
+    if s["request_ms_p50"] is not None:
+        print(f"request wall: p50 {_fmt(s['request_ms_p50'])} ms   "
+              f"p95 {_fmt(s['request_ms_p95'])} ms   "
+              f"max {_fmt(s['request_ms_max'])} ms   "
+              f"span coverage p50 {_fmt(s['coverage_p50'], '.1%')}")
+    if s["spans"]:
+        print("\n| span | count | p50 ms | p95 ms | share of wall |")
+        print("|---|---|---|---|---|")
+        for r in s["spans"]:
+            share = "—" if r["share"] is None else f"{100 * r['share']:.1f}%"
+            print(f"| {r['span']} | {r['count']} | {_fmt(r['p50_ms'])} | "
+                  f"{_fmt(r['p95_ms'])} | {share} |")
+    if s["slowest"]:
+        print("\nslowest requests:")
+        for r in s["slowest"]:
+            parts = ", ".join(f"{k} {v:.2f}" for k, v in
+                              list(r["breakdown_ms"].items())[:4])
+            cov = "—" if r["coverage"] is None else f"{100 * r['coverage']:.0f}%"
+            print(f"  {r['trace_id']}  {r['duration_ms']:.2f} ms  "
+                  f"(coverage {cov}; {parts})")
+    if s["buckets"]:
+        print("\n| bucket | batches | images | mean padding waste | p95 execute ms |")
+        print("|---|---|---|---|---|")
+        for r in s["buckets"]:
+            print(f"| {r['bucket']} | {r['batches']} | {r['images']} | "
+                  f"{100 * r['mean_padding_waste']:.1f}% | "
+                  f"{_fmt(r['p95_execute_ms'])} |")
+
+
+def print_trace(traces, trace_id) -> int:
+    match = [t for t in traces if t["trace_id"] == trace_id]
+    if not match:
+        print(f"error: no trace {trace_id!r} in the feed", file=sys.stderr)
+        return 1
+    for t in match:
+        spans = sorted(t["spans"], key=lambda s: s["start"])
+        by_id = {s["span_id"]: s for s in spans}
+
+        def depth(s):
+            d = 0
+            while s.get("parent_id") in by_id:
+                s = by_id[s["parent_id"]]
+                d += 1
+            return d
+
+        print(f"trace {t['trace_id']}  root={t.get('root')}  "
+              f"{_fmt(t.get('duration_ms'))} ms")
+        t0 = spans[0]["start"] if spans else 0.0
+        for s in spans:
+            indent = "  " * (1 + depth(s))
+            attrs = s.get("attrs") or {}
+            extra = " ".join(f"{k}={attrs[k]}" for k in
+                             ("bucket", "padding_waste", "flush_reason",
+                              "status") if k in attrs)
+            print(f"{indent}{s['name']}  +{1e3 * (s['start'] - t0):.2f} ms  "
+                  f"dur {_fmt(s.get('duration_ms'))} ms  {extra}".rstrip())
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("jsonl", help="per-trace JSONL feed (engine --trace-log)")
+    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--slowest", type=int, default=5,
+                   help="how many slowest traces to list")
+    p.add_argument("--trace", default=None, metavar="ID",
+                   help="print one trace's spans (indented by parentage)")
+    args = p.parse_args(argv)
+    try:
+        traces = read_traces(args.jsonl)
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if not traces:
+        print(f"error: no trace records in {args.jsonl}", file=sys.stderr)
+        return 1
+    if args.trace:
+        return print_trace(traces, args.trace)
+    s = summarize(traces, slowest=args.slowest)
+    if args.format == "json":
+        print(json.dumps(s))
+    else:
+        print_report(s)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
