@@ -25,12 +25,26 @@ import json
 import os
 import shutil
 import tempfile
+import zlib
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 
 _SEP = "/"
+
+
+class CorruptCheckpointError(ValueError):
+    """A checkpoint artifact failed integrity verification (torn write,
+    bit rot, truncation) — distinct from structural mismatches (KeyError /
+    shape ValueError), which mean the CODE changed, not the bytes.
+    Callers quarantine the step and fall back
+    (:func:`glom_tpu.resilience.integrity.latest_valid_step`)."""
+
+
+# after CorruptCheckpointError on purpose: resilience.integrity imports it
+# back from here (policy lives there, the byte-level mechanism lives here)
+from glom_tpu.resilience import faultinject  # noqa: E402
 
 
 def _entry_str(p) -> str:
@@ -87,6 +101,117 @@ def _orbax_path(directory: str, step: int) -> str:
     return os.path.abspath(os.path.join(directory, f"ckpt_{step}.orbax"))
 
 
+# -- integrity records (per-array CRCs next to every npz artifact) --------
+# The mechanism lives here (save computes, restore verifies); the POLICY —
+# quarantine, newest-valid fallback, telemetry — lives in
+# glom_tpu.resilience.integrity.
+
+def integrity_path(directory: str, step: int) -> str:
+    return os.path.join(directory, f"ckpt_{step}.integrity.json")
+
+
+def _array_crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes())
+
+
+def _file_crc(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc
+
+
+def _write_integrity(directory: str, step: int, artifact: str, arrays: dict) -> None:
+    # _file_crc re-reads the artifact just written.  Computing the CRC
+    # inline via a tee'd file object is NOT possible: zipfile (under
+    # np.savez) seeks back to patch each member's local header on close,
+    # so linearly-accumulated CRC/size would be wrong.  The read-back hits
+    # the page cache the write just populated, so the cost is memory
+    # bandwidth at save cadence, not a second trip to the filesystem.
+    payload = {
+        "schema": 1,
+        "algo": "crc32",
+        "step": int(step),
+        "artifact": os.path.basename(artifact),
+        "file_size": os.path.getsize(artifact),
+        "file_crc32": _file_crc(artifact),
+        "arrays": {k: _array_crc(v) for k, v in arrays.items()},
+    }
+    _atomic_write(
+        directory, f"ckpt_{step}.integrity.json",
+        lambda f: f.write(json.dumps(payload).encode()),
+    )
+
+
+def read_integrity(directory: str, step: int) -> Optional[dict]:
+    """The step's integrity record, or None when the step is unverifiable
+    (no sidecar — pre-resilience checkpoints, non-npz backends — or a
+    garbled sidecar, which is warned about but treated as absent: the
+    ARTIFACT may be fine, and refusing to load it on sidecar damage would
+    turn a cosmetic loss into an outage)."""
+    path = integrity_path(directory, step)
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        if not isinstance(rec, dict) or "arrays" not in rec:
+            raise ValueError("missing 'arrays'")
+        return rec
+    except FileNotFoundError:
+        return None
+    except (json.JSONDecodeError, ValueError, OSError) as e:
+        import warnings
+
+        warnings.warn(
+            f"unreadable integrity record {path} ({type(e).__name__}: {e}); "
+            f"step {step} loads unverified",
+            stacklevel=2,
+        )
+        return None
+
+
+def verify_file_integrity(directory: str, step: int, *,
+                          quick: bool = False) -> Optional[bool]:
+    """Whole-file check against the sidecar's record: True (verified),
+    False (corrupt or artifact missing while a record exists), None
+    (unverifiable — no record, or a non-npz artifact).  Default: one
+    streaming CRC pass, no npz parse.  ``quick=True`` checks only the
+    recorded file SIZE (a stat, no read) — catches torn/truncated writes
+    but not bitflips; the prune path uses it on the step it just wrote."""
+    rec = read_integrity(directory, step)
+    if rec is None or "file_crc32" not in rec:
+        return None
+    path = os.path.join(directory, rec.get("artifact", f"ckpt_{step}.npz"))
+    try:
+        if quick and "file_size" in rec:
+            return os.path.getsize(path) == rec["file_size"]
+        return _file_crc(path) == rec["file_crc32"]
+    except OSError:
+        return False
+
+
+def _apply_write_fault(path: str, step: int) -> None:
+    """``ckpt_write`` injection site: corrupt the just-written artifact the
+    way a crashed writer (torn) or failing media (bitflip) would — AFTER
+    the integrity record was computed from the intended bytes, so restore
+    sees exactly what a real corruption looks like.  No-op when no
+    FaultPlan is armed."""
+    kind = faultinject.fire("ckpt_write", step=step)
+    if kind is None:
+        return
+    size = os.path.getsize(path)
+    if kind == "torn":
+        with open(path, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+    elif kind == "bitflip":
+        off = int(faultinject.uniform("ckpt_write", 0, max(size - 1, 0)))
+        with open(path, "r+b") as f:
+            f.seek(off)
+            b = f.read(1) or b"\0"
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0xFF]))
+
+
 def save(
     directory: str, step: int, trees: Dict[str, Any], *, keep: int = 3,
     backend: str = "npz",
@@ -114,6 +239,12 @@ def save(
     if backend == "npz":
         arrays = _flatten_named(trees)
         path = _atomic_write(directory, f"ckpt_{step}.npz", lambda f: np.savez(f, **arrays))
+        # per-array CRCs + whole-file CRC next to the artifact: restore
+        # verifies them, latest_valid_step scans them.  Written before the
+        # fault site so an injected corruption is DETECTABLE — exactly the
+        # real-world sequence (good write ... later bytes go bad).
+        _write_integrity(directory, step, path, arrays)
+        _apply_write_fault(path, step)
 
     # one artifact per step: replace the other backends' same-step artifacts
     other = _orbax_path(directory, step) if backend == "npz" else _npz_path(directory, step)
@@ -121,6 +252,11 @@ def save(
         shutil.rmtree(other, ignore_errors=True)
     elif os.path.exists(other):
         os.remove(other)
+    if backend != "npz":
+        # a stale npz-era sidecar must not "verify" the replacing artifact
+        stale_rec = integrity_path(directory, step)
+        if os.path.exists(stale_rec):
+            os.remove(stale_rec)
     for stale_shard in _shard_paths(directory, step):
         os.remove(stale_shard)
 
@@ -204,7 +340,8 @@ def save_sharded(
     # AND shard files from a previous run with a different process count (a
     # crash between shard writes and manifest can strand them; mixing two
     # tilings at restore would silently blend two training states)
-    for stale in (_npz_path(directory, step), _orbax_path(directory, step)):
+    for stale in (_npz_path(directory, step), _orbax_path(directory, step),
+                  integrity_path(directory, step)):
         if os.path.isdir(stale):
             shutil.rmtree(stale, ignore_errors=True)
         elif os.path.exists(stale):
@@ -288,23 +425,46 @@ def _prune(directory: str, keep: int, *, protect: Optional[int] = None) -> None:
     """Keep the ``keep`` newest checkpoint steps ACROSS BOTH BACKENDS, never
     deleting step ``protect`` (the step the manifest points at — matters
     when saving a step lower than stale higher-numbered checkpoints after a
-    rollback)."""
+    rollback) nor the newest step that VERIFIES against its integrity
+    record — when later steps are corrupt (torn writes not yet
+    quarantined), pruning by raw step number could destroy the only valid
+    restore point."""
     ckpts = sorted(
         (f for f in os.listdir(directory) if _step_of(f) is not None),
         key=_step_of,
     )
+    protected = set() if protect is None else {protect}
+    # newest-valid scan, newest first: the first step that verifies joins
+    # the protected set.  The just-written ``protect`` step gets only the
+    # quick (stat-based) size check — catching the torn-own-write case
+    # without a full re-read — so the common path (newest step == protect,
+    # intact) stays one stat away from O(listdir).
+    for s in sorted({_step_of(f) for f in ckpts}, reverse=True):
+        if verify_file_integrity(directory, s, quick=s == protect) is not False:
+            protected.add(s)  # verified, or unverifiable-but-presumed-good
+            break
     for f in ckpts[:-keep] if keep > 0 else []:
-        if protect is not None and _step_of(f) == protect:
+        if _step_of(f) in protected:
             continue
         path = os.path.join(directory, f)
         if os.path.isdir(path):
             shutil.rmtree(path, ignore_errors=True)
         else:
             os.remove(path)
-    # sweep tmp files orphaned by crashed writers
+    # sweep tmp files orphaned by crashed writers, and integrity sidecars
+    # whose artifact is gone (pruned above, or removed out of band)
+    remaining = {_step_of(f) for f in os.listdir(directory)
+                 if _step_of(f) is not None}
     for f in os.listdir(directory):
         if f.endswith(".tmp"):
             os.remove(os.path.join(directory, f))
+        elif f.startswith("ckpt_") and f.endswith(".integrity.json"):
+            try:
+                s = int(f[len("ckpt_"):-len(".integrity.json")])
+            except ValueError:
+                continue
+            if s not in remaining:
+                os.remove(os.path.join(directory, f))
 
 
 def latest_step(directory: str, *, strict: bool = False) -> Optional[int]:
@@ -361,8 +521,33 @@ def _load_arrays(directory: str, step: int) -> dict:
         has_orbax = os.path.getmtime(orbax_dir) > os.path.getmtime(npz)
         has_npz = not has_orbax
     if has_npz:
-        with np.load(npz) as data:
-            return dict(data)
+        rec = read_integrity(directory, step)
+        try:
+            with np.load(npz) as data:
+                arrays = dict(data)
+        except Exception as e:
+            if rec is not None:
+                # an integrity record exists, so the artifact was once a
+                # well-formed npz: an unparseable file now IS corruption
+                # (torn write, truncation), not a foreign file
+                raise CorruptCheckpointError(
+                    f"checkpoint step {step} in {directory} is unreadable "
+                    f"({type(e).__name__}: {e}) but has an integrity record "
+                    f"— the artifact was damaged after save"
+                ) from e
+            raise
+        if rec is not None:
+            bad = sorted(
+                k for k, crc in rec["arrays"].items()
+                if k not in arrays or _array_crc(arrays[k]) != crc
+            )
+            if bad:
+                raise CorruptCheckpointError(
+                    f"checkpoint step {step} in {directory} failed per-array "
+                    f"CRC verification for {len(bad)} of "
+                    f"{len(rec['arrays'])} arrays (first: {bad[:3]})"
+                )
+        return arrays
     if has_orbax:
         import orbax.checkpoint as ocp
 

@@ -174,6 +174,14 @@ class TrainConfig:
     # log_every=0 run still surfaces NaN storms)
     monitor_numerics: bool = True
     grad_spike_factor: float = 10.0      # spike = grad_norm > factor * EMA
+    # fail fast (trainer.NonFiniteError) when a numerics window shows
+    # nonfinite grads/loss, BEFORE the poisoned params can be checkpointed
+    # — the knob that lets a supervisor (glom_tpu.resilience.supervisor)
+    # self-heal by restarting from the last clean checkpoint.  Off by
+    # default: an unsupervised research run may prefer to limp and log.
+    # Needs monitor_numerics; detection is window-granular, so keep
+    # log_every <= checkpoint_every for an airtight no-NaN-ckpt guarantee.
+    halt_on_nan: bool = False
     # GLOM-level diagnostics cadence (island agreement, attention entropy,
     # contribution norm shares) — one extra forward every N steps; 0 = off
     diag_every: int = 0

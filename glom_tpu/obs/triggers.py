@@ -29,6 +29,10 @@ TRIGGER_STEP_TIME = "step_time_regression"
 TRIGGER_QUEUE_SATURATION = "queue_saturation"
 # serving-side: multi-window SLO burn-rate breach (glom_tpu.obs.slo)
 TRIGGER_SLO_BURN = "slo_burn"
+# resilience-side (glom_tpu.resilience): a checkpoint failed integrity
+# verification and was quarantined; a supervised fit() crashed and restarted
+TRIGGER_CKPT_CORRUPT = "ckpt_corrupt"
+TRIGGER_CRASH_RESTART = "crash_restart"
 # terminal paths write bundles DIRECTLY (no debounce/budget — they fire at
 # most once per run by construction); named here so readers share the names
 TRIGGER_CRASH = "crash"

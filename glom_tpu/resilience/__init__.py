@@ -1,0 +1,50 @@
+"""Resilience subsystem: surviving the failures the telemetry layer detects.
+
+PRs 1-4 built eyes (metrics, forensics, tracing, SLOs); this package turns
+detection into survival.  Four pieces, each usable on its own:
+
+  * :mod:`glom_tpu.resilience.faultinject` — seeded, deterministic fault
+    injection: a :class:`FaultPlan` parsed from a spec string arms named
+    injection sites threaded through the checkpoint writer, the data
+    pipeline, and the serving reload watcher.  Zero overhead when
+    disarmed (one ``is None`` check per site).
+  * :mod:`glom_tpu.resilience.integrity` — checkpoint integrity policy:
+    per-array CRCs written next to every artifact
+    (:mod:`glom_tpu.checkpoint` computes them at save time and verifies
+    on restore), quarantine of corrupt artifacts (renamed ``*.corrupt``,
+    counter + ``ckpt_corrupt`` forensics trigger), and
+    :func:`latest_valid_step` — the newest checkpoint that VERIFIES,
+    which trainer auto-resume, ``denoise.load_checkpoint_state`` and the
+    serving hot-reload watcher all fall back to.
+  * :mod:`glom_tpu.resilience.supervisor` — a self-healing training
+    supervisor: runs ``fit()`` under a restart policy (exponential
+    backoff with jitter, crash-loop detection, resume-from-latest-valid
+    on every attempt) with restart/giveup counters in the shared obs
+    registry and a forensics bundle per restart.
+
+``tools/chaos.py`` is the acceptance harness: it runs every named fault
+against a tiny CPU train/serve loop and asserts recovery, reporting
+per-scenario MTTR.  See ``docs/RESILIENCE.md``.
+"""
+
+from glom_tpu.resilience.faultinject import (  # noqa: F401
+    FaultError,
+    FaultPlan,
+    arm,
+    armed,
+    disarm,
+    fire,
+    injected,
+)
+from glom_tpu.resilience.integrity import (  # noqa: F401
+    CorruptCheckpointError,
+    IntegrityObserver,
+    latest_valid_step,
+    quarantine,
+    verify_artifact,
+)
+from glom_tpu.resilience.supervisor import (  # noqa: F401
+    GiveUp,
+    RestartPolicy,
+    Supervisor,
+)

@@ -1,0 +1,222 @@
+"""Checkpoint integrity policy: quarantine and newest-valid fallback.
+
+The byte-level mechanism lives in :mod:`glom_tpu.checkpoint` (per-array
+CRCs written next to every npz artifact at save time, verified on
+restore); this module owns what happens when verification FAILS:
+
+  * :func:`quarantine` — rename the step's artifacts ``*.corrupt`` so no
+    later load (and no prune scan) ever considers them again, while the
+    bytes stay on disk for post-mortem.
+  * :func:`latest_valid_step` — the newest step that verifies, scanning
+    newest-first and quarantining failures on the way down.  Trainer
+    auto-resume, ``denoise.load_checkpoint_state``, and the serving
+    hot-reload watcher all restore from THIS, so a torn write degrades a
+    run by one checkpoint interval instead of killing it.
+  * :func:`restore_with_fallback` — restore that survives races: a step
+    that verified in the scan but fails per-array CRCs at load (bytes
+    went bad in between) is quarantined and the next-valid step is tried.
+  * :class:`IntegrityObserver` — the telemetry splice: every quarantine
+    bumps ``ckpt_corrupt_total`` and fires the debounced ``ckpt_corrupt``
+    forensics trigger (one bundle per incident, not one per damaged
+    file), matching the trainer's anomaly pipeline.
+
+Steps with no integrity record (pre-resilience checkpoints, orbax/sharded
+backends) are presumed good — refusing to load history because it predates
+the checksums would turn an upgrade into an outage.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Dict, Optional, Tuple
+
+from glom_tpu import checkpoint as ckpt_lib
+from glom_tpu.checkpoint import CorruptCheckpointError  # noqa: F401  (re-export)
+from glom_tpu.obs.triggers import TRIGGER_CKPT_CORRUPT
+
+QUARANTINE_SUFFIX = ".corrupt"
+
+
+class IntegrityObserver:
+    """Routes quarantine events into the shared obs stack: counter +
+    debounced ``ckpt_corrupt`` trigger + forensics bundle.  All three
+    backends are optional — an observer with only a registry still counts.
+    ``triggers``/``forensics`` may be attached after construction (the
+    serving engine builds them later in its own __init__)."""
+
+    def __init__(self, *, registry=None, triggers=None, forensics=None):
+        self.registry = registry
+        self.triggers = triggers
+        self.forensics = forensics
+
+    def on_corrupt(self, directory: str, step: int, detail: Dict[str, Any]) -> None:
+        if self.registry is not None:
+            self.registry.counter(
+                "ckpt_corrupt_total",
+                help="checkpoints quarantined after failing integrity "
+                     "verification",
+            ).inc()
+        if self.forensics is None:
+            return
+        if self.triggers is not None and not self.triggers.fire(
+            TRIGGER_CKPT_CORRUPT, step
+        ):
+            return  # debounced: one bundle per incident, not per artifact
+        detail = dict(detail, directory=directory)
+        path = self.forensics.capture(
+            TRIGGER_CKPT_CORRUPT, step, detail, trace=False,
+        )
+        if path is None and self.triggers is not None:
+            self.triggers.refund(TRIGGER_CKPT_CORRUPT, step)
+
+
+def verify_artifact(directory: str, step: int) -> Optional[bool]:
+    """Whole-file CRC check against the step's integrity record: True
+    (verified), False (corrupt), None (no record — unverifiable, presumed
+    good)."""
+    return ckpt_lib.verify_file_integrity(directory, step)
+
+
+def quarantine(
+    directory: str, step: int, *,
+    observer: Optional[IntegrityObserver] = None,
+    reason: str = "",
+) -> list:
+    """Rename every artifact of ``step`` (npz/orbax/shards + the integrity
+    record) to ``<name>.corrupt``.  Quarantined files stop matching the
+    checkpoint name patterns, so ``latest_step`` scans, restores, and
+    pruning all stop seeing the step — but the evidence stays on disk.
+    Best-effort (warns, never raises) and idempotent; returns the list of
+    renamed paths."""
+    renamed = []
+    candidates = [
+        ckpt_lib.npz_path(directory, step),
+        ckpt_lib._orbax_path(directory, step),
+        ckpt_lib.integrity_path(directory, step),
+        *ckpt_lib._shard_paths(directory, step),
+    ]
+    for path in candidates:
+        if not os.path.exists(path):
+            continue
+        try:
+            os.replace(path, path + QUARANTINE_SUFFIX)
+            renamed.append(path + QUARANTINE_SUFFIX)
+        except OSError as e:
+            warnings.warn(
+                f"failed to quarantine {path} ({type(e).__name__}: {e})",
+                stacklevel=2,
+            )
+    if renamed:
+        warnings.warn(
+            f"quarantined corrupt checkpoint step {step} in {directory}"
+            + (f" ({reason})" if reason else ""),
+            stacklevel=2,
+        )
+        if observer is not None:
+            observer.on_corrupt(directory, step, {
+                "step": int(step),
+                "reason": reason or "integrity verification failed",
+                "quarantined": [os.path.basename(p) for p in renamed],
+            })
+    return renamed
+
+
+def _candidate_steps(directory: str) -> list:
+    """All steps with on-disk artifacts, newest first.  Driven by the
+    artifact scan, not the manifest: the manifest only knows the latest
+    step, and it may point at exactly the artifact that went bad."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    return sorted(
+        {s for s in (ckpt_lib._step_of(f) for f in names) if s is not None},
+        reverse=True,
+    )
+
+
+def latest_valid_step(
+    directory: str, *,
+    observer: Optional[IntegrityObserver] = None,
+    quarantine_corrupt: bool = True,
+    newer_than: Optional[int] = None,
+) -> Optional[int]:
+    """The newest checkpoint step that verifies, quarantining every newer
+    step that fails the whole-file CRC.  Returns None when the directory
+    holds no loadable checkpoint at all.
+
+    This is the restore anchor for every resilience consumer: trainer
+    auto-resume, the serving engine's initial load and hot-reload watcher,
+    and the supervisor's pre-restart sweep.
+
+    The manifest rename is the FINALIZATION BARRIER: no step above the
+    manifest's is ever chosen (skipped without even a CRC read, and never
+    quarantined).  Two realities force this: a stranded higher artifact
+    may be a partial write (a sharded save that crashed between shard
+    writes and the manifest rename), and — decisively — an intentional
+    ROLLBACK save (manifest moved to a lower step while stale higher
+    checkpoints await pruning) must not be silently undone by resuming
+    the very step the operator abandoned.  A writer that crashed after
+    the artifact but before the rename therefore costs one checkpoint
+    interval — the pre-resilience contract, traded for rollback safety.
+    Steps at or below the barrier with no integrity record (sharded/orbax
+    backends, pre-resilience npz) are presumed good.  An unreadable or
+    absent manifest drops the barrier (foreign/legacy dirs still load).
+
+    ``newer_than``: steps at or below it are returned WITHOUT paying the
+    file-CRC read — the caller is already serving/holding that step and
+    only wants to know nothing newer landed (the hot-reload watcher's
+    every-2s poll must not stream a multi-GB artifact each time)."""
+    manifest_step = -1  # lazily read: most polls never need it
+    for step in _candidate_steps(directory):
+        if newer_than is not None and step <= newer_than:
+            return step
+        if manifest_step == -1:
+            manifest_step = ckpt_lib.latest_step(directory)
+        if manifest_step is not None and step > manifest_step:
+            continue  # above the finalization barrier: never chosen
+        ok = verify_artifact(directory, step)
+        if ok is False:
+            if quarantine_corrupt:
+                quarantine(directory, step, observer=observer,
+                           reason="file CRC mismatch")
+            continue
+        return step
+    return None
+
+
+def restore_with_fallback(
+    directory: str,
+    templates: Dict[str, Any],
+    *,
+    step: Optional[int] = None,
+    per_process: Tuple[str, ...] = (),
+    observer: Optional[IntegrityObserver] = None,
+) -> Tuple[int, Dict[str, Any]]:
+    """``checkpoint.restore`` that survives corruption: with ``step=None``
+    each attempt restores the newest VALID step, and a step whose per-array
+    CRCs fail at load time (corruption landed between the scan and the
+    read) is quarantined and the next one tried.  A pinned ``step`` keeps
+    fail-loud semantics — the caller asked for those exact bytes.
+
+    Structural errors (KeyError / shape ValueError: the live pytree differs
+    from the saved one) propagate unchanged — falling back to an OLDER
+    checkpoint could not fix a code/config mismatch, only hide it."""
+    if step is not None:
+        return ckpt_lib.restore(directory, templates, step=step,
+                                per_process=per_process)
+    while True:
+        chosen = latest_valid_step(directory, observer=observer)
+        if chosen is None:
+            raise FileNotFoundError(
+                f"no valid checkpoint in {directory} (all candidates "
+                f"corrupt or absent)"
+            )
+        try:
+            return ckpt_lib.restore(directory, templates, step=chosen,
+                                    per_process=per_process)
+        except CorruptCheckpointError as e:
+            # each pass quarantines its failure, so the candidate set
+            # strictly shrinks — termination is structural
+            quarantine(directory, chosen, observer=observer, reason=str(e))

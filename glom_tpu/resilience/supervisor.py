@@ -1,0 +1,188 @@
+"""Self-healing training supervisor: restart ``fit()`` until it finishes.
+
+A production training job dies for reasons that have nothing to do with
+the model: a poisoned batch NaNs the loss, a data worker crashes, a
+filesystem hiccup kills a checkpoint read.  The supervisor converts those
+deaths into restarts governed by a policy:
+
+  * **exponential backoff with deterministic jitter** — retries never
+    hammer a struggling filesystem, and a fleet of supervised jobs never
+    thunders in sync (the jitter is seeded, so tests replay it exactly);
+  * **crash-loop detection** — ``max_failures`` failures inside a sliding
+    ``window_s`` means restarting is not helping (bad code, poisoned
+    checkpoint lineage): give up loudly with a final forensics bundle
+    instead of burning the fleet forever;
+  * **resume-from-latest-valid** — before every retry the checkpoint
+    directory is swept with :func:`~glom_tpu.resilience.integrity.
+    latest_valid_step`, quarantining torn/corrupt steps so the trainer's
+    auto-resume lands on bytes that verify;
+  * **evidence per restart** — each crash writes a ``crash_restart``
+    forensics bundle (error + traceback + attempt arithmetic), and
+    restart/giveup counters live in the shared obs registry next to the
+    trainer's own metrics.
+
+``fit_fn`` is called fresh on every attempt and must REBUILD its world
+(Trainer, data iterator) rather than reuse a possibly-poisoned one —
+recovery state flows exclusively through the checkpoint directory.  Clock,
+sleep, and jitter RNG are injectable so the backoff/crash-loop arithmetic
+is unit-testable without wall time.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+import traceback
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from glom_tpu.obs.triggers import TRIGGER_CRASH_RESTART
+from glom_tpu.resilience import integrity
+
+
+class GiveUp(RuntimeError):
+    """The crash-loop policy exhausted: restarting is not helping.  The
+    final underlying failure is the ``__cause__``."""
+
+
+@dataclass(frozen=True)
+class RestartPolicy:
+    """Restart arithmetic.  ``max_failures`` failures within the sliding
+    ``window_s`` seconds => give up.  Backoff before attempt ``k`` (0-based
+    failure count) is ``min(base * factor**k, max) * (1 ± jitter)``."""
+
+    max_failures: int = 5
+    window_s: float = 600.0
+    backoff_base_s: float = 1.0
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 60.0
+    jitter: float = 0.1
+
+    def __post_init__(self):
+        if self.max_failures < 1:
+            raise ValueError(f"max_failures must be >= 1, got {self.max_failures}")
+        if self.window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {self.window_s}")
+        if self.backoff_base_s < 0 or self.backoff_max_s < 0:
+            raise ValueError("backoff bounds must be >= 0")
+        if self.backoff_factor < 1.0:
+            raise ValueError(
+                f"backoff_factor must be >= 1, got {self.backoff_factor}"
+            )
+        if not 0.0 <= self.jitter < 1.0:
+            raise ValueError(f"jitter must be in [0, 1), got {self.jitter}")
+
+    def backoff_s(self, failure_index: int, rng: random.Random) -> float:
+        base = min(
+            self.backoff_base_s * (self.backoff_factor ** failure_index),
+            self.backoff_max_s,
+        )
+        if self.jitter:
+            base *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
+        return max(base, 0.0)
+
+
+class Supervisor:
+    """Run ``fit_fn`` under a :class:`RestartPolicy`.
+
+    ``fit_fn()`` takes no arguments and returns fit's result; it is invoked
+    fresh per attempt (see module docstring).  ``checkpoint_dir`` enables
+    the pre-restart integrity sweep; ``registry``/``forensics``/
+    ``observer`` splice into the shared obs stack.  ``clock``/``sleep``/
+    ``seed`` make every time-dependent decision injectable.
+    """
+
+    def __init__(
+        self,
+        fit_fn: Callable[[], Any],
+        *,
+        policy: Optional[RestartPolicy] = None,
+        checkpoint_dir: Optional[str] = None,
+        registry=None,
+        forensics=None,
+        observer: Optional[integrity.IntegrityObserver] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        seed: int = 0,
+    ):
+        self.fit_fn = fit_fn
+        self.policy = policy if policy is not None else RestartPolicy()
+        self.checkpoint_dir = checkpoint_dir
+        self.registry = registry
+        self.forensics = forensics
+        self.observer = observer if observer is not None else (
+            integrity.IntegrityObserver(registry=registry, forensics=forensics)
+        )
+        self._clock = clock
+        self._sleep = sleep
+        self._rng = random.Random(seed)
+        self.restarts = 0          # completed restart decisions
+        self.last_backoff_s = 0.0
+
+    # -- telemetry ---------------------------------------------------------
+    def _count(self, name: str, help: str) -> None:
+        if self.registry is not None:
+            self.registry.counter(name, help=help).inc()
+
+    def _bundle(self, step: int, detail: dict) -> None:
+        """One ``crash_restart`` bundle per restart (and one for the final
+        giveup).  Direct capture, no debounce: each restart is a distinct
+        incident and the ISSUE's contract is evidence per restart; the
+        policy's max_failures bounds the count."""
+        if self.forensics is not None:
+            self.forensics.capture(TRIGGER_CRASH_RESTART, step, detail,
+                                   trace=False)
+
+    # -- the loop ----------------------------------------------------------
+    def run(self) -> Any:
+        failures: deque = deque()
+        while True:
+            try:
+                return self.fit_fn()
+            except (KeyboardInterrupt, SystemExit):
+                raise  # operator intent, never a restartable failure
+            except Exception as e:
+                now = self._clock()
+                failures.append(now)
+                while failures and now - failures[0] > self.policy.window_s:
+                    failures.popleft()
+                n_fail = len(failures)
+                detail = {
+                    "error": f"{type(e).__name__}: {e}",
+                    "traceback": "".join(traceback.format_exception(
+                        type(e), e, e.__traceback__)),
+                    "failures_in_window": n_fail,
+                    "window_s": self.policy.window_s,
+                    "restarts_so_far": self.restarts,
+                }
+                if n_fail >= self.policy.max_failures:
+                    self._count(
+                        "supervisor_giveups",
+                        "supervised runs abandoned by crash-loop detection",
+                    )
+                    self._bundle(self.restarts, dict(detail, outcome="giveup"))
+                    raise GiveUp(
+                        f"giving up after {n_fail} failures within "
+                        f"{self.policy.window_s:.0f}s (last: "
+                        f"{type(e).__name__}: {e})"
+                    ) from e
+                self._count("supervisor_restarts",
+                            "supervised fit() restarts after a crash")
+                self._bundle(self.restarts, dict(detail, outcome="restart"))
+                if self.checkpoint_dir:
+                    # quarantine torn/corrupt steps NOW so the retry's
+                    # auto-resume anchors on the newest step that verifies
+                    integrity.latest_valid_step(
+                        self.checkpoint_dir, observer=self.observer
+                    )
+                delay = self.policy.backoff_s(self.restarts, self._rng)
+                self.last_backoff_s = delay
+                if self.registry is not None:
+                    self.registry.gauge(
+                        "supervisor_backoff_s",
+                        help="backoff slept before the most recent restart",
+                        unit="seconds",
+                    ).set(delay)
+                self.restarts += 1
+                self._sleep(delay)

@@ -72,6 +72,7 @@ from glom_tpu.obs.triggers import (
     QueueSaturationMonitor,
     TriggerEngine,
 )
+from glom_tpu.resilience import faultinject, integrity
 from glom_tpu.serving.batcher import Closed, DynamicBatcher, Overloaded  # noqa: F401
 from glom_tpu.serving.compile_cache import BucketedCompileCache
 from glom_tpu.training import denoise
@@ -163,6 +164,10 @@ class ServingEngine:
         max_queue: int = 64,
         registry: Optional[MetricRegistry] = None,
         reload_poll_s: float = 2.0,
+        reload_retries: int = 3,
+        reload_retry_base_s: float = 0.05,
+        reload_backoff_max: int = 8,
+        sleep=None,
         warmup: bool = True,
         warmup_dir: Optional[str] = None,
         forensics_dir: Optional[str] = None,
@@ -195,17 +200,30 @@ class ServingEngine:
             exporter=trace_exporter,
         )
         self._reload_poll_s = reload_poll_s
+        if reload_retries < 1:
+            raise ValueError(f"reload_retries must be >= 1, got {reload_retries}")
+        self._reload_retries = reload_retries
+        self._reload_retry_base_s = reload_retry_base_s
+        self._reload_backoff_max = max(1, reload_backoff_max)
+        self._reload_failstreak = 0
+        self._sleep = sleep if sleep is not None else time.sleep
         self._warmup_dir = warmup_dir
+        # checkpoint-integrity telemetry (triggers/forensics attached below,
+        # once they exist): corrupt artifacts found at load or reload time
+        # are quarantined, counted, and ckpt_corrupt-triggered — the engine
+        # serves the newest params that VERIFY instead of crashing
+        self._integrity_obs = integrity.IntegrityObserver(registry=self.registry)
 
-        step = ckpt_lib.latest_step(checkpoint_dir)
-        if step is None:
+        if ckpt_lib.latest_step(checkpoint_dir) is None:
             raise FileNotFoundError(
                 f"no finalized checkpoint in {checkpoint_dir!r} — the engine "
                 f"needs a manifest to serve from (train first, or "
                 f"make_demo_checkpoint for a smoke run)"
             )
         step, self.config, self.train_cfg, host_params = (
-            denoise.load_checkpoint_state(checkpoint_dir, step=step)
+            denoise.load_checkpoint_state(
+                checkpoint_dir, observer=self._integrity_obs,
+            )
         )
         # template for every later reload: restore() places leaves onto the
         # template's dtypes/shardings, so reloads land where the originals did
@@ -260,6 +278,10 @@ class ServingEngine:
                 snapshot_fn=lambda: self.caches["embed"].snapshots.get(max_bucket),
                 registry=self.registry,
             )
+        # now that triggers/forensics exist, give quarantine events the
+        # full pipeline (debounced ckpt_corrupt trigger -> bundle)
+        self._integrity_obs.triggers = self._triggers
+        self._integrity_obs.forensics = self._forensics
 
         # -- SLO burn-rate alerting (glom_tpu.obs.slo) ---------------------
         # Declarative targets ("embed:p95<250ms", "errors<1%" or SLO
@@ -385,17 +407,62 @@ class ServingEngine:
             self.tracer.exporter.close()
 
     # -- hot reload --------------------------------------------------------
+    def _reload_failure(self, what: str, e: Exception) -> None:
+        self.registry.counter(
+            "serving_reload_failures",
+            help="failed hot-reload polls/loads (engine kept old params)",
+        ).inc()
+        warnings.warn(
+            f"{what} failed ({type(e).__name__}: {e}); continuing to serve "
+            f"step {self.step}",
+            stacklevel=3,
+        )
+
+    def _poll_latest(self):
+        """One newest-valid-step poll, with the ``reload`` fault-injection
+        site threaded in front (io_error raises the way a flaky NFS/GCS
+        mount would; corrupt_manifest reads as "nothing finalized", the
+        hardened ``latest_step`` behavior)."""
+        kind = faultinject.fire("reload")
+        if kind == "io_error":
+            raise faultinject.FaultError("injected reload io_error")
+        if kind == "corrupt_manifest":
+            warnings.warn("injected corrupt reload manifest", stacklevel=2)
+            return None
+        # artifact-scan based and integrity-verified: a torn newest write
+        # is quarantined HERE and an older valid step offered instead.
+        # newer_than skips verification for the step already being served
+        # and below — the every-poll case must never stream a multi-GB
+        # artifact's CRC just to learn nothing new landed
+        return integrity.latest_valid_step(
+            self.checkpoint_dir, observer=self._integrity_obs,
+            newer_than=self.step,
+        )
+
     def check_reload(self) -> bool:
-        """One watcher poll: load + swap when a newer finalized checkpoint
-        landed.  Returns True on a successful swap.  Never raises — a
-        half-written checkpoint (skipped by the hardened ``latest_step``)
-        or a failing restore leaves the old params serving."""
-        try:
-            newest = ckpt_lib.latest_step(self.checkpoint_dir)
-        except Exception as e:  # latest_step is hardened; belt and braces
-            warnings.warn(f"reload poll failed ({type(e).__name__}: {e})",
-                          stacklevel=2)
-            return False
+        """One watcher poll: load + swap when a newer VALID checkpoint
+        landed.  Returns True on a successful swap.  Never raises — the
+        poll runs under bounded retry-with-backoff (transient I/O errors
+        are the normal weather of network filesystems), corrupt artifacts
+        are quarantined with restore falling back to the newest step that
+        verifies, and any terminal failure leaves the old params serving
+        with ``serving_reload_failures`` bumped — the watcher thread (and
+        ``/healthz``) must outlive every checkpoint-side failure."""
+        newest = None
+        for attempt in range(self._reload_retries):
+            try:
+                newest = self._poll_latest()
+                # the POLL succeeded (even if a retry was needed): the
+                # filesystem is answering, so the watcher cadence snaps
+                # back to normal regardless of whether a swap follows
+                self._reload_failstreak = 0
+                break
+            except Exception as e:
+                self._reload_failure("reload poll", e)
+                if attempt + 1 >= self._reload_retries:
+                    self._reload_failstreak += 1
+                    return False
+                self._sleep(self._reload_retry_base_s * (2 ** attempt))
         if newest is None or newest <= self.step:
             return False
         reload_span = self.tracer.start_trace(
@@ -410,13 +477,19 @@ class ServingEngine:
             # block before the swap: a reload must never make the first
             # request after it pay the H2D transfer
             jax.block_until_ready(jax.tree_util.tree_leaves(new_params)[0])
+        except ckpt_lib.CorruptCheckpointError as e:
+            # the bytes went bad between the verified poll and the read:
+            # quarantine so the next poll falls back to an older valid step
+            self.tracer.end(reload_span, attrs={"error": repr(e)})
+            integrity.quarantine(self.checkpoint_dir, newest,
+                                 observer=self._integrity_obs, reason=str(e))
+            self._reload_failure(f"hot reload of step {newest}", e)
+            self._reload_failstreak += 1
+            return False
         except Exception as e:
             self.tracer.end(reload_span, attrs={"error": repr(e)})
-            warnings.warn(
-                f"hot reload of step {newest} failed ({type(e).__name__}: "
-                f"{e}); continuing to serve step {self.step}",
-                stacklevel=2,
-            )
+            self._reload_failure(f"hot reload of step {newest}", e)
+            self._reload_failstreak += 1
             return False
         with self._lock:
             self._params = new_params
@@ -431,7 +504,15 @@ class ServingEngine:
         return True
 
     def _watch_loop(self) -> None:
-        while not self._stop.wait(self._reload_poll_s):
+        # consecutive FULLY-failed polls stretch the wait (doubling, capped
+        # at reload_backoff_max x poll): a dead filesystem is polled
+        # gently, and one answered poll snaps the cadence back to normal
+        # (check_reload owns the streak — a poll that needed a transient
+        # retry but ultimately answered resets it)
+        while not self._stop.wait(
+            self._reload_poll_s
+            * min(2 ** self._reload_failstreak, self._reload_backoff_max)
+        ):
             self.check_reload()
 
     # -- request path ------------------------------------------------------

@@ -20,9 +20,12 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from typing import Iterator, Optional
 
 import numpy as np
+
+from glom_tpu.resilience import faultinject
 
 
 def synthetic_batches(
@@ -188,39 +191,133 @@ def augmented(it, kind: str, seed: int = 0):
     return gen()
 
 
+def fault_injected(it: Iterator[np.ndarray]) -> Iterator[np.ndarray]:
+    """The ``data`` injection site (:mod:`glom_tpu.resilience.faultinject`):
+    wraps a batch iterator so an armed FaultPlan can delay, drop, or poison
+    batches — or crash the pipeline — deterministically.  Batches are
+    counted 1-based; disarmed cost is one no-op call per batch."""
+
+    def gen():
+        idx = 0
+        for batch in it:
+            idx += 1
+            kind = faultinject.fire("data", step=idx)
+            if kind == "drop_batch":
+                continue
+            if kind == "crash":
+                raise faultinject.FaultError(
+                    f"injected data-pipeline crash at batch {idx}"
+                )
+            if kind == "delay":
+                time.sleep(faultinject.uniform("data", 0.05, 0.25))
+            elif kind == "nan_batch":
+                batch = np.full_like(batch, np.nan)
+            yield batch
+
+    return gen()
+
+
 class Prefetcher:
     """Bounded background-thread prefetch of host batches (the data-loader
     overlap role; device transfer happens at dispatch inside jit).  Producer
-    exceptions are captured and re-raised on the consumer side — a pipeline
-    error must not masquerade as end-of-data."""
+    exceptions are captured and re-raised — original object, original
+    traceback — on the consumer side as soon as the queue drains to them: a
+    pipeline error must not masquerade as end-of-data.
+
+    ``close()`` (also the context-manager exit) shuts the pipeline down
+    deterministically: the worker is unblocked and joined, and an inner
+    iterator exposing ``close()`` (generators; ``ImageFolderStream``'s
+    decode pools) is closed too — nothing leaks until interpreter exit just
+    because a consumer stopped early."""
 
     def __init__(self, it: Iterator[np.ndarray], depth: int = 2):
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._it = it
         self._done = object()
         self._error: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._closed = False
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
     def _run(self):
         try:
             for item in self._it:
-                self._q.put(item)
+                # bounded-wait put: a consumer that vanished (or called
+                # close()) must not leave this thread blocked forever on a
+                # full queue
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
         except BaseException as e:  # re-raised in __next__
             self._error = e
         finally:
-            self._q.put(self._done)
+            while not self._stop.is_set():
+                try:
+                    self._q.put(self._done, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
 
     def __iter__(self):
         return self
 
     def __next__(self):
+        if self._closed:
+            raise StopIteration
         item = self._q.get()
         if item is self._done:
-            if self._error is not None:
-                raise self._error
+            err = self._error
+            if err is not None:
+                # the original exception OBJECT, carrying the worker
+                # thread's traceback — the consumer sees where the
+                # pipeline actually died, not a generic queue poisoning
+                raise err
             raise StopIteration
         return item
+
+    def close(self) -> None:
+        """Deterministic shutdown (idempotent): stop the worker, drain the
+        queue so its bounded put unblocks, join, and close the inner
+        iterator.  After close(), iteration raises StopIteration."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        while True:  # unblock a worker waiting on a full queue
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            # the worker is wedged inside next(self._it) (hung decode or
+            # network read): closing a generator mid-execution raises
+            # "generator already executing" — and from finally blocks that
+            # would mask the exception the caller actually cares about.
+            # Leave the daemon thread to die with the process.
+            import warnings
+
+            warnings.warn(
+                "Prefetcher.close(): worker did not stop within 5s; "
+                "skipping inner-iterator close",
+                stacklevel=2,
+            )
+            return
+        close = getattr(self._it, "close", None)
+        if callable(close):
+            close()
+
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 class _StatefulAugmented:
@@ -243,6 +340,11 @@ class _StatefulAugmented:
 
     def load_state_dict(self, state):
         self._inner.load_state_dict(state)
+
+    def close(self):
+        close = getattr(self._inner, "close", None)
+        if callable(close):
+            close()
 
 
 def make_batches(
@@ -271,11 +373,13 @@ def make_batches(
             prefetch=max(prefetch, 1),
         )
         # internal per-file prefetch + a resumable cursor: no Prefetcher wrap
-        # (its read-ahead would desynchronize state_dict from the consumer)
+        # (its read-ahead would desynchronize state_dict from the consumer);
+        # no fault_injected wrap either — it would break the state_dict
+        # forwarding contract (arm faults on the stateless sources instead)
         if augment == "none":
             return stream
         return _StatefulAugmented(stream, augment, seed)
     else:
         raise ValueError(f"unknown data source {kind!r}")
-    it = augmented(it, augment, seed)
+    it = fault_injected(augmented(it, augment, seed))
     return Prefetcher(it, prefetch) if prefetch > 0 else it

@@ -230,7 +230,8 @@ def make_train_step(
     )
 
 
-def load_checkpoint_state(directory: str, *, step: Optional[int] = None):
+def load_checkpoint_state(directory: str, *, step: Optional[int] = None,
+                          observer=None):
     """``(step, config, train_cfg, params)`` from a self-describing Trainer
     checkpoint dir — the FULL param tree ``{"glom": ..., "decoder": ...}``
     plus the recorded :class:`TrainConfig` (decoder arch, loss timestep /
@@ -239,6 +240,14 @@ def load_checkpoint_state(directory: str, *, step: Optional[int] = None):
     consumer (``training.extract``, the serving engine, the islands
     example) so the checkpoint layout has a single read path.
 
+    With ``step=None`` the newest checkpoint that passes integrity
+    verification is loaded: corrupt newer steps are quarantined (counted /
+    ``ckpt_corrupt``-triggered through ``observer``, an
+    :class:`~glom_tpu.resilience.integrity.IntegrityObserver`) and the
+    load falls back — a torn write can no longer take down a consumer
+    that just wants the newest servable params.  A pinned ``step`` stays
+    fail-loud.
+
     The recorded train dict is filtered to the fields THIS build knows:
     a checkpoint written by a newer build with extra knobs still loads
     (those knobs can't matter to a build that doesn't implement them)."""
@@ -246,8 +255,8 @@ def load_checkpoint_state(directory: str, *, step: Optional[int] = None):
     import json
     import os
 
-    from glom_tpu import checkpoint as ckpt_lib
     from glom_tpu.config import TrainConfig
+    from glom_tpu.resilience import integrity
 
     with open(os.path.join(directory, "config.json")) as f:
         payload = json.load(f)
@@ -265,8 +274,9 @@ def load_checkpoint_state(directory: str, *, step: Optional[int] = None):
         decoder=train_cfg.decoder,
         decoder_hidden_mult=train_cfg.decoder_hidden_mult,
     )
-    step, trees = ckpt_lib.restore(directory, {"params": template.params},
-                                   step=step)
+    step, trees = integrity.restore_with_fallback(
+        directory, {"params": template.params}, step=step, observer=observer,
+    )
     return step, config, train_cfg, trees["params"]
 
 

@@ -269,3 +269,21 @@ class ImageFolderStream:
             self._pending.append((state, get))
         _, get = self._pending.popleft()
         return get()
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        """Deterministic shutdown of the decode pools (idempotent): drops
+        read-ahead work and joins the Python pool and the native-dispatch
+        slot — the ``Prefetcher.close()`` contract, so a wrapped stream
+        tears down end to end instead of leaking executors."""
+        self._pending.clear()
+        self._pool.shutdown(wait=True, cancel_futures=True)
+        pool = getattr(self, "_native_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=True, cancel_futures=True)
+
+    def __enter__(self) -> "ImageFolderStream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -137,6 +137,21 @@ def parse_args(argv=None):
     p.add_argument("--grad-spike-factor", type=float, default=10.0,
                    help="flag a window when grad_norm exceeds this factor "
                         "times its running EMA")
+    # resilience (glom_tpu.resilience)
+    p.add_argument("--halt-on-nan", action="store_true",
+                   help="fail fast when a numerics window shows nonfinite "
+                        "grads/loss, before poisoned params can be "
+                        "checkpointed (pairs with --supervise)")
+    p.add_argument("--supervise", action="store_true",
+                   help="run fit() under the self-healing supervisor: "
+                        "crashes restart with exponential backoff from the "
+                        "newest checkpoint that passes integrity "
+                        "verification; a crash loop gives up loudly")
+    p.add_argument("--max-restart-failures", type=int, default=5,
+                   help="(--supervise) failures within the crash-loop "
+                        "window before giving up")
+    p.add_argument("--restart-window-s", type=float, default=600.0,
+                   help="(--supervise) sliding crash-loop window, seconds")
     # forensics (glom_tpu.obs.forensics): anomaly-triggered evidence capture
     p.add_argument("--forensics-dir", default=None,
                    help="write post-mortem bundles (flight-recorder ring, "
@@ -228,6 +243,7 @@ def main(argv=None):
         trace_dir=args.trace_dir,
         monitor_numerics=not args.no_monitor_numerics,
         grad_spike_factor=args.grad_spike_factor,
+        halt_on_nan=args.halt_on_nan,
         diag_every=args.diag_every,
         forensics_dir=args.forensics_dir,
         forensics_ring=args.forensics_ring,
@@ -243,13 +259,14 @@ def main(argv=None):
         param_sharding=args.param_sharding,
     )
 
+    eval_data = None
+    train_files = None
     if args.data == "images" and args.eval_every:
         # carve a held-out split BEFORE the training stream exists, so eval
         # images never enter the step function (VERDICT r1 item 6)
-        from glom_tpu.training.data import _StatefulAugmented
-        from glom_tpu.training.eval import EvalSuite, holdout_split
+        from glom_tpu.training.eval import holdout_split
         from glom_tpu.training.image_stream import (
-            ImageFolderStream, labels_from_paths, list_image_files, load_images,
+            labels_from_paths, list_image_files, load_images,
         )
 
         import numpy as np
@@ -274,33 +291,82 @@ def main(argv=None):
                     probe_l2_grid=args.probe_l2_grid,
                 )
         eval_data = (eval_imgs, probe_kwargs)
-        batches = ImageFolderStream(
-            args.data_dir, args.batch_size, args.image_size,
-            channels=config.channels, seed=args.seed, files=train_files,
-        )
-        if args.augment != "none":
-            batches = _StatefulAugmented(batches, args.augment, args.seed)
-    else:
-        eval_data = None
-        batches = make_batches(
+
+    def make_stream():
+        if train_files is not None:
+            from glom_tpu.training.data import _StatefulAugmented
+            from glom_tpu.training.image_stream import ImageFolderStream
+
+            stream = ImageFolderStream(
+                args.data_dir, args.batch_size, args.image_size,
+                channels=config.channels, seed=args.seed, files=train_files,
+            )
+            if args.augment != "none":
+                stream = _StatefulAugmented(stream, args.augment, args.seed)
+            return stream
+        return make_batches(
             args.data, args.batch_size, args.image_size,
             config.channels, args.seed, args.data_dir,
             augment=args.augment,
         )
-    trainer = Trainer(config, train_cfg, logger=MetricLogger(path=args.log_file))
-    if eval_data is not None:
-        # built after the Trainer so the suite shares its mesh-bound
-        # consensus/FF implementations (ring/ulysses/sharded-pallas)
-        eval_imgs, probe_kwargs = eval_data
-        trainer.set_eval_suite(EvalSuite(
-            config, eval_imgs, noise_std=args.noise_std, iters=args.iters,
-            timestep=args.loss_timestep,  # PSNR scores the trained state
-            chunk=min(args.batch_size, len(eval_imgs)),
-            consensus_fn=trainer._consensus_fn, ff_fn=trainer._ff_fn,
-            decoder=args.decoder,
-            **probe_kwargs,
-        ))
-    final = trainer.fit(batches)
+
+    def run_once():
+        # rebuilt fresh per (supervised) attempt: a crashed attempt's
+        # trainer/state/iterator may be poisoned — recovery state flows
+        # only through the checkpoint directory
+        trainer = Trainer(config, train_cfg, logger=MetricLogger(path=args.log_file))
+        if eval_data is not None:
+            # built after the Trainer so the suite shares its mesh-bound
+            # consensus/FF implementations (ring/ulysses/sharded-pallas)
+            from glom_tpu.training.eval import EvalSuite
+
+            eval_imgs, probe_kwargs = eval_data
+            trainer.set_eval_suite(EvalSuite(
+                config, eval_imgs, noise_std=args.noise_std, iters=args.iters,
+                timestep=args.loss_timestep,  # PSNR scores the trained state
+                chunk=min(args.batch_size, len(eval_imgs)),
+                consensus_fn=trainer._consensus_fn, ff_fn=trainer._ff_fn,
+                decoder=args.decoder,
+                **probe_kwargs,
+            ))
+        batches = make_stream()
+        try:
+            return trainer.fit(batches)
+        finally:
+            close = getattr(batches, "close", None)
+            if callable(close):
+                close()
+
+    if args.supervise:
+        from glom_tpu.obs import MetricRegistry
+        from glom_tpu.resilience.supervisor import RestartPolicy, Supervisor
+
+        # the supervisor outlives every per-attempt Trainer (each attempt
+        # rebuilds its own registry/forensics), so it gets its own: restart
+        # counters land in each crash_restart bundle's metrics.json
+        sup_registry = MetricRegistry()
+        sup_forensics = None
+        if args.forensics_dir:
+            from glom_tpu.obs import ForensicsManager
+
+            sup_forensics = ForensicsManager(
+                args.forensics_dir, registry=sup_registry,
+                config={"glom": config.to_json_dict(),
+                        "train": train_cfg.to_json_dict()},
+            )
+        final = Supervisor(
+            run_once,
+            policy=RestartPolicy(
+                max_failures=args.max_restart_failures,
+                window_s=args.restart_window_s,
+            ),
+            checkpoint_dir=args.checkpoint_dir,
+            registry=sup_registry,
+            forensics=sup_forensics,
+            seed=args.seed,
+        ).run()
+    else:
+        final = run_once()
     if jax.process_index() == 0:
         print({"final": final})
 

@@ -44,9 +44,19 @@ from glom_tpu.obs.triggers import (
 )
 from glom_tpu.parallel.mesh import make_mesh
 from glom_tpu.parallel.placement import state_shardings
+from glom_tpu.resilience import integrity
 from glom_tpu.parallel.sharding import batch_pspec, param_pspecs
 from glom_tpu.training import denoise
 from glom_tpu.training.metrics import MetricLogger
+
+
+class NonFiniteError(RuntimeError):
+    """Raised (with ``TrainConfig.halt_on_nan``) when a numerics window
+    shows nonfinite grads/loss: continuing would train on poisoned
+    parameters and eventually CHECKPOINT them, destroying the resume
+    lineage.  Failing fast here is what lets a supervisor
+    (:mod:`glom_tpu.resilience.supervisor`) restart from the last clean
+    checkpoint."""
 
 
 def _decoder_specs(arch: str = "linear") -> dict:
@@ -351,6 +361,14 @@ class Trainer:
                 self._steptime_mon = StepTimeRegressionMonitor(
                     factor=train.forensics_step_time_factor
                 )
+        # checkpoint-integrity telemetry (glom_tpu.resilience.integrity):
+        # quarantines found during resume bump ckpt_corrupt_total and fire
+        # the debounced ckpt_corrupt trigger into a forensics bundle
+        self._integrity_obs = integrity.IntegrityObserver(
+            registry=self.registry, triggers=self._triggers,
+            forensics=self._forensics,
+        )
+
         self._diag = None
         if train.diag_every:
             from glom_tpu.obs import make_diagnostics_fn
@@ -609,13 +627,20 @@ class Trainer:
             backend=self.train_cfg.checkpoint_backend,
         )
 
-    def restore(self, directory: str, *, batches=None) -> int:
+    def restore(self, directory: str, *, batches=None,
+                step: Optional[int] = None) -> int:
         """Restore params, optimizer state AND the training RNG, so a resumed
         run continues the noise-key sequence instead of replaying it.  When
         ``batches`` exposes ``state_dict``/``load_state_dict`` (the
         ``ImageFolderStream`` contract) its cursor is restored too, so the
         stream resumes on the exact next batch; stateless synthetic/folder
         streams are unaffected.
+
+        With ``step=None`` the newest checkpoint that passes integrity
+        verification is restored — corrupt newer steps are quarantined
+        (``*.corrupt``, counted, ``ckpt_corrupt``-triggered) and the
+        restore falls back, so a torn write costs one checkpoint interval,
+        not the run.  A pinned ``step`` keeps fail-loud semantics.
 
         If the directory carries a ``config.json`` (written by save), its
         MODEL config must match this trainer's — loading weights into a
@@ -624,9 +649,10 @@ class Trainer:
         config is informational only (it may legitimately change)."""
         self.finish_saves()  # never read past an in-flight write
         self._validate_config_json(directory)
-        step, trees = ckpt_lib.restore(
+        step, trees = integrity.restore_with_fallback(
             directory,
             {"params": self.state.params, "opt": self.state.opt_state, "rng": self.state.rng},
+            step=step, observer=self._integrity_obs,
         )
         self.state = denoise.DenoiseState(
             trees["params"], trees["opt"], jnp.asarray(step, jnp.int32), trees["rng"]
@@ -713,12 +739,29 @@ class Trainer:
             )
         stateful_stream = hasattr(batches, "state_dict")
         # strict: a garbled manifest must abort the resume, not silently
-        # restart from step 0 (the lenient form is for the serving watcher)
+        # restart from step 0 (the lenient form is for the serving watcher).
+        # The resume ANCHOR, though, is the newest step that verifies —
+        # not the manifest's raw latest_step, which may name a torn write.
         if cfg.checkpoint_dir and ckpt_lib.latest_step(
             cfg.checkpoint_dir, strict=True
         ) is not None:
-            resumed = self.restore(cfg.checkpoint_dir, batches=batches)
-            self._log(resumed, event=EVENT_RESUME)
+            resume_step = integrity.latest_valid_step(
+                cfg.checkpoint_dir, observer=self._integrity_obs
+            )
+            if resume_step is not None:
+                resumed = self.restore(
+                    cfg.checkpoint_dir, batches=batches, step=resume_step
+                )
+                self._log(resumed, event=EVENT_RESUME)
+            else:
+                import warnings
+
+                warnings.warn(
+                    f"every checkpoint in {cfg.checkpoint_dir} failed "
+                    f"integrity verification and was quarantined — "
+                    f"training restarts from step 0",
+                    stacklevel=2,
+                )
 
         # Preemption safety (TPU pods get SIGTERM'd): convert the signal to
         # a flag, finish the in-flight step, checkpoint, and return cleanly —
@@ -804,6 +847,18 @@ class Trainer:
                 "nonfinite_grads": num["nonfinite_grads"],
                 "loss_nonfinite_steps": num["loss_nonfinite_steps"],
             })
+            if self.train_cfg.halt_on_nan:
+                # fail fast BEFORE this iteration's checkpoint phase: the
+                # poisoned params must never enter the resume lineage.
+                # Detection is window-granular, so keep log_every at or
+                # below checkpoint_every for an airtight guarantee.
+                raise NonFiniteError(
+                    f"nonfinite grads/loss detected at step {step} "
+                    f"(nonfinite_grads={num['nonfinite_grads']}, "
+                    f"loss_nonfinite_steps={num['loss_nonfinite_steps']}); "
+                    f"halting so a supervisor can resume from the last "
+                    f"clean checkpoint"
+                )
         return num
 
     def _log_window(self, step, timer, window_metrics, window_imgs, cfg):

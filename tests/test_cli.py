@@ -49,6 +49,8 @@ def test_every_train_config_field_has_a_cli_path():
         "forensics_hlo", "forensics_step_time_factor",
         # tracing (--trace-dir)
         "trace_dir",
+        # resilience (--halt-on-nan; --supervise wraps fit, no field)
+        "halt_on_nan",
     }
     # fields intentionally config-only (documented, no flag yet)
     config_only = {"loss_level", "mesh_axes", "donate"}

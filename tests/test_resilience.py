@@ -1,0 +1,765 @@
+"""Resilience subsystem: fault injection, checkpoint integrity, supervisor.
+
+Covers the PR-5 acceptance surface on CPU:
+  * deterministic FaultPlan replay (same spec + seed => same faults);
+  * per-array integrity records, quarantine, newest-valid fallback, and
+    the exactly-once (debounced) ``ckpt_corrupt`` trigger;
+  * prune never deleting the newest VERIFIED checkpoint;
+  * supervisor backoff / crash-loop arithmetic with an injectable clock;
+  * the serving engine surviving corrupt checkpoints and flaky reload
+    polls (``serving_reload_failures``);
+  * Prefetcher close()/context-manager lifecycle and worker-exception
+    re-raise;
+  * ``tools/chaos.py --smoke`` as a tier-1 subprocess gate.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+from glom_tpu import checkpoint as ckpt_lib
+from glom_tpu.obs import MetricRegistry
+from glom_tpu.obs.forensics import ForensicsManager
+from glom_tpu.obs.triggers import TriggerEngine
+from glom_tpu.resilience import faultinject, integrity
+from glom_tpu.resilience.supervisor import GiveUp, RestartPolicy, Supervisor
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TREES = {"params": {"w": np.arange(12.0).reshape(3, 4), "b": np.ones(3)}}
+
+
+def _template():
+    return {"params": {"w": np.zeros((3, 4)), "b": np.zeros(3)}}
+
+
+# -- FaultPlan -------------------------------------------------------------
+
+class TestFaultPlan:
+    def test_parse_spec_forms(self):
+        p = faultinject.FaultPlan.parse(
+            "ckpt_write:torn@step120; data:nan_batch@37; reload:io_error*3;"
+            " data:delay@5*2"
+        )
+        specs = [f.spec() for f in p.faults]
+        assert specs == ["ckpt_write:torn@120", "data:nan_batch@37",
+                         "reload:io_error*3", "data:delay@5*2"]
+
+    @pytest.mark.parametrize("bad", [
+        "nope:torn", "ckpt_write:bogus", "ckpt_write", "data:nan_batch@x",
+    ])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            faultinject.FaultPlan.parse(bad)
+
+    def test_deterministic_replay(self):
+        spec = "reload:io_error*2; data:nan_batch@3; data:drop_batch@5"
+
+        def drive(plan):
+            out = []
+            for i in range(1, 7):
+                out.append((plan.fire("reload"), plan.fire("data", step=i),
+                            round(plan.uniform("data", 0.0, 1.0), 9)))
+            return out
+
+        a = drive(faultinject.FaultPlan.parse(spec, seed=11))
+        b = drive(faultinject.FaultPlan.parse(spec, seed=11))
+        assert a == b
+        kinds = [d for _, d, _ in a]
+        assert kinds == [None, None, "nan_batch", None, "drop_batch", None]
+        assert [r for r, _, _ in a] == ["io_error", "io_error", None,
+                                        None, None, None]
+        # a different seed changes parameters, never the fault schedule
+        c = drive(faultinject.FaultPlan.parse(spec, seed=12))
+        assert [x[:2] for x in c] == [x[:2] for x in a]
+        assert [x[2] for x in c] != [x[2] for x in a]
+
+    def test_default_fires_once_on_first_occurrence(self):
+        p = faultinject.FaultPlan.parse("data:crash")
+        assert p.fire("data") == "crash"
+        assert p.fire("data") is None
+
+    def test_disarmed_fire_is_none_and_scoped_arming(self):
+        assert faultinject.fire("data") is None
+        with faultinject.injected("data:nan_batch@1"):
+            assert faultinject.armed()
+            assert faultinject.fire("data", step=1) == "nan_batch"
+        assert not faultinject.armed()
+        assert faultinject.fire("data", step=1) is None
+
+    def test_injected_disarms_on_error(self):
+        with pytest.raises(RuntimeError):
+            with faultinject.injected("data:crash"):
+                raise RuntimeError("boom")
+        assert not faultinject.armed()
+
+
+# -- checkpoint integrity --------------------------------------------------
+
+class TestIntegrity:
+    def test_save_writes_record_and_restore_verifies(self, tmp_path):
+        d = str(tmp_path)
+        ckpt_lib.save(d, 3, TREES)
+        rec = ckpt_lib.read_integrity(d, 3)
+        assert rec["algo"] == "crc32"
+        assert set(rec["arrays"]) == {"params/w", "params/b"}
+        assert ckpt_lib.verify_file_integrity(d, 3) is True
+        step, out = ckpt_lib.restore(d, _template())
+        assert step == 3
+        np.testing.assert_array_equal(out["params"]["w"], TREES["params"]["w"])
+
+    def test_bitflip_detected_at_restore(self, tmp_path):
+        d = str(tmp_path)
+        ckpt_lib.save(d, 1, TREES)
+        path = ckpt_lib.npz_path(d, 1)
+        with open(path, "r+b") as f:
+            f.seek(os.path.getsize(path) // 2)
+            b = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(ckpt_lib.CorruptCheckpointError):
+            ckpt_lib.restore(d, _template())
+
+    def test_truncation_detected_at_restore(self, tmp_path):
+        d = str(tmp_path)
+        ckpt_lib.save(d, 1, TREES)
+        path = ckpt_lib.npz_path(d, 1)
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) // 2)
+        assert ckpt_lib.verify_file_integrity(d, 1) is False
+        with pytest.raises(ckpt_lib.CorruptCheckpointError):
+            ckpt_lib.restore(d, _template())
+
+    def test_no_record_loads_unverified(self, tmp_path):
+        d = str(tmp_path)
+        ckpt_lib.save(d, 2, TREES)
+        os.remove(ckpt_lib.integrity_path(d, 2))  # legacy checkpoint
+        assert ckpt_lib.verify_file_integrity(d, 2) is None
+        step, _ = ckpt_lib.restore(d, _template())
+        assert step == 2
+
+    def test_quarantine_renames_and_fallback(self, tmp_path):
+        d = str(tmp_path)
+        ckpt_lib.save(d, 1, TREES)
+        ckpt_lib.save(d, 2, TREES)
+        path = ckpt_lib.npz_path(d, 2)
+        with open(path, "r+b") as f:
+            f.truncate(10)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert integrity.latest_valid_step(d) == 1
+        assert os.path.exists(path + ".corrupt")
+        assert os.path.exists(ckpt_lib.integrity_path(d, 2) + ".corrupt")
+        assert not os.path.exists(path)
+        # idempotent: a second scan has nothing left to quarantine
+        assert integrity.latest_valid_step(d) == 1
+        step, out = integrity.restore_with_fallback(d, _template())
+        assert step == 1
+        np.testing.assert_array_equal(out["params"]["b"], TREES["params"]["b"])
+
+    def test_all_corrupt_raises_filenotfound(self, tmp_path):
+        d = str(tmp_path)
+        ckpt_lib.save(d, 1, TREES)
+        with open(ckpt_lib.npz_path(d, 1), "r+b") as f:
+            f.truncate(8)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with pytest.raises(FileNotFoundError):
+                integrity.restore_with_fallback(d, _template())
+
+    def test_observer_counts_and_debounces_trigger(self, tmp_path):
+        d = str(tmp_path)
+        froot = str(tmp_path / "forensics")
+        registry = MetricRegistry()
+        triggers = TriggerEngine(debounce_steps=200, max_captures=5,
+                                 registry=registry)
+        forensics = ForensicsManager(froot, registry=registry)
+        obs = integrity.IntegrityObserver(registry=registry, triggers=triggers,
+                                          forensics=forensics)
+        for s in (1, 2, 3):
+            ckpt_lib.save(d, s, TREES, keep=0)
+        for s in (2, 3):
+            with open(ckpt_lib.npz_path(d, s), "r+b") as f:
+                f.truncate(10)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert integrity.latest_valid_step(d, observer=obs) == 1
+        # two quarantines, ONE debounced ckpt_corrupt bundle
+        assert registry.snapshot()["ckpt_corrupt_total"] == 2
+        bundles = [b for b in os.listdir(froot)
+                   if b.startswith("ckpt_corrupt-")]
+        assert len(bundles) == 1
+
+    def test_fault_injected_torn_write_roundtrip(self, tmp_path):
+        d = str(tmp_path)
+        ckpt_lib.save(d, 1, TREES)
+        with faultinject.injected("ckpt_write:torn@step2"):
+            ckpt_lib.save(d, 2, TREES)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            step, _ = integrity.restore_with_fallback(d, _template())
+        assert step == 1
+
+    def test_fault_injected_bitflip_write(self, tmp_path):
+        d = str(tmp_path)
+        with faultinject.injected("ckpt_write:bitflip@step1", seed=3):
+            ckpt_lib.save(d, 1, TREES)
+        assert ckpt_lib.verify_file_integrity(d, 1) is False
+
+    def test_stranded_partial_shards_above_manifest_skipped(self, tmp_path):
+        """A sharded save that crashed between shard writes and the
+        manifest rename strands unverifiable artifacts ABOVE the
+        finalized step; they must be skipped (not chosen, not
+        quarantined) so auto-resume anchors on the manifest step."""
+        d = str(tmp_path)
+        ckpt_lib.save(d, 3, TREES)  # finalized: manifest points at 3
+        # stranded partial shard set at step 4 (1 of 2 shards, no sidecar)
+        stranded = os.path.join(d, "ckpt_4.shard0of2.npz")
+        np.savez(stranded, **{"params/w": np.zeros(2)})
+        assert integrity.latest_valid_step(d) == 3
+        assert os.path.exists(stranded)  # skipped, NOT quarantined
+
+    def test_rollback_manifest_is_the_finalization_barrier(self, tmp_path):
+        """An intentional rollback (manifest moved to a LOWER step while
+        stale higher checkpoints await pruning) must anchor resume on the
+        manifest step — even though the stale higher artifacts verify.
+        Choosing them would silently undo the operator's rollback."""
+        d = str(tmp_path)
+        for s in (80, 90):
+            ckpt_lib.save(d, s, TREES, keep=10)
+        ckpt_lib.save(d, 50, TREES, keep=10)  # rollback: manifest -> 50
+        assert ckpt_lib.latest_step(d) == 50
+        assert integrity.latest_valid_step(d) == 50
+        # the stale steps are skipped, never quarantined (they are legit)
+        assert os.path.exists(ckpt_lib.npz_path(d, 90))
+        step, _ = integrity.restore_with_fallback(d, _template())
+        assert step == 50
+
+    def test_newer_than_short_circuits_without_crc_read(self, tmp_path,
+                                                        monkeypatch):
+        d = str(tmp_path)
+        ckpt_lib.save(d, 3, TREES)
+        reads = []
+        real = ckpt_lib._file_crc
+        monkeypatch.setattr(ckpt_lib, "_file_crc",
+                            lambda p: reads.append(p) or real(p))
+        # the watcher's idle poll: the newest step is already being served
+        assert integrity.latest_valid_step(d, newer_than=3) == 3
+        assert reads == []  # no artifact bytes were streamed
+        # a NEW step must still be verified
+        ckpt_lib.save(d, 4, TREES)
+        reads.clear()
+        assert integrity.latest_valid_step(d, newer_than=3) == 4
+        assert len(reads) == 1
+
+
+class TestPruneProtection:
+    def test_prune_keeps_newest_verified_over_raw_step_order(self, tmp_path):
+        d = str(tmp_path)
+        for s in (1, 2, 3, 4):
+            ckpt_lib.save(d, s, TREES, keep=10)
+        for s in (3, 4):  # newer steps silently corrupt, not yet quarantined
+            with open(ckpt_lib.npz_path(d, s), "r+b") as f:
+                f.truncate(10)
+        with faultinject.injected("ckpt_write:torn@step5"):
+            ckpt_lib.save(d, 5, TREES, keep=1)  # own write torn too
+        names = {f for f in os.listdir(d) if f.endswith(".npz")}
+        # raw-step keep=1 would leave only the torn step 5; the newest
+        # VERIFIED step (2) must survive
+        assert "ckpt_2.npz" in names
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert integrity.latest_valid_step(d) == 2
+
+    def test_prune_removes_orphaned_records(self, tmp_path):
+        d = str(tmp_path)
+        for s in (1, 2, 3, 4):
+            ckpt_lib.save(d, s, TREES, keep=2)
+        records = {f for f in os.listdir(d) if f.endswith(".integrity.json")}
+        assert records == {"ckpt_3.integrity.json", "ckpt_4.integrity.json"}
+
+    def test_normal_prune_unchanged(self, tmp_path):
+        d = str(tmp_path)
+        for s in (1, 2, 3, 4, 5):
+            ckpt_lib.save(d, s, TREES, keep=3)
+        steps = sorted(ckpt_lib._step_of(f) for f in os.listdir(d)
+                       if f.endswith(".npz"))
+        assert steps == [3, 4, 5]
+
+
+# -- supervisor ------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.t += s
+
+
+class TestSupervisor:
+    def test_restarts_until_success(self):
+        clock = FakeClock()
+        registry = MetricRegistry()
+        calls = []
+
+        def fit_fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError(f"crash {len(calls)}")
+            return {"ok": True}
+
+        sup = Supervisor(fit_fn, registry=registry,
+                         policy=RestartPolicy(max_failures=5, jitter=0.0),
+                         clock=clock, sleep=clock.sleep)
+        assert sup.run() == {"ok": True}
+        assert sup.restarts == 2
+        assert registry.snapshot()["supervisor_restarts"] == 2
+        # exponential backoff: base 1.0, factor 2.0
+        assert clock.sleeps == [1.0, 2.0]
+
+    def test_backoff_jitter_seeded_and_capped(self):
+        import random
+
+        policy = RestartPolicy(backoff_base_s=1.0, backoff_factor=2.0,
+                               backoff_max_s=8.0, jitter=0.5)
+        a = [policy.backoff_s(k, random.Random(9)) for k in range(6)]
+        b = [policy.backoff_s(k, random.Random(9)) for k in range(6)]
+        assert a == b  # deterministic under a seeded rng
+        assert all(x <= 8.0 * 1.5 for x in a)
+        assert policy.backoff_s(10, random.Random(0)) <= 8.0 * 1.5
+
+    def test_crash_loop_gives_up_within_window(self):
+        clock = FakeClock()
+        registry = MetricRegistry()
+
+        def fit_fn():
+            raise ValueError("always")
+
+        sup = Supervisor(fit_fn, registry=registry,
+                         policy=RestartPolicy(max_failures=3, window_s=1000.0,
+                                              jitter=0.0),
+                         clock=clock, sleep=clock.sleep)
+        with pytest.raises(GiveUp) as ei:
+            sup.run()
+        assert isinstance(ei.value.__cause__, ValueError)
+        snap = registry.snapshot()
+        assert snap["supervisor_giveups"] == 1
+        assert snap["supervisor_restarts"] == 2  # 3 failures, 2 restarts
+
+    def test_old_failures_age_out_of_window(self):
+        clock = FakeClock()
+        calls = []
+
+        def fit_fn():
+            calls.append(1)
+            if len(calls) <= 4:
+                clock.t += 100.0  # each attempt runs "100s" before dying
+                raise ValueError(f"crash {len(calls)}")
+            return "done"
+
+        # window shorter than two failure spacings: the loop never holds
+        # 3 failures at once, so 4 crashes still end in success
+        sup = Supervisor(fit_fn,
+                         policy=RestartPolicy(max_failures=3, window_s=150.0,
+                                              jitter=0.0, backoff_base_s=0.0),
+                         clock=clock, sleep=clock.sleep)
+        assert sup.run() == "done"
+        assert sup.restarts == 4
+
+    def test_bundle_per_restart_and_giveup(self, tmp_path):
+        forensics = ForensicsManager(str(tmp_path))
+
+        def fit_fn():
+            raise RuntimeError("die")
+
+        clock = FakeClock()
+        sup = Supervisor(fit_fn, forensics=forensics,
+                         policy=RestartPolicy(max_failures=2, jitter=0.0),
+                         clock=clock, sleep=clock.sleep)
+        with pytest.raises(GiveUp):
+            sup.run()
+        bundles = sorted(b for b in os.listdir(str(tmp_path))
+                         if b.startswith("crash_restart-"))
+        assert len(bundles) == 2  # one restart bundle + one giveup bundle
+        outcomes = set()
+        for b in bundles:
+            with open(os.path.join(str(tmp_path), b, "manifest.json")) as f:
+                m = json.load(f)
+            outcomes.add(m["detail"]["outcome"])
+            assert "RuntimeError: die" in m["detail"]["error"]
+        assert outcomes == {"restart", "giveup"}
+
+    def test_pre_restart_sweep_quarantines(self, tmp_path):
+        d = str(tmp_path)
+        ckpt_lib.save(d, 1, TREES)
+        ckpt_lib.save(d, 2, TREES)
+        with open(ckpt_lib.npz_path(d, 2), "r+b") as f:
+            f.truncate(10)
+        calls = []
+
+        def fit_fn():
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError("crash")
+            return integrity.latest_valid_step(d)
+
+        clock = FakeClock()
+        sup = Supervisor(fit_fn, checkpoint_dir=d,
+                         policy=RestartPolicy(jitter=0.0),
+                         clock=clock, sleep=clock.sleep)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            resumed_step = sup.run()
+        assert resumed_step == 1  # the torn step was quarantined pre-retry
+        assert os.path.exists(ckpt_lib.npz_path(d, 2) + ".corrupt")
+
+    def test_keyboard_interrupt_not_restarted(self):
+        def fit_fn():
+            raise KeyboardInterrupt
+
+        sup = Supervisor(fit_fn, clock=FakeClock(), sleep=lambda s: None)
+        with pytest.raises(KeyboardInterrupt):
+            sup.run()
+        assert sup.restarts == 0
+
+
+# -- data pipeline ---------------------------------------------------------
+
+class TestPrefetcherLifecycle:
+    def test_close_joins_worker_and_inner(self):
+        import itertools
+
+        from glom_tpu.training.data import Prefetcher
+
+        closed = []
+
+        class Inner:
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                return np.zeros(2)
+
+            def close(self):
+                closed.append(True)
+
+        pf = Prefetcher(Inner(), depth=2)
+        next(pf)
+        pf.close()
+        assert not pf._thread.is_alive()
+        assert closed == [True]
+        with pytest.raises(StopIteration):
+            next(pf)
+        pf.close()  # idempotent
+
+    def test_context_manager(self):
+        import itertools
+
+        from glom_tpu.training.data import Prefetcher
+
+        gen = (np.zeros(1) for _ in itertools.count())
+        with Prefetcher(gen, depth=2) as pf:
+            next(pf)
+        assert not pf._thread.is_alive()
+
+    def test_worker_exception_reraised_with_traceback(self):
+        from glom_tpu.training.data import Prefetcher
+
+        def boom():
+            yield np.zeros(1)
+            raise ValueError("inner-boom")
+
+        pf = Prefetcher(boom(), depth=1)
+        next(pf)
+        with pytest.raises(ValueError, match="inner-boom") as ei:
+            next(pf)
+        # the worker thread's frames survive on the re-raised object
+        import traceback
+
+        tb = "".join(traceback.format_tb(ei.value.__traceback__))
+        assert "boom" in tb
+
+    def test_nan_batch_fault_wraps_make_batches(self):
+        from glom_tpu.training.data import make_batches
+
+        with faultinject.injected("data:nan_batch@2"):
+            it = make_batches("synthetic", 2, 8, 3, seed=0, prefetch=0)
+            first = next(it)
+            second = next(it)
+            third = next(it)
+        assert np.isfinite(first).all()
+        assert np.isnan(second).all()
+        assert np.isfinite(third).all()
+
+    def test_drop_and_crash_faults(self):
+        from glom_tpu.training.data import fault_injected, synthetic_batches
+
+        with faultinject.injected("data:drop_batch@1; data:crash@3"):
+            it = fault_injected(synthetic_batches(2, 8))
+            next(it)  # batch 2 (batch 1 dropped)
+            with pytest.raises(faultinject.FaultError):
+                next(it)  # batch 3 crashes
+
+
+# -- serving engine resilience --------------------------------------------
+
+@pytest.fixture(scope="module")
+def demo_dir(tmp_path_factory):
+    from glom_tpu.serving.engine import make_demo_checkpoint
+
+    d = str(tmp_path_factory.mktemp("serve_ckpt"))
+    make_demo_checkpoint(d)
+    return d
+
+
+def _engine(directory, **kw):
+    from glom_tpu.serving.engine import ServingEngine
+
+    kw.setdefault("buckets", (1,))
+    kw.setdefault("warmup", False)
+    kw.setdefault("reload_poll_s", 0)
+    kw.setdefault("sleep", lambda s: None)
+    return ServingEngine(directory, **kw)
+
+
+class TestEngineResilience:
+    def test_reload_io_error_bounded_retry_and_counter(self, demo_dir):
+        eng = _engine(demo_dir)
+        with faultinject.injected("reload:io_error*2"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                # 2 faults < 3 retries: the poll succeeds within one call
+                assert eng.check_reload() is False  # no newer step, though
+        assert eng.registry.snapshot()["serving_reload_failures"] == 2
+        assert eng.health()["status"] == "ok"
+
+    def test_reload_exhausted_retries_keeps_serving(self, demo_dir):
+        eng = _engine(demo_dir)
+        with faultinject.injected("reload:io_error*5"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                assert eng.check_reload() is False  # 3 of 5 burned
+                assert eng.check_reload() is False  # last 2 + one success
+        assert eng.registry.snapshot()["serving_reload_failures"] == 5
+        assert eng.health()["status"] == "ok"
+
+    def test_failstreak_resets_when_poll_answers_after_retry(self, demo_dir):
+        """A transient first-attempt blip whose retry succeeds must NOT
+        stretch the watcher cadence: check_reload owns the streak and
+        resets it the moment a poll answers."""
+        eng = _engine(demo_dir)
+        with faultinject.injected("reload:io_error"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                assert eng.check_reload() is False  # retry answered: no-op
+        assert eng.registry.snapshot()["serving_reload_failures"] == 1
+        assert eng._reload_failstreak == 0  # cadence stays normal
+
+    def test_failstreak_grows_only_on_fully_failed_polls(self, demo_dir):
+        eng = _engine(demo_dir)
+        with faultinject.injected("reload:io_error*5"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                assert eng.check_reload() is False  # all 3 attempts fail
+                assert eng._reload_failstreak == 1
+                assert eng.check_reload() is False  # 2 fail, 3rd answers
+        assert eng._reload_failstreak == 0
+
+    def test_corrupt_manifest_fault_reads_as_no_checkpoint(self, demo_dir):
+        eng = _engine(demo_dir)
+        with faultinject.injected("reload:corrupt_manifest"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                assert eng.check_reload() is False
+        assert eng.health()["status"] == "ok"
+
+    def test_engine_survives_corrupt_newer_checkpoint(self, tmp_path):
+        import jax
+
+        from glom_tpu.serving.engine import make_demo_checkpoint
+
+        d = str(tmp_path)
+        make_demo_checkpoint(d)
+        eng = _engine(d)
+        params = jax.device_get(eng._template)
+        # a newer step lands torn: the watcher must quarantine it, keep
+        # serving step 0, and stay alive for the NEXT (good) checkpoint
+        with faultinject.injected("ckpt_write:torn@step1"):
+            ckpt_lib.save(d, 1, {"params": params})
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert eng.check_reload() is False
+        assert eng.step == 0
+        assert eng.health()["status"] == "ok"
+        snap = eng.registry.snapshot()
+        assert snap["ckpt_corrupt_total"] == 1
+        ckpt_lib.save(d, 2, {"params": params})
+        assert eng.check_reload() is True
+        assert eng.step == 2
+        assert eng.health()["status"] == "ok"
+
+    def test_initial_load_falls_back_over_corrupt_newest(self, tmp_path):
+        import jax
+
+        from glom_tpu.serving.engine import make_demo_checkpoint
+
+        d = str(tmp_path)
+        make_demo_checkpoint(d)
+        eng0 = _engine(d)
+        params = jax.device_get(eng0._template)
+        with faultinject.injected("ckpt_write:torn@step7"):
+            ckpt_lib.save(d, 7, {"params": params})
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            eng = _engine(d)
+        assert eng.step == 0  # fell back past the torn step 7
+        assert eng.registry.snapshot()["ckpt_corrupt_total"] == 1
+
+
+# -- trainer integration ---------------------------------------------------
+
+def _tiny_cfgs(tmp_path, steps, **kw):
+    from glom_tpu.config import GlomConfig, TrainConfig
+
+    glom = GlomConfig(dim=8, levels=2, image_size=8, patch_size=4)
+    train = TrainConfig(
+        batch_size=8, steps=steps, log_every=1, checkpoint_every=1,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        forensics_hlo=False, forensics_step_time_factor=0.0, **kw,
+    )
+    return glom, train
+
+
+def _fit(glom, train, steps=None):
+    import io
+
+    import jax
+
+    from glom_tpu.training.data import make_batches
+    from glom_tpu.training.metrics import MetricLogger
+    from glom_tpu.training.trainer import Trainer
+
+    trainer = Trainer(glom, train, logger=MetricLogger(stream=io.StringIO()))
+    batches = make_batches("synthetic", train.batch_size, glom.image_size,
+                           glom.channels, seed=0)
+    try:
+        trainer.fit(batches, steps=steps)
+    finally:
+        batches.close()
+    return trainer, int(jax.device_get(trainer.state.step))
+
+
+class TestTrainerResilience:
+    def test_resume_falls_back_over_torn_final_save(self, tmp_path):
+        glom, train = _tiny_cfgs(tmp_path, 2,
+                                 forensics_dir=str(tmp_path / "forensics"))
+        with faultinject.injected("ckpt_write:torn@step2"):
+            _fit(glom, train)
+        glom, train = _tiny_cfgs(tmp_path, 4,
+                                 forensics_dir=str(tmp_path / "forensics"))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            trainer, final = _fit(glom, train)
+        assert final == 4
+        snap = trainer.registry.snapshot()
+        assert snap["ckpt_corrupt_total"] == 1
+        bundles = [b for b in os.listdir(str(tmp_path / "forensics"))
+                   if b.startswith("ckpt_corrupt-")]
+        assert len(bundles) == 1  # debounced: exactly one
+        assert any(f.endswith(".corrupt")
+                   for f in os.listdir(str(tmp_path / "ckpt")))
+
+    def test_halt_on_nan_raises_before_checkpointing_poison(self, tmp_path):
+        from glom_tpu.training.trainer import NonFiniteError
+
+        glom, train = _tiny_cfgs(tmp_path, 6, halt_on_nan=True)
+        with faultinject.injected("data:nan_batch@3"):
+            with pytest.raises(NonFiniteError):
+                _fit(glom, train)
+        # the newest checkpoint predates the poisoned step: halt fired at
+        # the step-3 window boundary BEFORE that iteration's save phase
+        assert integrity.latest_valid_step(str(tmp_path / "ckpt")) == 2
+
+    def test_supervised_nan_run_self_heals(self, tmp_path):
+        import jax
+
+        glom, train = _tiny_cfgs(tmp_path, 5, halt_on_nan=True)
+        attempts = []
+
+        def fit_fn():
+            trainer, final = _fit(glom, train)
+            attempts.append(final)
+            return final
+
+        sup = Supervisor(
+            fit_fn, checkpoint_dir=train.checkpoint_dir,
+            policy=RestartPolicy(max_failures=3, backoff_base_s=0.0,
+                                 jitter=0.0),
+        )
+        with faultinject.injected("data:nan_batch@3"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                final = sup.run()
+        assert sup.restarts == 1
+        assert final == 5
+
+
+# -- chaos CLI -------------------------------------------------------------
+
+class TestChaosCli:
+    def test_scenario_registry_complete(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "chaos", os.path.join(ROOT, "tools", "chaos.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert set(mod.SCENARIOS) == {
+            "torn_ckpt_write", "corrupt_restore", "nan_batch",
+            "reload_io_error", "train_crash",
+        }
+
+    def test_smoke_suite_recovers(self, tmp_path):
+        """The tier-1 gate: every injected fault ends in automatic
+        recovery, in a fresh subprocess on CPU, within the CI budget."""
+        out_json = str(tmp_path / "chaos.json")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "chaos.py"),
+             "--smoke", "--json", out_json],
+            capture_output=True, text=True, timeout=300, env=env, cwd=ROOT,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        with open(out_json) as f:
+            summary = json.load(f)
+        assert summary["recovered"] == summary["total"] == 5
+        for rec in summary["results"]:
+            assert rec["outcome"] == "recovered", rec
+            assert rec["mttr_s"] >= 0.0
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+    def test_full_suite_recovers(self, tmp_path):
+        out_json = str(tmp_path / "chaos.json")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "chaos.py"),
+             "--json", out_json],
+            capture_output=True, text=True, timeout=900, env=env, cwd=ROOT,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        with open(out_json) as f:
+            summary = json.load(f)
+        assert summary["recovered"] == summary["total"] == 5

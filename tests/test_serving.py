@@ -371,22 +371,26 @@ class TestHotReload:
         assert eng.check_reload() is False
 
     def test_skips_torn_manifest_and_keeps_serving(self, tmp_path):
+        # the PR-5 watcher polls the ARTIFACT scan (integrity-verified), so
+        # a garbled manifest is simply irrelevant to it: no-op, old params
+        # keep serving, no watcher death
         d = str(tmp_path)
         make_demo_checkpoint(d)
         eng = self._engine(d)
         (tmp_path / "manifest.json").write_bytes(b"not json at all")
-        with pytest.warns(UserWarning, match="unreadable checkpoint manifest"):
-            assert eng.check_reload() is False
+        assert eng.check_reload() is False
         assert eng.step == 0  # old params still serving
 
     def test_survives_manifest_pointing_at_missing_artifact(self, tmp_path):
+        # likewise: a manifest naming a nonexistent step cannot mislead the
+        # artifact-driven poll — the newest on-disk step (0) is not newer
+        # than what's serving, so the poll is a clean no-op
         d = str(tmp_path)
         make_demo_checkpoint(d)
         eng = self._engine(d)
         (tmp_path / "manifest.json").write_text(
             json.dumps({"latest_step": 9, "path": "ckpt_9.npz"}))
-        with pytest.warns(UserWarning, match="hot reload of step 9 failed"):
-            assert eng.check_reload() is False
+        assert eng.check_reload() is False
         assert eng.step == 0
 
 

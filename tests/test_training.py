@@ -462,7 +462,9 @@ def test_checkpoint_mixed_backends_one_directory(tmp_path):
     for s in (11, 12, 13):
         ckpt_lib.save(str(tmp_path), s, {"params": host.params})
     names = sorted(f for f in __import__("os").listdir(str(tmp_path)) if f.startswith("ckpt_"))
-    assert names == ["ckpt_11.npz", "ckpt_12.npz", "ckpt_13.npz"], names
+    assert names == ["ckpt_11.integrity.json", "ckpt_11.npz",
+                     "ckpt_12.integrity.json", "ckpt_12.npz",
+                     "ckpt_13.integrity.json", "ckpt_13.npz"], names
 
 
 def test_checkpoint_ignores_stray_nonnumeric_files(tmp_path):
@@ -507,8 +509,8 @@ def test_checkpoint_same_step_resave_replaces_other_backend(tmp_path):
     tree_b = {"params": {"w": np.arange(4.0) + 100.0}}
     ckpt_lib.save(str(tmp_path), 5, tree_a, backend="orbax")
     ckpt_lib.save(str(tmp_path), 5, tree_b)  # npz re-save of the same step
-    names = [f for f in os.listdir(str(tmp_path)) if f.startswith("ckpt_5")]
-    assert names == ["ckpt_5.npz"], names
+    names = sorted(f for f in os.listdir(str(tmp_path)) if f.startswith("ckpt_5"))
+    assert names == ["ckpt_5.integrity.json", "ckpt_5.npz"], names
     _, trees = ckpt_lib.restore(str(tmp_path), {"params": tree_a["params"]}, step=5)
     np.testing.assert_array_equal(np.asarray(trees["params"]["w"]), tree_b["params"]["w"])
 

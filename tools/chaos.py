@@ -1,0 +1,360 @@
+#!/usr/bin/env python
+"""Chaos scenario suite: inject every named fault, assert recovery.
+
+The acceptance harness for ``glom_tpu/resilience/``: each scenario arms a
+deterministic :class:`~glom_tpu.resilience.faultinject.FaultPlan` against
+a tiny CPU train/serve loop and asserts the system HEALS — training
+resumes from the newest checkpoint that verifies, quarantine + telemetry
+fire, the serving watcher outlives its faults — reporting per-scenario
+outcome and MTTR (wall seconds from the fault's first observable impact to
+restored service) as JSON.
+
+    python tools/chaos.py --smoke          # fast variants, CI tier-1 (<60s)
+    python tools/chaos.py                  # soak variants (more steps/faults)
+    python tools/chaos.py --scenario nan_batch --json out.json
+
+Exit code 0 iff every selected scenario recovered.  Stdlib CLI — only
+in-repo imports beyond the standard library.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+import traceback
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _force_cpu():
+    # env alone is not enough under site plugins (see tests/conftest.py)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+# -- tiny shared shapes (every scenario reuses them: minimal compiles) -----
+
+def _configs(steps, *, halt_on_nan=False, forensics_dir=None,
+             checkpoint_dir=None):
+    from glom_tpu.config import GlomConfig, TrainConfig
+
+    glom = GlomConfig(dim=8, levels=2, image_size=8, patch_size=4)
+    train = TrainConfig(
+        # batch 8: divisible by the data axis on a real single-CPU host
+        # AND under the test harness's faked 8-device topology
+        batch_size=8, steps=steps, log_every=1, checkpoint_every=1,
+        checkpoint_dir=checkpoint_dir, halt_on_nan=halt_on_nan,
+        forensics_dir=forensics_dir, forensics_hlo=False,
+        forensics_step_time_factor=0.0,
+    )
+    return glom, train
+
+
+_DEVNULL = None
+
+
+def _quiet_trainer(glom, train):
+    """A Trainer whose JSONL log goes to /dev/null: the chaos harness's
+    stdout is the scenario JSON, not training telemetry."""
+    from glom_tpu.training.metrics import MetricLogger
+    from glom_tpu.training.trainer import Trainer
+
+    global _DEVNULL
+    if _DEVNULL is None:
+        _DEVNULL = open(os.devnull, "w")
+    return Trainer(glom, train, logger=MetricLogger(stream=_DEVNULL))
+
+
+def _fit_once(glom, train, steps=None):
+    """One fresh Trainer + synthetic stream driven to completion; returns
+    (trainer, final_step)."""
+    import jax
+
+    from glom_tpu.training.data import make_batches
+
+    trainer = _quiet_trainer(glom, train)
+    batches = make_batches("synthetic", train.batch_size, glom.image_size,
+                           glom.channels, seed=0)
+    try:
+        trainer.fit(batches, steps=steps)
+    finally:
+        close = getattr(batches, "close", None)
+        if callable(close):
+            close()
+    return trainer, int(jax.device_get(trainer.state.step))
+
+
+# -- scenarios -------------------------------------------------------------
+
+def scenario_torn_ckpt_write(soak):
+    """A torn (half-written) checkpoint artifact: the resume after it must
+    quarantine the torn step and fall back to the previous verified one."""
+    from glom_tpu.resilience import faultinject, integrity
+
+    steps1, steps2 = (2, 5) if not soak else (4, 12)
+    with tempfile.TemporaryDirectory() as root:
+        ckpt_dir = os.path.join(root, "ckpt")
+        fdir = os.path.join(root, "forensics")
+        glom, train = _configs(steps1, checkpoint_dir=ckpt_dir,
+                               forensics_dir=fdir)
+        with faultinject.injected(f"ckpt_write:torn@step{steps1}"):
+            _fit_once(glom, train)  # final save of step `steps1` is torn
+        assert integrity.latest_valid_step(
+            ckpt_dir, quarantine_corrupt=False) == steps1 - 1
+        t0 = time.monotonic()
+        glom, train = _configs(steps2, checkpoint_dir=ckpt_dir,
+                               forensics_dir=fdir)
+        trainer, final = _fit_once(glom, train)
+        mttr = time.monotonic() - t0
+        snap = trainer.registry.snapshot()
+        assert final == steps2, f"resumed run stopped at {final}"
+        assert snap.get("ckpt_corrupt_total") == 1, snap.get("ckpt_corrupt_total")
+        corrupt = [f for f in os.listdir(ckpt_dir) if f.endswith(".corrupt")]
+        assert corrupt, "torn artifact was not quarantined"
+        bundles = [d for d in os.listdir(fdir) if d.startswith("ckpt_corrupt-")]
+        assert len(bundles) == 1, f"expected 1 debounced bundle, got {bundles}"
+        return {"mttr_s": mttr, "resumed_from": steps1 - 1,
+                "completed_step": final}
+
+
+def scenario_corrupt_restore(soak):
+    """Bytes go bad on disk AFTER a clean save (bit rot / partial media
+    failure): restore quarantines and falls back; the ckpt_corrupt trigger
+    fires exactly once."""
+    from glom_tpu.resilience import integrity
+
+    steps1, steps2 = (2, 5) if not soak else (4, 12)
+    with tempfile.TemporaryDirectory() as root:
+        ckpt_dir = os.path.join(root, "ckpt")
+        fdir = os.path.join(root, "forensics")
+        glom, train = _configs(steps1, checkpoint_dir=ckpt_dir,
+                               forensics_dir=fdir)
+        _fit_once(glom, train)
+        from glom_tpu import checkpoint as ckpt_lib
+
+        path = ckpt_lib.npz_path(ckpt_dir, steps1)
+        with open(path, "r+b") as f:  # flip one mid-file byte
+            f.seek(os.path.getsize(path) // 2)
+            b = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([b[0] ^ 0xFF]))
+        t0 = time.monotonic()
+        glom, train = _configs(steps2, checkpoint_dir=ckpt_dir,
+                               forensics_dir=fdir)
+        trainer, final = _fit_once(glom, train)
+        mttr = time.monotonic() - t0
+        snap = trainer.registry.snapshot()
+        assert final == steps2, f"resumed run stopped at {final}"
+        assert snap.get("ckpt_corrupt_total") == 1
+        assert integrity.latest_valid_step(ckpt_dir) == steps2
+        bundles = [d for d in os.listdir(fdir) if d.startswith("ckpt_corrupt-")]
+        assert len(bundles) == 1, f"expected 1 debounced bundle, got {bundles}"
+        return {"mttr_s": mttr, "resumed_from": steps1 - 1,
+                "completed_step": final}
+
+
+def scenario_nan_batch(soak):
+    """A poisoned (all-NaN) batch: halt_on_nan fails the run before the
+    poisoned params reach a checkpoint; the supervisor restarts from the
+    last clean step and the one-shot fault does not re-fire."""
+    import jax
+
+    from glom_tpu.resilience import faultinject
+    from glom_tpu.resilience.supervisor import RestartPolicy, Supervisor
+    from glom_tpu.training.data import make_batches
+    from glom_tpu.training.trainer import NonFiniteError
+
+    steps, nan_at = (6, 4) if not soak else (16, 9)
+    with tempfile.TemporaryDirectory() as root:
+        ckpt_dir = os.path.join(root, "ckpt")
+        glom, train = _configs(steps, checkpoint_dir=ckpt_dir,
+                               halt_on_nan=True)
+        trainers = []
+        fail_t = []
+
+        def fit_fn():
+            trainer = _quiet_trainer(glom, train)
+            trainers.append(trainer)
+            batches = make_batches("synthetic", train.batch_size,
+                                   glom.image_size, glom.channels, seed=0)
+            try:
+                return trainer.fit(batches)
+            except NonFiniteError:
+                fail_t.append(time.monotonic())
+                raise
+            finally:
+                batches.close()
+
+        sup = Supervisor(
+            fit_fn, checkpoint_dir=ckpt_dir,
+            policy=RestartPolicy(max_failures=3, window_s=300.0,
+                                 backoff_base_s=0.01, backoff_max_s=0.05),
+        )
+        with faultinject.injected(f"data:nan_batch@{nan_at}"):
+            sup.run()
+        mttr = time.monotonic() - fail_t[0] if fail_t else 0.0
+        final = int(jax.device_get(trainers[-1].state.step))
+        assert sup.restarts == 1, f"expected exactly 1 restart, got {sup.restarts}"
+        assert final == steps, f"supervised run stopped at {final}"
+        snap = trainers[0].registry.snapshot()
+        assert snap.get("nan_windows", 0) >= 1, "NaN was never detected"
+        return {"mttr_s": mttr, "restarts": sup.restarts,
+                "completed_step": final}
+
+
+def scenario_reload_io_error(soak):
+    """Transient I/O errors on the serving hot-reload poll: bounded
+    retry-with-backoff keeps the watcher alive, /healthz never degrades,
+    and the swap lands once the filesystem recovers."""
+    import jax
+
+    from glom_tpu import checkpoint as ckpt_lib
+    from glom_tpu.resilience import faultinject
+    from glom_tpu.serving.engine import ServingEngine, make_demo_checkpoint
+
+    n_faults = 6 if not soak else 24
+    with tempfile.TemporaryDirectory() as root:
+        make_demo_checkpoint(root)
+        engine = ServingEngine(
+            root, buckets=(1,), warmup=False, reload_poll_s=0,
+            sleep=lambda s: None,  # no real backoff sleeps in the harness
+        )
+        t0 = time.monotonic()
+        with faultinject.injected(f"reload:io_error*{n_faults}"):
+            polls = 0
+            while faultinject.armed() and any(
+                f.fired < f.count for f in faultinject._PLAN.faults
+            ):
+                assert engine.check_reload() is False
+                assert engine.health()["status"] == "ok"
+                polls += 1
+                assert polls <= n_faults + 2, "faults never exhausted"
+        failures = engine.registry.counter("serving_reload_failures").value
+        assert failures == n_faults, (failures, n_faults)
+        # filesystem "recovers": a newer checkpoint lands and swaps in
+        ckpt_lib.save(root, 1, {"params": jax.device_get(engine._template)})
+        assert engine.check_reload() is True
+        mttr = time.monotonic() - t0
+        assert engine.step == 1
+        assert engine.health()["status"] == "ok"
+        return {"mttr_s": mttr, "reload_failures": int(failures),
+            "served_step": int(engine.step)}
+
+
+def scenario_train_crash(soak):
+    """The data pipeline crashes mid-run: the supervisor restarts with
+    backoff, auto-resume continues from the last checkpoint, and the run
+    completes."""
+    import jax
+
+    from glom_tpu.resilience import faultinject
+    from glom_tpu.resilience.supervisor import RestartPolicy, Supervisor
+    from glom_tpu.training.data import make_batches
+
+    steps, crash_at = (5, 3) if not soak else (14, 7)
+    with tempfile.TemporaryDirectory() as root:
+        ckpt_dir = os.path.join(root, "ckpt")
+        glom, train = _configs(steps, checkpoint_dir=ckpt_dir)
+        trainers = []
+        fail_t = []
+
+        def fit_fn():
+            trainer = _quiet_trainer(glom, train)
+            trainers.append(trainer)
+            batches = make_batches("synthetic", train.batch_size,
+                                   glom.image_size, glom.channels, seed=0)
+            try:
+                return trainer.fit(batches)
+            except faultinject.FaultError:
+                fail_t.append(time.monotonic())
+                raise
+            finally:
+                batches.close()
+
+        sup = Supervisor(
+            fit_fn, checkpoint_dir=ckpt_dir,
+            policy=RestartPolicy(max_failures=3, window_s=300.0,
+                                 backoff_base_s=0.01, backoff_max_s=0.05),
+        )
+        with faultinject.injected(f"data:crash@{crash_at}"):
+            sup.run()
+        mttr = time.monotonic() - fail_t[0] if fail_t else 0.0
+        final = int(jax.device_get(trainers[-1].state.step))
+        assert sup.restarts == 1, f"expected exactly 1 restart, got {sup.restarts}"
+        assert final == steps, f"supervised run stopped at {final}"
+        return {"mttr_s": mttr, "restarts": sup.restarts,
+                "completed_step": final}
+
+
+SCENARIOS = {
+    "torn_ckpt_write": scenario_torn_ckpt_write,
+    "corrupt_restore": scenario_corrupt_restore,
+    "nan_batch": scenario_nan_batch,
+    "reload_io_error": scenario_reload_io_error,
+    "train_crash": scenario_train_crash,
+}
+
+
+def run(names, *, soak, quiet=False):
+    from glom_tpu.resilience import faultinject
+
+    results = []
+    for name in names:
+        t0 = time.monotonic()
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                detail = SCENARIOS[name](soak)
+            outcome = "recovered"
+        except Exception as e:
+            detail = {"error": f"{type(e).__name__}: {e}",
+                      "traceback": traceback.format_exc()}
+            outcome = "failed"
+        finally:
+            faultinject.disarm()  # a failed scenario must not poison the next
+        rec = {"scenario": name, "outcome": outcome,
+               "wall_s": round(time.monotonic() - t0, 3), **detail}
+        if "mttr_s" in rec:
+            rec["mttr_s"] = round(rec["mttr_s"], 3)
+        results.append(rec)
+        if not quiet:
+            print(json.dumps(rec), flush=True)
+    return results
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="GLOM resilience chaos suite")
+    p.add_argument("--smoke", action="store_true",
+                   help="fast variants of every scenario (CI tier-1, <60s)")
+    p.add_argument("--scenario", action="append", choices=sorted(SCENARIOS),
+                   help="run only this scenario (repeatable)")
+    p.add_argument("--json", dest="json_out", default=None,
+                   help="also write the full results array to this file")
+    args = p.parse_args(argv)
+    _force_cpu()
+
+    names = args.scenario or list(SCENARIOS)
+    results = run(names, soak=not args.smoke)
+    summary = {
+        "mode": "smoke" if args.smoke else "soak",
+        "recovered": sum(r["outcome"] == "recovered" for r in results),
+        "total": len(results),
+        "results": results,
+    }
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(summary, f, indent=2)
+    ok = summary["recovered"] == summary["total"]
+    print(json.dumps({k: summary[k] for k in ("mode", "recovered", "total")}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
