@@ -47,7 +47,17 @@ class GlomConfig:
     attention_impl: str = "dense"   # "auto" | "dense" | "pallas" | "ring" | "ulysses"
     # ("auto": pallas on TPU when num_patches > 256 — the measured crossover —
     #  else dense; resolved at make_consensus_fn time)
-    ff_impl: str = "dense"          # "dense" | "pallas" (fused, hidden stays in VMEM)
+    # "dense": XLA batched matmuls.  "pallas": fused grouped-FF kernel
+    # (hidden stays in VMEM).  "fused": the WHOLE level update — consensus
+    # attention + both grouped FFs — as one Pallas launch per iteration
+    # (kernels/fused_update_pallas.py); when the shape predicates
+    # (fused_update_pallas.supports_config) don't hold or a sharded/ring
+    # consensus or FF is injected, it falls back to the grouped pallas FF
+    # plus attention resolved by the measured "auto" policy (pallas above
+    # the crossover on TPU — the unfused pallas pair at bench scale —
+    # dense below it and off-TPU); an explicit non-default attention_impl
+    # is honored in the fallback.
+    ff_impl: str = "dense"          # "dense" | "pallas" | "fused"
     # with ff_impl="pallas": fused Pallas backward kernels (hidden recomputed
     # per tile, never in HBM) vs the XLA einsum VJP.  Default stays False
     # until the fused backward has a hardware A/B check on record (it is
@@ -77,7 +87,7 @@ class GlomConfig:
             raise ValueError("levels must be >= 2 (top_down uses levels-1 groups)")
         if self.attention_impl not in ("auto", "dense", "pallas", "ring", "ulysses"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
-        if self.ff_impl not in ("dense", "pallas"):
+        if self.ff_impl not in ("dense", "pallas", "fused"):
             raise ValueError(f"unknown ff_impl {self.ff_impl!r}")
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(f"unknown remat_policy {self.remat_policy!r}")

@@ -9,5 +9,10 @@ round-trip through HBM.
 
 from glom_tpu.kernels.consensus_pallas import consensus_attention_pallas
 from glom_tpu.kernels.ff_pallas import grouped_ff_pallas
+from glom_tpu.kernels.fused_update_pallas import fused_level_update
 
-__all__ = ["consensus_attention_pallas", "grouped_ff_pallas"]
+__all__ = [
+    "consensus_attention_pallas",
+    "fused_level_update",
+    "grouped_ff_pallas",
+]

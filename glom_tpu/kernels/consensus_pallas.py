@@ -47,7 +47,38 @@ from glom_tpu.ops.consensus import TOKEN_ATTEND_SELF_VALUE, consensus_attention,
 _MAX_NEG = float(-jnp.finfo(jnp.float32).max)
 
 
-def _kernel(q_ref, kv_ref, *refs, scale, attend_self, block_i, n, has_mask):
+def attend_oneshot(q, kv, *, scale, attend_self, mask, i0):
+    """One-shot masked consensus attention of a ``(Bi, d)`` f32 query block
+    against the full ``(n, d)`` f32 K/V row; returns ``(out, lse)`` in f32.
+
+    The SINGLE definition of the per-block consensus math: the consensus
+    kernel below and the fused level-update kernel
+    (``kernels/fused_update_pallas.py``) both call it, which is what makes
+    the fused path's f32 forward bit-identical to this one.  ``i0`` is the
+    query block's global row offset (for the soft self-mask diagonal);
+    ``mask`` is the already-loaded ``(Bi, n)`` int8 locality tile or None."""
+    bi, n = q.shape[0], kv.shape[0]
+    k = l2_normalize(kv, axis=-1)                # torch F.normalize semantics
+
+    sim = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale                                     # (Bi, n)
+
+    if not attend_self:
+        i_ids = jax.lax.broadcasted_iota(jnp.int32, (bi, n), 0) + i0
+        j_ids = jax.lax.broadcasted_iota(jnp.int32, (bi, n), 1)
+        sim = jnp.where(i_ids == j_ids, jnp.float32(TOKEN_ATTEND_SELF_VALUE), sim)
+
+    if mask is not None:
+        sim = jnp.where(mask != 0, _MAX_NEG, sim)
+
+    m = sim.max(axis=-1)
+    lse = m + jnp.log(jnp.exp(sim - m[:, None]).sum(axis=-1))
+    attn = jnp.exp(sim - lse[:, None])
+    return jnp.dot(attn, kv, preferred_element_type=jnp.float32), lse
+
+
+def _kernel(q_ref, kv_ref, *refs, scale, attend_self, block_i, has_mask):
     """One fused consensus block.  ``refs`` is (mask_ref, o_ref, lse_ref)
     when ``has_mask`` (selected statically in ``_forward``), else
     (o_ref, lse_ref)."""
@@ -56,25 +87,11 @@ def _kernel(q_ref, kv_ref, *refs, scale, attend_self, block_i, n, has_mask):
 
     q = q_ref[0, 0].astype(jnp.float32)          # (Bi, d)
     kv = kv_ref[0, 0].astype(jnp.float32)        # (n, d)
-    k = l2_normalize(kv, axis=-1)                # torch F.normalize semantics
-
-    sim = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale                                     # (Bi, n)
-
-    if not attend_self:
-        i_ids = jax.lax.broadcasted_iota(jnp.int32, (block_i, n), 0)
-        i_ids = i_ids + pl.program_id(2) * block_i
-        j_ids = jax.lax.broadcasted_iota(jnp.int32, (block_i, n), 1)
-        sim = jnp.where(i_ids == j_ids, jnp.float32(TOKEN_ATTEND_SELF_VALUE), sim)
-
-    if mask_ref is not None:
-        sim = jnp.where(mask_ref[:] != 0, _MAX_NEG, sim)
-
-    m = sim.max(axis=-1)
-    lse = m + jnp.log(jnp.exp(sim - m[:, None]).sum(axis=-1))
-    attn = jnp.exp(sim - lse[:, None])
-    out = jnp.dot(attn, kv, preferred_element_type=jnp.float32)
+    out, lse = attend_oneshot(
+        q, kv, scale=scale, attend_self=attend_self,
+        mask=mask_ref[:] if has_mask else None,
+        i0=pl.program_id(2) * block_i,
+    )
     o_ref[0, 0] = out.astype(o_ref.dtype)
     lse_ref[0, 0] = lse[:, None]
 
@@ -219,7 +236,7 @@ def _forward(levels, mask_i8, *, attend_self, interpret):
 
     has_mask = mask_i8 is not None
     kern = functools.partial(
-        _kernel, scale=scale, attend_self=attend_self, block_i=block_i, n=n,
+        _kernel, scale=scale, attend_self=attend_self, block_i=block_i,
         has_mask=has_mask,
     )
     in_specs = [q_spec, kv_spec]

@@ -1,0 +1,337 @@
+"""Fused GLOM level update as a single Pallas TPU launch.
+
+One GLOM iteration (`glom_pytorch.py:131-145`, ``models/glom._update_step``)
+is
+
+    new[l] = (levels[l] + BU_l(stack[l]) + TD_l(levels[l+1] + pos)
+              + consensus(levels)[l]) / div_l
+
+with ``stack = [tokens, levels]``, the top level taking no top-down term,
+and ``div = [4, ..., 4, 3]``.  The unfused fast path (``ff_impl=pallas`` +
+``attention_impl=pallas``) already runs each piece as its own Pallas kernel,
+but every iteration still pays 3+ kernel launches and writes/re-reads the
+``(b, n, L, d)`` bottom-up, top-down, and consensus contributions through
+HBM between them.  This kernel computes the WHOLE update per
+(level, batch, query-block) grid cell with every intermediate resident in
+VMEM: the attention row, both FF hiddens, and the three contribution
+accumulators never exist in HBM.  Per iteration that removes three
+full-state HBM round-trips (~12 MB x 3 at flagship scale) and two kernel
+launches.
+
+Layout (grid ``(L, b, n/bn, h/hc)``, level outermost so each level's weight
+chunks stay VMEM-resident across all (batch, n-block) steps):
+
+  * consensus attention runs once per (l, b, n-block) at the first hidden
+    chunk via the SAME :func:`~glom_tpu.kernels.consensus_pallas.attend_oneshot`
+    the consensus kernel uses — f32 forward results are bit-identical;
+  * the two grouped-FF contributions accumulate over hidden chunks exactly
+    like ``kernels/ff_pallas._kernel`` (same op order, same
+    :func:`~glom_tpu.kernels.ff_pallas._gelu_cdf`), so when both paths
+    resolve the same hidden chunking the f32 sums match bitwise;
+  * level inputs are selected by BlockSpec index maps: bottom-up group l
+    reads stack entry l (tokens at l=0 via an in-kernel select), top-down
+    group l reads level l+1 (index clamped; the top level's contribution is
+    predicated off).
+
+Backward is a custom VJP that differentiates the REFERENCE composition of
+the unfused Pallas kernels (flash consensus backward + grouped-FF backward)
+— structurally the same graph the unfused path's autodiff builds, so f32
+gradients are bit-identical to ``ff_impl=pallas`` and no fourth kernel
+family has to be maintained.  The fused forward is where the HBM traffic
+was; the backward already never materializes (n, n) or the hidden.
+
+``supports_config`` gates default selection: the one-shot attention needs
+the full (n, d) K/V row in VMEM (n <= 1024), and on real hardware the
+double-buffered working set must fit the VMEM envelope with Mosaic-friendly
+tile shapes.  Interpret mode (CPU tests) only needs the n bound.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from glom_tpu.kernels.consensus_pallas import _ONE_SHOT_MAX_N, attend_oneshot
+from glom_tpu.kernels.ff_pallas import _VMEM_BUDGET, _gelu_cdf, _shrink
+
+
+def _kernel(q_ref, kv_ref, prev_ref, tok_ref, nxt_ref, pos_ref,
+            bw1_ref, bb1_ref, bw2_ref, bb2_ref,
+            tw1_ref, tb1_ref, tw2_ref, tb2_ref, *refs,
+            scale, attend_self, block_i, levels_count, has_mask):
+    """One fused level-update cell.  ``refs`` is ([mask_ref,] o_ref,
+    cons_acc, bu_acc, td_acc); the three f32 scratch accumulators carry the
+    consensus row and the two FF partial sums across hidden chunks."""
+    mask_ref = refs[0] if has_mask else None
+    o_ref, cons_acc, bu_acc, td_acc = refs[-4], refs[-3], refs[-2], refs[-1]
+
+    il = pl.program_id(0)
+    ih = pl.program_id(3)
+    nh = pl.num_programs(3)
+    # hoisted out of the pl.when blocks: program_id inside a predicated
+    # region has no interpret-mode rule on this jax version
+    i0 = pl.program_id(2) * block_i
+    L = levels_count
+
+    @pl.when(ih == 0)
+    def _():
+        # consensus attention for this query block: same math (same helper)
+        # as the standalone consensus kernel — the (Bi, n) attention row
+        # lives only here
+        q = q_ref[0, 0].astype(jnp.float32)
+        kv = kv_ref[0, 0].astype(jnp.float32)
+        out, _ = attend_oneshot(
+            q, kv, scale=scale, attend_self=attend_self,
+            mask=mask_ref[:] if has_mask else None,
+            i0=i0,
+        )
+        cons_acc[:] = out
+        bu_acc[:] = jnp.zeros_like(bu_acc)
+        td_acc[:] = jnp.zeros_like(td_acc)
+
+    # bottom-up group l consumes stack entry l: tokens at the bottom, the
+    # level below otherwise (prev_ref's index map clamps l-1 to 0; the
+    # select picks which of the two loaded blocks applies)
+    x_bu = jnp.where(
+        il == 0,
+        tok_ref[0].astype(jnp.float32),
+        prev_ref[0, 0].astype(jnp.float32),
+    )
+    h = jnp.dot(
+        x_bu, bw1_ref[0].astype(jnp.float32), preferred_element_type=jnp.float32
+    ) + bb1_ref[0, 0].astype(jnp.float32)
+    h = h * _gelu_cdf(h)
+    bu_acc[:] = bu_acc[:] + jnp.dot(
+        h, bw2_ref[0].astype(jnp.float32), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(il < L - 1)
+    def _():
+        # top-down group l consumes level l+1 plus the positional embedding
+        # (`glom_pytorch.py:136`); the top level has no top-down term
+        x_td = nxt_ref[0, 0].astype(jnp.float32) + pos_ref[:].astype(jnp.float32)
+        ht = jnp.dot(
+            x_td, tw1_ref[0].astype(jnp.float32), preferred_element_type=jnp.float32
+        ) + tb1_ref[0, 0].astype(jnp.float32)
+        ht = ht * _gelu_cdf(ht)
+        td_acc[:] = td_acc[:] + jnp.dot(
+            ht, tw2_ref[0].astype(jnp.float32), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ih == nh - 1)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)
+        bu = bu_acc[:] + bb2_ref[0, 0].astype(jnp.float32)
+        # the top level ADDS a zero top-down term (mirroring the unfused
+        # path's zero-pad), it doesn't skip the addition — keeps -0.0
+        # edge cases bit-identical
+        td = jnp.where(
+            il < L - 1, td_acc[:] + tb2_ref[0, 0].astype(jnp.float32), 0.0
+        )
+        div = jnp.where(il == L - 1, jnp.float32(3.0), jnp.float32(4.0))
+        out = (q + bu + td + cons_acc[:]) / div
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def _vmem_bytes(bn, hc, n, d, itemsize, has_mask):
+    """Working-set estimate for one grid cell: Pallas double-buffers every
+    pipelined block (q, kv, prev, tok, nxt, pos, the two nets' weight
+    chunks, out[, mask]); the f32 scratch accumulators and the live (Bi, n)
+    attention row ride on top."""
+    blocks = 6 * bn * d + n * d + 2 * (d * hc + hc + hc * d + d) + bn * d
+    mask_bytes = 2 * bn * n if has_mask else 0  # int8, double-buffered
+    return 2 * itemsize * blocks + mask_bytes + 4 * (3 * bn * d + bn * n)
+
+
+def supports_config(config, *, interpret: Optional[bool] = None) -> bool:
+    """True when the fused level-update kernel can take this model shape.
+
+    The one-shot attention keeps the full ``(n, d)`` K/V row per (b, l) in
+    VMEM, so ``n`` is bounded like the consensus kernel's one-shot path.
+    On hardware, Mosaic additionally needs 8-aligned sublane tiles and a
+    lane-aligned feature dim, and the double-buffered working set must fit
+    the VMEM envelope after hidden-chunk shrinking.  Interpret mode (CPU
+    tests) has no memory model — only the n bound applies."""
+    n, d = config.num_patches, config.dim
+    h = config.dim * config.ff_mult
+    if n > _ONE_SHOT_MAX_N:
+        return False
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    if interpret:
+        return True
+    if n % 8 or d % 128 or h % 128:
+        return False
+    has_mask = config.local_consensus_radius > 0
+    budget = lambda bn, hc, d_, its: _vmem_bytes(bn, hc, n, d_, its, has_mask)
+    itemsize = jnp.dtype(config.compute_dtype or config.param_dtype).itemsize
+    bn, hc = _shrink(n, h, budget, d, itemsize)
+    return budget(bn, hc, d, itemsize) <= _VMEM_BUDGET
+
+
+def _forward(bu, td, levels, bottom, pos, mask_i8, *, attend_self, interpret):
+    b, n, L, d = levels.shape
+    h = bu["w1"].shape[-1]
+    x = jnp.transpose(levels, (0, 2, 1, 3))       # (b, L, n, d)
+    tokens = bottom[:, :, 0, :]                   # (b, n, d)
+    pos2d = pos[0, :, 0, :]                       # (n, d)
+    itemsize = max(levels.dtype.itemsize, bu["w1"].dtype.itemsize)
+    has_mask = mask_i8 is not None
+    budget = lambda bn_, hc_, d_, its: _vmem_bytes(bn_, hc_, n, d_, its, has_mask)
+    bn, hc = _shrink(n, h, budget, d, itemsize)
+    grid = (L, b, n // bn, h // hc)
+    scale = d ** -0.5
+
+    def xblk(index_map):
+        return pl.BlockSpec((1, 1, bn, d), index_map, memory_space=pltpu.VMEM)
+
+    in_specs = [
+        xblk(lambda il, ib, ii, ih: (ib, il, ii, 0)),                      # q
+        pl.BlockSpec((1, 1, n, d), lambda il, ib, ii, ih: (ib, il, 0, 0),
+                     memory_space=pltpu.VMEM),                             # kv
+        xblk(lambda il, ib, ii, ih: (ib, jnp.maximum(il - 1, 0), ii, 0)),  # prev
+        pl.BlockSpec((1, bn, d), lambda il, ib, ii, ih: (ib, ii, 0),
+                     memory_space=pltpu.VMEM),                             # tokens
+        xblk(lambda il, ib, ii, ih: (ib, jnp.minimum(il + 1, L - 1), ii, 0)),  # next
+        pl.BlockSpec((bn, d), lambda il, ib, ii, ih: (ii, 0),
+                     memory_space=pltpu.VMEM),                             # pos
+        # bottom-up net: one (d, hc)/(hc, d) weight chunk pair per cell;
+        # biases carried (g, 1, h) for the Mosaic sublane rule (ff_pallas)
+        pl.BlockSpec((1, d, hc), lambda il, ib, ii, ih: (il, 0, ih), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, hc), lambda il, ib, ii, ih: (il, 0, ih), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, hc, d), lambda il, ib, ii, ih: (il, ih, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, d), lambda il, ib, ii, ih: (il, 0, 0), memory_space=pltpu.VMEM),
+        # top-down net has L-1 groups: clamp the level index (the top
+        # level's fetch is unused — its contribution is predicated off)
+        pl.BlockSpec((1, d, hc), lambda il, ib, ii, ih: (jnp.minimum(il, L - 2), 0, ih), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, hc), lambda il, ib, ii, ih: (jnp.minimum(il, L - 2), 0, ih), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, hc, d), lambda il, ib, ii, ih: (jnp.minimum(il, L - 2), ih, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, d), lambda il, ib, ii, ih: (jnp.minimum(il, L - 2), 0, 0), memory_space=pltpu.VMEM),
+    ]
+    operands = [
+        x, x, x, tokens, x, pos2d,
+        bu["w1"], bu["b1"][:, None, :], bu["w2"], bu["b2"][:, None, :],
+        td["w1"], td["b1"][:, None, :], td["w2"], td["b2"][:, None, :],
+    ]
+    if has_mask:
+        in_specs.append(pl.BlockSpec(
+            (bn, n), lambda il, ib, ii, ih: (ii, 0), memory_space=pltpu.VMEM))
+        operands.append(mask_i8)
+
+    kern = functools.partial(
+        _kernel, scale=scale, attend_self=attend_self, block_i=bn,
+        levels_count=L, has_mask=has_mask,
+    )
+    y = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, bn, d), lambda il, ib, ii, ih: (ib, il, ii, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, L, n, d), levels.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bn, d), jnp.float32),   # consensus row
+            pltpu.VMEM((bn, d), jnp.float32),   # bottom-up partial sum
+            pltpu.VMEM((bn, d), jnp.float32),   # top-down partial sum
+        ],
+        interpret=interpret,
+    )(*operands)
+    return jnp.transpose(y, (0, 2, 1, 3))         # (b, n, L, d)
+
+
+def reference_update(bu, td, levels, bottom, pos, mask_i8, *, attend_self,
+                     interpret, ff_fused_bwd=False):
+    """The unfused composition of the same iteration — consensus Pallas
+    kernel + two grouped-FF Pallas kernels, combined exactly like
+    ``models/glom._update_step``.  The custom VJP differentiates THIS, so
+    fused-path gradients are the unfused path's gradients; it is also the
+    A/B oracle the tests compare the fused forward against."""
+    from glom_tpu.kernels.consensus_pallas import consensus_attention_pallas
+    from glom_tpu.kernels.ff_pallas import grouped_ff_pallas
+
+    levels_with_input = jnp.concatenate([bottom, levels], axis=-2)
+    bu_out = grouped_ff_pallas(
+        bu, levels_with_input[..., :-1, :], interpret=interpret,
+        fused_bwd=ff_fused_bwd,
+    )
+    td_out = grouped_ff_pallas(
+        td, levels_with_input[..., 2:, :] + pos, interpret=interpret,
+        fused_bwd=ff_fused_bwd,
+    )
+    td_out = jnp.pad(td_out, ((0, 0), (0, 0), (0, 1), (0, 0)))
+    cons = consensus_attention_pallas(
+        levels, attend_self=attend_self, non_local_mask=mask_i8,
+        interpret=interpret,
+    )
+    L = levels.shape[2]
+    divisors = np.full((L, 1), 4.0, dtype=np.float32)
+    divisors[-1] = 3.0
+    divisors = jnp.asarray(divisors, levels.dtype)
+    return (levels + bu_out + td_out + cons) / divisors
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def _fused_update(bu, td, levels, bottom, pos, mask_i8, attend_self,
+                  interpret, ff_fused_bwd):
+    return _forward(bu, td, levels, bottom, pos, mask_i8,
+                    attend_self=attend_self, interpret=interpret)
+
+
+def _fwd(bu, td, levels, bottom, pos, mask_i8, attend_self, interpret,
+         ff_fused_bwd):
+    out = _forward(bu, td, levels, bottom, pos, mask_i8,
+                   attend_self=attend_self, interpret=interpret)
+    return out, (bu, td, levels, bottom, pos, mask_i8)
+
+
+def _bwd(attend_self, interpret, ff_fused_bwd, res, g):
+    bu, td, levels, bottom, pos, mask_i8 = res
+    _, vjp = jax.vjp(
+        lambda bu_, td_, lv_, bt_, ps_: reference_update(
+            bu_, td_, lv_, bt_, ps_, mask_i8, attend_self=attend_self,
+            interpret=interpret, ff_fused_bwd=ff_fused_bwd,
+        ),
+        bu, td, levels, bottom, pos,
+    )
+    return (*vjp(g), None)
+
+
+_fused_update.defvjp(_fwd, _bwd)
+
+
+def fused_level_update(
+    bu_params: dict,
+    td_params: dict,
+    levels: jax.Array,
+    bottom_level: jax.Array,
+    pos_embs: jax.Array,
+    *,
+    attend_self: bool = False,
+    non_local_mask: Optional[jax.Array] = None,
+    interpret: Optional[bool] = None,
+    ff_fused_bwd: bool = False,
+) -> jax.Array:
+    """One GLOM iteration in a single Pallas launch — drop-in for the body
+    of ``models/glom._update_step`` (``levels`` ``(b, n, L, d)``,
+    ``bottom_level`` ``(b, n, 1, d)``, ``pos_embs`` ``(1, n, 1, d)``).
+
+    ``interpret=None`` auto-selects interpreter mode off-TPU (CPU tests).
+    ``ff_fused_bwd`` mirrors ``GlomConfig.ff_fused_bwd``: it picks which
+    grouped-FF backward (fused Pallas vs XLA einsum VJP) the reference
+    composition differentiates, keeping fused-path gradients identical to
+    the unfused path under the same config."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    mask_i8 = None
+    if non_local_mask is not None:
+        mask_i8 = non_local_mask.astype(jnp.int8)
+    return _fused_update(bu_params, td_params, levels, bottom_level, pos_embs,
+                         mask_i8, attend_self, interpret, ff_fused_bwd)

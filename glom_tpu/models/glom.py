@@ -66,12 +66,52 @@ def param_count(params) -> int:
 
 def make_ff_fn(config: GlomConfig):
     """Resolve the grouped-FF implementation: XLA batched matmuls or the
-    fused Pallas kernel (hidden activation VMEM-resident)."""
-    if config.ff_impl == "pallas":
+    fused Pallas kernel (hidden activation VMEM-resident).  ``"fused"``
+    resolves to the same grouped-FF pallas kernel here — the whole-update
+    fusion is a STEP-level dispatch (:func:`make_fused_update_fn` via
+    ``make_step_builder``), and this grouped kernel is both its fallback
+    when the shape predicates fail and what bare-``ff_fn`` consumers
+    (diagnostics, pipeline stages) get."""
+    if config.ff_impl in ("pallas", "fused"):
         from glom_tpu.kernels.ff_pallas import grouped_ff_pallas
 
         return functools.partial(grouped_ff_pallas, fused_bwd=config.ff_fused_bwd)
     return grouped_ff_apply
+
+
+def fused_update_supported(config: GlomConfig, *, interpret=None) -> bool:
+    """True when ``ff_impl='fused'`` can actually take this model shape —
+    the ``supports_n``-style predicate gating default selection of the
+    single-launch level-update kernel (one-shot attention bounds n; on
+    hardware the double-buffered working set must fit VMEM)."""
+    if config.ff_impl != "fused" or config.fuse_ff:
+        # fuse_ff concatenates the two nets into one grouped call — a
+        # different (measured-loss) fusion; the two knobs don't compose
+        return False
+    from glom_tpu.kernels.fused_update_pallas import supports_config
+
+    return supports_config(config, interpret=interpret)
+
+
+def make_fused_update_fn(config: GlomConfig, *, interpret=None):
+    """The single-launch level update bound to this config:
+    ``f(bu_params, td_params, levels, bottom_level, pos_embs) ->
+    new_levels`` — consensus attention + both grouped FFs in one Pallas
+    call (``kernels/fused_update_pallas.py``), every intermediate
+    VMEM-resident.  ``make_step_builder`` consumes it; the sharded
+    analogue is ``glom_tpu.parallel.fused_shard.make_sharded_fused_update``."""
+    from glom_tpu.kernels.fused_update_pallas import fused_level_update
+
+    mask = resolve_locality_mask(config)
+
+    def f(bu_params, td_params, levels, bottom_level, pos_embs):
+        return fused_level_update(
+            bu_params, td_params, levels, bottom_level, pos_embs,
+            attend_self=config.consensus_self, non_local_mask=mask,
+            interpret=interpret, ff_fused_bwd=config.ff_fused_bwd,
+        )
+
+    return f
 
 
 def _update_step(params, bottom_level, pos_embs, divisors, consensus_fn, ff_fn, levels):
@@ -167,12 +207,36 @@ def initial_levels(params, b: int, config: GlomConfig, dtype) -> jax.Array:
 
 
 def make_step_builder(params, config: GlomConfig, pos_embs, divisors,
-                      consensus_fn, ff_fn):
+                      consensus_fn, ff_fn, fused_fn=None):
     """Returns ``build(bottom_level) -> step`` where ``step(levels)`` is one
     GLOM iteration honoring the config's ``fuse_ff`` and ``remat`` knobs.
     Shared by the sequential scan (:func:`apply`) and the pipelined schedule
-    (``glom_tpu.parallel.pipeline``) so the two paths cannot drift."""
+    (``glom_tpu.parallel.pipeline``) so the two paths cannot drift.
+
+    ``fused_fn`` (from :func:`make_fused_update_fn`, or its shard_mapped
+    analogue) replaces the whole update body with the single-launch fused
+    kernel — ``consensus_fn``/``ff_fn`` are then unused; its custom VJP
+    already differentiates the unfused composition, so ``remat`` applies on
+    top identically."""
     c = config
+    if fused_fn is not None:
+        def build_fused(bottom_level):
+            step = functools.partial(
+                fused_fn, params["bottom_up"], params["top_down"],
+            )
+
+            def fused_step(levels):
+                return step(levels, bottom_level, pos_embs)
+
+            if c.remat:
+                policy = (
+                    jax.checkpoint_policies.checkpoint_dots
+                    if c.remat_policy == "dots" else None
+                )
+                fused_step = jax.checkpoint(fused_step, policy=policy)
+            return fused_step
+
+        return build_fused
     if c.fuse_ff:
         # one weight concat per step (hoisted out of the scan), 2L-1 groups
         cat_params = jax.tree_util.tree_map(
@@ -311,6 +375,7 @@ def apply(
     capture_timestep: Optional[int] = None,
     consensus_fn=None,
     ff_fn=None,
+    fused_fn=None,
     state_sharding=None,
 ) -> jax.Array:
     """Forward pass.
@@ -330,7 +395,12 @@ def apply(
     (``glom_tpu.parallel.ring.make_ring_consensus``).  ``ff_fn`` likewise
     overrides the grouped-FF implementation — used to inject the
     shard_map-wrapped Pallas FF
-    (``glom_tpu.parallel.ff_shard.make_sharded_ff_pallas``).
+    (``glom_tpu.parallel.ff_shard.make_sharded_ff_pallas``).  ``fused_fn``
+    replaces the WHOLE update body with the single-launch fused kernel
+    (auto-resolved from ``ff_impl='fused'`` when its shape predicates hold
+    and neither override is injected; the Trainer injects the shard_mapped
+    variant, ``glom_tpu.parallel.fused_shard.make_sharded_fused_update``,
+    under a multi-device mesh).
 
     ``state_sharding`` (a ``NamedSharding``, Trainer-injected under a mesh)
     pins the ``(b, n, L, d)`` scan carry to the activation layout — batch
@@ -364,13 +434,31 @@ def apply(
 
     divisors = update_divisors(c, compute_dtype)
 
-    if consensus_fn is None:
-        consensus_fn = make_consensus_fn(c)
-    if ff_fn is None:
-        ff_fn = make_ff_fn(c)
-    step = make_step_builder(params, c, pos_embs, divisors, consensus_fn, ff_fn)(
-        bottom_level
-    )
+    if (fused_fn is None and consensus_fn is None and ff_fn is None
+            and fused_update_supported(c)):
+        # ff_impl='fused' with the shape predicates holding and no injected
+        # (sharded/ring) override: the whole update runs as one Pallas
+        # launch.  Injected fns win — a mesh-bound caller already decided
+        # how this step is laid out across devices.
+        fused_fn = make_fused_update_fn(c)
+    if fused_fn is None:
+        # the unfused (or fallback) composition needs both halves resolved
+        if consensus_fn is None:
+            cc = c
+            if c.ff_impl == "fused" and c.attention_impl == "dense":
+                # ff_impl='fused' owns the attention half outright when the
+                # predicates hold, so on fallback the default 'dense' is a
+                # leftover, not a choice: resolve by the measured 'auto'
+                # policy instead (pallas above the crossover on TPU, dense
+                # below it and off-TPU) — the "unfused pallas pair" the
+                # fallback promises at bench scale.  An explicit
+                # auto/pallas/ring/ulysses is honored as-is.
+                cc = dataclasses.replace(c, attention_impl="auto")
+            consensus_fn = make_consensus_fn(cc)
+        if ff_fn is None:
+            ff_fn = make_ff_fn(c)
+    step = make_step_builder(params, c, pos_embs, divisors, consensus_fn, ff_fn,
+                             fused_fn=fused_fn)(bottom_level)
 
     if state_sharding is not None:
         levels = jax.lax.with_sharding_constraint(levels, state_sharding)

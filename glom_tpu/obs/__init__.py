@@ -97,3 +97,12 @@ from glom_tpu.obs.forensics import (  # noqa: F401
     is_bundle_dir,
     write_bundle,
 )
+from glom_tpu.obs.perfgate import (  # noqa: F401
+    GATE_FAIL,
+    GATE_PASS,
+    GATE_SKIP,
+    evaluate_p95,
+    evaluate_throughput,
+    load_trajectory,
+    reference_value,
+)

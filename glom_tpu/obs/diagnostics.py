@@ -96,6 +96,7 @@ def make_diagnostics_fn(
     iters: Optional[int] = None,
     consensus_fn=None,
     ff_fn=None,
+    fused_fn=None,
     state_sharding=None,
 ):
     """Build the jittable ``(glom_params, img) -> {name: scalar/vector}``
@@ -108,6 +109,9 @@ def make_diagnostics_fn(
     """
     c = config
     n_iters = iters if iters is not None else c.default_iters
+    if (fused_fn is None and consensus_fn is None and ff_fn is None
+            and glom_model.fused_update_supported(c)):
+        fused_fn = glom_model.make_fused_update_fn(c)
     if consensus_fn is None:
         consensus_fn = glom_model.make_consensus_fn(c)
     if ff_fn is None:
@@ -119,7 +123,7 @@ def make_diagnostics_fn(
         )
         final = glom_model.apply(
             glom_params, img, config=c, iters=n_iters,
-            consensus_fn=consensus_fn, ff_fn=ff_fn,
+            consensus_fn=consensus_fn, ff_fn=ff_fn, fused_fn=fused_fn,
             state_sharding=state_sharding,
         )
         out = {

@@ -35,6 +35,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from glom_tpu.parallel.shard_compat import shard_map
+
 from glom_tpu.kernels.ff_pallas import grouped_ff_pallas
 
 
@@ -68,9 +70,8 @@ def make_sharded_ff_pallas(
                  "w2": P(None, None, None), "b2": P(None, None)}
 
     # -- replicated params (pure DP, or the EP fallback for awkward groups)
-    run_replicated = jax.shard_map(
+    run_replicated = shard_map(
         kernel, mesh=mesh, in_specs=(rep_pspec, x_spec()), out_specs=x_spec(),
-        check_vma=False,
     )
 
     if param_sharding == "tp":
@@ -86,9 +87,9 @@ def make_sharded_ff_pallas(
             part = kernel(local, x)
             return jax.lax.psum(part, model_axis)
 
-        run_tp = jax.shard_map(
+        run_tp = shard_map(
             tp_body, mesh=mesh, in_specs=(tp_pspec, x_spec()),
-            out_specs=x_spec(), check_vma=False,
+            out_specs=x_spec(),
         )
 
         def ff_fn(params, x):
@@ -113,9 +114,9 @@ def make_sharded_ff_pallas(
         def ep_run(axis):
             ep_pspec = {"w1": P(axis, None, None), "b1": P(axis, None),
                         "w2": P(axis, None, None), "b2": P(axis, None)}
-            return jax.shard_map(
+            return shard_map(
                 kernel, mesh=mesh, in_specs=(ep_pspec, x_spec(axis)),
-                out_specs=x_spec(axis), check_vma=False,
+                out_specs=x_spec(axis),
             )
 
         runs = {axis: ep_run(axis) for axis, size in candidates if size > 1}

@@ -1,0 +1,55 @@
+"""Fused level-update kernel composed with the device mesh via shard_map.
+
+Same hole :mod:`glom_tpu.parallel.ff_shard` closes for the grouped-FF
+kernel: ``pallas_call`` is opaque to GSPMD, so jitting the fused
+level-update directly under a >1-device mesh would silently all-gather
+its batch-sharded operands onto every device.  Here the kernel runs
+*inside* ``jax.shard_map`` with the batch axis sharded over ``data`` and
+everything else replicated — per-shard execution, zero collectives (the
+level update has no cross-batch math).
+
+Scope is deliberately data-parallel only: the fused kernel's one-shot
+consensus needs the FULL (n, d) K/V row per (batch, level) in VMEM, so a
+sequence-sharded state is structurally incompatible (use the ring/ulysses
+consensus + unfused FF there), and its weight BlockSpecs index whole
+per-level nets, so TP/EP-sharded params are too (use
+``ff_shard.make_sharded_ff_pallas``).  The Trainer enforces exactly that
+split: fused under pure DP / replicated params, the proven sharded
+unfused pair otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+from glom_tpu.config import GlomConfig
+from glom_tpu.models.glom import make_fused_update_fn
+from glom_tpu.parallel.shard_compat import shard_map
+
+
+def make_sharded_fused_update(
+    mesh: Mesh,
+    config: GlomConfig,
+    *,
+    data_axis: str = "data",
+    interpret: Optional[bool] = None,
+):
+    """Returns ``f(bu_params, td_params, levels, bottom_level, pos_embs)``
+    — the :func:`glom_tpu.models.glom.make_fused_update_fn` contract, run
+    per data shard.  ``levels`` is ``(b, n, L, d)`` and ``bottom_level``
+    ``(b, n, 1, d)``, both sharded over ``data_axis``; params and the
+    ``(1, n, 1, d)`` positional embeddings are replicated."""
+    kernel = make_fused_update_fn(config, interpret=interpret)
+
+    net_spec = {"w1": P(None, None, None), "b1": P(None, None),
+                "w2": P(None, None, None), "b2": P(None, None)}
+    x_spec = P(data_axis, None, None, None)
+
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(net_spec, net_spec, x_spec, x_spec, P(None, None, None, None)),
+        out_specs=x_spec,
+    )

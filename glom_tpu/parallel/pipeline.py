@@ -42,6 +42,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from glom_tpu.parallel.shard_compat import shard_map
+
 from glom_tpu.config import GlomConfig
 from glom_tpu.models import glom as glom_model
 
@@ -315,7 +317,7 @@ def make_pipelined_apply(
             if want_traj
             else ((sliced, sliced) if capture_timestep else sliced)
         )
-        run = jax.shard_map(
+        run = shard_map(
             pipelined,
             mesh=mesh,
             in_specs=(token_spec, nets_spec, pos_spec, state_spec),
@@ -323,7 +325,6 @@ def make_pipelined_apply(
                                   # (post-psum), data-sharded on the
                                   # microbatch batch dim; trajectory:
                                   # pipe-SHARDED on its stage-chunk dim
-            check_vma=False,
         )
         args = (tokens_mb, nets, pos_embs, init_state)
         if want_traj:

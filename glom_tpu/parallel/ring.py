@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from glom_tpu.parallel.shard_compat import shard_map
+
 from glom_tpu.ops.consensus import TOKEN_ATTEND_SELF_VALUE, l2_normalize
 
 
@@ -138,12 +140,11 @@ def make_ring_consensus(
         attend_self=attend_self,
         non_local_mask=non_local_mask,
     )
-    sharded = jax.shard_map(
+    sharded = shard_map(
         body,
         mesh=mesh,
         in_specs=(spec,),
         out_specs=spec,
-        check_vma=False,
     )
 
     def consensus_fn(levels: jax.Array) -> jax.Array:

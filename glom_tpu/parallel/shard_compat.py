@@ -1,0 +1,26 @@
+"""``shard_map`` across jax versions — the one compat seam.
+
+Newer jax exports ``jax.shard_map`` (replication checking toggled by
+``check_vma``); the 0.4.3x line carries it as
+``jax.experimental.shard_map.shard_map`` with ``check_rep``.  Every
+shard_map site in ``glom_tpu.parallel`` goes through this wrapper so a
+jax upgrade (or downgrade onto a baked container image) is a no-op for
+the callers.  Checking is always off: the Pallas kernels inside these
+maps are opaque to the replication checker and would false-positive.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)

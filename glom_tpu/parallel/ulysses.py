@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from glom_tpu.parallel.shard_compat import shard_map
+
 from glom_tpu.ops.consensus import consensus_attention
 
 
@@ -73,7 +75,7 @@ def make_ulysses_consensus(
         attend_self=attend_self,
         non_local_mask=non_local_mask,
     )
-    sharded = jax.shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec, check_vma=False)
+    sharded = shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec)
 
     def consensus_fn(levels: jax.Array) -> jax.Array:
         n, L = levels.shape[1], levels.shape[2]

@@ -67,7 +67,9 @@ class BucketedCompileCache:
     :meth:`__call__` pads, runs the bucket's executable, and slices.
     """
 
-    def __init__(self, fn: Callable, buckets: Sequence[int], *, name: str = "forward"):
+    def __init__(self, fn: Callable, buckets: Sequence[int], *,
+                 name: str = "forward", quant: str = "f32",
+                 donate: Optional[bool] = None):
         buckets = sorted(set(int(b) for b in buckets))
         if not buckets:
             raise ValueError("need at least one bucket size")
@@ -75,7 +77,25 @@ class BucketedCompileCache:
             raise ValueError(f"bucket sizes must be >= 1, got {buckets[0]}")
         self.name = name
         self.buckets: Tuple[int, ...] = tuple(buckets)
-        self._jit_fn = jax.jit(fn)
+        # the quant label of every entry this cache registers: one cache
+        # serves one (endpoint, quant) pair, so executables compiled for
+        # int8 weight trees can never be fed an f32 tree (the aval
+        # mismatch would raise, but the label makes the registry legible:
+        # snapshots, warmup bundles, and /healthz all carry it)
+        from glom_tpu.serving.quant import QUANT_MODES
+
+        if quant not in QUANT_MODES:
+            raise ValueError(f"unknown quant label {quant!r}")
+        self.quant = quant
+        # donate the IMAGE buffer into the executable (params are reused
+        # across requests and must never be donated): every call builds a
+        # fresh padded batch, so its buffer is dead after dispatch — on
+        # TPU this lets XLA alias it for the first layer's scratch.
+        # None => auto: donation is a no-op (with a warning) on CPU.
+        if donate is None:
+            donate = jax.default_backend() != "cpu"
+        self.donates_input = bool(donate)
+        self._jit_fn = jax.jit(fn, donate_argnums=(1,) if donate else ())
         self._compiled: Dict[int, Any] = {}
         self.monitor = RecompileMonitor(self._jit_fn)
         self.snapshots: Dict[int, Dict[str, Any]] = {}
@@ -109,6 +129,10 @@ class BucketedCompileCache:
             snap = profiling.snapshot_from_compiled(lowered, compiled)
             if not keep_hlo:
                 snap.pop("hlo", None)
+            # each registered entry carries its quant label: an operator
+            # reading warmup bundles can tell an int8 executable's cost
+            # model from the f32 one's at a glance
+            snap["quant"] = self.quant
             self.snapshots[bucket] = snap
         # baseline the monitor AFTER warmup: AOT lower/compile never touches
         # the jit dispatch cache, but a zero poll here makes that explicit —

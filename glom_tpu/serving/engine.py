@@ -73,6 +73,7 @@ from glom_tpu.obs.triggers import (
     TriggerEngine,
 )
 from glom_tpu.resilience import faultinject, integrity
+from glom_tpu.serving import quant as serving_quant
 from glom_tpu.serving.batcher import Closed, DynamicBatcher, Overloaded  # noqa: F401
 from glom_tpu.serving.compile_cache import BucketedCompileCache
 from glom_tpu.training import denoise
@@ -179,6 +180,9 @@ class ServingEngine:
         trace_log: Optional[str] = None,
         trace_max_traces: int = 256,
         slos: Optional[Sequence] = None,
+        quant: str = "f32",
+        ff_impl: Optional[str] = None,
+        donate_inputs: Optional[bool] = None,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.registry = registry if registry is not None else MetricRegistry()
@@ -225,20 +229,44 @@ class ServingEngine:
                 checkpoint_dir, observer=self._integrity_obs,
             )
         )
+        if ff_impl is not None:
+            # serving-side kernel override: lets an operator turn the fused
+            # single-launch level update (ff_impl='fused') on/off for a
+            # checkpoint regardless of the config it trained under — the
+            # weights are identical either way
+            import dataclasses
+
+            self.config = dataclasses.replace(self.config, ff_impl=ff_impl)
+        # -- quantized serving (glom_tpu.serving.quant) --------------------
+        # One engine serves ONE quant mode: the compile cache registers its
+        # per-bucket entries under that label, and hot reload re-quantizes
+        # every new checkpoint the same way.  The f32 host tree stays the
+        # restore template; the device tree is the quantized one.
+        if quant not in serving_quant.QUANT_MODES:
+            raise ValueError(
+                f"unknown quant mode {quant!r}; one of {serving_quant.QUANT_MODES}"
+            )
+        self.quant = quant
+        serve_cfg = serving_quant.serving_config(self.config, quant)
         # template for every later reload: restore() places leaves onto the
         # template's dtypes/shardings, so reloads land where the originals did
         self._template = host_params
-        self._params = jax.device_put(host_params)
+        self._params = jax.device_put(serving_quant.quantize_tree(host_params, quant))
         self.step = step
         self.iters = iters
 
         # -- compiled forward per endpoint ---------------------------------
         self.caches: Dict[str, BucketedCompileCache] = {
             "embed": BucketedCompileCache(
-                _make_embed_fn(self.config, iters), buckets, name="embed"),
+                serving_quant.quantized_forward(
+                    _make_embed_fn(serve_cfg, iters), quant),
+                buckets, name="embed", quant=quant, donate=donate_inputs),
             "reconstruct": BucketedCompileCache(
-                _make_reconstruct_fn(self.config, self.train_cfg, iters),
-                buckets, name="reconstruct"),
+                serving_quant.quantized_forward(
+                    _make_reconstruct_fn(serve_cfg, self.train_cfg, iters),
+                    quant),
+                buckets, name="reconstruct", quant=quant,
+                donate=donate_inputs),
         }
         max_bucket = self.caches["embed"].max_bucket
 
@@ -349,7 +377,7 @@ class ServingEngine:
 
         for bucket, snap in cache.snapshots.items():
             files = {"manifest.json": {
-                "endpoint": endpoint, "bucket": bucket,
+                "endpoint": endpoint, "bucket": bucket, "quant": cache.quant,
                 "cost_analysis": snap.get("cost_analysis", {}),
                 "memory_analysis": snap.get("memory_analysis", {}),
             }}
@@ -473,7 +501,11 @@ class ServingEngine:
             _, trees = ckpt_lib.restore(
                 self.checkpoint_dir, {"params": self._template}, step=newest,
             )
-            new_params = jax.device_put(trees["params"])
+            # re-quantize exactly like startup: a reload must land in the
+            # same dtype layout the AOT executables were compiled against
+            new_params = jax.device_put(
+                serving_quant.quantize_tree(trees["params"], self.quant)
+            )
             # block before the swap: a reload must never make the first
             # request after it pay the H2D transfer
             jax.block_until_ready(jax.tree_util.tree_leaves(new_params)[0])
@@ -678,6 +710,9 @@ class ServingEngine:
             "warm": all(cache.warmed for cache in self.caches.values()),
             "queue_depth": {ep: b.depth for ep, b in self.batchers.items()},
             "buckets": list(self.caches["embed"].buckets),
+            "quant": self.quant,
+            "ff_impl": c.ff_impl,
+            "donate_inputs": self.caches["embed"].donates_input,
             "image_size": c.image_size,
             "channels": c.channels,
             "levels": c.levels,

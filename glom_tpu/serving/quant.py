@@ -1,0 +1,219 @@
+"""Reduced-precision serving variants: int8 weights / bf16 activations.
+
+Serving a frozen checkpoint is weight-bandwidth-bound long before it is
+FLOP-bound (the Gemma-on-TPU study, arXiv:2605.25645: once batching and
+AOT compilation are in place, reduced-precision inference is the dominant
+remaining lever).  Three quant modes, selected per engine:
+
+  * ``f32``  — the checkpoint's native dtype; the accuracy reference.
+  * ``bf16`` — every float leaf cast to bf16 HOST-SIDE (half the HBM
+    footprint and half the weight-fetch bandwidth; an in-graph cast would
+    keep f32 in HBM) and bf16 compute.
+  * ``int8`` — weight-only symmetric per-output-channel int8: matrix
+    leaves are stored as ``{"int8_q": int8, "int8_scale": f32}`` and
+    dequantized IN-GRAPH to bf16 right before the matmul (XLA fuses the
+    dequant into the weight read, so HBM traffic is 1 byte/weight);
+    activations run bf16.  Vectors (biases, pos/init embeddings) stay
+    bf16 — they are bandwidth-trivial and quantizing them costs accuracy
+    for nothing.
+
+``accuracy_report`` is the bit-accuracy harness contract
+(``tools/quant_check.py``): per-level cosine / max-abs error of each
+quant mode against the f32 reference on the two serving endpoints.  The
+documented acceptance thresholds live in :data:`ACCURACY_THRESHOLDS`;
+a mode that misses them must not be deployed (the harness exits
+nonzero).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+QUANT_MODES = ("f32", "bf16", "int8")
+
+# Acceptance thresholds of the bit-accuracy harness, per quant mode:
+# cosine similarity vs the f32 reference (per level for /embed, whole
+# tensor for /reconstruct) must be >= `cosine`, and the max abs error
+# normalized by the f32 output's abs max must be <= `max_abs_rel`.
+# Calibrated on the demo + tiny configs with ~4x margin over measured
+# error (int8 measured ~0.9999 cosine / ~0.01 rel; bf16 tighter) —
+# tools/quant_check.py enforces them, tests/test_quant.py pins them.
+ACCURACY_THRESHOLDS = {
+    "bf16": {"cosine": 0.995, "max_abs_rel": 0.05},
+    "int8": {"cosine": 0.99, "max_abs_rel": 0.10},
+}
+
+_QKEY, _SKEY = "int8_q", "int8_scale"
+
+
+def _is_qleaf(x) -> bool:
+    return isinstance(x, dict) and _QKEY in x and _SKEY in x
+
+
+def _quantize_leaf_int8(w: jax.Array) -> dict:
+    """Symmetric per-output-channel int8: scale over the input-feature
+    axis only (axis -2), so each output channel keeps its own dynamic
+    range AND leading group axes stay independent — the grouped
+    ``(L, d, h)`` nets get a per-(level, channel) ``(L, 1, h)`` scale
+    rather than one range shared across all level nets (a level whose
+    weights sit 10x lower than another's must not quantize to a handful
+    of codes)."""
+    w32 = np.asarray(w, dtype=np.float32)
+    amax = np.max(np.abs(w32), axis=-2, keepdims=True)
+    scale = (amax / 127.0 + np.float32(amax == 0.0)).astype(np.float32)
+    q = np.clip(np.rint(w32 / scale), -127, 127).astype(np.int8)
+    return {_QKEY: q, _SKEY: scale}
+
+
+# every matmul weight in this model's param trees sits under one of these
+# dict keys (decoder/patch_embed "w", the grouped nets' "w1"/"w2");
+# biases and the pos/init embeddings deliberately never match
+_MATMUL_KEYS = frozenset({"w", "w1", "w2"})
+
+
+def quantize_tree(params, mode: str):
+    """Host-side quantization of a parameter pytree for serving.
+
+    ``f32`` returns the tree unchanged; ``bf16`` casts float leaves;
+    ``int8`` replaces matmul WEIGHT leaves (dict key ``w``/``w1``/``w2``
+    — shape alone would also catch pos_emb/init_levels, whose error lands
+    verbatim in activations instead of washing through a matmul) with
+    ``{"int8_q", "int8_scale"}`` records and casts the rest to bf16.
+    The result round-trips through ``jax.device_put`` and
+    ``ShapeDtypeStruct`` tree_maps like any pytree."""
+    if mode not in QUANT_MODES:
+        raise ValueError(f"unknown quant mode {mode!r}; one of {QUANT_MODES}")
+    if mode == "f32":
+        return params
+
+    def one(path, leaf):
+        arr = np.asarray(leaf)
+        # jnp.issubdtype, not np: a bf16-param checkpoint's ml_dtypes
+        # leaves are floating to jax but not to numpy — np's check would
+        # silently pass every leaf through unquantized
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
+            return leaf
+        key = getattr(path[-1], "key", None) if path else None
+        if mode == "int8" and arr.ndim >= 2 and key in _MATMUL_KEYS:
+            return _quantize_leaf_int8(arr)
+        return arr.astype(jnp.bfloat16)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def dequantize_tree(params):
+    """In-graph inverse: int8 records become bf16 weights (product taken
+    in f32, then cast — one rounding, fused by XLA into the weight read);
+    everything else passes through.  Identity for f32/bf16 trees."""
+
+    def one(leaf):
+        if _is_qleaf(leaf):
+            return (leaf[_QKEY].astype(jnp.float32) * leaf[_SKEY]).astype(
+                jnp.bfloat16
+            )
+        return leaf
+
+    return jax.tree_util.tree_map(one, params, is_leaf=_is_qleaf)
+
+
+def serving_config(config, mode: str):
+    """The model config a quantized engine compiles against: bf16 compute
+    for the reduced-precision modes, untouched for f32."""
+    if mode == "f32":
+        return config
+    return dataclasses.replace(config, compute_dtype=jnp.bfloat16)
+
+
+def quantized_forward(fn, mode: str):
+    """Wrap an endpoint forward ``fn(params, imgs)`` so it accepts the
+    quantized tree: dequantization happens INSIDE the traced graph (the
+    whole point — the executable's weight inputs stay int8/bf16)."""
+    if mode == "f32":
+        return fn
+
+    def f(qparams, imgs):
+        return fn(dequantize_tree(qparams), imgs)
+
+    return f
+
+
+# ---------------------------------------------------------------------------
+# bit-accuracy harness core (tools/quant_check.py is the CLI)
+# ---------------------------------------------------------------------------
+
+
+def _cosine(a: np.ndarray, b: np.ndarray) -> float:
+    a = a.astype(np.float64).ravel()
+    b = b.astype(np.float64).ravel()
+    denom = float(np.linalg.norm(a) * np.linalg.norm(b))
+    return float(a @ b / denom) if denom else 1.0
+
+
+def _errors(ref: np.ndarray, got: np.ndarray) -> Dict[str, float]:
+    ref = np.asarray(ref, np.float32)
+    got = np.asarray(got, np.float32)
+    scale = float(np.max(np.abs(ref))) or 1.0
+    return {
+        "cosine": round(_cosine(ref, got), 6),
+        "max_abs": round(float(np.max(np.abs(ref - got))), 6),
+        "max_abs_rel": round(float(np.max(np.abs(ref - got))) / scale, 6),
+    }
+
+
+def accuracy_report(config, train_cfg, params, imgs,
+                    modes=("bf16", "int8"), *, iters: Optional[int] = None):
+    """Run each quant mode against the f32 reference on both serving
+    endpoints; returns ``{mode: {"embed": {...per-level + overall...},
+    "reconstruct": {...}, "pass": bool}}``.  Per-level rows for /embed —
+    GLOM's levels are the product being served, and quantization error
+    concentrates in the upper levels (more matmuls deep)."""
+    from glom_tpu.serving.engine import _make_embed_fn, _make_reconstruct_fn
+
+    def run(mode):
+        cfg = serving_config(config, mode)
+        qp = jax.device_put(quantize_tree(params, mode))
+        embed = jax.jit(quantized_forward(_make_embed_fn(cfg, iters), mode))
+        recon = jax.jit(
+            quantized_forward(_make_reconstruct_fn(cfg, train_cfg, iters), mode)
+        )
+        return np.asarray(embed(qp, imgs)), np.asarray(recon(qp, imgs))
+
+    ref_embed, ref_recon = run("f32")
+    report = {}
+    for mode in modes:
+        if mode == "f32":
+            continue
+        got_embed, got_recon = run(mode)
+        levels = {
+            f"level_{l}": _errors(ref_embed[:, l], got_embed[:, l])
+            for l in range(ref_embed.shape[1])
+        }
+        embed_err = _errors(ref_embed, got_embed)
+        recon_err = _errors(ref_recon, got_recon)
+        thr = ACCURACY_THRESHOLDS[mode]
+        worst_cos = min(
+            [embed_err["cosine"], recon_err["cosine"]]
+            + [v["cosine"] for v in levels.values()]
+        )
+        # per-level rows participate like they do in worst_cos: each level
+        # normalizes by its OWN abs-max, so a degraded upper level cannot
+        # hide behind the whole-tensor scale (dominated by level 0)
+        worst_rel = max(
+            [embed_err["max_abs_rel"], recon_err["max_abs_rel"]]
+            + [v["max_abs_rel"] for v in levels.values()]
+        )
+        report[mode] = {
+            "embed": {"overall": embed_err, **levels},
+            "reconstruct": recon_err,
+            "thresholds": dict(thr),
+            "worst_cosine": round(worst_cos, 6),
+            "worst_max_abs_rel": round(worst_rel, 6),
+            "pass": bool(worst_cos >= thr["cosine"]
+                         and worst_rel <= thr["max_abs_rel"]),
+        }
+    return report

@@ -273,6 +273,26 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--buckets", default="1,2,4,8",
                    help="comma-separated batch buckets, padded up to")
+    p.add_argument("--buckets-file", default=None,
+                   help="JSON file holding the bucket ladder — either a "
+                        "plain list or a tools/trace_report.py "
+                        "--suggest-buckets payload (its 'suggested_buckets' "
+                        "key); overrides --buckets.  The measured auto-tune "
+                        "loop: serve with --trace-log, run trace_report "
+                        "--suggest-buckets, restart with the emitted file")
+    p.add_argument("--quant", default="f32", choices=["f32", "bf16", "int8"],
+                   help="serving precision: bf16 = half-size weights + bf16 "
+                        "compute; int8 = weight-only symmetric int8 "
+                        "(dequantized in-graph, bf16 activations).  Gate a "
+                        "non-f32 rollout on tools/quant_check.py first")
+    p.add_argument("--ff-impl", default=None,
+                   choices=["dense", "pallas", "fused"],
+                   help="override the checkpoint config's kernel choice "
+                        "(fused = single-launch level update)")
+    p.add_argument("--no-donate", action="store_true",
+                   help="keep the executables' input image buffers "
+                        "un-donated (debugging aid; donation is the default "
+                        "off-CPU)")
     p.add_argument("--max-wait-ms", type=float, default=5.0,
                    help="micro-batch deadline: flush a partial batch after this")
     p.add_argument("--max-queue", type=int, default=64,
@@ -316,9 +336,23 @@ def main(argv=None) -> int:
         make_demo_checkpoint(args.checkpoint_dir)
         print(json.dumps({"event": "demo_checkpoint", "dir": args.checkpoint_dir}))
 
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    if args.buckets_file:
+        with open(args.buckets_file) as f:
+            ladder = json.load(f)
+        if isinstance(ladder, dict):
+            ladder = ladder.get("suggested_buckets")
+        if not (isinstance(ladder, list) and ladder
+                and all(isinstance(b, int) and b >= 1 for b in ladder)):
+            raise SystemExit(
+                f"--buckets-file {args.buckets_file!r} holds no usable "
+                f"ladder (want a list of ints or a --suggest-buckets payload)"
+            )
+        buckets = tuple(ladder)
+
     engine = ServingEngine(
         args.checkpoint_dir,
-        buckets=tuple(int(b) for b in args.buckets.split(",")),
+        buckets=buckets,
         iters=args.iters,
         max_wait_ms=args.max_wait_ms,
         max_queue=args.max_queue,
@@ -328,6 +362,9 @@ def main(argv=None) -> int:
         forensics_dir=args.forensics_dir,
         trace_log=args.trace_log,
         slos=args.slo,
+        quant=args.quant,
+        ff_impl=args.ff_impl,
+        donate_inputs=False if args.no_donate else None,
     )
     engine.start()
     server = make_server(engine, args.host, args.port, quiet=not args.verbose)
@@ -349,7 +386,8 @@ def main(argv=None) -> int:
     print(json.dumps({
         "event": "serving", "host": host, "port": port,
         "step": int(engine.step), "buckets": engine.health()["buckets"],
-        "warm": engine.health()["warm"],
+        "warm": engine.health()["warm"], "quant": engine.quant,
+        "ff_impl": engine.config.ff_impl,
     }), flush=True)
     try:
         server.serve_forever(poll_interval=0.2)

@@ -65,7 +65,7 @@ def resolve_loss_timestep(train: TrainConfig, iters: int) -> int:
 
 
 def make_loss_fn(config: GlomConfig, train: TrainConfig, *, consensus_fn=None,
-                 ff_fn=None, apply_fn=None, state_sharding=None):
+                 ff_fn=None, fused_fn=None, apply_fn=None, state_sharding=None):
     """loss(params, img, rng) -> (loss, recon).  Mirrors README.md:74-88.
 
     ``apply_fn`` overrides the forward entirely — a pipeline-parallel caller
@@ -101,7 +101,7 @@ def make_loss_fn(config: GlomConfig, train: TrainConfig, *, consensus_fn=None,
             _, captured = glom_model.apply(
                 params["glom"], noised, config=config, iters=iters,
                 capture_timestep=timestep, consensus_fn=consensus_fn, ff_fn=ff_fn,
-                state_sharding=state_sharding,
+                fused_fn=fused_fn, state_sharding=state_sharding,
             )
         # level selection (reference: all_levels[t][..., -1]) + decode live
         # in decoder_apply; arch='linear' is the exact reference recipe
@@ -136,6 +136,7 @@ def make_step_fn(
     *,
     consensus_fn=None,
     ff_fn=None,
+    fused_fn=None,
     apply_fn=None,
     microbatch_sharding=None,
     state_sharding=None,
@@ -150,7 +151,8 @@ def make_step_fn(
     (InfoNCE consistency) see per-microbatch negatives instead — documented
     semantics, not drift."""
     loss_fn = make_loss_fn(config, train, consensus_fn=consensus_fn, ff_fn=ff_fn,
-                           apply_fn=apply_fn, state_sharding=state_sharding)
+                           fused_fn=fused_fn, apply_fn=apply_fn,
+                           state_sharding=state_sharding)
     accum = train.grad_accum_steps
 
     def step_fn(state: DenoiseState, img: jax.Array) -> Tuple[DenoiseState, dict]:

@@ -128,6 +128,7 @@ def make_psnr_fn(
     data_range: float = 2.0,
     consensus_fn=None,
     ff_fn=None,
+    fused_fn=None,
     state_sharding=None,
     decoder: str = "linear",
 ):
@@ -145,7 +146,7 @@ def make_psnr_fn(
         _, captured = glom_model.apply(
             params["glom"], noised, config=config, iters=iters,
             capture_timestep=timestep, consensus_fn=consensus_fn, ff_fn=ff_fn,
-            state_sharding=state_sharding,
+            fused_fn=fused_fn, state_sharding=state_sharding,
         )
         recon = decoder_apply(
             params["decoder"], captured, config, arch=decoder, level=level

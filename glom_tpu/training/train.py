@@ -32,7 +32,12 @@ def parse_args(argv=None):
                    help="what the scan-body checkpoint saves (dots = keep "
                         "matmul outputs, recompute only elementwise)")
     p.add_argument("--attention-impl", default="dense", choices=["auto", "dense", "pallas", "ring", "ulysses"])
-    p.add_argument("--ff-impl", default="dense", choices=["dense", "pallas"])
+    p.add_argument("--ff-impl", default="dense",
+                   choices=["dense", "pallas", "fused"],
+                   help="fused = the single-launch level-update kernel "
+                        "(consensus + both FFs in one Pallas call); falls "
+                        "back to the unfused pallas pair where its shape "
+                        "predicates or the mesh don't support it")
     p.add_argument("--fused-ff-bwd", action="store_true",
                    help="with --ff-impl pallas: gradients via the fused Pallas "
                         "backward kernels (hidden recomputed in VMEM) instead "

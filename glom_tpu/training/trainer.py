@@ -222,22 +222,54 @@ class Trainer:
         self.state = init_fn()
 
         ff_fn = None
-        if config.ff_impl == "pallas" and self.mesh.devices.size > 1:
-            # pallas_call is opaque to GSPMD — run the kernel inside a
-            # shard_map matching the actual param/batch placements so each
-            # device sees only its shard (TP gets the row-parallel psum)
-            from glom_tpu.parallel.ff_shard import make_sharded_ff_pallas
+        fused_fn = None
+        if config.ff_impl in ("pallas", "fused") and self.mesh.devices.size > 1:
+            from glom_tpu.models.glom import fused_update_supported
 
-            ff_fn = make_sharded_ff_pallas(
-                self.mesh,
-                param_sharding=train.param_sharding,
-                data_axis=data_axis,
-                model_axis=model_axis,
-                seq_axis=train.mesh_axes[2] if len(train.mesh_axes) > 2 else None,
-                fused_bwd=config.ff_fused_bwd,
-                extra_expert_axes=expert_axes,
-            )
+            seq_ax_name = train.mesh_axes[2] if len(train.mesh_axes) > 2 else None
+            seq_sharded = (seq_ax_name is not None
+                           and self.mesh.shape.get(seq_ax_name, 1) > 1)
+            params_sharded = (train.param_sharding != "replicated"
+                              and self.mesh.shape[model_axis] > 1)
+            if (config.ff_impl == "fused" and fused_update_supported(config)
+                    and not seq_sharded and not params_sharded):
+                # pure DP / replicated params: the whole update runs as one
+                # Pallas launch per shard (parallel/fused_shard.py).  Any
+                # seq/TP/EP sharding is structurally incompatible with the
+                # one-shot consensus + whole-net weight blocks — those
+                # meshes fall through to the proven sharded unfused pair.
+                from glom_tpu.parallel.fused_shard import make_sharded_fused_update
+
+                fused_fn = make_sharded_fused_update(
+                    self.mesh, config, data_axis=data_axis,
+                )
+            else:
+                if config.ff_impl == "fused":
+                    import warnings
+
+                    warnings.warn(
+                        "ff_impl='fused' does not support this mesh/shape "
+                        "(seq- or model-sharded, or supports_config failed); "
+                        "falling back to the sharded unfused pallas FF",
+                        stacklevel=2,
+                    )
+                # pallas_call is opaque to GSPMD — run the kernel inside a
+                # shard_map matching the actual param/batch placements so
+                # each device sees only its shard (TP gets the row-parallel
+                # psum)
+                from glom_tpu.parallel.ff_shard import make_sharded_ff_pallas
+
+                ff_fn = make_sharded_ff_pallas(
+                    self.mesh,
+                    param_sharding=train.param_sharding,
+                    data_axis=data_axis,
+                    model_axis=model_axis,
+                    seq_axis=seq_ax_name,
+                    fused_bwd=config.ff_fused_bwd,
+                    extra_expert_axes=expert_axes,
+                )
         self._ff_fn = ff_fn
+        self._fused_fn = fused_fn
 
         consensus_fn = None
         if config.attention_impl in ("ring", "ulysses"):
@@ -280,7 +312,7 @@ class Trainer:
                 make_psnr_fn(
                     config, noise_std=train.noise_std, iters=train.iters,
                     timestep=train.loss_timestep, level=train.loss_level,
-                    consensus_fn=consensus_fn, ff_fn=ff_fn,
+                    consensus_fn=consensus_fn, ff_fn=ff_fn, fused_fn=fused_fn,
                     state_sharding=act_sh, decoder=train.decoder,
                 )
             )
@@ -292,7 +324,8 @@ class Trainer:
         self._step = jax.jit(
             denoise.make_step_fn(
                 config, train, tx, consensus_fn=consensus_fn, ff_fn=ff_fn,
-                microbatch_sharding=micro_sh, state_sharding=act_sh,
+                fused_fn=fused_fn, microbatch_sharding=micro_sh,
+                state_sharding=act_sh,
             ),
             in_shardings=(self._state_sh, self._batch_sh),
             out_shardings=(self._state_sh, NamedSharding(self.mesh, P())),
@@ -375,7 +408,7 @@ class Trainer:
 
             self._diag = jax.jit(make_diagnostics_fn(
                 self.config, iters=train.iters, consensus_fn=consensus_fn,
-                ff_fn=ff_fn, state_sharding=act_sh,
+                ff_fn=ff_fn, fused_fn=fused_fn, state_sharding=act_sh,
             ))
 
     def set_eval_suite(self, suite) -> None:
@@ -654,6 +687,21 @@ class Trainer:
             {"params": self.state.params, "opt": self.state.opt_state, "rng": self.state.rng},
             step=step, observer=self._integrity_obs,
         )
+        # Launder the restored trees through a non-donating jit identity
+        # BEFORE they reach the donating step.  The npz restore yields host
+        # numpy arrays, and on the CPU backend both the direct jit feed and
+        # ``jax.device_put`` can zero-copy alias the numpy heap allocation;
+        # donating such a buffer has XLA free memory numpy still owns
+        # (glibc "corrupted double-linked list", reliably fatal under
+        # persistent-cache-deserialized step executables).  A jit identity
+        # forces XLA-owned output buffers — donation-safe by construction —
+        # and its out_shardings restore the mesh placement the step's
+        # in_shardings expect.
+        trees = jax.jit(
+            lambda t: t,
+            out_shardings={"params": self._state_sh.params,
+                           "opt": self._state_sh.opt_state,
+                           "rng": self._state_sh.rng})(trees)
         self.state = denoise.DenoiseState(
             trees["params"], trees["opt"], jnp.asarray(step, jnp.int32), trees["rng"]
         )

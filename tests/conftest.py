@@ -9,7 +9,9 @@ is not enough; we must update the config after importing jax (before any
 backend initializes).
 """
 
+import hashlib
 import os
+import tempfile
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -19,6 +21,39 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compile cache: dozens of tests build byte-identical step
+# functions (same tiny configs, fresh closures), and jax's in-memory jit
+# cache can't see across them — the on-disk cache dedupes those compiles,
+# roughly halving compile-bound suite time even from cold.  Executables
+# are reused byte-for-byte (the key covers HLO + compile options + jaxlib
+# version), so numerics are untouched; recompile-monitor tests still see
+# every compile because the tracing/lowering path runs either way.
+# Deliberately jax.config, NOT os.environ: subprocess tests (bench.py,
+# tools/chaos.py) exercise cold-compile and recompile-guard behavior and
+# must not see a warm cache.  (An earlier SIGABRT under an inherited
+# cache — "corrupted double-linked list" — was the donation/aliasing bug
+# since fixed in Trainer.restore, not cache sharing itself; cold
+# subprocess compiles remain the intended semantics regardless.)
+# The directory is keyed on uid + checkout path so two concurrent pytest
+# runs (two worktrees, overlapping CI jobs, or different users on one
+# host) never share one cache: cross-process sharing is unvalidated on
+# this jaxlib, and a first-user-owned /tmp dir would be unwritable for
+# everyone else.
+# Opt out with GLOM_TEST_NO_COMPILE_CACHE=1 (e.g. to time true compiles).
+if not os.environ.get("GLOM_TEST_NO_COMPILE_CACHE"):
+    _checkout_key = hashlib.sha1(
+        os.path.dirname(os.path.abspath(__file__)).encode()).hexdigest()[:12]
+    _uid = os.getuid() if hasattr(os, "getuid") else 0
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(tempfile.gettempdir(),
+                     f"glom_tpu_test_xla_cache_u{_uid}_{_checkout_key}"))
+    # default min is 1s, which skips exactly the small-model compiles the
+    # suite repeats hundreds of times
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    jax.config.update("jax_compilation_cache_max_size",
+                      512 * 1024 * 1024)  # LRU-bounded
 
 
 def write_image(path, arr):

@@ -1,0 +1,349 @@
+"""Perf-regression gate (glom_tpu.obs.perfgate + tools/bench_gate.py) and
+the bucket-ladder auto-tune (tools/trace_report.py --suggest-buckets).
+
+These ARE the tier-1 wiring of `bench_gate --check`: the golden fixtures
+under tests/data/bench_gate/ are replayed on every CI run with no
+accelerator, so the gate logic itself cannot rot between hardware
+windows."""
+
+import json
+import os
+import runpy
+import sys
+
+import pytest
+
+from glom_tpu.obs import perfgate
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+
+def _run_tool(name, argv, capsys):
+    path = os.path.join(TOOLS, name)
+    old = sys.argv
+    sys.argv = [path] + argv
+    try:
+        with pytest.raises(SystemExit) as e:
+            runpy.run_path(path, run_name="__main__")
+        code = e.value.code
+    finally:
+        sys.argv = old
+    out = capsys.readouterr()
+    return code or 0, out.out, out.err
+
+
+# ---------------------------------------------------------------------------
+# record classification (the bench.py "skipped" satellite contract)
+# ---------------------------------------------------------------------------
+class TestRecordStatus:
+    def test_measured(self):
+        assert perfgate.record_status({"value": 288.6, "status": "ok"}) == "ok"
+
+    def test_new_style_skip(self):
+        assert perfgate.record_status(
+            {"status": "skipped", "reason": "relay unreachable"}) == "skipped"
+
+    def test_legacy_relay_shape_is_skip(self):
+        """The exact BENCH_r05 shape: value 0.0 + unreachable error must
+        read as an outage, never a regression."""
+        with open(os.path.join(REPO, "BENCH_r05.json")) as f:
+            rec = json.load(f)["parsed"]
+        assert perfgate.record_status(rec) == "skipped"
+
+    def test_zero_value_with_real_error_is_error(self):
+        assert perfgate.record_status(
+            {"value": 0.0, "error": "implausible rate — timing fault"}
+        ) == "error"
+
+    def test_non_tpu_backend_is_skip_even_with_ok_shape(self):
+        """A CPU-fallback measurement carries status "ok" and value > 0 —
+        the backend stamp must still classify it as an outage."""
+        assert perfgate.record_status(
+            {"value": 0.06, "status": "ok", "backend": "cpu"}) == "skipped"
+        assert perfgate.record_status(
+            {"value": 288.6, "status": "ok", "backend": "tpu"}) == "ok"
+
+
+class TestTrajectory:
+    def test_reads_repo_rounds_and_reference(self):
+        rounds = perfgate.load_trajectory(os.path.join(REPO, "BENCH_*.json"))
+        assert len(rounds) >= 5
+        ref = perfgate.reference_value(rounds)
+        assert ref is not None
+        value, provenance = ref
+        assert value > 0 and "BENCH" in provenance
+
+    def test_newest_measured_wins_over_older_skip(self, tmp_path):
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+            {"n": 1, "parsed": {"value": 100.0, "status": "ok"}}))
+        (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+            {"n": 2, "parsed": {"value": 250.0, "status": "ok"}}))
+        (tmp_path / "BENCH_r03.json").write_text(json.dumps(
+            {"n": 3, "parsed": {"status": "skipped", "reason": "unreachable",
+                                "last_measured": {"value": 250.0,
+                                                  "when": "r2"}}}))
+        value, provenance = perfgate.reference_value(
+            perfgate.load_trajectory(str(tmp_path / "BENCH_*.json")))
+        assert value == 250.0 and "r03" in provenance  # carried forward
+
+    def test_cpu_fallback_round_never_becomes_reference(self, tmp_path):
+        """A fallback capture recorded into the trajectory (status "ok",
+        backend "cpu") must read as skipped — a local 0.06 imgs/sec/chip
+        silently replacing the hardware reference would make every later
+        round "pass" regardless of regression."""
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+            {"n": 1, "parsed": {"value": 250.0, "status": "ok"}}))
+        (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+            {"n": 2, "parsed": {"value": 0.06, "status": "ok",
+                                "backend": "cpu"}}))
+        rounds = perfgate.load_trajectory(str(tmp_path / "BENCH_*.json"))
+        assert [r["status"] for r in rounds] == ["ok", "skipped"]
+        value, provenance = perfgate.reference_value(rounds)
+        assert value == 250.0 and "r01" in provenance
+
+    def test_unnumbered_record_sorts_oldest_never_hijacks_reference(
+            self, tmp_path):
+        """A bare bench record in the glob (no ``n`` round number, legacy
+        shape without a backend stamp) has unknown recency — it must sort
+        before every numbered round so newest-wins reference selection
+        still lands on the latest driver capture."""
+        (tmp_path / "BENCH_local.json").write_text(json.dumps(
+            {"value": 150.0, "status": "ok"}))  # bare record, no "n"
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+            {"n": 1, "parsed": {"value": 288.6, "status": "ok"}}))
+        rounds = perfgate.load_trajectory(str(tmp_path / "BENCH_*.json"))
+        assert [r["path"] for r in rounds] == ["BENCH_local.json",
+                                               "BENCH_r01.json"]
+        value, provenance = perfgate.reference_value(rounds)
+        assert value == 288.6 and "r01" in provenance
+
+
+class TestEvaluate:
+    def test_synthetic_10pct_regression_fails(self):
+        got = perfgate.evaluate_throughput(
+            {"value": 288.6 * 0.89, "status": "ok"}, 288.6)
+        assert got["gate"] == perfgate.GATE_FAIL
+
+    def test_within_allowance_passes(self):
+        got = perfgate.evaluate_throughput(
+            {"value": 288.6 * 0.95, "status": "ok"}, 288.6)
+        assert got["gate"] == perfgate.GATE_PASS
+
+    def test_outage_skips(self):
+        got = perfgate.evaluate_throughput(
+            {"status": "skipped", "reason": "relay unreachable"}, 288.6)
+        assert got["gate"] == perfgate.GATE_SKIP
+
+    def test_cpu_fallback_measurement_skips(self):
+        """bench.py's CPU fallback measures an honest (tiny) local number;
+        the gate must read it as an outage — not a 100% regression against
+        the recorded hardware trajectory.  Absent ``backend`` (legacy /
+        hardware records) keeps the normal gating."""
+        got = perfgate.evaluate_throughput(
+            {"value": 0.06, "status": "ok", "backend": "cpu"}, 288.6)
+        assert got["gate"] == perfgate.GATE_SKIP
+        assert "not comparable" in got["detail"]
+        got = perfgate.evaluate_throughput(
+            {"value": 288.6, "status": "ok", "backend": "tpu"}, 288.6)
+        assert got["gate"] == perfgate.GATE_PASS
+
+    def test_cpu_fallback_zero_value_still_skips(self):
+        """A fallback so slow its rounded throughput is 0.0 classifies as
+        "error" by value alone — the backend check must win so an
+        accelerator outage never hard-fails the gate."""
+        got = perfgate.evaluate_throughput(
+            {"value": 0.0, "status": "ok", "backend": "cpu"}, 288.6)
+        assert got["gate"] == perfgate.GATE_SKIP
+        assert "not comparable" in got["detail"]
+
+    def test_p95_regression_fails_and_improvement_passes(self):
+        assert perfgate.evaluate_p95(50.0, 40.0)["gate"] == perfgate.GATE_FAIL
+        assert perfgate.evaluate_p95(39.0, 40.0)["gate"] == perfgate.GATE_PASS
+
+    def test_combine(self):
+        f = {"gate": perfgate.GATE_FAIL}
+        s = {"gate": perfgate.GATE_SKIP}
+        p = {"gate": perfgate.GATE_PASS}
+        assert perfgate.combine(p, f) == perfgate.GATE_FAIL
+        assert perfgate.combine(s, s) == perfgate.GATE_SKIP
+        assert perfgate.combine(p, s) == perfgate.GATE_PASS
+
+
+# ---------------------------------------------------------------------------
+# CLI: --check (the tier-1 smoke) and --record plumbing
+# ---------------------------------------------------------------------------
+def test_bench_gate_check_fixtures(capsys):
+    code, out, _ = _run_tool("bench_gate.py", ["--check"], capsys)
+    assert code == 0
+    assert "check ok" in out and "8 fixtures" in out
+
+
+def test_bench_gate_record_fail_and_skip(tmp_path, capsys):
+    rec = tmp_path / "rec.json"
+    rec.write_text(json.dumps({"value": 200.0, "status": "ok"}))
+    code, out, _ = _run_tool("bench_gate.py", ["--record", str(rec)], capsys)
+    assert code == 1 and json.loads(out)["gate"] == "fail"
+
+    rec.write_text(json.dumps({"status": "skipped",
+                               "reason": "relay unreachable"}))
+    code, out, err = _run_tool("bench_gate.py", ["--record", str(rec)], capsys)
+    assert code == 0 and json.loads(out)["gate"] == "skip"
+    assert "NOT a pass" in err
+
+    # throughput skip + passing p95: overall "pass" (exit 0) but the skip
+    # warning must still be loud — the throughput half went ungated
+    rec.write_text(json.dumps({"status": "skipped",
+                               "reason": "relay unreachable"}))
+    loadgen = tmp_path / "loadgen.json"
+    loadgen.write_text(json.dumps({"latency_ms": {"p95": 40.0}}))
+    code, out, err = _run_tool(
+        "bench_gate.py",
+        ["--record", str(rec), "--loadgen-json", str(loadgen),
+         "--p95-baseline-ms", "42"],
+        capsys)
+    result = json.loads(out)
+    assert code == 0 and result["gate"] == "pass"
+    assert result["throughput"]["gate"] == "skip"
+    assert "SKIP on throughput" in err and "NOT a pass" in err
+
+    rec.write_text(json.dumps({"value": 400.0, "status": "ok"}))
+    code, out, _ = _run_tool(
+        "bench_gate.py",
+        ["--record", str(rec), "--prom-textfile", str(tmp_path / "prom.txt")],
+        capsys)
+    assert code == 0
+    prom = (tmp_path / "prom.txt").read_text()
+    assert "bench_gate_verdict 1" in prom
+
+
+# ---------------------------------------------------------------------------
+# bucket-ladder auto-tune golden test
+# ---------------------------------------------------------------------------
+def _trace_feed(path, sizes, bucket=8):
+    """A minimal trace JSONL: one trace per batch, each with one execute
+    span annotated the way the compile cache annotates them."""
+    with open(path, "w") as f:
+        for i, s in enumerate(sizes):
+            f.write(json.dumps({
+                "trace_id": f"t{i}", "root": "request", "duration_ms": 1.0,
+                "spans": [
+                    {"span_id": f"r{i}", "name": "request", "root_span": True,
+                     "start": float(i), "end": float(i) + 0.001,
+                     "duration_ms": 1.0},
+                    {"span_id": f"e{i}", "name": "execute",
+                     "parent_id": f"r{i}",
+                     "start": float(i), "end": float(i) + 0.0005,
+                     "duration_ms": 0.5,
+                     "attrs": {"bucket": bucket, "images": s,
+                               "padding_waste": (bucket - s) / bucket}},
+                ],
+            }) + "\n")
+
+
+def test_suggest_ladder_exact_dp():
+    from tools.trace_report import suggest_ladder
+
+    # sizes {1: x3, 2, 3, 8}: the optimal 2-bucket ladder is [3, 8]
+    # (padded slots: (3-1)*3 + (3-2) + 0 = 7), strictly better than
+    # [1, 8] (11) or [2, 8] (8)
+    ladder, padded = suggest_ladder([1, 1, 1, 2, 3, 8], 2)
+    assert ladder == [3, 8] and padded == 7
+    # enough buckets => exact cover, zero waste
+    ladder, padded = suggest_ladder([1, 1, 1, 2, 3, 8], 4)
+    assert ladder == [1, 2, 3, 8] and padded == 0
+
+
+def test_suggest_buckets_tool_and_server_accepts_file(tmp_path, capsys):
+    feed = tmp_path / "traces.jsonl"
+    _trace_feed(str(feed), [1, 1, 1, 2, 3, 8])
+    code, out, _ = _run_tool(
+        "trace_report.py",
+        [str(feed), "--suggest-buckets", "--ladder-size", "2"], capsys)
+    assert code == 0
+    payload = json.loads(out)
+    assert payload["suggested_buckets"] == [3, 8]
+    assert payload["observed_batches"] == 6
+    assert (payload["suggested_mean_padding_waste"]
+            < payload["current_mean_padding_waste"])
+    # the server-side acceptance path parses exactly this payload shape
+    ladder_file = tmp_path / "ladder.json"
+    ladder_file.write_text(out)
+    loaded = json.loads(ladder_file.read_text())["suggested_buckets"]
+    assert loaded == [3, 8]
+
+
+# ---------------------------------------------------------------------------
+# bench.py skipped-status satellite (the emit path, no accelerator needed)
+# ---------------------------------------------------------------------------
+def test_bench_emit_error_classifies_outage_vs_fault(capsys):
+    """Drive bench.py's _emit_error through the device-guard contract:
+    an unreachable relay must print status=skipped and raise
+    SystemExit(0); a genuine fault keeps the error shape."""
+    import subprocess
+
+    code = (
+        "import json, sys\n"
+        "sys.argv = ['bench.py']\n"
+        "import bench\n"
+        "import glom_tpu.device_guard as dg\n"
+        "def fake_guarded(platform, timeout, emit):\n"
+        "    emit('accelerator relay 127.0.0.1:8083 unreachable for 240s "
+        "(retry-polled)')\n"
+        "    raise SystemExit(2)\n"
+        "dg_mod = sys.modules['glom_tpu.device_guard']\n"
+        "dg_mod.guarded_jax_init = fake_guarded\n"
+        "try:\n"
+        "    bench.main()\n"
+        "except SystemExit as e:\n"
+        "    print('EXIT:' + str(e.code))\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                          capture_output=True, text=True, timeout=120)
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert lines, proc.stdout + proc.stderr
+    rec = json.loads(lines[-1])
+    assert rec["status"] == "skipped"
+    assert "unreachable" in rec["reason"]
+    assert "value" not in rec  # no fake 0.0 for the trend tooling
+    assert rec["last_measured"]["value"] > 0
+    assert "EXIT:0" in proc.stdout  # outage exits 0, not 2
+
+
+def test_bench_emit_error_from_watchdog_thread_does_not_raise():
+    """The init watchdog calls the emit callback from its timer THREAD; a
+    SystemExit raised there is swallowed by threading and would cancel the
+    watchdog's own os._exit(2) — i.e. the silent hang the guard exists to
+    prevent.  The skip-exit must fire only on the main thread."""
+    import subprocess
+
+    code = (
+        "import json, sys, threading\n"
+        "sys.argv = ['bench.py']\n"
+        "import bench\n"
+        "import glom_tpu.device_guard as dg\n"
+        "def fake_guarded(platform, timeout, emit):\n"
+        "    raised = []\n"
+        "    def from_watchdog():\n"
+        "        try:\n"
+        "            emit('device init exceeded 240s (accelerator "
+        "unreachable or backend wedged)')\n"
+        "        except SystemExit:\n"
+        "            raised.append(True)\n"
+        "    t = threading.Thread(target=from_watchdog)\n"
+        "    t.start(); t.join()\n"
+        "    print('RAISED:' + str(bool(raised)))\n"
+        "    raise SystemExit(2)\n"
+        "sys.modules['glom_tpu.device_guard'].guarded_jax_init = fake_guarded\n"
+        "try:\n"
+        "    bench.main()\n"
+        "except SystemExit as e:\n"
+        "    print('EXIT:' + str(e.code))\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                          capture_output=True, text=True, timeout=120)
+    assert "RAISED:False" in proc.stdout, proc.stdout + proc.stderr
+    assert "EXIT:2" in proc.stdout  # the guard's own exit is untouched
+    rec = json.loads([l for l in proc.stdout.splitlines()
+                      if l.startswith("{")][-1])
+    assert rec["status"] == "skipped"  # the record itself still says outage

@@ -1,0 +1,226 @@
+"""Quantized serving path: quantize/dequantize properties, the
+bit-accuracy harness thresholds, engine integration (per-bucket quant
+entries, zero request-path recompiles), and input-buffer donation."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from glom_tpu.serving import quant
+from glom_tpu.serving.compile_cache import BucketedCompileCache
+from glom_tpu.serving.engine import ServingEngine, make_demo_checkpoint
+
+
+@pytest.fixture(scope="module")
+def demo_ckpt(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("quant-ckpt"))
+    make_demo_checkpoint(d)
+    return d
+
+
+def _imgs(k, seed=0, size=16):
+    return np.random.RandomState(seed).randn(k, 3, size, size).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# quantize/dequantize
+# ---------------------------------------------------------------------------
+class TestQuantizeTree:
+    def test_f32_identity(self):
+        tree = {"w": np.ones((16, 16), np.float32)}
+        assert quant.quantize_tree(tree, "f32") is tree
+
+    def test_bf16_casts_floats_only(self):
+        tree = {"w": np.ones((16, 16), np.float32),
+                "step": np.int32(3)}
+        q = quant.quantize_tree(tree, "bf16")
+        assert q["w"].dtype == jnp.bfloat16
+        assert q["step"] == np.int32(3)
+
+    def test_int8_quantizes_matrices_keeps_vectors_bf16(self):
+        tree = {"w": np.random.RandomState(0).randn(16, 32).astype(np.float32),
+                "b": np.random.RandomState(1).randn(32).astype(np.float32)}
+        q = quant.quantize_tree(tree, "int8")
+        assert q["w"]["int8_q"].dtype == np.int8
+        assert q["w"]["int8_scale"].shape == (1, 32)  # per-output-channel
+        assert q["b"].dtype == jnp.bfloat16
+
+    def test_int8_embeddings_stay_bf16_and_bf16_params_still_quantize(self):
+        """pos_emb/init_levels are 2-D and big enough to look like
+        matrices, but their error lands verbatim in activations — they
+        must stay bf16.  And a bf16-param checkpoint must actually
+        quantize (ml_dtypes floats are invisible to np.issubdtype)."""
+        rng = np.random.RandomState(0)
+        tree = {"pos_emb": rng.randn(64, 32).astype(np.float32),
+                "init_levels": rng.randn(8, 32).astype(np.float32),
+                "bottom_up": {"w1": rng.randn(3, 32, 64).astype(np.float32)}}
+        q = quant.quantize_tree(tree, "int8")
+        assert q["pos_emb"].dtype == jnp.bfloat16
+        assert q["init_levels"].dtype == jnp.bfloat16
+        assert q["bottom_up"]["w1"]["int8_q"].dtype == np.int8
+
+        bf16_tree = {"w": np.asarray(
+            rng.randn(16, 32), dtype=jnp.bfloat16)}
+        qb = quant.quantize_tree(bf16_tree, "int8")
+        assert qb["w"]["int8_q"].dtype == np.int8, (
+            "bf16 params silently skipped quantization")
+
+    def test_int8_grouped_nets_get_per_level_scales(self):
+        """The grouped (L, d, h) nets must not share one dynamic range
+        across level nets: a 100x-smaller level keeps its own scale and
+        round-trips with proportionally small error."""
+        rng = np.random.RandomState(0)
+        w = rng.randn(3, 16, 32).astype(np.float32)
+        w[1] *= 0.01
+        q = quant.quantize_tree({"w": w}, "int8")["w"]
+        assert q["int8_scale"].shape == (3, 1, 32)  # per (level, channel)
+        deq = np.asarray(quant.dequantize_tree({"w": q})["w"], np.float32)
+        for lvl in range(3):
+            scale = np.abs(w[lvl]).max()
+            assert np.max(np.abs(deq[lvl] - w[lvl])) / scale < 0.02
+
+    def test_int8_roundtrip_error_bounded(self):
+        w = np.random.RandomState(0).randn(64, 64).astype(np.float32)
+        q = quant.quantize_tree({"w": w}, "int8")
+        deq = np.asarray(quant.dequantize_tree(q)["w"], np.float32)
+        # symmetric per-channel int8 (error <= scale/2) + bf16 storage
+        # rounding (<= amax * 2^-8 per channel)
+        amax = np.abs(w).max(axis=0)
+        bound = amax / 127.0 * 0.5 + amax * 2.0 ** -8 + 1e-6
+        assert np.all(np.abs(deq - w) <= bound[None, :])
+
+    def test_int8_zero_channel_safe(self):
+        w = np.zeros((16, 8), np.float32)
+        q = quant.quantize_tree({"w": w}, "int8")
+        deq = np.asarray(quant.dequantize_tree(q)["w"], np.float32)
+        assert np.all(deq == 0.0) and np.all(np.isfinite(deq))
+
+    def test_unknown_mode_raises(self):
+        with pytest.raises(ValueError, match="unknown quant mode"):
+            quant.quantize_tree({}, "fp4")
+
+    def test_quantized_tree_device_put_and_structs(self):
+        tree = {"w": np.random.RandomState(0).randn(16, 16).astype(np.float32)}
+        q = jax.device_put(quant.quantize_tree(tree, "int8"))
+        structs = jax.tree_util.tree_map(
+            lambda p: jax.ShapeDtypeStruct(np.shape(p), p.dtype), q
+        )
+        assert structs["w"]["int8_q"].dtype == jnp.int8
+
+
+# ---------------------------------------------------------------------------
+# bit-accuracy harness
+# ---------------------------------------------------------------------------
+def test_accuracy_report_passes_thresholds_on_demo(demo_ckpt):
+    """The documented acceptance thresholds must hold for int8 AND bf16 on
+    both endpoints — this is the acceptance criterion of the quantized
+    serving path."""
+    from glom_tpu.training import denoise
+
+    _, cfg, train_cfg, params = denoise.load_checkpoint_state(demo_ckpt)
+    rep = quant.accuracy_report(cfg, train_cfg, params, _imgs(4))
+    for mode in ("bf16", "int8"):
+        assert rep[mode]["pass"], rep[mode]
+        assert "level_0" in rep[mode]["embed"]  # per-level rows present
+        assert rep[mode]["thresholds"] == quant.ACCURACY_THRESHOLDS[mode]
+
+
+def test_quant_check_tool_demo(capsys):
+    import json
+    import os
+    import runpy
+    import sys
+
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "quant_check.py")
+    old = sys.argv
+    sys.argv = [tool, "--demo", "--batch", "2"]
+    try:
+        with pytest.raises(SystemExit) as e:
+            runpy.run_path(tool, run_name="__main__")
+        assert e.value.code == 0
+    finally:
+        sys.argv = old
+    out = json.loads(capsys.readouterr().out)
+    assert out["pass"] and set(out["modes"]) == {"bf16", "int8"}
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("mode", ["bf16", "int8"])
+def test_engine_serves_quantized_with_zero_recompiles(demo_ckpt, mode):
+    eng = ServingEngine(demo_ckpt, buckets=(2, 4), max_wait_ms=0.0,
+                        reload_poll_s=0, quant=mode)
+    try:
+        health = eng.health()
+        assert health["quant"] == mode
+        # per-bucket entries registered under the quant label
+        assert all(s["quant"] == mode
+                   for s in eng.caches["embed"].snapshots.values())
+        for ep, shape in [("embed", (3, 3, 16)), ("reconstruct", (3, 3, 16, 16))]:
+            fut = eng.submit(ep, _imgs(3))
+            assert eng.process_once(ep) == 3
+            assert fut.result(timeout=0).shape == shape
+            assert eng.caches[ep].poll_compiles() == 0
+    finally:
+        eng.shutdown(drain=False)
+
+
+def test_engine_quant_outputs_close_to_f32(demo_ckpt):
+    outs = {}
+    for mode in ("f32", "int8"):
+        eng = ServingEngine(demo_ckpt, buckets=(4,), max_wait_ms=0.0,
+                            reload_poll_s=0, quant=mode)
+        try:
+            fut = eng.submit("embed", _imgs(4))
+            eng.process_once("embed")
+            outs[mode] = np.asarray(fut.result(timeout=0), np.float32)
+        finally:
+            eng.shutdown(drain=False)
+    scale = np.abs(outs["f32"]).max() or 1.0
+    assert np.max(np.abs(outs["f32"] - outs["int8"])) / scale < 0.1
+
+
+def test_engine_rejects_unknown_quant(demo_ckpt):
+    with pytest.raises(ValueError, match="unknown quant mode"):
+        ServingEngine(demo_ckpt, quant="fp8", warmup=False, reload_poll_s=0)
+
+
+def test_engine_ff_impl_override(demo_ckpt):
+    eng = ServingEngine(demo_ckpt, buckets=(2,), max_wait_ms=0.0,
+                        reload_poll_s=0, ff_impl="fused")
+    try:
+        assert eng.config.ff_impl == "fused"
+        assert eng.health()["ff_impl"] == "fused"
+        fut = eng.submit("embed", _imgs(2))
+        assert eng.process_once("embed") == 2
+        assert fut.result(timeout=0).shape == (2, 3, 16)
+    finally:
+        eng.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# input-buffer donation (satellite: mirror trainer donate_argnums)
+# ---------------------------------------------------------------------------
+@pytest.mark.filterwarnings("ignore:.*[Dd]onat.*")
+def test_cache_donates_inputs_correct_and_no_recompiles():
+    """With donation forced on (a no-op on CPU, but the jit signature is
+    identical to the TPU one), the request path must stay correct and the
+    RecompileMonitor tripwire must stay silent."""
+    cache = BucketedCompileCache(
+        lambda params, x: x * params["w"], (2, 4), name="toy", donate=True)
+    assert cache.donates_input
+    params = {"w": np.float32(3.0)}
+    cache.warmup(params, lambda b: jax.ShapeDtypeStruct((b, 2), np.float32))
+    for n in (1, 2, 3, 4):
+        x = np.arange(n * 2, dtype=np.float32).reshape(n, 2)
+        out = np.asarray(cache(params, x))
+        np.testing.assert_array_equal(out, x * 3.0)
+    assert cache.poll_compiles() == 0
+
+
+def test_cache_donation_defaults_off_on_cpu():
+    cache = BucketedCompileCache(lambda p, x: x, (1,), name="toy")
+    assert not cache.donates_input  # auto: CPU backend

@@ -1,0 +1,170 @@
+"""Perf-regression gate: fail the PR when the hot path got slower.
+
+  python tools/bench_gate.py                          # run bench.py, gate it
+  python tools/bench_gate.py --record out.json        # gate an existing record
+  python tools/bench_gate.py --loadgen-json rep.json --p95-baseline-ms 42
+  python tools/bench_gate.py --check                  # self-test vs fixtures
+
+Compares a fresh ``bench.py`` run (and optionally a ``tools/loadgen.py``
+report's p95) against the recorded ``last_measured`` trajectory in the
+repo's ``BENCH_*.json`` round captures, via
+:mod:`glom_tpu.obs.perfgate`.  Exit codes:
+
+  * 0 — pass, or SKIP (accelerator unreachable: the fresh record says
+    ``status: skipped`` — an outage is not a regression; a loud warning
+    line is printed so the skip can't masquerade as a pass);
+  * 1 — regression beyond ``--max-regression`` (default 10%), or the
+    bench errored when a result was expected.
+
+``--check`` replays the gate logic over the golden fixtures in
+``tests/data/bench_gate/`` (pass / 10%-regression fail / relay-
+unreachable skip) with no accelerator and no model import — the tier-1
+CI smoke that keeps the gate itself from rotting.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE_DIR = os.path.join(REPO_ROOT, "tests", "data", "bench_gate")
+
+
+def run_check(fixture_dir: str) -> int:
+    """Replay every golden fixture; each is ``{"record": <bench JSON>,
+    "reference": <float|null>, "expect": "pass|fail|skip"}``."""
+    from glom_tpu.obs import perfgate
+
+    paths = sorted(
+        os.path.join(fixture_dir, f)
+        for f in os.listdir(fixture_dir) if f.endswith(".json")
+    )
+    if not paths:
+        print(f"error: no fixtures in {fixture_dir}", file=sys.stderr)
+        return 1
+    failures = []
+    for path in paths:
+        with open(path) as f:
+            fx = json.load(f)
+        got = perfgate.evaluate_throughput(
+            fx.get("record"), fx.get("reference"),
+            max_regression=fx.get("max_regression", 0.10),
+        )
+        ok = got["gate"] == fx["expect"]
+        print(json.dumps({
+            "fixture": os.path.basename(path), "expect": fx["expect"],
+            "got": got["gate"], "ok": ok, "detail": got.get("detail"),
+        }))
+        if not ok:
+            failures.append(os.path.basename(path))
+    if failures:
+        print(f"check FAILED: {failures}", file=sys.stderr)
+        return 1
+    print(f"check ok: {len(paths)} fixtures")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--bench-cmd", default=None,
+                   help="command producing one bench JSON line (default: "
+                        "`python bench.py` in the repo root)")
+    p.add_argument("--record", default=None, metavar="FILE",
+                   help="gate an existing bench JSON record (file or '-' "
+                        "for stdin) instead of running the bench")
+    p.add_argument("--bench-glob", default=os.path.join(REPO_ROOT, "BENCH_*.json"),
+                   help="recorded trajectory files (driver round captures)")
+    p.add_argument("--max-regression", type=float, default=0.10,
+                   help="allowed fractional throughput drop vs the recorded "
+                        "reference (0.10 = 10%%)")
+    p.add_argument("--loadgen-json", default=None,
+                   help="tools/loadgen.py report; its latency p95 gates "
+                        "against --p95-baseline-ms")
+    p.add_argument("--p95-baseline-ms", type=float, default=None,
+                   help="recorded serving p95 to gate the loadgen report "
+                        "against")
+    p.add_argument("--p95-max-regression", type=float, default=0.10)
+    p.add_argument("--prom-textfile", default=None,
+                   help="write the verdict as Prometheus gauges via the obs "
+                        "registry (textfile-collector format)")
+    p.add_argument("--check", action="store_true",
+                   help="self-test the gate logic against the golden "
+                        "fixtures (no accelerator, no bench run)")
+    p.add_argument("--fixture-dir", default=FIXTURE_DIR)
+    args = p.parse_args(argv)
+
+    if args.check:
+        return run_check(args.fixture_dir)
+
+    from glom_tpu.obs import perfgate
+
+    # -- fresh bench record ------------------------------------------------
+    if args.record:
+        text = (sys.stdin.read() if args.record == "-"
+                else open(args.record).read())
+        bench_rc = None
+    else:
+        cmd = args.bench_cmd or f"{sys.executable} bench.py"
+        proc = subprocess.run(
+            cmd, shell=True, cwd=REPO_ROOT,
+            capture_output=True, text=True,
+        )
+        text = proc.stdout
+        bench_rc = proc.returncode
+        if proc.stderr.strip():
+            print(proc.stderr.rstrip(), file=sys.stderr)
+    rec = perfgate.parse_bench_output(text)
+
+    # -- trajectory + verdicts ---------------------------------------------
+    trajectory = perfgate.load_trajectory(args.bench_glob)
+    ref = perfgate.reference_value(trajectory)
+    throughput = perfgate.evaluate_throughput(
+        rec, ref[0] if ref else None, max_regression=args.max_regression,
+    )
+    p95 = None
+    if args.loadgen_json:
+        with open(args.loadgen_json) as f:
+            report = json.load(f)
+        p95 = perfgate.evaluate_p95(
+            (report.get("latency_ms") or {}).get("p95"),
+            args.p95_baseline_ms,
+            max_regression=args.p95_max_regression,
+        )
+    verdict = perfgate.combine(throughput, *( [p95] if p95 else [] ))
+    result = {
+        "gate": verdict,
+        "throughput": throughput,
+        "p95": p95,
+        "reference_provenance": ref[1] if ref else None,
+        "trajectory_rounds": len(trajectory),
+        "bench_rc": bench_rc,
+    }
+    print(json.dumps(result, indent=2))
+    if args.prom_textfile:
+        from glom_tpu.obs import MetricRegistry
+        from glom_tpu.obs.exporters import prometheus_lines
+
+        registry = MetricRegistry()
+        perfgate.export_to_registry(result, registry)
+        with open(args.prom_textfile, "w") as f:
+            f.write(prometheus_lines(registry))
+    skipped = [name for name, part in (("throughput", throughput),
+                                       ("p95", p95))
+               if part and part["gate"] == perfgate.GATE_SKIP]
+    if skipped:
+        # Loud even when another component passed and the combined verdict
+        # is "pass": an ungated component must never masquerade as gated.
+        print(f"bench_gate: SKIP on {', '.join(skipped)} — no comparable "
+              f"measurement taken for the skipped component(s) (NOT a pass)",
+              file=sys.stderr)
+    return 0 if verdict in (perfgate.GATE_PASS, perfgate.GATE_SKIP) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,106 @@
+"""Bit-accuracy harness for the quantized serving paths.
+
+  python tools/quant_check.py --checkpoint-dir /ckpt [--modes int8,bf16]
+  python tools/quant_check.py --demo            # tiny self-contained run
+
+Runs each quant mode (``glom_tpu.serving.quant``) against the f32
+reference on BOTH serving endpoints — the mean-pooled per-level /embed
+embeddings and the /reconstruct decode — and reports per-level cosine
+similarity and max-abs error.  Exits nonzero when any requested mode
+misses its documented acceptance threshold
+(:data:`glom_tpu.serving.quant.ACCURACY_THRESHOLDS`): the deploy gate
+for ``--quant int8|bf16`` serving is THIS tool passing on the checkpoint
+about to be served, not a global judgment call.
+
+Per-level rows matter: GLOM's levels are the product being served, and
+quantization error compounds up the level stack (each level's state has
+passed through more quantized matmuls).  A failure localized to the top
+level with clean lower levels usually means the decoder/top-down weights
+need to stay bf16.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# runnable as `python tools/quant_check.py` from a checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="Trainer checkpoint dir (reads its config.json)")
+    p.add_argument("--demo", action="store_true",
+                   help="run on a throwaway demo checkpoint (plumbing check)")
+    p.add_argument("--modes", default="bf16,int8",
+                   help="comma-separated quant modes to check vs f32")
+    p.add_argument("--batch", type=int, default=4,
+                   help="probe batch size (random normal images)")
+    p.add_argument("--iters", type=int, default=None,
+                   help="GLOM iterations (default: the model's)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--platform", default="auto", choices=["auto", "cpu"])
+    p.add_argument("--device-probe-timeout", type=float, default=240.0,
+                   help="relay retry-poll + init watchdog budget "
+                        "(bench.py's guard); <=0 disables")
+    args = p.parse_args(argv)
+    if not args.demo and not args.checkpoint_dir:
+        p.error("need --checkpoint-dir or --demo")
+
+    # this is the deploy gate — it runs unattended against the relay, so a
+    # dead tunnel must produce a JSON error line, never a silent hang
+    def _emit_error(msg):
+        print(json.dumps({"pass": False, "error": msg}), flush=True)
+
+    from glom_tpu.device_guard import guarded_jax_init
+
+    jax, timer = guarded_jax_init(args.platform, args.device_probe_timeout,
+                                  _emit_error)
+    import numpy as np
+
+    jax.devices()
+    if timer is not None:
+        timer.cancel()  # device init completed; the guarded window is over
+
+    from glom_tpu.serving import quant
+    from glom_tpu.training import denoise
+
+    ckpt_dir = args.checkpoint_dir
+    if args.demo and (ckpt_dir is None):
+        import tempfile
+
+        from glom_tpu.serving.engine import make_demo_checkpoint
+
+        ckpt_dir = tempfile.mkdtemp(prefix="glom-quant-demo-")
+        make_demo_checkpoint(ckpt_dir)
+
+    _, config, train_cfg, params = denoise.load_checkpoint_state(ckpt_dir)
+    rng = np.random.RandomState(args.seed)
+    imgs = rng.randn(
+        args.batch, config.channels, config.image_size, config.image_size
+    ).astype(np.float32)
+
+    modes = tuple(m.strip() for m in args.modes.split(",") if m.strip())
+    for m in modes:
+        if m not in quant.ACCURACY_THRESHOLDS:
+            p.error(f"no acceptance threshold for mode {m!r} "
+                    f"(known: {sorted(quant.ACCURACY_THRESHOLDS)})")
+    report = quant.accuracy_report(
+        config, train_cfg, params, imgs, modes=modes, iters=args.iters,
+    )
+    ok = all(r["pass"] for r in report.values())
+    print(json.dumps({
+        "checkpoint_dir": ckpt_dir,
+        "batch": args.batch,
+        "modes": report,
+        "pass": ok,
+    }, indent=2))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,6 +3,7 @@
   python tools/trace_report.py traces.jsonl [--format json]
   python tools/trace_report.py traces.jsonl --slowest 10
   python tools/trace_report.py traces.jsonl --trace <request-id>
+  python tools/trace_report.py traces.jsonl --suggest-buckets [--ladder-size 4]
 
 Reads the per-trace JSONL the serving engine emits (``--trace-log``: one
 JSON object per COMPLETED trace — ``trace_id``, root span name, duration,
@@ -17,7 +18,11 @@ and the span list) and prints:
   * per-bucket padding-waste table from ``execute`` span annotations
     (which compiled batch shapes burn compute on zeros);
   * ``--trace <id>`` — one trace's spans, indented by parentage (the
-    lookup target for ``tools/loadgen.py --slow-n`` output).
+    lookup target for ``tools/loadgen.py --slow-n`` output);
+  * ``--suggest-buckets`` — an auto-tuned bucket ladder fitted to the
+    MEASURED per-batch size distribution (exact DP minimizing padded
+    image-slots), printed as JSON the serving front accepts verbatim via
+    ``--buckets-file`` — close the loop: measure waste, re-ladder, serve.
 
 Stdlib-only on purpose (like obs_report.py / forensics_report.py): it
 must run on a machine with no jax, straight off a scp'd trace log.
@@ -208,6 +213,114 @@ def summarize(traces, slowest=5):
     }
 
 
+# ---------------------------------------------------------------------------
+# bucket-ladder auto-tune (--suggest-buckets)
+# ---------------------------------------------------------------------------
+
+
+def observed_batch_sizes(traces):
+    """Real images per EXECUTED batch, from the execute-span annotations
+    (deduped across mirrored member traces exactly like the waste table)."""
+    seen = set()
+    sizes = []
+    for t in traces:
+        for s in t["spans"]:
+            attrs = s.get("attrs") or {}
+            if s["name"] != "execute" or "bucket" not in attrs:
+                continue
+            key = (attrs["bucket"], s["start"])
+            if key in seen:
+                continue
+            seen.add(key)
+            if attrs.get("images"):
+                sizes.append(int(attrs["images"]))
+    return sizes
+
+
+def suggest_ladder(sizes, k):
+    """The k-bucket ladder minimizing total padded image-slots over the
+    observed per-batch sizes — exact DP over the unique sizes (an optimal
+    ladder only ever needs bucket boundaries AT observed sizes; anything
+    between two observed sizes pads strictly more).  Returns
+    ``(ladder, padded_slots)``."""
+    if not sizes:
+        raise ValueError("no executed batches in the trace feed")
+    from collections import Counter
+
+    counts = Counter(sizes)
+    uniq = sorted(counts)
+    if k >= len(uniq):
+        return uniq, 0
+    # cost(i, j): every batch sized in uniq[i..j] padded up to uniq[j]
+    pref_n = [0]
+    pref_sum = [0]
+    for u in uniq:
+        pref_n.append(pref_n[-1] + counts[u])
+        pref_sum.append(pref_sum[-1] + counts[u] * u)
+
+    def cost(i, j):
+        n = pref_n[j + 1] - pref_n[i]
+        s = pref_sum[j + 1] - pref_sum[i]
+        return uniq[j] * n - s
+
+    INF = float("inf")
+    u = len(uniq)
+    # best[m][j]: min padded slots covering uniq[0..j] with m buckets, the
+    # largest being uniq[j] (the top bucket must be an observed max cover)
+    best = [[INF] * u for _ in range(k + 1)]
+    back = [[None] * u for _ in range(k + 1)]
+    for j in range(u):
+        best[1][j] = cost(0, j)
+    for m in range(2, k + 1):
+        for j in range(m - 1, u):
+            for i in range(m - 2, j):
+                c = best[m - 1][i] + cost(i + 1, j)
+                if c < best[m][j]:
+                    best[m][j] = c
+                    back[m][j] = i
+    ladder = []
+    j, m = u - 1, k
+    while m >= 1:
+        ladder.append(uniq[j])
+        j, m = back[m][j], m - 1
+        if j is None:
+            break
+    return sorted(ladder), best[k][u - 1]
+
+
+def suggest_buckets(traces, ladder_size=None):
+    """The ``--suggest-buckets`` payload: measured waste under the ladder
+    the feed was recorded with, the fitted ladder, and its projected waste
+    over the same batch distribution."""
+    sizes = observed_batch_sizes(traces)
+    if not sizes:
+        return {"error": "no executed batches with bucket annotations"}
+    current = sorted({
+        (s.get("attrs") or {}).get("bucket")
+        for t in traces for s in t["spans"]
+        if s["name"] == "execute" and (s.get("attrs") or {}).get("bucket")
+    })
+    k = ladder_size if ladder_size else max(len(current), 1)
+    ladder, padded = suggest_ladder(sizes, k)
+
+    def mean_waste(buckets):
+        total = 0.0
+        for s in sizes:
+            b = next((x for x in buckets if x >= s), max(buckets))
+            total += (b - s) / b
+        return round(total / len(sizes), 4)
+
+    return {
+        "observed_batches": len(sizes),
+        "observed_sizes": {str(s): sizes.count(s) for s in sorted(set(sizes))},
+        "current_buckets": current,
+        "current_mean_padding_waste": mean_waste(current) if current else None,
+        "suggested_buckets": ladder,
+        "suggested_mean_padding_waste": mean_waste(ladder),
+        "suggested_padded_slots": padded,
+    }
+
+
 def _fmt(v, spec=".2f"):
     return "—" if v is None else format(v, spec)
 
@@ -281,6 +394,12 @@ def main(argv=None) -> int:
                    help="how many slowest traces to list")
     p.add_argument("--trace", default=None, metavar="ID",
                    help="print one trace's spans (indented by parentage)")
+    p.add_argument("--suggest-buckets", action="store_true",
+                   help="emit a bucket ladder fitted to the measured batch "
+                        "sizes (JSON; feed it to the server's --buckets-file)")
+    p.add_argument("--ladder-size", type=int, default=None,
+                   help="bucket count for --suggest-buckets (default: as "
+                        "many as the feed's current ladder)")
     args = p.parse_args(argv)
     try:
         traces = read_traces(args.jsonl)
@@ -292,6 +411,10 @@ def main(argv=None) -> int:
         return 1
     if args.trace:
         return print_trace(traces, args.trace)
+    if args.suggest_buckets:
+        out = suggest_buckets(traces, ladder_size=args.ladder_size)
+        print(json.dumps(out, indent=2))
+        return 1 if "error" in out else 0
     s = summarize(traces, slowest=args.slowest)
     if args.format == "json":
         print(json.dumps(s))
