@@ -23,7 +23,10 @@ The contract, round for round:
     itself reports the accelerator unreachable — an outage is not a
     regression, and the BENCH_r05 relay-unreachable shape must never
     hard-fail CI;
-  * serving latency gates the same way against a recorded loadgen p95.
+  * serving latency gates the same way against a recorded loadgen p95 —
+    once for the single engine and once THROUGH the fleet router
+    (``--fleet-loadgen-json``), so the router hop's overhead is in the
+    trajectory from the day the fleet shipped.
 """
 
 from __future__ import annotations
@@ -255,3 +258,9 @@ def export_to_registry(result: dict, registry) -> None:
         registry.gauge(
             "bench_gate_p95_ms", help="fresh loadgen p95 the gate evaluated",
         ).set(float(p95["p95_ms"]))
+    fleet = result.get("fleet_p95") or {}
+    if fleet.get("p95_ms") is not None:
+        registry.gauge(
+            "bench_gate_fleet_p95_ms",
+            help="fresh router-fronted loadgen p95 the gate evaluated",
+        ).set(float(fleet["p95_ms"]))

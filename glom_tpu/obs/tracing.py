@@ -52,6 +52,13 @@ SPAN_EXECUTE = "execute"            # compile_cache: device execution
 SPAN_RESPOND = "respond"            # server: result slice + JSON write
 SPAN_BATCH = "batch"                # batch-level span (own trace, links)
 SPAN_RELOAD = "reload_swap"         # engine: checkpoint hot-reload swap
+# -- fleet tier (serving/router.py) --
+SPAN_ROUTER_REQUEST = "router_request"  # router: whole front-door handler
+SPAN_ROUTE = "route"                # router: replica pick (policy + choice)
+SPAN_PROXY = "proxy"                # router: one upstream attempt; its span
+#                                     id rides the forwarded traceparent, so
+#                                     the engine's request span parents under
+#                                     it and trace_report shows the full hop
 
 # span kind -> registry histogram (milliseconds).  EXECUTE additionally
 # feeds a per-bucket histogram when the span carries a "bucket" attribute.
@@ -64,6 +71,8 @@ SPAN_METRICS = {
     SPAN_EXECUTE: "serving_execute_ms",
     SPAN_RESPOND: "serving_respond_ms",
     SPAN_RELOAD: "serving_reload_swap_ms",
+    SPAN_ROUTER_REQUEST: "router_request_ms",
+    SPAN_PROXY: "router_proxy_ms",
 }
 
 

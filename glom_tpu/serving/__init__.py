@@ -16,12 +16,23 @@ telemetry/forensics stack (PRs 1-2) on the request path:
     params when a newer one lands, graceful drain on shutdown, and the
     ``queue_saturation`` forensics trigger;
   * :mod:`glom_tpu.serving.server` — stdlib ``ThreadingHTTPServer``
-    front: ``/embed``, ``/reconstruct``, ``/healthz``, ``/metrics``.
+    front: ``/embed``, ``/reconstruct``, ``/healthz``, ``/metrics``, plus
+    the ``/admin/reload/*`` staged-swap API the fleet router drives;
+  * :mod:`glom_tpu.serving.sharded` — mesh-sharded serving: buckets
+    AOT-compile against explicit in/out shardings so TP/EP-sharded
+    configs serve from the ``parallel/`` stack with zero request-path
+    compiles;
+  * :mod:`glom_tpu.serving.router` — the fleet tier: one front door over
+    N engine replicas (least-loaded + consistent-hash dispatch,
+    health-aware ejection/re-admission, aggregated per-replica metrics,
+    trace propagation through the hop, coordinated two-phase hot-reload).
 
-``tools/loadgen.py`` drives it (closed/open loop, p50/p95/p99 report);
-``docs/SERVING.md`` documents tuning.  Quickstart::
+``tools/loadgen.py`` drives it (closed/open loop, p50/p95/p99 report,
+multi-target + per-replica breakdown); ``docs/SERVING.md`` documents
+tuning.  Quickstart::
 
     python -m glom_tpu.serving.server --checkpoint-dir /ckpt --port 8000
+    python -m glom_tpu.serving.router --spawn 4 --checkpoint-dir /ckpt
 """
 
 from glom_tpu.serving.batcher import (  # noqa: F401
@@ -37,6 +48,10 @@ from glom_tpu.serving.compile_cache import (  # noqa: F401
 from glom_tpu.serving.engine import (  # noqa: F401
     ServingEngine,
     make_demo_checkpoint,
+)
+from glom_tpu.serving.router import (  # noqa: F401
+    FleetRouter,
+    NoHealthyReplica,
 )
 
 # glom_tpu.serving.server is intentionally NOT imported here: the package

@@ -17,7 +17,12 @@ seconds against a millisecond forward).  The contract here:
     detects, is never entered;
   * warmup records a :func:`glom_tpu.profiling.snapshot_from_compiled`
     per bucket (HLO text + compiler cost/memory model) so the operator can
-    see what each shape costs before traffic arrives.
+    see what each shape costs before traffic arrives;
+  * with ``shardings`` set (a mesh-sharded engine —
+    :mod:`glom_tpu.serving.sharded`), every bucket compiles against
+    explicit in/out shardings: TP/EP-sharded params serve without the
+    request path ever moving a weight, and the no-compile invariant holds
+    unchanged (the monitor watches the same single jit fn).
 
 The attached :class:`RecompileMonitor` is the tripwire for the invariant,
 not a bookkeeping nicety: any code path that falls back to calling the
@@ -69,7 +74,9 @@ class BucketedCompileCache:
 
     def __init__(self, fn: Callable, buckets: Sequence[int], *,
                  name: str = "forward", quant: str = "f32",
-                 donate: Optional[bool] = None):
+                 donate: Optional[bool] = None,
+                 shardings: Optional[Tuple[Any, Any, Any]] = None,
+                 mesh_axes: Optional[dict] = None):
         buckets = sorted(set(int(b) for b in buckets))
         if not buckets:
             raise ValueError("need at least one bucket size")
@@ -95,7 +102,21 @@ class BucketedCompileCache:
         if donate is None:
             donate = jax.default_backend() != "cpu"
         self.donates_input = bool(donate)
-        self._jit_fn = jax.jit(fn, donate_argnums=(1,) if donate else ())
+        # -- mesh-sharded execution (glom_tpu.serving.sharded) -------------
+        # ``shardings`` = (params_sharding_tree, img_sharding, out_sharding)
+        # pins every bucket's executable to an explicit partitioned layout:
+        # params stay resident where the engine placed them (TP/EP shards
+        # never move), the padded batch shards over the data axis on the
+        # way in, and the jit boundary is the ONE place the layout is
+        # stated — exactly the parallel/inference.py recipe, AOT-compiled.
+        # ``mesh_axes`` ({"data": 4, ...}) labels snapshots and /healthz.
+        self.mesh_axes = dict(mesh_axes) if mesh_axes else None
+        jit_kwargs = {"donate_argnums": (1,) if donate else ()}
+        if shardings is not None:
+            params_sh, img_sh, out_sh = shardings
+            jit_kwargs.update(in_shardings=(params_sh, img_sh),
+                              out_shardings=out_sh)
+        self._jit_fn = jax.jit(fn, **jit_kwargs)
         self._compiled: Dict[int, Any] = {}
         self.monitor = RecompileMonitor(self._jit_fn)
         self.snapshots: Dict[int, Dict[str, Any]] = {}
@@ -133,6 +154,8 @@ class BucketedCompileCache:
             # reading warmup bundles can tell an int8 executable's cost
             # model from the f32 one's at a glance
             snap["quant"] = self.quant
+            if self.mesh_axes:
+                snap["mesh"] = dict(self.mesh_axes)
             self.snapshots[bucket] = snap
         # baseline the monitor AFTER warmup: AOT lower/compile never touches
         # the jit dispatch cache, but a zero poll here makes that explicit —

@@ -20,10 +20,22 @@ Model lifecycle:
     params — no request ever sees a half-updated tree.  A reload that
     fails (half-written artifact, torn manifest, shape drift) warns and
     keeps serving the old params;
+  * **staged reload** — the fleet-coordination primitive
+    (:meth:`ServingEngine.stage_reload` / :meth:`commit_staged` /
+    :meth:`abort_staged` / :meth:`rollback`, exposed over HTTP as
+    ``/admin/reload/*``): a router rolls N replicas forward in two
+    phases so the fleet is never half-old/half-new, and a failed commit
+    anywhere reverts everyone (:mod:`glom_tpu.serving.router`);
   * **drain** — :meth:`ServingEngine.shutdown` with ``drain=True`` (the
     server's SIGTERM path, mirroring the trainer's preemption handling)
     stops admission, lets queued work flush, and joins the workers before
     returning.
+
+With a ``mesh_shape``, every bucket AOT-compiles against explicit in/out
+shardings and the params are placed per the training-side rules
+(:mod:`glom_tpu.serving.sharded`) — TP/EP-sharded configs serve from the
+proven ``parallel/`` stack with the same zero-request-path-compile
+contract.
 
 Observability rides the existing ``glom_tpu.obs`` registry: latency
 histograms, queue-depth / batch-occupancy metrics, shed + compile + reload
@@ -109,22 +121,26 @@ def make_demo_checkpoint(directory: str, *, config: Optional[GlomConfig] = None,
     return 0
 
 
-def _make_embed_fn(config: GlomConfig, iters: Optional[int]):
+def _make_embed_fn(config: GlomConfig, iters: Optional[int],
+                   *, ff_fn=None, fused_fn=None):
     """``(params, imgs) -> (b, L, d)`` mean-pooled per-level embeddings —
     the per-level artifact GLOM exposes downstream (PAPER.md levels;
     ``training/extract.py``'s pooling, compiled for serving).  All levels
     are always computed; the endpoint slices one host-side, so one compiled
-    graph per bucket serves every ``level=`` query."""
+    graph per bucket serves every ``level=`` query.  ``ff_fn``/``fused_fn``
+    are the mesh-bound kernels a sharded engine injects
+    (:func:`glom_tpu.serving.sharded.resolve_sharded_kernels`)."""
 
     def f(params, imgs):
-        out = glom_model.apply(params["glom"], imgs, config=config, iters=iters)
+        out = glom_model.apply(params["glom"], imgs, config=config,
+                               iters=iters, ff_fn=ff_fn, fused_fn=fused_fn)
         return jnp.mean(out, axis=1)
 
     return f
 
 
 def _make_reconstruct_fn(config: GlomConfig, train_cfg: TrainConfig,
-                         iters: Optional[int]):
+                         iters: Optional[int], *, ff_fn=None, fused_fn=None):
     """``(params, imgs) -> (b, c, H, W)`` denoising forward: the state at
     the TRAINING loss timestep decoded through the trained head — the
     decode path the decoder was optimized for, not an arbitrary final-state
@@ -137,7 +153,7 @@ def _make_reconstruct_fn(config: GlomConfig, train_cfg: TrainConfig,
     def f(params, imgs):
         _, captured = glom_model.apply(
             params["glom"], imgs, config=config, iters=resolved_iters,
-            capture_timestep=timestep,
+            capture_timestep=timestep, ff_fn=ff_fn, fused_fn=fused_fn,
         )
         return decoder_apply(
             params["decoder"], captured, config,
@@ -183,6 +199,9 @@ class ServingEngine:
         quant: str = "f32",
         ff_impl: Optional[str] = None,
         donate_inputs: Optional[bool] = None,
+        mesh_shape: Optional[Sequence[int]] = None,
+        param_sharding: str = "replicated",
+        mesh_axis_names: Sequence[str] = ("data", "model", "seq"),
     ):
         self.checkpoint_dir = checkpoint_dir
         self.registry = registry if registry is not None else MetricRegistry()
@@ -251,22 +270,72 @@ class ServingEngine:
         # template for every later reload: restore() places leaves onto the
         # template's dtypes/shardings, so reloads land where the originals did
         self._template = host_params
-        self._params = jax.device_put(serving_quant.quantize_tree(host_params, quant))
+
+        # -- mesh-sharded execution (glom_tpu.serving.sharded) -------------
+        # With a mesh_shape, every bucket AOT-compiles against explicit
+        # in/out shardings: params placed per the training-side rules
+        # (TP: FF hidden sharded; EP: whole level-nets), the batch over
+        # the data axis — the proven parallel/ stack in the request path.
+        self.param_sharding = param_sharding
+        self.mesh = None
+        param_sh = img_sh = out_sh = None
+        ff_fn = fused_fn = None
+        # quantize ONCE: the same host tree feeds the sharding-tree
+        # derivation (shapes) and the device placement (values) — int8's
+        # per-channel absmax pass over every weight must not run twice
+        quantized = serving_quant.quantize_tree(host_params, quant)
+        if mesh_shape is not None or param_sharding != "replicated":
+            from glom_tpu.serving import sharded as serving_sharded
+
+            if mesh_shape is None:
+                raise ValueError(
+                    f"param_sharding={param_sharding!r} needs a mesh_shape "
+                    f"(e.g. (1, 4, 1) for 4-way TP)"
+                )
+            self.mesh = serving_sharded.resolve_mesh(mesh_shape,
+                                                     mesh_axis_names)
+            serving_sharded.validate_buckets(
+                buckets, self.mesh, data_axis=mesh_axis_names[0])
+            ff_fn, fused_fn = serving_sharded.resolve_sharded_kernels(
+                self.mesh, serve_cfg, param_sharding=param_sharding,
+                data_axis=mesh_axis_names[0], model_axis=mesh_axis_names[1],
+                seq_axis=mesh_axis_names[2],
+            )
+            param_sh = serving_sharded.param_shardings(
+                self.mesh, serve_cfg, quantized,
+                param_sharding=param_sharding,
+                model_axis=mesh_axis_names[1],
+            )
+            img_sh, out_sh = serving_sharded.batch_shardings(
+                self.mesh, data_axis=mesh_axis_names[0])
+        self._param_shardings = param_sh
+        self._params = self._place(quantized)
         self.step = step
         self.iters = iters
 
         # -- compiled forward per endpoint ---------------------------------
+        mesh_axes = None
+        if self.mesh is not None:
+            from glom_tpu.serving.sharded import mesh_axes_dict
+
+            mesh_axes = mesh_axes_dict(self.mesh)
+        shardings = (None if param_sh is None
+                     else (param_sh, img_sh, out_sh))
         self.caches: Dict[str, BucketedCompileCache] = {
             "embed": BucketedCompileCache(
                 serving_quant.quantized_forward(
-                    _make_embed_fn(serve_cfg, iters), quant),
-                buckets, name="embed", quant=quant, donate=donate_inputs),
+                    _make_embed_fn(serve_cfg, iters,
+                                   ff_fn=ff_fn, fused_fn=fused_fn), quant),
+                buckets, name="embed", quant=quant, donate=donate_inputs,
+                shardings=shardings, mesh_axes=mesh_axes),
             "reconstruct": BucketedCompileCache(
                 serving_quant.quantized_forward(
-                    _make_reconstruct_fn(serve_cfg, self.train_cfg, iters),
+                    _make_reconstruct_fn(serve_cfg, self.train_cfg, iters,
+                                         ff_fn=ff_fn, fused_fn=fused_fn),
                     quant),
                 buckets, name="reconstruct", quant=quant,
-                donate=donate_inputs),
+                donate=donate_inputs,
+                shardings=shardings, mesh_axes=mesh_axes),
         }
         max_bucket = self.caches["embed"].max_bucket
 
@@ -335,6 +404,17 @@ class ServingEngine:
                 tracer=self.tracer,
             )
 
+        # -- staged (two-phase) reload state -------------------------------
+        # ``_staged`` holds (step, placed-params) loaded by stage_reload()
+        # but not yet serving; ``_prev`` holds the (step, params) a commit
+        # displaced, so a fleet coordinator can roll THIS replica back if
+        # a sibling's commit fails.  Guarded by ``_reload_lock`` — stage/
+        # commit/abort/rollback arrive on router admin threads and must
+        # not interleave.
+        self._staged: Optional[tuple] = None
+        self._prev: Optional[tuple] = None
+        self._reload_lock = threading.Lock()
+
         self._lock = threading.Lock()  # params swap + counters + saturation
         self._threads: list = []
         self._stop = threading.Event()
@@ -392,6 +472,16 @@ class ServingEngine:
     @property
     def params(self):
         return self._params  # reference read is atomic; swap happens whole
+
+    def _place(self, quantized_tree):
+        """Put a quantized host tree on device(s) — sharded per the mesh
+        placement when one exists, default single-device otherwise.  The
+        ONE placement call shared by startup, hot reload, and staged
+        reloads, so a reload can never land in a different layout than
+        the executables were compiled against."""
+        if self._param_shardings is not None:
+            return jax.device_put(quantized_tree, self._param_shardings)
+        return jax.device_put(quantized_tree)
 
     def start(self, *, workers: bool = True, watch: Optional[bool] = None) -> None:
         """Spin up one worker thread per endpoint plus the hot-reload
@@ -493,47 +583,186 @@ class ServingEngine:
                 self._sleep(self._reload_retry_base_s * (2 ** attempt))
         if newest is None or newest <= self.step:
             return False
-        reload_span = self.tracer.start_trace(
-            SPAN_RELOAD, attrs={"from_step": int(self.step),
-                                "to_step": int(newest)},
+        # serialize with the staged-reload API: a router-driven commit and
+        # the standalone watcher must never interleave their load+swap
+        with self._reload_lock:
+            if newest <= self.step:
+                return False
+            reload_span = self.tracer.start_trace(
+                SPAN_RELOAD, attrs={"from_step": int(self.step),
+                                    "to_step": int(newest)},
+            )
+            try:
+                new_params = self._restore_placed(newest)
+            except ckpt_lib.CorruptCheckpointError as e:
+                # the bytes went bad between the verified poll and the read:
+                # quarantine so the next poll falls back to an older valid
+                # step
+                self.tracer.end(reload_span, attrs={"error": repr(e)})
+                integrity.quarantine(self.checkpoint_dir, newest,
+                                     observer=self._integrity_obs,
+                                     reason=str(e))
+                self._reload_failure(f"hot reload of step {newest}", e)
+                self._reload_failstreak += 1
+                return False
+            except Exception as e:
+                self.tracer.end(reload_span, attrs={"error": repr(e)})
+                self._reload_failure(f"hot reload of step {newest}", e)
+                self._reload_failstreak += 1
+                return False
+            with self._lock:
+                # NOTE: no rollback point here — the standalone watcher
+                # never rolls back, and pinning the displaced device tree
+                # would hold two full param sets resident forever.  Only
+                # the fleet-coordinated commit_staged() keeps _prev (and
+                # the router finalizes it away once the rollout lands).
+                self._params = new_params
+                self.step = newest
+            self.tracer.end(reload_span)
+            self._note_swap(newest)
+            return True
+
+    def _restore_placed(self, step: int):
+        """Restore ``step`` onto the serving layout: re-quantize exactly
+        like startup (a reload must land in the dtype layout the AOT
+        executables were compiled against), place via :meth:`_place`
+        (sharded engines re-shard identically), and block before
+        returning — a swap must never make the first request after it pay
+        the H2D transfer."""
+        _, trees = ckpt_lib.restore(
+            self.checkpoint_dir, {"params": self._template}, step=step,
         )
-        try:
-            _, trees = ckpt_lib.restore(
-                self.checkpoint_dir, {"params": self._template}, step=newest,
-            )
-            # re-quantize exactly like startup: a reload must land in the
-            # same dtype layout the AOT executables were compiled against
-            new_params = jax.device_put(
-                serving_quant.quantize_tree(trees["params"], self.quant)
-            )
-            # block before the swap: a reload must never make the first
-            # request after it pay the H2D transfer
-            jax.block_until_ready(jax.tree_util.tree_leaves(new_params)[0])
-        except ckpt_lib.CorruptCheckpointError as e:
-            # the bytes went bad between the verified poll and the read:
-            # quarantine so the next poll falls back to an older valid step
-            self.tracer.end(reload_span, attrs={"error": repr(e)})
-            integrity.quarantine(self.checkpoint_dir, newest,
-                                 observer=self._integrity_obs, reason=str(e))
-            self._reload_failure(f"hot reload of step {newest}", e)
-            self._reload_failstreak += 1
-            return False
-        except Exception as e:
-            self.tracer.end(reload_span, attrs={"error": repr(e)})
-            self._reload_failure(f"hot reload of step {newest}", e)
-            self._reload_failstreak += 1
-            return False
-        with self._lock:
-            self._params = new_params
-            self.step = newest
-        self.tracer.end(reload_span)
+        new_params = self._place(
+            serving_quant.quantize_tree(trees["params"], self.quant)
+        )
+        jax.block_until_ready(jax.tree_util.tree_leaves(new_params)[0])
+        return new_params
+
+    def _note_swap(self, step: int) -> None:
         self.registry.counter(
             "serving_param_reloads", help="successful checkpoint hot reloads",
         ).inc()
         self.registry.gauge(
             "serving_checkpoint_step", help="step of the params being served",
-        ).set(newest)
-        return True
+        ).set(step)
+
+    # -- staged (two-phase) reload: the fleet coordination primitive -------
+    def stage_reload(self, step: Optional[int] = None) -> Optional[int]:
+        """Phase one of a coordinated rollout: load + place the new params
+        OFF the request path, but don't serve them.  ``step=None`` polls
+        for the newest valid step newer than the one serving; a pinned
+        ``step`` stages exactly that checkpoint (the router pins every
+        replica to the same step so a checkpoint landing mid-rollout can't
+        split the fleet).  Returns the staged step, or None when there is
+        nothing to stage — nothing newer, already serving the pinned
+        step (the coordinator reads ``serving_step`` to tell "already
+        there" from "couldn't"), or the load failed.  Old params keep
+        serving either way: staging is side-effect-free on the serving
+        path.  Every attempt SUPERSEDES prior staging — a leftover tree
+        from an aborted earlier rollout must never be committable."""
+        with self._reload_lock:
+            self._staged = None
+            target = step
+            if target is None:
+                try:
+                    target = self._poll_latest()
+                except Exception as e:
+                    self._reload_failure("stage poll", e)
+                    return None
+            if target is None or (step is None and target <= self.step):
+                return None
+            if target == self.step:
+                # pinned to what's already serving: nothing to stage and
+                # nothing to commit — the coordinator treats this replica
+                # as trivially current (staged_step None, serving_step ==
+                # target), so no rollback call can ever land on it
+                return None
+            try:
+                params = self._restore_placed(int(target))
+            except ckpt_lib.CorruptCheckpointError as e:
+                integrity.quarantine(self.checkpoint_dir, int(target),
+                                     observer=self._integrity_obs,
+                                     reason=str(e))
+                self._reload_failure(f"stage of step {target}", e)
+                return None
+            except Exception as e:
+                self._reload_failure(f"stage of step {target}", e)
+                return None
+            self._staged = (int(target), params)
+            return int(target)
+
+    def commit_staged(self) -> Optional[int]:
+        """Phase two: atomically swap the staged params in (one reference
+        assignment — in-flight batches finish on the old tree).  The
+        displaced params are kept as the rollback point.  Returns the new
+        step, or the CURRENT step when nothing is staged (a replica whose
+        stage was a no-op commits trivially)."""
+        with self._reload_lock:
+            if self._staged is None:
+                return int(self.step)
+            new_step, params = self._staged
+            self._staged = None
+            span = self.tracer.start_trace(
+                SPAN_RELOAD, attrs={"from_step": int(self.step),
+                                    "to_step": int(new_step),
+                                    "phase": "commit"},
+            )
+            with self._lock:
+                self._prev = (self.step, self._params)
+                self._params = params
+                self.step = new_step
+            self.tracer.end(span)
+            self._note_swap(new_step)
+            return int(new_step)
+
+    def abort_staged(self) -> bool:
+        """Drop staged params (phase-one failure elsewhere in the fleet).
+        Returns True when something was staged."""
+        with self._reload_lock:
+            had = self._staged is not None
+            self._staged = None
+            return had
+
+    def finalize_reload(self) -> bool:
+        """Release the rollback point after the fleet-wide rollout landed
+        everywhere — the displaced device tree is a full second param set,
+        and holding it past the rollout window would permanently double
+        the engine's memory.  Returns True when something was released;
+        afterwards :meth:`rollback` has nothing to revert to (by design:
+        the rollback window IS commit -> finalize)."""
+        with self._reload_lock:
+            had = self._prev is not None
+            self._prev = None
+            return had
+
+    def rollback(self) -> Optional[int]:
+        """Swap back to the params the last commit displaced — the fleet
+        coordinator's recovery move when a sibling replica's commit
+        failed mid-rollout.  One-shot (the rollback point is consumed);
+        returns the step now serving, or None with nothing to roll to."""
+        with self._reload_lock:
+            if self._prev is None:
+                return None
+            old_step, old_params = self._prev
+            self._prev = None
+            span = self.tracer.start_trace(
+                SPAN_RELOAD, attrs={"from_step": int(self.step),
+                                    "to_step": int(old_step),
+                                    "phase": "rollback"},
+            )
+            with self._lock:
+                self._params = old_params
+                self.step = old_step
+            self.tracer.end(span)
+            self.registry.counter(
+                "serving_reload_rollbacks",
+                help="param swaps reverted by a fleet-coordinated rollback",
+            ).inc()
+            self.registry.gauge(
+                "serving_checkpoint_step",
+                help="step of the params being served",
+            ).set(old_step)
+            return int(old_step)
 
     def _watch_loop(self) -> None:
         # consecutive FULLY-failed polls stretch the wait (doubling, capped
@@ -703,7 +932,13 @@ class ServingEngine:
     def health(self) -> dict:
         """The ``/healthz`` payload: liveness plus the config a client
         (loadgen) needs to build valid requests."""
+        from glom_tpu.serving.sharded import mesh_axes_dict
+
         c = self.config
+        # single reference read: a concurrent commit/abort may null
+        # self._staged between a check and an index, and /healthz must
+        # never crash during exactly the rollout windows it monitors
+        staged = self._staged
         return {
             "status": "ok",
             "step": int(self.step),
@@ -713,6 +948,9 @@ class ServingEngine:
             "quant": self.quant,
             "ff_impl": c.ff_impl,
             "donate_inputs": self.caches["embed"].donates_input,
+            "mesh": mesh_axes_dict(self.mesh),
+            "param_sharding": self.param_sharding,
+            "staged_step": None if staged is None else int(staged[0]),
             "image_size": c.image_size,
             "channels": c.channels,
             "levels": c.levels,

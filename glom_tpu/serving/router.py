@@ -1,0 +1,1124 @@
+"""Replica fleet router: one front door over N serving engines.
+
+Within a replica, :mod:`glom_tpu.serving.sharded` scales the MODEL (mesh-
+sharded buckets); this module scales THROUGHPUT: a stdlib HTTP front that
+dispatches ``/embed`` / ``/reconstruct`` across N independent engine
+replicas — the TPU serving playbook (arXiv:2204.06514, the Gemma serving
+comparison arXiv:2605.25645): shard within a slice for size, replicate
+across slices for load.
+
+**Dispatch** is least-loaded (fewest in-flight proxied requests, ties
+rotated round-robin) unless the request carries an ``X-Affinity-Key``
+header, which routes on a consistent-hash ring (64 vnodes/replica) so a
+client's related requests land on one replica (warm session state, stable
+tail latency) while the ring redistributes only the failed replica's keys
+on ejection.
+
+**Health**: a probe loop GETs each replica's ``/healthz`` every
+``health_interval_s``.  ``eject_after`` consecutive failures — probe
+failures and request-path connection errors count alike — ejects the
+replica from dispatch; probes continue at exponentially backed-off
+intervals and a passing probe re-admits it (after a version catch-up when
+the fleet rolled forward while it was gone).  A request that hits a dead
+replica fails over to the next healthy one; only a fleet with zero
+healthy replicas answers 503.
+
+**Coordinated rollout** (no half-old/half-new fleet): hot reload across
+replicas is a staged two-phase swap driven through the engines'
+``/admin/reload/*`` API —
+
+  1. *prepare*: every healthy replica loads + places the SAME pinned
+     checkpoint step off its request path; any failure aborts the
+     rollout with every replica still serving the old step;
+  2. *commit*: the router briefly gates dispatch (in-flight requests
+     finish on old params; new arrivals queue), then commits every
+     replica's one-reference swap; a commit failure rolls the already-
+     committed replicas back before the gate reopens.
+
+The gate gives the observable guarantee tested in
+``tests/test_router.py``: ordered by dispatch time, responses never go
+new-step -> old-step — a client can never read version N and then be
+served version N-1 by a later request.
+
+**Observability**: the router runs the same tracing/metrics stack as the
+engine.  Every request gets a ``router_request`` root span with ``route``
+and per-attempt ``proxy`` children; the forwarded ``traceparent`` carries
+the proxy span's id, so the engine's ``request`` span parents under it
+and ``tools/trace_report.py`` shows the whole hop.  ``/metrics`` serves
+the router's own families plus every replica's families relabeled with
+``replica="<name>"``; ``/healthz`` aggregates per-replica state and the
+model's input contract (``tools/loadgen.py`` reads the router exactly
+like a single engine).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+import warnings
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from glom_tpu.obs import MetricRegistry
+from glom_tpu.obs.exporters import prometheus_lines
+from glom_tpu.obs.tracing import (
+    SPAN_PROXY,
+    SPAN_ROUTE,
+    SPAN_ROUTER_REQUEST,
+    TraceSink,
+    Tracer,
+    format_traceparent,
+    parse_traceparent,
+    request_trace_id,
+)
+
+ENDPOINTS = ("embed", "reconstruct")
+_VNODES = 64
+_HEX_ID = re.compile(r"[0-9a-f]{1,32}")
+# one Prometheus sample line: name[{labels}] value [timestamp]
+_SAMPLE_RE = re.compile(r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{[^}]*\})?( .+)$")
+
+
+class NoHealthyReplica(RuntimeError):
+    """Every replica is ejected (or the dispatch gate timed out)."""
+
+
+def _default_http(method: str, url: str, body: Optional[bytes],
+                  headers: Dict[str, str], timeout: float
+                  ) -> Tuple[int, Dict[str, str], bytes]:
+    """The one HTTP client (stdlib), injectable for deterministic tests.
+    Returns ``(status, headers, body)`` for ANY HTTP status — a replica's
+    4xx/5xx is a valid answer to pass through, not a transport failure;
+    only connection-level errors raise (URLError/OSError)."""
+    req = urllib.request.Request(url, data=body, headers=headers,
+                                 method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers.items()), r.read()
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, dict(e.headers.items()), payload
+
+
+class Replica:
+    """One engine replica's routing state (mutated under the router lock)."""
+
+    __slots__ = ("name", "url", "healthy", "inflight", "fail_streak",
+                 "next_probe_at", "step", "requests", "errors", "ejections",
+                 "last_health")
+
+    def __init__(self, name: str, url: str):
+        self.name = name
+        self.url = url.rstrip("/")
+        self.healthy = True       # optimistic: first probe/request corrects
+        self.inflight = 0
+        self.fail_streak = 0
+        self.next_probe_at = 0.0  # monotonic deadline for the next probe
+        self.step: Optional[int] = None
+        self.requests = 0
+        self.errors = 0
+        self.ejections = 0
+        self.last_health: Optional[dict] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "url": self.url, "healthy": self.healthy,
+            "inflight": self.inflight, "step": self.step,
+            "fail_streak": self.fail_streak, "requests": self.requests,
+            "errors": self.errors, "ejections": self.ejections,
+        }
+
+
+class FleetRouter:
+    """Dispatch + health + coordinated-rollout brain (transport-agnostic:
+    the HTTP front below is one thin consumer; tests drive the methods
+    directly with an injected clock and http fn)."""
+
+    def __init__(
+        self,
+        replica_urls: Sequence[str],
+        *,
+        names: Optional[Sequence[str]] = None,
+        health_interval_s: float = 1.0,
+        health_timeout_s: float = 5.0,
+        eject_after: int = 2,
+        probe_backoff_max: int = 8,
+        request_timeout_s: float = 60.0,
+        admin_timeout_s: float = 120.0,
+        commit_timeout_s: float = 10.0,
+        gate_timeout_s: float = 30.0,
+        rollout_poll_s: float = 0.0,
+        drain_timeout_s: float = 10.0,
+        registry: Optional[MetricRegistry] = None,
+        clock=None,
+        sleep=None,
+        http=None,
+        trace_log: Optional[str] = None,
+        trace_max_traces: int = 256,
+    ):
+        if not replica_urls:
+            raise ValueError("need at least one replica URL")
+        names = list(names) if names else [
+            f"r{i}" for i in range(len(replica_urls))]
+        if len(names) != len(replica_urls):
+            raise ValueError("names and replica_urls must align")
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique, got {names}")
+        if eject_after < 1:
+            raise ValueError(f"eject_after must be >= 1, got {eject_after}")
+        self.replicas: List[Replica] = [
+            Replica(n, u) for n, u in zip(names, replica_urls)
+        ]
+        self.health_interval_s = health_interval_s
+        self.health_timeout_s = health_timeout_s
+        self.eject_after = eject_after
+        self.probe_backoff_max = max(1, probe_backoff_max)
+        self.request_timeout_s = request_timeout_s
+        self.admin_timeout_s = admin_timeout_s
+        # the GATED phase's per-call bound: while the dispatch gate is
+        # closed every client is waiting, so a hung replica's commit must
+        # fail fast (<< gate_timeout_s) instead of riding the generous
+        # prepare-phase admin timeout into a fleet-wide 503
+        self.commit_timeout_s = commit_timeout_s
+        self.gate_timeout_s = gate_timeout_s
+        self.rollout_poll_s = rollout_poll_s
+        self.drain_timeout_s = drain_timeout_s
+        self.registry = registry if registry is not None else MetricRegistry()
+        self._clock = clock if clock is not None else time.monotonic
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._http = http if http is not None else _default_http
+        self._lock = threading.Lock()          # replica state + rr counter
+        self._rollout_lock = threading.Lock()  # one rollout at a time
+        self._rr = 0
+        self.fleet_step: Optional[int] = None  # last coordinated commit
+        # the commit gate: cleared only for the (short) commit phase of a
+        # rollout; handler threads wait on it before picking a replica
+        self._dispatch_open = threading.Event()
+        self._dispatch_open.set()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+        trace_exporter = None
+        if trace_log:
+            from glom_tpu.obs.exporters import JsonlExporter
+
+            trace_exporter = JsonlExporter(path=trace_log)
+        self.tracer = Tracer(
+            clock=self._clock, sink=TraceSink(max_traces=trace_max_traces),
+            registry=self.registry, exporter=trace_exporter,
+        )
+
+        # consistent-hash ring over ALL replicas (ejection skips forward at
+        # lookup time, so only the dead replica's keys move)
+        self._ring: List[Tuple[int, Replica]] = sorted(
+            (int(hashlib.sha1(f"{r.name}#{v}".encode()).hexdigest()[:16], 16),
+             r)
+            for r in self.replicas for v in range(_VNODES)
+        )
+        self._ring_keys = [h for h, _ in self._ring]
+        self._gauge_replicas()
+
+    # -- metrics helpers ----------------------------------------------------
+    def _gauge_replicas(self) -> None:
+        healthy = sum(r.healthy for r in self.replicas)
+        self.registry.gauge(
+            "router_replicas_total", help="replicas configured",
+        ).set(len(self.replicas))
+        self.registry.gauge(
+            "router_replicas_healthy", help="replicas in dispatch rotation",
+        ).set(healthy)
+
+    # -- health: probe loop, ejection, re-admission -------------------------
+    def _probe(self, replica: Replica) -> Optional[dict]:
+        try:
+            status, _, body = self._http(
+                "GET", f"{replica.url}/healthz", None, {},
+                self.health_timeout_s,
+            )
+            if status != 200:
+                return None
+            health = json.loads(body)
+            return health if health.get("status") == "ok" else None
+        except Exception:
+            return None
+
+    def _note_failure(self, replica: Replica) -> None:
+        """One observed failure (probe or request path); ejects at the
+        ``eject_after`` streak.  Caller holds the lock."""
+        replica.fail_streak += 1
+        if replica.healthy and replica.fail_streak >= self.eject_after:
+            replica.healthy = False
+            replica.ejections += 1
+            self.registry.counter(
+                "router_ejections_total",
+                help="replicas removed from dispatch after failures",
+            ).inc()
+            self._gauge_replicas()
+        # backoff: probes of a persistently-dead replica stretch out
+        # (doubling per failure past ejection, capped), so a downed box
+        # costs one cheap probe per backoff window, not per interval
+        over = max(0, replica.fail_streak - self.eject_after)
+        factor = min(2 ** over, self.probe_backoff_max)
+        replica.next_probe_at = self._clock() + self.health_interval_s * factor
+
+    def _catch_up(self, replica: Replica) -> bool:
+        """A re-admission candidate that missed a coordinated rollout must
+        reach the fleet step BEFORE taking traffic, or the fleet would mix
+        versions.  Drives the same prepare/commit pair, singly."""
+        try:
+            status, _, body = self._http(
+                "POST", f"{replica.url}/admin/reload/prepare",
+                json.dumps({"step": self.fleet_step}).encode(),
+                {"Content-Type": "application/json"}, self.admin_timeout_s,
+            )
+            if status != 200:
+                return False
+            staged = json.loads(body)
+            if (staged.get("staged_step") is None
+                    and staged.get("serving_step") != self.fleet_step):
+                return False
+            status, _, body = self._http(
+                "POST", f"{replica.url}/admin/reload/commit", b"", {},
+                self.admin_timeout_s,
+            )
+            if status != 200 or json.loads(body).get(
+                    "step") != self.fleet_step:
+                return False
+            # free the displaced tree — this replica's catch-up is not a
+            # rollout anyone will roll back
+            self._admin(replica, "finalize", timeout=self.commit_timeout_s)
+            return True
+        except Exception:
+            return False
+
+    def check_health_once(self, *, force: bool = False) -> None:
+        """One pass over every replica whose probe is due (``force`` probes
+        all).  The health loop calls this each interval; tests call it
+        directly against an injected clock."""
+        now = self._clock()
+        for replica in self.replicas:
+            with self._lock:
+                due = force or now >= replica.next_probe_at
+            if not due:
+                continue
+            health = self._probe(replica)
+            if health is None:
+                with self._lock:
+                    self._note_failure(replica)
+                continue
+            with self._lock:
+                was_down = not replica.healthy
+                if not was_down:
+                    replica.last_health = health
+                    replica.step = health.get("step")
+                    self._admit(replica, False)
+                    continue
+            # -- re-admission: serialized with rollouts.  A replica
+            # re-admitted mid-rollout would be invisible to the commit
+            # (the rollout snapshotted the fleet before it came back)
+            # AND pass the catch-up check against the STALE fleet_step —
+            # then serve the old version after everyone else flipped.
+            # Holding the replica out one more probe round is cheap;
+            # mixing versions is not.
+            if not self._rollout_lock.acquire(blocking=False):
+                with self._lock:
+                    replica.next_probe_at = (
+                        self._clock() + self.health_interval_s)
+                continue
+            try:
+                with self._lock:
+                    replica.last_health = health
+                    replica.step = health.get("step")
+                    needs_catch_up = (
+                        self.fleet_step is not None
+                        and replica.step != self.fleet_step)
+                    if not needs_catch_up:
+                        self._admit(replica, True)
+                        continue
+                # catch-up runs OUTSIDE the dispatch lock (two HTTP
+                # calls) but INSIDE the rollout lock: no rollout can
+                # change fleet_step mid-catch-up
+                if self._catch_up(replica):
+                    with self._lock:
+                        replica.step = self.fleet_step
+                        self._admit(replica, True)
+                else:
+                    with self._lock:
+                        self._note_failure(replica)
+            finally:
+                self._rollout_lock.release()
+
+    def _admit(self, replica: Replica, was_down: bool) -> None:
+        """Caller holds the lock."""
+        replica.fail_streak = 0
+        replica.next_probe_at = self._clock() + self.health_interval_s
+        if was_down:
+            replica.healthy = True
+            self.registry.counter(
+                "router_readmissions_total",
+                help="ejected replicas restored to dispatch",
+            ).inc()
+            self._gauge_replicas()
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.health_interval_s):
+            self.check_health_once()
+
+    # -- dispatch -----------------------------------------------------------
+    def _hash_pick(self, key: str) -> Optional[Replica]:
+        """Consistent-hash lookup: first HEALTHY replica clockwise from the
+        key's point.  Caller holds the lock."""
+        h = int(hashlib.sha1(key.encode()).hexdigest()[:16], 16)
+        start = bisect.bisect_left(self._ring_keys, h)
+        for i in range(len(self._ring)):
+            _, replica = self._ring[(start + i) % len(self._ring)]
+            if replica.healthy:
+                return replica
+        return None
+
+    def pick(self, affinity_key: Optional[str] = None,
+             exclude: Sequence[Replica] = ()) -> Replica:
+        """Choose a replica: consistent-hash with an affinity key,
+        least-loaded (ties rotated) otherwise.  ``exclude`` holds replicas
+        already tried this request (failover never retries the same box).
+
+        The commit gate is checked INSIDE the lock that also increments
+        ``inflight``: the rollout closes the gate under the same lock, so
+        after ``coordinated_reload`` clears it, every request is either
+        already counted in-flight (the drain sees it) or will re-wait —
+        no request can slip between a gate check and its accounting and
+        land on a half-committed fleet."""
+        while True:
+            if not self._dispatch_open.wait(timeout=self.gate_timeout_s):
+                self.registry.counter(
+                    "router_no_replica_total",
+                    help="requests that found no healthy replica",
+                ).inc()
+                raise NoHealthyReplica(
+                    "dispatch gated longer than gate_timeout_s")
+            with self._lock:
+                if not self._dispatch_open.is_set():
+                    continue  # gate closed between wait and lock: re-wait
+                return self._pick_locked(affinity_key, exclude)
+
+    def _pick_locked(self, affinity_key, exclude) -> Replica:
+        """Caller holds the lock and has passed the gate."""
+        if affinity_key:
+            replica = self._hash_pick(affinity_key)
+            if replica is not None and replica not in exclude:
+                replica.inflight += 1
+                return replica
+            # the hashed replica was just tried (or everything on the
+            # ring is down): fail over to least-loaded
+        candidates = [r for r in self.replicas
+                      if r.healthy and r not in exclude]
+        if not candidates:
+            self.registry.counter(
+                "router_no_replica_total",
+                help="requests that found no healthy replica",
+            ).inc()
+            raise NoHealthyReplica(
+                f"0 of {len(self.replicas)} replicas available"
+            )
+        least = min(r.inflight for r in candidates)
+        tied = [r for r in candidates if r.inflight == least]
+        replica = tied[self._rr % len(tied)]
+        self._rr += 1
+        replica.inflight += 1
+        return replica
+
+    def dispatch(self, endpoint: str, body: bytes, headers: Dict[str, str],
+                 root_span=None, affinity_key: Optional[str] = None,
+                 ) -> Tuple[int, Dict[str, str], bytes, Replica]:
+        """Proxy one request: pick (which gates — a commit in progress
+        holds new arrivals), forward; connection-level failure fails over
+        to the next healthy replica.  Returns ``(status, headers, body,
+        replica)``; raises :class:`NoHealthyReplica` when the fleet is
+        dry."""
+        tracer = self.tracer
+        t_route0 = tracer.clock()
+        tried: List[Replica] = []
+        last_exc: Optional[Exception] = None
+        while len(tried) < len(self.replicas):
+            replica = self.pick(affinity_key, exclude=tried)
+            if root_span is not None and not tried:
+                tracer.record(
+                    SPAN_ROUTE, root_span, t_route0, tracer.clock(),
+                    attrs={"replica": replica.name,
+                           "policy": "hash" if affinity_key else
+                           "least_loaded"},
+                )
+            tried.append(replica)
+            proxy_span = None
+            fwd = dict(headers)
+            if root_span is not None:
+                proxy_span = tracer.start_span(
+                    SPAN_PROXY, root_span,
+                    attrs={"replica": replica.name, "endpoint": endpoint},
+                )
+                # the engine's request span will parent under THIS
+                # attempt's proxy span — retries re-parent cleanly
+                if _HEX_ID.fullmatch(root_span.trace_id):
+                    fwd["traceparent"] = format_traceparent(
+                        root_span.trace_id, proxy_span.span_id)
+                elif "X-Request-Id" in fwd:
+                    # non-hex operator id: the engine adopts the forwarded
+                    # X-Request-Id as its trace id (it wins over the
+                    # traceparent's trace field), so the header is purely
+                    # the parent-span carrier — pad the span id into the
+                    # trace field to keep the W3C shape valid
+                    fwd["traceparent"] = format_traceparent(
+                        proxy_span.span_id, proxy_span.span_id)
+            try:
+                status, resp_headers, resp_body = self._http(
+                    "POST", f"{replica.url}/{endpoint}", body, fwd,
+                    self.request_timeout_s,
+                )
+            except Exception as e:  # connection-level: fail over
+                last_exc = e
+                with self._lock:
+                    replica.inflight -= 1
+                    replica.errors += 1
+                    self._note_failure(replica)
+                if proxy_span is not None:
+                    tracer.end(proxy_span, attrs={"error": repr(e)})
+                self.registry.counter(
+                    "router_failovers_total",
+                    help="proxy attempts retried on another replica after "
+                         "a connection failure",
+                ).inc()
+                continue
+            with self._lock:
+                replica.inflight -= 1
+                replica.requests += 1
+                replica.fail_streak = 0
+                if status >= 500:
+                    replica.errors += 1
+            if proxy_span is not None:
+                tracer.end(proxy_span, attrs={"status": status})
+            return status, resp_headers, resp_body, replica
+        raise NoHealthyReplica(
+            f"all {len(tried)} replicas failed: {last_exc!r}")
+
+    # -- coordinated rollout ------------------------------------------------
+    def _admin(self, replica: Replica, action: str,
+               payload: Optional[dict] = None,
+               timeout: Optional[float] = None) -> Optional[dict]:
+        try:
+            status, _, body = self._http(
+                "POST", f"{replica.url}/admin/reload/{action}",
+                json.dumps(payload).encode() if payload is not None else b"",
+                {"Content-Type": "application/json"} if payload is not None
+                else {},
+                timeout if timeout is not None else self.admin_timeout_s,
+            )
+            return json.loads(body) if status == 200 else None
+        except Exception:
+            return None
+
+    def coordinated_reload(self, step: Optional[int] = None) -> dict:
+        """Roll the whole healthy fleet to one checkpoint step; see module
+        docstring for the two-phase protocol.  Returns a report dict with
+        ``status`` in {"noop", "no_replicas", "aborted", "committed",
+        "rolled_back"}."""
+        with self._rollout_lock:
+            with self._lock:
+                fleet = [r for r in self.replicas if r.healthy]
+            if not fleet:
+                return {"status": "no_replicas"}
+
+            # -- phase 1: stage the SAME step everywhere ------------------
+            # With no pinned step, DISCOVER the target first: walk the
+            # fleet until some replica stages something newer than what it
+            # serves.  One replica saying "nothing newer" is NOT a fleet
+            # noop — a replica started earlier may serve an older step,
+            # and the rollout is also the convergence mechanism for a
+            # mixed fleet: if nobody stages but serving steps disagree,
+            # the newest serving step becomes the target.
+            target = step
+            # the CONSERVATIVE pre-rollout version: the MINIMUM serving
+            # step seen in phase 1.  It is only used to pin fleet_step on
+            # a rolled-back rollout (so a suspect replica's re-admission
+            # catch-up can never be steered to the new target) — on a
+            # mixed fleet the first response's step could BE the target,
+            # which would defeat the pin entirely.
+            old_step: Optional[int] = None
+
+            def note_serving(resp) -> None:
+                nonlocal old_step
+                s = resp.get("serving_step")
+                if s is not None and (old_step is None or s < old_step):
+                    old_step = int(s)
+
+            prepared: List[Replica] = []
+            trivial: List[Replica] = []  # already serving the target
+            if target is None:
+                serving: Dict[str, Optional[int]] = {}
+                for replica in fleet:
+                    resp = self._admin(replica, "prepare", {})
+                    if resp is None:
+                        # the failed replica gets an abort too: a router-
+                        # side timeout with engine-side success would
+                        # strand a full staged param tree there
+                        self._abort(prepared + [replica])
+                        return {"status": "aborted", "phase": "prepare",
+                                "replica": replica.name,
+                                "detail": "prepare failed"}
+                    note_serving(resp)
+                    serving[replica.name] = resp.get("serving_step")
+                    staged = resp.get("staged_step")
+                    if staged is not None:
+                        target = int(staged)
+                        prepared.append(replica)
+                        break  # pin the rest to this step below
+                if target is None:
+                    distinct = {v for v in serving.values()}
+                    if len(distinct) <= 1:
+                        return {"status": "noop",
+                                "step": next(iter(distinct), None)}
+                    target = max(v for v in distinct if v is not None)
+
+            for replica in fleet:
+                if replica in prepared:
+                    continue
+                resp = self._admin(replica, "prepare", {"step": target})
+                if resp is None:
+                    self._abort(prepared + [replica])
+                    return {"status": "aborted", "phase": "prepare",
+                            "replica": replica.name,
+                            "detail": "prepare failed"}
+                note_serving(resp)
+                staged = resp.get("staged_step")
+                if staged is None:
+                    if resp.get("serving_step") == target:
+                        trivial.append(replica)
+                        continue
+                    self._abort(prepared + [replica])
+                    return {"status": "aborted", "phase": "prepare",
+                            "replica": replica.name,
+                            "detail": f"could not stage step {target}"}
+                if int(staged) != target:
+                    self._abort(prepared + [replica])
+                    return {"status": "aborted", "phase": "prepare",
+                            "replica": replica.name,
+                            "detail": f"staged {staged} != target {target}"}
+                prepared.append(replica)
+            if not prepared and not trivial:
+                return {"status": "noop", "step": target}
+
+            # -- phase 2: gate dispatch, drain, commit everywhere ---------
+            # the gate closes UNDER the dispatch lock: _pick_locked checks
+            # it in the same critical section that increments inflight, so
+            # once clear() returns, every admitted request is visible to
+            # the drain below and every unadmitted one re-waits
+            with self._lock:
+                self._dispatch_open.clear()
+            try:
+                # drain in-flight work before the first commit: a response
+                # computed DURING the commit window would expose a half-
+                # committed fleet — or, worse, a dirty read of the new
+                # step that a later rollback retracts.  With the gate
+                # closed and in-flight at zero, every response completes
+                # strictly before (all-old) or strictly after (all-new,
+                # or all-old on rollback) the swap.
+                drain_deadline = self._clock() + self.drain_timeout_s
+                while True:
+                    with self._lock:
+                        if all(r.inflight == 0 for r in self.replicas):
+                            break
+                    if self._clock() >= drain_deadline:
+                        # proceeding with stragglers in flight weakens the
+                        # ordering guarantee for exactly those requests —
+                        # never silently: the counter + warning make an
+                        # undersized drain_timeout_s visible
+                        self.registry.counter(
+                            "router_drain_timeouts_total",
+                            help="rollouts that committed with requests "
+                                 "still in flight (drain deadline hit)",
+                        ).inc()
+                        warnings.warn(
+                            f"rollout drain did not reach zero in-flight "
+                            f"within {self.drain_timeout_s}s; committing "
+                            f"anyway — in-flight responses may interleave "
+                            f"with the version flip", stacklevel=2,
+                        )
+                        break
+                    self._sleep(0.005)
+                committed: List[Replica] = []
+                for replica in prepared:
+                    resp = self._admin(replica, "commit",
+                                       timeout=self.commit_timeout_s)
+                    if resp is None or resp.get("step") != target:
+                        # roll the fleet back BEFORE the gate reopens: no
+                        # post-gate dispatch may ever see the new step.
+                        # The failed replica gets an abort too — an HTTP-
+                        # level commit failure may have left it staged.
+                        for done in committed:
+                            if self._admin(done, "rollback",
+                                           timeout=self.commit_timeout_s
+                                           ) is None:
+                                # the rollback itself failed: this replica
+                                # may still serve the NEW step in a fleet
+                                # that reverted — eject it; re-admission
+                                # catch-up (fleet_step pinned below) rolls
+                                # it back before it takes traffic again
+                                with self._lock:
+                                    done.fail_streak = max(
+                                        done.fail_streak,
+                                        self.eject_after - 1)
+                                    self._note_failure(done)
+                                self.registry.counter(
+                                    "router_rollback_failures_total",
+                                    help="replicas whose rollback call "
+                                         "failed (ejected until catch-up)",
+                                ).inc()
+                        self._abort([r for r in prepared
+                                     if r not in committed])
+                        # the failed replica may have committed server-side
+                        # with the response lost: eject it, and pin the
+                        # fleet step to the OLD version so re-admission
+                        # catch-up forces it back into agreement before it
+                        # takes traffic again
+                        with self._lock:
+                            replica.fail_streak = max(
+                                replica.fail_streak, self.eject_after - 1)
+                            self._note_failure(replica)
+                        if old_step is not None:
+                            self.fleet_step = int(old_step)
+                        self.registry.counter(
+                            "router_rollbacks_total",
+                            help="coordinated rollouts reverted after a "
+                                 "commit failure",
+                        ).inc()
+                        return {"status": "rolled_back",
+                                "replica": replica.name,
+                                "step": target,
+                                "detail": "commit failed; fleet reverted"}
+                    committed.append(replica)
+                self.fleet_step = target
+                with self._lock:
+                    for replica in prepared + trivial:
+                        replica.step = target
+                self.registry.counter(
+                    "router_rollouts_total",
+                    help="coordinated fleet reloads committed",
+                ).inc()
+                self.registry.gauge(
+                    "router_fleet_step",
+                    help="checkpoint step the fleet serves",
+                ).set(target)
+            finally:
+                self._dispatch_open.set()
+            # the rollout landed everywhere: release each replica's
+            # rollback point (a full second device param tree) AFTER the
+            # gate reopened — memory hygiene must not extend the gated
+            # window, and the rollback window is over by definition here.
+            # A failed finalize only delays the release to the next
+            # rollout; never worth failing the rollout over.
+            for replica in prepared:
+                self._admin(replica, "finalize",
+                            timeout=self.commit_timeout_s)
+            return {"status": "committed", "step": target,
+                    "replicas": [r.name for r in prepared + trivial]}
+
+    def _abort(self, prepared: Sequence[Replica]) -> None:
+        for replica in prepared:
+            self._admin(replica, "abort")
+
+    def _rollout_loop(self) -> None:
+        while not self._stop.wait(self.rollout_poll_s):
+            try:
+                self.coordinated_reload()
+            except Exception:  # the poll loop must outlive any rollout bug
+                self.registry.counter(
+                    "router_rollout_errors_total",
+                    help="rollout poll iterations that raised",
+                ).inc()
+
+    # -- aggregate views ----------------------------------------------------
+    def health(self) -> dict:
+        with self._lock:
+            replicas = [r.to_dict() for r in self.replicas]
+            healthy = [r for r in self.replicas if r.healthy]
+            model = next(
+                (r.last_health for r in healthy if r.last_health), None)
+        n = len(healthy)
+        status = "ok" if n == len(self.replicas) else (
+            "degraded" if n else "down")
+        out = {
+            "status": status,
+            "role": "router",
+            "healthy_replicas": n,
+            "fleet_step": self.fleet_step,
+            "replicas": replicas,
+        }
+        if model:
+            # surface the model's input contract so loadgen (and any other
+            # client) reads the router exactly like a single engine
+            for key in ("image_size", "channels", "levels", "dim", "step",
+                        "buckets", "quant", "mesh", "param_sharding"):
+                if key in model:
+                    out[key] = model[key]
+        return out
+
+    def metrics_text(self) -> str:
+        """Router families verbatim + every reachable replica's families
+        relabeled with ``replica="<name>"`` (HELP/TYPE deduped across
+        replicas — Prometheus rejects repeated metadata).  Replica
+        scrapes run CONCURRENTLY: serial fetches would stack one
+        ``health_timeout_s`` per blackholed replica and blow a typical
+        Prometheus scrape_timeout exactly when replicas are unhealthy."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        replicas = list(self.replicas)
+
+        def fetch(replica):
+            try:
+                return self._http("GET", f"{replica.url}/metrics", None,
+                                  {}, self.health_timeout_s)
+            except Exception:
+                return None
+
+        with ThreadPoolExecutor(
+            max_workers=min(8, max(1, len(replicas)))
+        ) as pool:
+            fetched = list(pool.map(fetch, replicas))
+
+        parts = [prometheus_lines(self.registry)]
+        seen_meta = set()
+        for replica, result in zip(replicas, fetched):
+            if result is None:
+                parts.append(f"# replica {replica.name} unreachable\n")
+                continue
+            status, _, body = result
+            if status != 200:
+                parts.append(f"# replica {replica.name} /metrics -> "
+                             f"{status}\n")
+                continue
+            out = []
+            for line in body.decode(errors="replace").splitlines():
+                if line.startswith("#"):
+                    if line not in seen_meta:
+                        seen_meta.add(line)
+                        out.append(line)
+                    continue
+                m = _SAMPLE_RE.match(line)
+                if not m:
+                    continue
+                name, labels, rest = m.groups()
+                inner = labels[1:-1] if labels else ""
+                label = f'replica="{replica.name}"' + (
+                    f",{inner}" if inner else "")
+                out.append(f"{name}{{{label}}}{rest}")
+            parts.append("\n".join(out) + "\n")
+        return "".join(parts)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self, *, health: bool = True) -> None:
+        """Probe every replica once synchronously (a dead replica must be
+        ejected before the first request, not an interval later), then run
+        the probe loop — and the rollout poll when configured."""
+        self.check_health_once(force=True)
+        if health and self.health_interval_s > 0:
+            t = threading.Thread(target=self._health_loop,
+                                 name="glom-router-health", daemon=True)
+            t.start()
+            self._threads.append(t)
+        if self.rollout_poll_s > 0:
+            t = threading.Thread(target=self._rollout_loop,
+                                 name="glom-router-rollout", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._dispatch_open.set()  # release any gated handler threads
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = []
+        if self.tracer.exporter is not None:
+            self.tracer.exporter.close()
+
+
+# ---------------------------------------------------------------------------
+# stdlib HTTP front
+# ---------------------------------------------------------------------------
+class RouterHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    # stdlib default backlog is 5: a burst of fresh connections (clients
+    # without keep-alive, a loadgen wave) overflows it and the dropped
+    # SYNs retransmit on second-scale timers — a 300ms+ latency cliff
+    # that looks like router overhead but is just the listen queue
+    request_queue_size = 128
+
+    def __init__(self, addr, handler, router: FleetRouter, *,
+                 quiet: bool = True):
+        super().__init__(addr, handler)
+        self.router = router
+        self.quiet = quiet
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    server_version = "glom-router"
+    protocol_version = "HTTP/1.1"
+    # headers and body are separate writes; without TCP_NODELAY Nagle can
+    # hold the body segment against a delayed ACK — 40ms quanta on a
+    # reply that took 2ms to compute
+    disable_nagle_algorithm = True
+
+    def log_message(self, fmt, *args):
+        if not getattr(self.server, "quiet", True):
+            super().log_message(fmt, *args)
+
+    def _reply(self, code: int, payload, content_type="application/json",
+               extra_headers: Optional[Dict[str, str]] = None) -> None:
+        if code >= 400:
+            self.server.router.registry.counter(
+                f"router_errors_{code // 100}xx",
+                help=f"router replies with a {code // 100}xx status",
+            ).inc()
+        body = (json.dumps(payload) if isinstance(payload, (dict, list))
+                else payload)
+        body = body.encode() if isinstance(body, str) else body
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        rid = getattr(self, "_request_id", None)
+        if rid is not None:
+            self.send_header("X-Request-Id", rid)
+            tid = self._trace_root.trace_id
+            if _HEX_ID.fullmatch(tid):
+                self.send_header("traceparent", format_traceparent(
+                    tid, self._trace_root.span_id))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        self._request_id = None
+        router = self.server.router
+        if self.path == "/healthz":
+            self._reply(200, router.health())
+        elif self.path == "/metrics":
+            self._reply(200, router.metrics_text(),
+                        content_type="text/plain; version=0.0.4")
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):  # noqa: N802
+        self._request_id = None
+        router = self.server.router
+        if self.path == "/rollout":
+            length = int(self.headers.get("Content-Length") or 0)
+            payload = {}
+            if length:
+                try:
+                    payload = json.loads(self.rfile.read(length))
+                except ValueError as e:
+                    self._reply(400, {"error": f"invalid JSON: {e}"})
+                    return
+            if not isinstance(payload, dict):
+                self._reply(400, {"error": "body must be a JSON object"})
+                return
+            step = payload.get("step")
+            report = router.coordinated_reload(
+                step=int(step) if step is not None else None)
+            code = 200 if report["status"] in ("committed", "noop") else 502
+            self._reply(code, report)
+            return
+        if self.path not in ("/embed", "/reconstruct"):
+            self._reply(404, {"error": f"no route {self.path}"})
+            return
+        endpoint = self.path[1:]
+        tracer = router.tracer
+
+        rid_header = request_trace_id(self.headers.get("X-Request-Id"))
+        remote = parse_traceparent(self.headers.get("traceparent"))
+        root = tracer.start_trace(
+            SPAN_ROUTER_REQUEST,
+            trace_id=rid_header or (remote[0] if remote else None),
+            parent_id=remote[1] if remote else None,
+            attrs={"endpoint": endpoint},
+        )
+        self._trace_root = root
+        self._request_id = rid_header or root.trace_id
+
+        length = int(self.headers.get("Content-Length") or 0)
+        if length <= 0:
+            self._reply(400, {"error": f"bad Content-Length {length}"})
+            tracer.end(root, attrs={"status": 400})
+            return
+        body = self.rfile.read(length)
+        fwd = {"Content-Type": self.headers.get("Content-Type",
+                                                "application/json")}
+        if rid_header:
+            fwd["X-Request-Id"] = rid_header
+        affinity = self.headers.get("X-Affinity-Key")
+        if affinity:
+            fwd["X-Affinity-Key"] = affinity
+        try:
+            status, _resp_headers, resp_body, replica = router.dispatch(
+                endpoint, body, fwd, root_span=root, affinity_key=affinity,
+            )
+        except NoHealthyReplica as e:
+            self._reply(503, {"error": "no_replica", "detail": str(e)})
+            tracer.end(root, attrs={"status": 503})
+            return
+        router.registry.counter(
+            "router_requests_total", help="requests proxied to replicas",
+        ).inc()
+        self._reply(status, resp_body,
+                    extra_headers={"X-Served-By": replica.name})
+        tracer.end(root, attrs={"status": status, "replica": replica.name})
+
+
+def make_router_server(router: FleetRouter, host: str = "127.0.0.1",
+                       port: int = 0, *, quiet: bool = True
+                       ) -> RouterHTTPServer:
+    """Bind (port 0 = ephemeral); caller runs ``serve_forever``."""
+    return RouterHTTPServer((host, port), _RouterHandler, router, quiet=quiet)
+
+
+# ---------------------------------------------------------------------------
+# CLI: route existing replicas, or --spawn an in-process fleet
+# ---------------------------------------------------------------------------
+def _spawn_fleet(n: int, args) -> Tuple[List[str], list]:
+    """--spawn mode: N engines + servers in this process (CPU demo /
+    single-host multi-replica; each replica owns its own batcher, cache,
+    and params).  Returns (urls, [(engine, server), ...])."""
+    from glom_tpu.serving.engine import ServingEngine, make_demo_checkpoint
+    from glom_tpu.serving.server import make_server
+    from glom_tpu import checkpoint as ckpt_lib
+
+    if args.demo and ckpt_lib.latest_step(args.checkpoint_dir) is None:
+        make_demo_checkpoint(args.checkpoint_dir)
+    urls, members = [], []
+    for i in range(n):
+        engine = ServingEngine(
+            args.checkpoint_dir,
+            buckets=tuple(int(b) for b in args.buckets.split(",")),
+            max_wait_ms=args.max_wait_ms,
+            # replicas NEVER self-reload: the router's coordinated
+            # rollout is the only param-swap path in a fleet
+            reload_poll_s=0,
+            quant=args.quant,
+        )
+        engine.start(watch=False)
+        server = make_server(engine, args.host, 0)
+        threading.Thread(target=server.serve_forever, daemon=True,
+                         name=f"glom-replica-{i}").start()
+        host, port = server.server_address[:2]
+        urls.append(f"http://{host}:{port}")
+        members.append((engine, server))
+    return urls, members
+
+
+def main(argv=None) -> int:
+    import argparse
+    import signal
+
+    p = argparse.ArgumentParser(
+        description="GLOM replica fleet router: least-loaded/consistent-"
+                    "hash dispatch, health-aware ejection, coordinated "
+                    "hot-reload",
+    )
+    p.add_argument("--replica", action="append", default=None, metavar="URL",
+                   help="engine replica base URL (repeatable)")
+    p.add_argument("--spawn", type=int, default=0, metavar="N",
+                   help="spawn N in-process engine replicas from "
+                        "--checkpoint-dir instead of routing external URLs")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="checkpoint dir for --spawn replicas")
+    p.add_argument("--demo", action="store_true",
+                   help="with --spawn: write a demo checkpoint if the dir "
+                        "has none")
+    p.add_argument("--buckets", default="1,2,4,8",
+                   help="with --spawn: per-replica batch buckets")
+    p.add_argument("--quant", default="f32", choices=["f32", "bf16", "int8"],
+                   help="with --spawn: per-replica serving precision")
+    p.add_argument("--max-wait-ms", type=float, default=5.0,
+                   help="with --spawn: per-replica micro-batch deadline")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8800)
+    p.add_argument("--health-interval-s", type=float, default=1.0,
+                   help="replica /healthz probe period")
+    p.add_argument("--eject-after", type=int, default=2,
+                   help="consecutive failures before a replica is ejected")
+    p.add_argument("--rollout-poll-s", type=float, default=0.0,
+                   help="poll for new checkpoints and roll the fleet "
+                        "forward every this many seconds; 0 = POST "
+                        "/rollout only")
+    p.add_argument("--trace-log", default=None,
+                   help="JSONL file receiving one record per completed "
+                        "router trace")
+    p.add_argument("--platform", default="auto",
+                   help="force a JAX platform for --spawn (e.g. 'cpu')")
+    p.add_argument("--verbose", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.platform != "auto":
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    members = []
+    if args.spawn:
+        if not args.checkpoint_dir:
+            p.error("--spawn needs --checkpoint-dir")
+        urls, members = _spawn_fleet(args.spawn, args)
+    else:
+        urls = args.replica or []
+        if not urls:
+            p.error("need --replica URL(s) or --spawn N")
+
+    router = FleetRouter(
+        urls,
+        health_interval_s=args.health_interval_s,
+        eject_after=args.eject_after,
+        rollout_poll_s=args.rollout_poll_s,
+        trace_log=args.trace_log,
+    )
+    router.start()
+    server = make_router_server(router, args.host, args.port,
+                                quiet=not args.verbose)
+
+    stop_once = threading.Event()
+
+    def _graceful(signum, frame):
+        if stop_once.is_set():
+            return
+        stop_once.set()
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+
+    host, port = server.server_address[:2]
+    print(json.dumps({
+        "event": "routing", "host": host, "port": port,
+        "replicas": urls,
+        "healthy": router.health()["healthy_replicas"],
+    }), flush=True)
+    try:
+        server.serve_forever(poll_interval=0.2)
+    finally:
+        router.shutdown()
+        server.server_close()
+        for engine, eng_server in members:
+            eng_server.shutdown()
+            engine.shutdown(drain=True)
+            eng_server.server_close()
+        print(json.dumps({"event": "router_drained"}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -62,6 +62,11 @@ _HEX_ID = re.compile(r"[0-9a-f]{1,32}")
 class ServingHTTPServer(ThreadingHTTPServer):
     daemon_threads = True   # handler threads must not block process exit
     allow_reuse_address = True
+    # see RouterHTTPServer: the default backlog of 5 drops SYNs under
+    # connection bursts (the router opens a fresh upstream connection per
+    # proxied request), turning queue pressure into second-scale
+    # retransmit stalls
+    request_queue_size = 128
 
     def __init__(self, addr, handler, engine: ServingEngine, *, quiet: bool = True):
         super().__init__(addr, handler)
@@ -72,6 +77,9 @@ class ServingHTTPServer(ThreadingHTTPServer):
 class _Handler(BaseHTTPRequestHandler):
     server_version = "glom-serving"
     protocol_version = "HTTP/1.1"
+    # headers flush + body write are separate sends; TCP_NODELAY keeps
+    # Nagle from parking the body against a delayed ACK (40ms quanta)
+    disable_nagle_algorithm = True
 
     # -- plumbing ----------------------------------------------------------
     def log_message(self, fmt, *args):
@@ -149,8 +157,44 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 
+    # -- fleet admin: the staged two-phase reload primitive ----------------
+    # POSTed by the router's coordinated rollout (docs/SERVING.md fleet
+    # section).  Small JSON in/out, no tracing — these are control-plane
+    # calls, not requests.
+    def _do_admin(self):
+        engine = self.server.engine
+        action = self.path[len("/admin/reload/"):]
+        if action == "prepare":
+            payload = self._read_json() if int(
+                self.headers.get("Content-Length") or 0) > 0 else {}
+            if payload is None:
+                return
+            step = payload.get("step")
+            staged = engine.stage_reload(
+                step=int(step) if step is not None else None)
+            self._reply(200, {"staged_step": staged,
+                              "serving_step": int(engine.step)})
+        elif action == "commit":
+            step = engine.commit_staged()
+            self._reply(200, {"step": step})
+        elif action == "abort":
+            self._reply(200, {"aborted": engine.abort_staged()})
+        elif action == "finalize":
+            self._reply(200, {"finalized": engine.finalize_reload()})
+        elif action == "rollback":
+            step = engine.rollback()
+            if step is None:
+                self._reply(409, {"error": "nothing to roll back to"})
+            else:
+                self._reply(200, {"step": step})
+        else:
+            self._reply(404, {"error": f"no admin action {action!r}"})
+
     def do_POST(self):  # noqa: N802
         self._request_id = None  # reset before routing (keep-alive reuse)
+        if self.path.startswith("/admin/reload/"):
+            self._do_admin()
+            return
         if self.path not in ("/embed", "/reconstruct"):
             self._reply(404, {"error": f"no route {self.path}"})
             return
@@ -289,6 +333,16 @@ def main(argv=None) -> int:
                    choices=["dense", "pallas", "fused"],
                    help="override the checkpoint config's kernel choice "
                         "(fused = single-launch level update)")
+    p.add_argument("--mesh-shape", default=None,
+                   help="serve mesh-sharded: comma '(data,model,seq)' device "
+                        "counts, e.g. '1,4,1' = 4-way TP within this "
+                        "replica.  Buckets must divide the data axis.  "
+                        "Default: single-device replicated")
+    p.add_argument("--param-sharding", default="replicated",
+                   choices=["replicated", "tp", "ep"],
+                   help="param placement on the mesh (parallel/sharding.py "
+                        "rules): tp shards every level-MLP's hidden dim "
+                        "over the model axis; ep shards whole level-nets")
     p.add_argument("--no-donate", action="store_true",
                    help="keep the executables' input image buffers "
                         "un-donated (debugging aid; donation is the default "
@@ -365,6 +419,9 @@ def main(argv=None) -> int:
         quant=args.quant,
         ff_impl=args.ff_impl,
         donate_inputs=False if args.no_donate else None,
+        mesh_shape=(tuple(int(s) for s in args.mesh_shape.split(","))
+                    if args.mesh_shape else None),
+        param_sharding=args.param_sharding,
     )
     engine.start()
     server = make_server(engine, args.host, args.port, quiet=not args.verbose)
@@ -388,6 +445,8 @@ def main(argv=None) -> int:
         "step": int(engine.step), "buckets": engine.health()["buckets"],
         "warm": engine.health()["warm"], "quant": engine.quant,
         "ff_impl": engine.config.ff_impl,
+        "mesh": engine.health()["mesh"],
+        "param_sharding": engine.param_sharding,
     }), flush=True)
     try:
         server.serve_forever(poll_interval=0.2)

@@ -1,0 +1,241 @@
+"""Mesh-sharded serving: placement rules + kernel dispatch for the engine.
+
+The `MULTICHIP_r05` dry-run proves the ``parallel/`` stack (DP/TP/SP +
+expert sharding) matches sequential execution; this module is the seam
+that brings it into the REQUEST path.  An engine built with a
+``mesh_shape`` AOT-compiles every bucket with explicit in/out shardings
+(the ``parallel/inference.py`` recipe, generalized to sharded params), so
+a model too large for one chip serves TP-sharded with zero request-path
+compiles — the same bucketed-executable contract as the single-device
+engine, just partitioned.
+
+Three concerns live here, shared by startup and every hot reload:
+
+  * **mesh resolution** — :func:`resolve_mesh` builds a
+    ``parallel/mesh.py`` mesh over the FIRST ``prod(mesh_shape)``
+    devices (a serving replica may deliberately own a subset of a host's
+    chips; training's make_mesh covers all of them);
+  * **placement** — :func:`param_shardings` turns the training-side
+    pspec rules (``parallel/sharding.py``) into a NamedSharding tree
+    matching the QUANTIZED param tree: int8 leaves become
+    ``{int8_q, int8_scale}`` records whose specs are derived from the
+    original weight's spec with any axis that no longer divides its dim
+    dropped (a ``(g, 1, d)`` scale can't shard a size-1 dim — it rides
+    replicated, which is exactly right for a bandwidth-trivial scale);
+  * **kernel dispatch** — :func:`resolve_sharded_kernels` mirrors the
+    Trainer's rule: ``ff_impl='fused'`` runs the single-launch kernel via
+    ``parallel/fused_shard.py`` under pure-DP meshes only, and
+    warns + falls back to the shard_mapped unfused pair
+    (``parallel/ff_shard.py``) on TP/EP/seq meshes, where the one-shot
+    consensus and whole-net weight blocks are structurally incompatible.
+
+Everything here is host-side setup (runs once at engine build / reload);
+the request path still only calls pre-compiled executables.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from glom_tpu.config import GlomConfig
+
+PARAM_SHARDINGS = ("replicated", "tp", "ep")
+
+
+def resolve_mesh(
+    mesh_shape: Sequence[int],
+    axis_names: Sequence[str] = ("data", "model", "seq"),
+) -> Mesh:
+    """A serving mesh over the first ``prod(mesh_shape)`` local devices.
+
+    Unlike training's :func:`glom_tpu.parallel.mesh.make_mesh` (which must
+    cover every device), a serving replica may own a SUBSET of the host's
+    chips — e.g. two 4-chip replicas on one 8-chip host — so the mesh is
+    built over exactly the devices the shape names."""
+    from glom_tpu.parallel.mesh import make_mesh
+
+    shape = tuple(int(s) for s in mesh_shape)
+    if any(s < 1 for s in shape):
+        raise ValueError(f"mesh_shape entries must be >= 1, got {shape}")
+    n = int(np.prod(shape))
+    devices = jax.devices()
+    if n > len(devices):
+        raise ValueError(
+            f"mesh_shape {shape} needs {n} devices; only "
+            f"{len(devices)} available"
+        )
+    return make_mesh(shape, tuple(axis_names), devices=devices[:n])
+
+
+def mesh_axes_dict(mesh: Optional[Mesh]) -> Optional[dict]:
+    """``{"data": 4, "model": 2, ...}`` — the /healthz + snapshot label."""
+    if mesh is None:
+        return None
+    return {name: int(size) for name, size in mesh.shape.items()}
+
+
+def _sanitize_spec(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
+    """Drop any spec axis that does not evenly divide its dim.
+
+    The ONE rule that makes the training pspecs safe to reuse on
+    quantized trees: an int8 scale's contracted dim is 1, so the weight's
+    model-axis entry stops dividing and is dropped (the scale replicates);
+    a genuinely mis-sized weight would likewise fall back loudly rather
+    than fail deep inside GSPMD."""
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    out = []
+    for dim, entry in zip(shape, entries[: len(shape)]):
+        if entry is None:
+            out.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        size = int(np.prod([mesh.shape[a] for a in axes]))
+        out.append(entry if size > 0 and dim % size == 0 else None)
+    return P(*out)
+
+
+def _lookup(spec_tree, path) -> Optional[P]:
+    """Walk a plain-dict pspec tree by a jax key path; None when the path
+    leaves the tree (an unexpected leaf rides replicated)."""
+    node = spec_tree
+    for key in path:
+        name = getattr(key, "key", None)
+        if not isinstance(node, dict) or name not in node:
+            return None
+        node = node[name]
+    return node if isinstance(node, P) else None
+
+
+def param_shardings(
+    mesh: Mesh,
+    config: GlomConfig,
+    quantized_params,
+    *,
+    param_sharding: str = "replicated",
+    model_axis: str = "model",
+) -> object:
+    """NamedSharding tree matching ``quantized_params`` (the engine's
+    ``{"glom": ..., "decoder": ...}`` host tree AFTER
+    :func:`glom_tpu.serving.quant.quantize_tree`).
+
+    The glom subtree follows the training placement rules
+    (``parallel.sharding.param_pspecs`` for tp,
+    ``level_sharded_pspecs`` for ep); the decoder (tiny) and any leaf the
+    rules don't name are replicated.  Each spec is sanitized against the
+    ACTUAL leaf shape, so int8 ``{int8_q, int8_scale}`` records inherit
+    the weight's spec where it still divides and replicate where it
+    doesn't."""
+    if param_sharding not in PARAM_SHARDINGS:
+        raise ValueError(
+            f"unknown param_sharding {param_sharding!r}; "
+            f"one of {PARAM_SHARDINGS}"
+        )
+    from glom_tpu.parallel import sharding as rules
+
+    if param_sharding == "tp":
+        glom_specs = rules.param_pspecs(config, model_axis=model_axis)
+    elif param_sharding == "ep":
+        glom_specs = rules.level_sharded_pspecs(
+            config, axis_size=int(mesh.shape[model_axis]),
+            model_axis=model_axis,
+        )
+    else:
+        glom_specs = {}
+    spec_tree = {"glom": glom_specs}
+
+    def one(path, leaf):
+        arr = np.asarray(leaf)
+        # int8 records sit one dict level BELOW the weight's spec: strip
+        # the record key so int8_q/int8_scale both resolve the weight spec
+        lookup_path = path
+        tail = getattr(path[-1], "key", None) if path else None
+        if tail in ("int8_q", "int8_scale"):
+            lookup_path = path[:-1]
+        spec = _lookup(spec_tree, lookup_path) or P()
+        return NamedSharding(mesh, _sanitize_spec(spec, arr.shape, mesh))
+
+    return jax.tree_util.tree_map_with_path(one, quantized_params)
+
+
+def batch_shardings(mesh: Mesh, *, data_axis: str = "data"):
+    """``(img_sharding, out_sharding)`` for the endpoint forwards: images
+    and every per-image output shard their leading batch axis over
+    ``data_axis`` (a trailing-axes P entry would over-constrain — GSPMD
+    lays the rest out itself)."""
+    sh = NamedSharding(mesh, P(data_axis))
+    return sh, sh
+
+
+def validate_buckets(buckets: Sequence[int], mesh: Mesh,
+                     *, data_axis: str = "data") -> None:
+    """Every bucket must divide over the data axis: a 4-way data-sharded
+    executable for bucket 2 cannot exist, and failing here names the fix
+    (pick buckets that are multiples) instead of erroring mid-warmup."""
+    n_data = int(mesh.shape[data_axis])
+    if n_data <= 1:
+        return
+    bad = [b for b in buckets if b % n_data]
+    if bad:
+        raise ValueError(
+            f"buckets {bad} are not divisible by the mesh's data axis "
+            f"({data_axis}={n_data}); every bucket must be a multiple so "
+            f"each device holds an equal batch shard"
+        )
+
+
+def resolve_sharded_kernels(
+    mesh: Mesh,
+    config: GlomConfig,
+    *,
+    param_sharding: str = "replicated",
+    data_axis: str = "data",
+    model_axis: str = "model",
+    seq_axis: str = "seq",
+):
+    """``(ff_fn, fused_fn)`` for :func:`glom_tpu.models.glom.apply` under
+    this mesh — the Trainer's dispatch rule, reused for serving:
+
+      * dense FF: ``(None, None)`` — GSPMD shards plain matmuls natively;
+      * ``ff_impl='fused'`` on a pure-DP mesh with the shape supported:
+        the single-launch kernel via ``parallel.fused_shard`` (params
+        replicated, batch sharded);
+      * ``ff_impl='pallas'``, or ``'fused'`` on a TP/EP/seq-sharded mesh
+        (structurally incompatible — warn): the shard_mapped unfused
+        pallas FF via ``parallel.ff_shard``, matching the actual param
+        placement so ``pallas_call``'s GSPMD opacity can't silently
+        all-gather the shards."""
+    if mesh.devices.size <= 1 or config.ff_impl not in ("pallas", "fused"):
+        return None, None
+    from glom_tpu.models.glom import fused_update_supported
+
+    seq_sharded = int(mesh.shape.get(seq_axis, 1)) > 1
+    params_sharded = (param_sharding != "replicated"
+                      and int(mesh.shape[model_axis]) > 1)
+    if (config.ff_impl == "fused" and fused_update_supported(config)
+            and not seq_sharded and not params_sharded):
+        from glom_tpu.parallel.fused_shard import make_sharded_fused_update
+
+        return None, make_sharded_fused_update(
+            mesh, config, data_axis=data_axis,
+        )
+    if config.ff_impl == "fused":
+        warnings.warn(
+            "serving ff_impl='fused' does not support this mesh (seq- or "
+            "model-sharded, or supports_config failed); falling back to "
+            "the sharded unfused pallas FF",
+            stacklevel=2,
+        )
+    from glom_tpu.parallel.ff_shard import make_sharded_ff_pallas
+
+    ff_fn = make_sharded_ff_pallas(
+        mesh, param_sharding=param_sharding, data_axis=data_axis,
+        model_axis=model_axis,
+        seq_axis=seq_axis if seq_sharded else None,
+        fused_bwd=config.ff_fused_bwd,
+    )
+    return ff_fn, None

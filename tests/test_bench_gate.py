@@ -176,7 +176,7 @@ class TestEvaluate:
 def test_bench_gate_check_fixtures(capsys):
     code, out, _ = _run_tool("bench_gate.py", ["--check"], capsys)
     assert code == 0
-    assert "check ok" in out and "8 fixtures" in out
+    assert "check ok" in out and "10 fixtures" in out
 
 
 def test_bench_gate_record_fail_and_skip(tmp_path, capsys):
@@ -215,6 +215,32 @@ def test_bench_gate_record_fail_and_skip(tmp_path, capsys):
     assert code == 0
     prom = (tmp_path / "prom.txt").read_text()
     assert "bench_gate_verdict 1" in prom
+
+
+def test_bench_gate_fleet_p95(tmp_path, capsys):
+    """The router-fronted p95 gates alongside the single-engine number:
+    a fleet-hop regression fails the PR even when throughput is skipped
+    (accelerator outage), and an in-allowance hop passes."""
+    rec = tmp_path / "rec.json"
+    rec.write_text(json.dumps({"status": "skipped",
+                               "reason": "relay unreachable"}))
+    fleet = tmp_path / "fleet_loadgen.json"
+    fleet.write_text(json.dumps({"latency_ms": {"p95": 80.0}}))
+    args = ["--record", str(rec), "--fleet-loadgen-json", str(fleet),
+            "--fleet-p95-baseline-ms", "50.0",
+            "--prom-textfile", str(tmp_path / "prom.txt")]
+    code, out, _ = _run_tool("bench_gate.py", args, capsys)
+    result = json.loads(out)
+    assert code == 1 and result["gate"] == "fail"
+    assert result["fleet_p95"]["gate"] == "fail"
+    assert "bench_gate_fleet_p95_ms 80" in (tmp_path / "prom.txt").read_text()
+
+    fleet.write_text(json.dumps({"latency_ms": {"p95": 52.0}}))
+    code, out, err = _run_tool("bench_gate.py", args, capsys)
+    result = json.loads(out)
+    assert code == 0 and result["fleet_p95"]["gate"] == "pass"
+    # the skipped throughput half must still be loud
+    assert "SKIP on throughput" in err
 
 
 # ---------------------------------------------------------------------------

@@ -727,7 +727,7 @@ class TestChaosCli:
         spec.loader.exec_module(mod)
         assert set(mod.SCENARIOS) == {
             "torn_ckpt_write", "corrupt_restore", "nan_batch",
-            "reload_io_error", "train_crash",
+            "reload_io_error", "train_crash", "replica_kill",
         }
 
     def test_smoke_suite_recovers(self, tmp_path):
@@ -743,7 +743,7 @@ class TestChaosCli:
         assert proc.returncode == 0, proc.stdout + proc.stderr
         with open(out_json) as f:
             summary = json.load(f)
-        assert summary["recovered"] == summary["total"] == 5
+        assert summary["recovered"] == summary["total"] == 6
         for rec in summary["results"]:
             assert rec["outcome"] == "recovered", rec
             assert rec["mttr_s"] >= 0.0
@@ -762,4 +762,4 @@ class TestChaosSoak:
         assert proc.returncode == 0, proc.stdout + proc.stderr
         with open(out_json) as f:
             summary = json.load(f)
-        assert summary["recovered"] == summary["total"] == 5
+        assert summary["recovered"] == summary["total"] == 6

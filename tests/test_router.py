@@ -1,0 +1,843 @@
+"""Fleet router tests (glom_tpu/serving/router.py).
+
+Two layers, mirroring the batcher/engine split in test_serving.py:
+
+  * **unit** — FleetRouter driven directly with an injected fake clock and
+    an in-memory fake HTTP fleet: dispatch policy, ejection/re-admission
+    backoff, coordinated-rollout state machine, metrics relabeling — all
+    deterministic, no sockets, no sleeps (beyond the injected no-op);
+  * **integration** — real ServingEngines + HTTP servers on ephemeral
+    ports behind a real router: trace propagation through the hop,
+    per-session version monotonicity under concurrent load across a
+    coordinated reload (the "no mixed-version responses" acceptance),
+    rollback leaving the fleet on the old step, and the >=3x fleet
+    throughput acceptance against stub replicas with a fixed service
+    time (stubs isolate the ROUTER's scaling from jax's CPU contention).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from glom_tpu.serving.router import (
+    FleetRouter,
+    NoHealthyReplica,
+    make_router_server,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, seconds):
+        self.t += seconds
+
+
+# ---------------------------------------------------------------------------
+# in-memory fake fleet (unit layer)
+# ---------------------------------------------------------------------------
+class FakeReplica:
+    """The engine surface the router talks to, as a dict machine: /healthz,
+    /embed|/reconstruct, /admin/reload/*.  ``available`` models the newest
+    checkpoint step on disk."""
+
+    def __init__(self, step=0):
+        self.step = step
+        self.available = step
+        self.staged = None
+        self.prev = None
+        self.up = True            # connection-level: down => URLError
+        self.fail_prepare = False
+        self.fail_commit = False
+        self.requests = []        # (endpoint, headers) per proxied request
+        self.attempts = 0         # every connection attempt, up or not
+        self.admin_calls = []     # /admin/reload/* actions received
+
+    def handle(self, method, path, body, headers):
+        """Returns (status, body_dict)."""
+        self.attempts += 1
+        if not self.up:
+            raise urllib.error.URLError("connection refused (fake)")
+        if path.startswith("/admin/reload/"):
+            self.admin_calls.append(path.rsplit("/", 1)[-1])
+        if path == "/healthz":
+            return 200, {"status": "ok", "step": self.step,
+                         "image_size": 16, "channels": 3, "levels": 3,
+                         "dim": 16}
+        if path == "/metrics":
+            return 200, ("# HELP glom_serving_requests_total images\n"
+                         "# TYPE glom_serving_requests_total counter\n"
+                         f"glom_serving_requests_total {len(self.requests)}\n"
+                         'glom_serving_latency_seconds_embed_bucket'
+                         f'{{le="+Inf"}} {len(self.requests)}\n')
+        if path in ("/embed", "/reconstruct"):
+            self.requests.append((path[1:], dict(headers)))
+            return 200, {"step": self.step, "embeddings": []}
+        if path == "/admin/reload/prepare":
+            if self.fail_prepare:
+                return 500, {"error": "injected prepare failure"}
+            payload = json.loads(body) if body else {}
+            step = payload.get("step")
+            if step is None:
+                step = self.available if self.available > self.step else None
+            if step is None or step == self.step:
+                self.staged = None
+                return 200, {"staged_step": None, "serving_step": self.step}
+            self.staged = int(step)
+            return 200, {"staged_step": self.staged,
+                         "serving_step": self.step}
+        if path == "/admin/reload/commit":
+            if self.fail_commit:
+                return 500, {"error": "injected commit failure"}
+            if self.staged is not None:
+                self.prev, self.step = self.step, self.staged
+                self.staged = None
+            return 200, {"step": self.step}
+        if path == "/admin/reload/abort":
+            had, self.staged = self.staged is not None, None
+            return 200, {"aborted": had}
+        if path == "/admin/reload/rollback":
+            if self.prev is None:
+                return 409, {"error": "nothing to roll back to"}
+            self.step, self.prev = self.prev, None
+            return 200, {"step": self.step}
+        if path == "/admin/reload/finalize":
+            had, self.prev = self.prev is not None, None
+            return 200, {"finalized": had}
+        return 404, {"error": path}
+
+
+class FakeFleet:
+    """url -> FakeReplica, exposed as the router's injectable ``http``."""
+
+    def __init__(self, n=3, step=0):
+        self.replicas = {f"http://fake-{i}": FakeReplica(step)
+                         for i in range(n)}
+
+    @property
+    def urls(self):
+        return list(self.replicas)
+
+    def __call__(self, method, url, body, headers, timeout):
+        for known in self.replicas:
+            if url.startswith(known):
+                status, payload = self.replicas[known].handle(
+                    method, url[len(known):], body, headers)
+                raw = (payload if isinstance(payload, str)
+                       else json.dumps(payload)).encode()
+                return status, {}, raw
+        raise urllib.error.URLError(f"unknown fake url {url}")
+
+
+def _router(fleet, **kw):
+    clock = FakeClock()
+    kw.setdefault("health_interval_s", 1.0)
+    kw.setdefault("eject_after", 2)
+    kw.setdefault("sleep", lambda s: None)
+    r = FleetRouter(fleet.urls, clock=clock, http=fleet, **kw)
+    return r, clock
+
+
+# ---------------------------------------------------------------------------
+# dispatch policy
+# ---------------------------------------------------------------------------
+class TestDispatch:
+    def test_least_loaded_spreads_evenly(self):
+        fleet = FakeFleet(3)
+        router, _ = _router(fleet)
+        for _ in range(9):
+            status, _, _, _ = router.dispatch("embed", b"{}", {})
+            assert status == 200
+        counts = [len(r.requests) for r in fleet.replicas.values()]
+        assert counts == [3, 3, 3], counts
+
+    def test_least_loaded_prefers_idle_replica(self):
+        fleet = FakeFleet(3)
+        router, _ = _router(fleet)
+        # pin synthetic in-flight load on r0/r1: every pick must go to r2
+        router.replicas[0].inflight = 5
+        router.replicas[1].inflight = 3
+        for _ in range(3):
+            picked = router.pick()
+            assert picked.name == "r2"
+            picked.inflight -= 1  # undo pick's accounting between calls
+
+    def test_affinity_key_is_sticky(self):
+        fleet = FakeFleet(4)
+        router, _ = _router(fleet)
+        first = router.pick(affinity_key="user-42")
+        first.inflight -= 1
+        for _ in range(10):
+            again = router.pick(affinity_key="user-42")
+            again.inflight -= 1
+            assert again is first
+
+    def test_affinity_moves_only_on_ejection(self):
+        fleet = FakeFleet(4)
+        router, _ = _router(fleet)
+        keys = [f"k{i}" for i in range(40)]
+
+        def placement():
+            out = {}
+            for k in keys:
+                r = router.pick(affinity_key=k)
+                r.inflight -= 1
+                out[k] = r.name
+            return out
+
+        before = placement()
+        victim = router.replicas[0]
+        victim.healthy = False
+        after = placement()
+        moved = [k for k in keys if before[k] != after[k]]
+        # exactly the dead replica's keys move; everyone else stays put
+        assert set(moved) == {k for k in keys if before[k] == victim.name}
+        assert all(after[k] != victim.name for k in keys)
+
+    def test_no_healthy_replica_raises(self):
+        fleet = FakeFleet(2)
+        router, _ = _router(fleet)
+        for r in router.replicas:
+            r.healthy = False
+        with pytest.raises(NoHealthyReplica):
+            router.pick()
+        assert router.registry.snapshot()["router_no_replica_total"] == 1.0
+
+    def test_connection_failure_fails_over(self):
+        fleet = FakeFleet(2)
+        router, _ = _router(fleet)
+        dead = fleet.replicas[fleet.urls[0]]
+        dead.up = False
+        for _ in range(4):
+            status, _, _, replica = router.dispatch("embed", b"{}", {})
+            assert status == 200 and replica.name == "r1"
+        snap = router.registry.snapshot()
+        assert snap["router_failovers_total"] >= 1
+        # two connection failures (eject_after) removed it from rotation
+        assert not router.replicas[0].healthy
+
+
+# ---------------------------------------------------------------------------
+# health: ejection, backoff, re-admission
+# ---------------------------------------------------------------------------
+class TestHealth:
+    def test_eject_after_consecutive_failures_and_readmit(self):
+        fleet = FakeFleet(3)
+        router, clock = _router(fleet)
+        victim = fleet.replicas[fleet.urls[1]]
+        victim.up = False
+        router.check_health_once(force=True)
+        assert router.replicas[1].healthy  # one failure: not yet
+        clock.advance(2.0)
+        router.check_health_once()
+        assert not router.replicas[1].healthy  # second failure: ejected
+        assert router.registry.snapshot()["router_ejections_total"] == 1.0
+
+        clock.advance(1.0)
+        router.check_health_once()  # third failure -> backoff doubles
+        attempts = victim.attempts
+        # backoff: the next probe is NOT due at the base interval anymore
+        clock.advance(1.0)
+        router.check_health_once()
+        assert victim.attempts == attempts  # no probe fired
+        clock.advance(1.0)
+        router.check_health_once()          # 2x interval elapsed: due
+        assert victim.attempts == attempts + 1
+
+        victim.up = True
+        clock.advance(60.0)  # past any backoff
+        router.check_health_once()
+        assert router.replicas[1].healthy
+        assert router.registry.snapshot()["router_readmissions_total"] == 1.0
+
+    def test_probe_backoff_is_capped(self):
+        fleet = FakeFleet(1)
+        router, clock = _router(fleet, probe_backoff_max=4)
+        victim = fleet.replicas[fleet.urls[0]]
+        victim.up = False
+        for _ in range(10):  # streak far past the cap
+            router.check_health_once(force=True)
+        gap = router.replicas[0].next_probe_at - clock()
+        assert gap <= router.health_interval_s * 4 + 1e-9
+
+    def test_readmission_held_during_active_rollout(self):
+        """A replica recovering WHILE a rollout is committing must wait
+        one probe round: re-admitted mid-rollout it would be invisible to
+        the commit and pass catch-up against the stale fleet step."""
+        fleet = FakeFleet(3)
+        router, clock = _router(fleet, eject_after=1)
+        victim = fleet.replicas[fleet.urls[0]]
+        victim.up = False
+        router.check_health_once(force=True)
+        assert not router.replicas[0].healthy
+        victim.up = True
+        clock.advance(60.0)
+        assert router._rollout_lock.acquire(blocking=False)
+        try:  # a rollout is in progress
+            router.check_health_once()
+            assert not router.replicas[0].healthy  # held out this round
+        finally:
+            router._rollout_lock.release()
+        clock.advance(60.0)
+        router.check_health_once()
+        assert router.replicas[0].healthy
+
+    def test_readmission_catches_up_to_fleet_step(self):
+        """A replica that missed a rollout while ejected must be rolled to
+        the fleet step before it takes traffic again."""
+        fleet = FakeFleet(3, step=1)
+        router, clock = _router(fleet, eject_after=1)
+        straggler = fleet.replicas[fleet.urls[2]]
+        straggler.up = False
+        router.check_health_once(force=True)
+        assert not router.replicas[2].healthy
+
+        for r in fleet.replicas.values():
+            r.available = 5
+        report = router.coordinated_reload()
+        assert report["status"] == "committed" and report["step"] == 5
+        assert straggler.step == 1  # ejected: not part of the rollout
+
+        straggler.up = True
+        clock.advance(60.0)
+        router.check_health_once()
+        assert router.replicas[2].healthy
+        assert straggler.step == 5  # caught up BEFORE re-admission
+
+
+# ---------------------------------------------------------------------------
+# coordinated rollout state machine
+# ---------------------------------------------------------------------------
+class TestCoordinatedRollout:
+    def test_commit_moves_whole_fleet(self):
+        fleet = FakeFleet(3, step=2)
+        router, _ = _router(fleet)
+        for r in fleet.replicas.values():
+            r.available = 7
+        report = router.coordinated_reload()
+        assert report["status"] == "committed" and report["step"] == 7
+        assert [r.step for r in fleet.replicas.values()] == [7, 7, 7]
+        assert router.fleet_step == 7
+        snap = router.registry.snapshot()
+        assert snap["router_rollouts_total"] == 1.0
+        assert snap["router_fleet_step"] == 7.0
+
+    def test_nothing_newer_is_noop(self):
+        fleet = FakeFleet(3, step=4)
+        router, _ = _router(fleet)
+        report = router.coordinated_reload()
+        assert report["status"] == "noop"
+        assert all(r.step == 4 for r in fleet.replicas.values())
+
+    def test_commit_releases_rollback_point(self):
+        """After the whole fleet committed, finalize frees each replica's
+        displaced param tree — the rollback window is commit..finalize."""
+        fleet = FakeFleet(2, step=1)
+        router, _ = _router(fleet)
+        for r in fleet.replicas.values():
+            r.available = 6
+        assert router.coordinated_reload()["status"] == "committed"
+        assert all(r.prev is None for r in fleet.replicas.values())
+
+    def test_mixed_fleet_converges(self):
+        """One replica saying 'nothing newer' must NOT declare a fleet
+        noop: a replica started earlier may serve an older step, and the
+        rollout is also the convergence mechanism for a mixed fleet."""
+        # case 1: a straggler can stage something the leader can't see
+        fleet = FakeFleet(3, step=2)
+        straggler = list(fleet.replicas.values())[1]
+        straggler.step = 1  # serves older; available is still 2
+        router, _ = _router(fleet)
+        report = router.coordinated_reload()
+        assert report["status"] == "committed" and report["step"] == 2
+        assert [r.step for r in fleet.replicas.values()] == [2, 2, 2]
+
+        # case 2: nobody stages, but serving steps disagree — the newest
+        # serving step becomes the target and the fleet converges to it
+        fleet = FakeFleet(3, step=2)
+        lagger = list(fleet.replicas.values())[2]
+        lagger.step = lagger.available = 1
+        router, _ = _router(fleet)
+        report = router.coordinated_reload()
+        assert report["status"] == "committed" and report["step"] == 2
+        assert [r.step for r in fleet.replicas.values()] == [2, 2, 2]
+
+        # a genuinely uniform fleet is still a noop
+        fleet = FakeFleet(3, step=2)
+        router, _ = _router(fleet)
+        assert router.coordinated_reload()["status"] == "noop"
+
+    def test_prepare_failure_aborts_with_no_swap_anywhere(self):
+        fleet = FakeFleet(3, step=1)
+        router, _ = _router(fleet)
+        for r in fleet.replicas.values():
+            r.available = 9
+        list(fleet.replicas.values())[2].fail_prepare = True
+        report = router.coordinated_reload()
+        assert report["status"] == "aborted" and report["phase"] == "prepare"
+        assert [r.step for r in fleet.replicas.values()] == [1, 1, 1]
+        assert all(r.staged is None for r in fleet.replicas.values())
+        assert router.fleet_step is None
+
+    def test_commit_failure_rolls_fleet_back(self):
+        fleet = FakeFleet(3, step=1)
+        router, _ = _router(fleet)
+        for r in fleet.replicas.values():
+            r.available = 9
+        bad = list(fleet.replicas.values())[2]
+        bad.fail_commit = True
+        report = router.coordinated_reload()
+        assert report["status"] == "rolled_back"
+        # every replica back on (or still on) the old step, nothing staged
+        assert [r.step for r in fleet.replicas.values()] == [1, 1, 1]
+        assert all(r.staged is None for r in fleet.replicas.values())
+        # the suspect replica is quarantined until health + catch-up
+        assert not router.replicas[2].healthy
+        assert router.fleet_step == 1  # pinned so catch-up can enforce
+        assert router.registry.snapshot()["router_rollbacks_total"] == 1.0
+
+    def test_rollback_on_mixed_fleet_pins_conservative_old_step(self):
+        """A trivially-current replica is never rolled back (it committed
+        nothing), and after a rollback fleet_step pins to the MINIMUM
+        pre-rollout serving step — the first response's step could BE the
+        new target on a mixed fleet, which would defeat the pin."""
+        fleet = FakeFleet(2, step=5)
+        r0, r1 = fleet.replicas.values()
+        r1.step = 3          # stale replica; available is still 5
+        r1.fail_commit = True
+        router, _ = _router(fleet)
+        report = router.coordinated_reload()
+        assert report["status"] == "rolled_back"
+        assert router.fleet_step == 3   # min serving, NOT the target 5
+        assert r0.step == 5             # trivial: untouched, not ejected
+        assert router.replicas[0].healthy
+        assert not router.replicas[1].healthy  # the suspect is out
+
+    def test_prepare_failure_aborts_the_failed_replica_too(self):
+        """A router-side prepare timeout with engine-side success must not
+        strand a staged param tree (2x memory) on the failed replica."""
+        fleet = FakeFleet(3, step=1)
+        router, _ = _router(fleet)
+        for r in fleet.replicas.values():
+            r.available = 9
+        bad = list(fleet.replicas.values())[1]
+        bad.fail_prepare = True
+        report = router.coordinated_reload()
+        assert report["status"] == "aborted"
+        # every replica — including the one whose prepare "failed" — got
+        # an abort POST (a timeout on the router side may have been a
+        # success on the engine side)
+        assert all(r.staged is None for r in fleet.replicas.values())
+        assert "abort" in bad.admin_calls
+
+    def test_pinned_step_rollout(self):
+        fleet = FakeFleet(2, step=3)
+        router, _ = _router(fleet)
+        for r in fleet.replicas.values():
+            r.available = 8
+        report = router.coordinated_reload(step=8)
+        assert report["status"] == "committed" and report["step"] == 8
+
+    def test_gate_reopens_after_rollout(self):
+        fleet = FakeFleet(2, step=0)
+        router, _ = _router(fleet)
+        for r in fleet.replicas.values():
+            r.available = 2
+        router.coordinated_reload()
+        assert router._dispatch_open.is_set()
+        status, _, _, _ = router.dispatch("embed", b"{}", {})
+        assert status == 200
+
+
+# ---------------------------------------------------------------------------
+# aggregate views
+# ---------------------------------------------------------------------------
+class TestAggregates:
+    def test_health_aggregates_and_model_contract(self):
+        fleet = FakeFleet(3)
+        router, _ = _router(fleet)
+        router.check_health_once(force=True)
+        health = router.health()
+        assert health["status"] == "ok" and health["healthy_replicas"] == 3
+        assert health["image_size"] == 16  # loadgen's input contract
+        fleet.replicas[fleet.urls[0]].up = False
+        router.check_health_once(force=True)
+        router.check_health_once(force=True)
+        assert router.health()["status"] == "degraded"
+
+    def test_metrics_relabeled_per_replica(self):
+        fleet = FakeFleet(2)
+        router, _ = _router(fleet)
+        router.dispatch("embed", b"{}", {})
+        text = router.metrics_text()
+        assert 'glom_serving_requests_total{replica="r0"}' in text
+        assert 'glom_serving_requests_total{replica="r1"}' in text
+        # existing labels are preserved, replica label prepended
+        assert 'replica="r0",le="+Inf"' in text
+        # HELP/TYPE appear once despite two replicas exporting the family
+        assert text.count("# HELP glom_serving_requests_total") == 1
+        # router's own families ride along unlabeled
+        assert "glom_router_replicas_healthy" in text
+
+    def test_metrics_marks_unreachable_replica(self):
+        fleet = FakeFleet(2)
+        router, _ = _router(fleet)
+        fleet.replicas[fleet.urls[1]].up = False
+        text = router.metrics_text()
+        assert "# replica r1 unreachable" in text
+
+
+# ---------------------------------------------------------------------------
+# integration: real engines behind a real router
+# ---------------------------------------------------------------------------
+from glom_tpu.serving.engine import (  # noqa: E402
+    DEMO_CONFIG,
+    ServingEngine,
+    make_demo_checkpoint,
+)
+from glom_tpu.serving.server import make_server  # noqa: E402
+
+
+def _imgs(n, seed=0):
+    c = DEMO_CONFIG
+    return np.random.RandomState(seed).randn(
+        n, c.channels, c.image_size, c.image_size).astype(np.float32)
+
+
+def _start_replica(ckpt, port=0):
+    eng = ServingEngine(ckpt, buckets=(1, 2, 4), max_wait_ms=1.0,
+                        warmup=True, reload_poll_s=0)
+    eng.start(watch=False)
+    srv = make_server(eng, port=port)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return eng, srv
+
+
+@pytest.fixture(scope="module")
+def fleet_ckpt(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("fleet_ckpt"))
+    make_demo_checkpoint(d)
+    return d
+
+
+@pytest.fixture()
+def fleet(fleet_ckpt):
+    members = [_start_replica(fleet_ckpt) for _ in range(3)]
+    urls = ["http://{}:{}".format(*srv.server_address[:2])
+            for _, srv in members]
+    router = FleetRouter(urls, health_interval_s=0.2)
+    router.start()
+    rsrv = make_router_server(router)
+    threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+    rurl = "http://{}:{}".format(*rsrv.server_address[:2])
+    yield rurl, router, members
+    router.shutdown()
+    rsrv.shutdown()
+    rsrv.server_close()
+    for eng, srv in members:
+        srv.shutdown()
+        srv.server_close()
+        eng.shutdown(drain=False)
+
+
+def _post(url, path, payload, headers=None, timeout=60):
+    data = (payload if isinstance(payload, bytes)
+            else json.dumps(payload).encode())
+    req = urllib.request.Request(
+        url + path, data=data,
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, dict(r.headers.items()), json.loads(r.read())
+
+
+class TestFleetIntegration:
+    def test_embed_roundtrip_with_served_by(self, fleet):
+        rurl, router, members = fleet
+        status, headers, resp = _post(
+            rurl, "/embed", {"images": _imgs(2).tolist()})
+        assert status == 200
+        emb = np.asarray(resp["embeddings"])
+        assert emb.shape == (2, DEMO_CONFIG.levels, DEMO_CONFIG.dim)
+        assert headers.get("X-Served-By") in {"r0", "r1", "r2"}
+
+    def test_trace_propagates_through_the_hop(self, fleet):
+        """Acceptance: the router's proxy span parents the engine's request
+        span, in ONE shared trace keyed by the client's X-Request-Id."""
+        rurl, router, members = fleet
+        rid = "fleet-trace-1"
+        status, headers, _ = _post(rurl, "/embed",
+                                   {"images": _imgs(1).tolist()},
+                                   headers={"X-Request-Id": rid})
+        assert status == 200 and headers.get("X-Request-Id") == rid
+
+        router_spans = [s.to_dict() for s in router.tracer.sink.trace(rid)]
+        names = {s["name"] for s in router_spans}
+        assert {"router_request", "route", "proxy"} <= names
+        proxy = next(s for s in router_spans if s["name"] == "proxy")
+
+        engine_spans = []
+        for eng, _ in members:
+            engine_spans += [s.to_dict() for s in eng.tracer.sink.trace(rid)]
+        root = next(s for s in engine_spans if s["name"] == "request")
+        assert root["trace_id"] == rid
+        assert root["parent_id"] == proxy["span_id"]
+        # the engine-side pipeline is all there, same trace
+        engine_names = {s["name"] for s in engine_spans}
+        assert {"queue_wait", "execute", "respond"} <= engine_names
+
+    def test_rollout_no_mixed_versions_under_load(self, fleet, fleet_ckpt):
+        """Acceptance: with concurrent load across a coordinated reload,
+        every client session observes a MONOTONIC step sequence (old...old
+        new...new — never new-then-old), and post-rollout everything
+        serves the new step."""
+        import jax
+
+        from glom_tpu import checkpoint as ckpt_lib
+
+        rurl, router, members = fleet
+        # widen the commit window so the load actually straddles it
+        orig_commit = members[1][0].commit_staged
+
+        def slow_commit():
+            time.sleep(0.15)
+            return orig_commit()
+
+        members[1][0].commit_staged = slow_commit
+
+        stop = threading.Event()
+        sessions = []
+        errors = []
+        body = json.dumps({"images": _imgs(1).tolist()}).encode()
+
+        def session():
+            steps = []
+            while not stop.is_set():
+                try:
+                    _, _, resp = _post(rurl, "/embed", body)
+                    steps.append(resp["step"])
+                except Exception as e:  # noqa: BLE001
+                    errors.append(repr(e))
+            sessions.append(steps)
+
+        workers = [threading.Thread(target=session, daemon=True)
+                   for _ in range(4)]
+        for w in workers:
+            w.start()
+        try:
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and not any(sessions):
+                time.sleep(0.02)
+            ckpt_lib.save(fleet_ckpt, 11, {
+                "params": jax.device_get(members[0][0]._template)})
+            report = router.coordinated_reload()
+            assert report["status"] == "committed", report
+            assert report["step"] == 11
+            t_end = time.monotonic() + 1.0
+            while time.monotonic() < t_end:
+                time.sleep(0.02)
+        finally:
+            stop.set()
+            for w in workers:
+                w.join(timeout=30)
+        members[1][0].commit_staged = orig_commit
+
+        assert not errors, errors[:3]
+        assert len(sessions) == 4
+        for steps in sessions:
+            assert steps, "a session made no requests"
+            # monotonic: once a session sees 11, it never sees 0 again
+            assert steps == sorted(steps), steps
+            assert steps[-1] == 11  # post-rollout traffic is all new
+        assert {e.step for e, _ in members} == {11}
+
+    def test_rollback_keeps_fleet_on_old_step(self, fleet, fleet_ckpt):
+        """A replica whose commit fails rolls the WHOLE fleet back: no
+        replica serves the new step afterwards."""
+        import jax
+
+        from glom_tpu import checkpoint as ckpt_lib
+
+        rurl, router, members = fleet
+        old_step = members[0][0].step
+        ckpt_lib.save(fleet_ckpt, 21, {
+            "params": jax.device_get(members[0][0]._template)})
+
+        bad_engine = members[2][0]
+        bad_engine.commit_staged = lambda: (_ for _ in ()).throw(
+            RuntimeError("injected commit failure"))
+        report = router.coordinated_reload()
+        assert report["status"] == "rolled_back"
+        for eng, _ in members:
+            assert eng.step == old_step
+            assert eng._staged is None
+        # traffic still flows at the old step — never the rolled-back one
+        status, _, resp = _post(rurl, "/embed", {"images": _imgs(1).tolist()})
+        assert status == 200 and resp["step"] == old_step
+        # the suspect replica was ejected (the live health loop may
+        # legitimately re-admit it moments later — it is version-consistent
+        # — so assert the monotonic counter, not the current rotation)
+        assert router.registry.snapshot()["router_ejections_total"] >= 1.0
+        assert router.fleet_step == old_step
+
+    def test_loadgen_reports_per_replica_through_router(self, fleet):
+        """Satellite: loadgen pointed at the router yields the aggregate
+        AND the per-replica (X-Served-By-keyed) breakdown."""
+        import importlib.util
+        import os
+
+        rurl, router, members = fleet
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        spec = importlib.util.spec_from_file_location(
+            "loadgen", os.path.join(tools, "loadgen.py"))
+        lg = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(lg)
+
+        health = lg._fetch_health(rurl, timeout=10)
+        payloads = lg._make_payloads(health, [1, 2])
+        results = lg._Results()
+        wall = lg.run_closed([rurl], "embed", payloads, [1, 2], 24, 4,
+                             30.0, results)
+        rep = lg.report(results, wall, "closed(c=4)")
+        assert rep["requests_ok"] == 24 and rep["request_id_mismatches"] == 0
+        per = rep["per_replica"]
+        assert set(per) <= {"r0", "r1", "r2"} and len(per) >= 2
+        assert sum(v["requests_ok"] for v in per.values()) == 24
+        for v in per.values():
+            assert v["latency_ms"]["p95"] is not None
+
+
+# ---------------------------------------------------------------------------
+# fleet throughput acceptance (stub replicas: fixed service time)
+# ---------------------------------------------------------------------------
+class _StubHandler:
+    """Factory for a minimal engine look-alike with a fixed per-request
+    service time and single-request concurrency (a lock models the
+    device: one batch at a time), so N replicas = N-way parallelism and
+    the router's scaling is measured without jax in the loop."""
+
+    @staticmethod
+    def make(service_s):
+        from http.server import BaseHTTPRequestHandler
+
+        lock = threading.Lock()
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._reply({"status": "ok", "step": 0, "image_size": 16,
+                             "channels": 3, "levels": 3, "dim": 16})
+
+            def do_POST(self):
+                self.rfile.read(
+                    int(self.headers.get("Content-Length") or 0))
+                with lock:          # the "device": serial service
+                    time.sleep(service_s)
+                self._reply({"step": 0, "embeddings": []})
+
+        return Handler
+
+
+def _stub_fleet(n, service_s):
+    from http.server import ThreadingHTTPServer
+
+    class _StubServer(ThreadingHTTPServer):
+        daemon_threads = True
+        request_queue_size = 128  # match the real servers: burst-proof
+
+    servers = []
+    urls = []
+    for _ in range(n):
+        srv = _StubServer(("127.0.0.1", 0),
+                          _StubHandler.make(service_s))
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        urls.append("http://{}:{}".format(*srv.server_address[:2]))
+        servers.append(srv)
+    return urls, servers
+
+
+def _closed_loop(url, n_requests, concurrency):
+    body = b'{"x": 1}'
+    done = [0]
+    lock = threading.Lock()
+
+    def worker():
+        while True:
+            with lock:
+                if done[0] >= n_requests:
+                    return
+                done[0] += 1
+            req = urllib.request.Request(
+                f"{url}/embed", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                r.read()
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return n_requests / (time.monotonic() - t0)
+
+
+def test_fleet_throughput_scales_3x_over_single_replica():
+    """Acceptance: 4 replicas behind the router sustain >= 3x one
+    replica's closed-loop throughput.  Stub replicas with a serialized
+    150 ms service time isolate the router hop's scaling: 4 real CPU
+    engines in one test process would contend for the same cores and
+    measure jax — and a shorter service time measures the GIL instead,
+    since ~10-20 ms of Python per proxied request (client + router +
+    stub handler threads) caps the whole PROCESS near 50 req/s on this
+    2-core container regardless of how well the router spreads load.
+    At 150 ms the 4-replica capacity (26.7 req/s) sits well under that
+    ceiling; measured ratios are a stable ~3.8-4.0x."""
+    service_s = 0.15
+    urls1, servers1 = _stub_fleet(1, service_s)
+    urls4, servers4 = _stub_fleet(4, service_s)
+    router1 = FleetRouter(urls1, health_interval_s=5.0)
+    router4 = FleetRouter(urls4, health_interval_s=5.0)
+    router1.start(health=False)
+    router4.start(health=False)
+    rsrv1 = make_router_server(router1)
+    rsrv4 = make_router_server(router4)
+    for s in (rsrv1, rsrv4):
+        threading.Thread(target=s.serve_forever, daemon=True).start()
+    url1 = "http://{}:{}".format(*rsrv1.server_address[:2])
+    url4 = "http://{}:{}".format(*rsrv4.server_address[:2])
+    try:
+        # best-of-2 per configuration absorbs residual scheduler noise on
+        # a contended CI box; tput1 is capacity-bound (~1/service_s) so
+        # trials barely move it
+        tput1 = max(_closed_loop(url1, 20, 12) for _ in range(2))
+        tput4 = max(_closed_loop(url4, 80, 12) for _ in range(2))
+        assert tput4 >= 3.0 * tput1, (tput1, tput4)
+    finally:
+        for r in (router1, router4):
+            r.shutdown()
+        for s in (rsrv1, rsrv4, *servers1, *servers4):
+            s.shutdown()
+            s.server_close()

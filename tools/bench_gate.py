@@ -37,8 +37,10 @@ FIXTURE_DIR = os.path.join(REPO_ROOT, "tests", "data", "bench_gate")
 
 
 def run_check(fixture_dir: str) -> int:
-    """Replay every golden fixture; each is ``{"record": <bench JSON>,
-    "reference": <float|null>, "expect": "pass|fail|skip"}``."""
+    """Replay every golden fixture; throughput fixtures are ``{"record":
+    <bench JSON>, "reference": <float|null>, "expect": "pass|fail|skip"}``;
+    latency fixtures (the serving/fleet p95 gate) are ``{"p95_ms": ...,
+    "baseline_ms": ..., "expect": ...}``."""
     from glom_tpu.obs import perfgate
 
     paths = sorted(
@@ -52,10 +54,16 @@ def run_check(fixture_dir: str) -> int:
     for path in paths:
         with open(path) as f:
             fx = json.load(f)
-        got = perfgate.evaluate_throughput(
-            fx.get("record"), fx.get("reference"),
-            max_regression=fx.get("max_regression", 0.10),
-        )
+        if "p95_ms" in fx:
+            got = perfgate.evaluate_p95(
+                fx.get("p95_ms"), fx.get("baseline_ms"),
+                max_regression=fx.get("max_regression", 0.10),
+            )
+        else:
+            got = perfgate.evaluate_throughput(
+                fx.get("record"), fx.get("reference"),
+                max_regression=fx.get("max_regression", 0.10),
+            )
         ok = got["gate"] == fx["expect"]
         print(json.dumps({
             "fixture": os.path.basename(path), "expect": fx["expect"],
@@ -90,6 +98,14 @@ def main(argv=None) -> int:
                    help="recorded serving p95 to gate the loadgen report "
                         "against")
     p.add_argument("--p95-max-regression", type=float, default=0.10)
+    p.add_argument("--fleet-loadgen-json", default=None,
+                   help="loadgen report taken THROUGH the fleet router; "
+                        "its p95 gates against --fleet-p95-baseline-ms so "
+                        "the router hop's overhead is tracked in the BENCH "
+                        "trajectory alongside the single-engine number")
+    p.add_argument("--fleet-p95-baseline-ms", type=float, default=None,
+                   help="recorded router-fronted p95 to gate against")
+    p.add_argument("--fleet-p95-max-regression", type=float, default=0.10)
     p.add_argument("--prom-textfile", default=None,
                    help="write the verdict as Prometheus gauges via the obs "
                         "registry (textfile-collector format)")
@@ -127,20 +143,28 @@ def main(argv=None) -> int:
     throughput = perfgate.evaluate_throughput(
         rec, ref[0] if ref else None, max_regression=args.max_regression,
     )
-    p95 = None
-    if args.loadgen_json:
-        with open(args.loadgen_json) as f:
+    def _p95_part(report_path, baseline, max_reg):
+        if not report_path:
+            return None
+        with open(report_path) as f:
             report = json.load(f)
-        p95 = perfgate.evaluate_p95(
-            (report.get("latency_ms") or {}).get("p95"),
-            args.p95_baseline_ms,
-            max_regression=args.p95_max_regression,
+        return perfgate.evaluate_p95(
+            (report.get("latency_ms") or {}).get("p95"), baseline,
+            max_regression=max_reg,
         )
-    verdict = perfgate.combine(throughput, *( [p95] if p95 else [] ))
+
+    p95 = _p95_part(args.loadgen_json, args.p95_baseline_ms,
+                    args.p95_max_regression)
+    fleet_p95 = _p95_part(args.fleet_loadgen_json,
+                          args.fleet_p95_baseline_ms,
+                          args.fleet_p95_max_regression)
+    verdict = perfgate.combine(
+        throughput, *[p for p in (p95, fleet_p95) if p])
     result = {
         "gate": verdict,
         "throughput": throughput,
         "p95": p95,
+        "fleet_p95": fleet_p95,
         "reference_provenance": ref[1] if ref else None,
         "trajectory_rounds": len(trajectory),
         "bench_rc": bench_rc,
@@ -155,7 +179,8 @@ def main(argv=None) -> int:
         with open(args.prom_textfile, "w") as f:
             f.write(prometheus_lines(registry))
     skipped = [name for name, part in (("throughput", throughput),
-                                       ("p95", p95))
+                                       ("p95", p95),
+                                       ("fleet_p95", fleet_p95))
                if part and part["gate"] == perfgate.GATE_SKIP]
     if skipped:
         # Loud even when another component passed and the combined verdict

@@ -9,7 +9,7 @@ fire, the serving watcher outlives its faults — reporting per-scenario
 outcome and MTTR (wall seconds from the fault's first observable impact to
 restored service) as JSON.
 
-    python tools/chaos.py --smoke          # fast variants, CI tier-1 (<60s)
+    python tools/chaos.py --smoke          # fast variants, CI tier-1 (<90s)
     python tools/chaos.py                  # soak variants (more steps/faults)
     python tools/chaos.py --scenario nan_batch --json out.json
 
@@ -293,12 +293,146 @@ def scenario_train_crash(soak):
                 "completed_step": final}
 
 
+def scenario_replica_kill(soak):
+    """One engine replica dies mid-load: the fleet router must eject it
+    within one health interval (eject_after=1 — a dead box is dead), the
+    error rate must stay bounded (connection failures fail over to the
+    surviving replicas), and the restarted replica must re-admit; MTTR is
+    kill -> back in rotation."""
+    import json
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from glom_tpu.serving.engine import ServingEngine, make_demo_checkpoint
+    from glom_tpu.serving.router import FleetRouter, make_router_server
+    from glom_tpu.serving.server import make_server
+
+    n_replicas, n_min_requests = (3, 40) if not soak else (4, 400)
+    health_interval = 0.2
+
+    def start_replica(ckpt, port=0):
+        eng = ServingEngine(ckpt, buckets=(1, 2), max_wait_ms=1.0,
+                            warmup=True, reload_poll_s=0)
+        eng.start(watch=False)
+        srv = make_server(eng, port=port)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return eng, srv
+
+    with tempfile.TemporaryDirectory() as root:
+        make_demo_checkpoint(root)
+        members = [start_replica(root) for _ in range(n_replicas)]
+        urls = ["http://{}:{}".format(*srv.server_address[:2])
+                for _, srv in members]
+        router = FleetRouter(urls, health_interval_s=health_interval,
+                             eject_after=1)
+        router.start()
+        rsrv = make_router_server(router)
+        threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+        rurl = "http://{}:{}".format(*rsrv.server_address[:2])
+
+        body = json.dumps({"images": np.zeros(
+            (1, 3, 16, 16), np.float32).tolist()}).encode()
+        stop = threading.Event()
+        counts = {"ok": 0, "error": 0}
+        lock = threading.Lock()
+
+        def load():
+            while not stop.is_set():
+                req = urllib.request.Request(
+                    f"{rurl}/embed", data=body,
+                    headers={"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        r.read()
+                    with lock:
+                        counts["ok"] += 1
+                except Exception:
+                    with lock:
+                        counts["error"] += 1
+
+        workers = [threading.Thread(target=load, daemon=True)
+                   for _ in range(2)]
+        for w in workers:
+            w.start()
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                with lock:
+                    if counts["ok"] >= n_min_requests // 2:
+                        break
+                time.sleep(0.02)
+
+            # -- kill one replica hard (no drain: a crash, not a deploy)
+            victim_eng, victim_srv = members[1]
+            victim_port = victim_srv.server_address[1]
+            t_kill = time.monotonic()
+            victim_srv.shutdown()
+            victim_srv.server_close()
+            victim_eng.shutdown(drain=False)
+
+            deadline = time.monotonic() + 10
+            while (router.health()["healthy_replicas"] == n_replicas
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            eject_s = time.monotonic() - t_kill
+            assert router.health()["healthy_replicas"] == n_replicas - 1, (
+                "router never ejected the dead replica")
+            # "within one health interval": generous 3x margin for CI
+            # scheduling noise — the contract is the ORDER of magnitude
+            assert eject_s <= health_interval * 3 + 1.0, eject_s
+
+            # keep load flowing on the survivors, then resurrect
+            deadline = time.monotonic() + 30
+            with lock:
+                target_ok = counts["ok"] + n_min_requests // 2
+            while time.monotonic() < deadline:
+                with lock:
+                    if counts["ok"] >= target_ok:
+                        break
+                time.sleep(0.02)
+            members[1] = start_replica(root, port=victim_port)
+            deadline = time.monotonic() + 20
+            while (router.health()["healthy_replicas"] < n_replicas
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            mttr = time.monotonic() - t_kill
+            assert router.health()["healthy_replicas"] == n_replicas, (
+                "restarted replica never re-admitted")
+        finally:
+            stop.set()
+            for w in workers:
+                w.join(timeout=10)
+        with lock:
+            total = counts["ok"] + counts["error"]
+            errors = counts["error"]
+        assert counts["ok"] >= n_min_requests, counts
+        # bounded error rate: failover turns a dead replica into retries,
+        # not client-visible failures — allow a small transient margin
+        assert errors / max(total, 1) <= 0.05, counts
+        snap = router.registry.snapshot()
+        assert snap.get("router_ejections_total", 0) >= 1
+        assert snap.get("router_readmissions_total", 0) >= 1
+        router.shutdown()
+        rsrv.shutdown()
+        rsrv.server_close()
+        for eng, srv in members:
+            srv.shutdown()
+            srv.server_close()
+            eng.shutdown(drain=False)
+        return {"mttr_s": mttr, "eject_s": round(eject_s, 3),
+                "requests_ok": counts["ok"], "requests_error": errors,
+                "error_rate": round(errors / max(total, 1), 4)}
+
+
 SCENARIOS = {
     "torn_ckpt_write": scenario_torn_ckpt_write,
     "corrupt_restore": scenario_corrupt_restore,
     "nan_batch": scenario_nan_batch,
     "reload_io_error": scenario_reload_io_error,
     "train_crash": scenario_train_crash,
+    "replica_kill": scenario_replica_kill,
 }
 
 

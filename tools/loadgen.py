@@ -18,6 +18,14 @@ shapes are exercised; the image contract (size/channels) is read from
 object: p50/p95/p99/mean/max latency (ms), throughput (requests and
 images per second), and error/shed counts.
 
+**Fleet mode**: pass ``--target`` multiple times (requests cycle across
+the URLs — client-side spraying over N engines), or point ``--url`` at a
+``glom_tpu.serving.router`` front.  Either way the report gains a
+``per_replica`` section — keyed by the router's ``X-Served-By`` header
+when present, by target URL otherwise — with per-replica p50/p95/p99 and
+throughput, so fleet scaling and dispatch fairness are measurable with
+the same harness that gates the single engine.
+
 Every request carries an ``X-Request-Id`` (``lg-<pid>-<seq>``) which the
 server adopts as the trace id and must echo back — a missing echo counts
 as ``request_id_mismatches`` (nonzero fails the run).  ``--slow-n N``
@@ -56,6 +64,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description="GLOM serving load generator")
     p.add_argument("--url", default="http://127.0.0.1:8000")
+    p.add_argument("--target", action="append", default=None, metavar="URL",
+                   help="repeatable: spray requests across several engine "
+                        "URLs (client-side fleet mode); overrides --url")
     p.add_argument("--endpoint", default="embed",
                    choices=["embed", "reconstruct"])
     p.add_argument("--requests", type=int, default=100,
@@ -117,29 +128,51 @@ class _Results:
         self.shed = 0
         self.errors = 0
         self.id_mismatches = 0   # X-Request-Id failed to round-trip
+        # per-replica breakdown (fleet mode): key = the router's
+        # X-Served-By echo when present, else the target URL the request
+        # was sprayed at.  {key: {"latencies_ms": [...], "ok": n, ...}}
+        self.replicas = {}
+
+    def _replica(self, key):
+        rec = self.replicas.get(key)
+        if rec is None:
+            rec = self.replicas[key] = {
+                "latencies_ms": [], "ok": 0, "images_ok": 0,
+                "shed": 0, "errors": 0,
+            }
+        return rec
 
     def record(self, latency_ms=None, images=0, shed=False, error=False,
-               request_id=None, id_mismatch=False):
+               request_id=None, id_mismatch=False, replica=None):
         with self.lock:
+            rep = self._replica(replica) if replica is not None else None
             if id_mismatch:
                 self.id_mismatches += 1
             if shed:
                 self.shed += 1
+                if rep is not None:
+                    rep["shed"] += 1
             elif error:
                 self.errors += 1
+                if rep is not None:
+                    rep["errors"] += 1
             else:
                 self.ok += 1
                 self.images_ok += images
                 self.latencies_ms.append(latency_ms)
                 if request_id is not None:
                     self.samples.append((latency_ms, request_id))
+                if rep is not None:
+                    rep["ok"] += 1
+                    rep["images_ok"] += images
+                    rep["latencies_ms"].append(latency_ms)
 
     def slowest(self, n):
         with self.lock:
             return sorted(self.samples, reverse=True)[:n]
 
 
-def run_closed(url, endpoint, payloads, batch_sizes, n_requests, concurrency,
+def run_closed(urls, endpoint, payloads, batch_sizes, n_requests, concurrency,
                timeout, results):
     idx_lock = threading.Lock()
     counter = [0]
@@ -151,10 +184,15 @@ def run_closed(url, endpoint, payloads, batch_sizes, n_requests, concurrency,
                 if i >= n_requests:
                     return
                 counter[0] += 1
-            b = batch_sizes[i % len(batch_sizes)]
+            # batch size advances once per full TARGET round, not per
+            # request: with both indexed by i, any shared factor between
+            # the two list lengths would pin each target to a fixed
+            # batch-size subset and skew the per-replica comparison
+            b = batch_sizes[(i // len(urls)) % len(batch_sizes)]
             t0 = time.monotonic()
-            _send(url, endpoint, payloads[b], b, timeout, results, t0,
-                  request_id=f"lg-{os.getpid()}-{i}")
+            _send(urls[i % len(urls)], endpoint, payloads[b], b, timeout,
+                  results, t0, request_id=f"lg-{os.getpid()}-{i}",
+                  multi_target=len(urls) > 1)
 
     threads = [threading.Thread(target=worker, daemon=True)
                for _ in range(concurrency)]
@@ -166,7 +204,7 @@ def run_closed(url, endpoint, payloads, batch_sizes, n_requests, concurrency,
     return time.monotonic() - t_start
 
 
-def run_open(url, endpoint, payloads, batch_sizes, rate, duration, timeout,
+def run_open(urls, endpoint, payloads, batch_sizes, rate, duration, timeout,
              results):
     """Fixed arrival schedule: request i fires at ``i / rate`` seconds
     whether or not earlier ones finished (one thread per in-flight
@@ -179,12 +217,14 @@ def run_open(url, endpoint, payloads, batch_sizes, rate, duration, timeout,
         delay = target - time.monotonic()
         if delay > 0:
             time.sleep(delay)
-        b = batch_sizes[i % len(batch_sizes)]
+        # per-target-round batch cycling — see run_closed for why
+        b = batch_sizes[(i // len(urls)) % len(batch_sizes)]
         t = threading.Thread(
             target=_send,
-            args=(url, endpoint, payloads[b], b, timeout, results,
-                  time.monotonic()),
-            kwargs={"request_id": f"lg-{os.getpid()}-{i}"},
+            args=(urls[i % len(urls)], endpoint, payloads[b], b, timeout,
+                  results, time.monotonic()),
+            kwargs={"request_id": f"lg-{os.getpid()}-{i}",
+                    "multi_target": len(urls) > 1},
             daemon=True,
         )
         t.start()
@@ -195,7 +235,7 @@ def run_open(url, endpoint, payloads, batch_sizes, rate, duration, timeout,
 
 
 def _send(url, endpoint, body, n_images, timeout, results, t0,
-          request_id=None):
+          request_id=None, multi_target=False):
     headers = {"Content-Type": "application/json"}
     if request_id is not None:
         # the trace identity: the server adopts it as the trace_id and
@@ -204,24 +244,37 @@ def _send(url, endpoint, body, n_images, timeout, results, t0,
         headers["X-Request-Id"] = request_id
     req = urllib.request.Request(f"{url}/{endpoint}", data=body,
                                  headers=headers)
+
+    def replica_key(resp_headers):
+        # the router names who actually served; direct multi-target
+        # spraying falls back to the URL the request went to
+        served_by = resp_headers.get("X-Served-By") if resp_headers else None
+        if served_by:
+            return served_by
+        return url if multi_target else None
+
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
             echoed = r.headers.get("X-Request-Id")
+            replica = replica_key(r.headers)
             json.loads(r.read())
     except urllib.error.HTTPError as e:
         echoed = e.headers.get("X-Request-Id")
         e.read()
         results.record(shed=(e.code == 503), error=(e.code != 503),
                        id_mismatch=(request_id is not None
-                                    and echoed != request_id))
+                                    and echoed != request_id),
+                       replica=replica_key(e.headers))
         return
     except Exception:
-        results.record(error=True)
+        results.record(error=True,
+                       replica=url if multi_target else None)
         return
     results.record(
         latency_ms=(time.monotonic() - t0) * 1e3, images=n_images,
         request_id=request_id,
         id_mismatch=(request_id is not None and echoed != request_id),
+        replica=replica,
     )
 
 
@@ -252,6 +305,24 @@ def report(results, wall_s, mode, slow_n=0):
             {"request_id": rid, "latency_ms": round(ms, 3)}
             for ms, rid in results.slowest(slow_n)
         ]
+    if results.replicas:
+        per = {}
+        for key, rec in sorted(results.replicas.items()):
+            rlat = rec["latencies_ms"]
+            per[key] = {
+                "requests_ok": rec["ok"],
+                "requests_shed": rec["shed"],
+                "requests_error": rec["errors"],
+                "images_ok": rec["images_ok"],
+                "throughput_req_per_s": (
+                    round(rec["ok"] / wall_s, 2) if wall_s else None),
+                "latency_ms": {
+                    "p50": round(percentile(rlat, 50), 3) if rlat else None,
+                    "p95": round(percentile(rlat, 95), 3) if rlat else None,
+                    "p99": round(percentile(rlat, 99), 3) if rlat else None,
+                },
+            }
+        out["per_replica"] = per
     return out
 
 
@@ -356,18 +427,21 @@ def main(argv=None) -> int:
         return run_smoke()
 
     batch_sizes = [int(b) for b in args.batch_sizes.split(",")]
-    health = _fetch_health(args.url, args.timeout)
+    urls = [u.rstrip("/") for u in (args.target or [args.url])]
+    health = _fetch_health(urls[0], args.timeout)
     payloads = _make_payloads(health, batch_sizes)
     results = _Results()
     if args.rate > 0:
-        wall = run_open(args.url, args.endpoint, payloads, batch_sizes,
+        wall = run_open(urls, args.endpoint, payloads, batch_sizes,
                         args.rate, args.duration, args.timeout, results)
         mode = f"open({args.rate}/s)"
     else:
-        wall = run_closed(args.url, args.endpoint, payloads, batch_sizes,
+        wall = run_closed(urls, args.endpoint, payloads, batch_sizes,
                           args.requests, args.concurrency, args.timeout,
                           results)
         mode = f"closed(c={args.concurrency})"
+    if len(urls) > 1:
+        mode += f" x{len(urls)} targets"
     print(json.dumps(report(results, wall, mode, slow_n=args.slow_n),
                      indent=2))
     return 0 if results.errors == 0 and results.id_mismatches == 0 else 1
