@@ -1,0 +1,33 @@
+"""glomlint: project-native static analysis for JAX/TPU and concurrency
+hazards.  See :mod:`glom_tpu.analysis.engine` for the rule engine and
+``docs/ANALYSIS.md`` for the rule catalog; ``tools/lint.py`` is the CLI
+and the CI gate."""
+
+from glom_tpu.analysis.engine import (  # noqa: F401
+    AnalysisResult, Finding, ModuleContext, Rule, analyze, load_baseline,
+    split_baseline, write_baseline,
+)
+from glom_tpu.analysis.rules_concurrency import CONCURRENCY_RULES
+from glom_tpu.analysis.rules_jax import JAX_RULES
+
+ALL_RULE_CLASSES = tuple(JAX_RULES) + tuple(CONCURRENCY_RULES)
+
+
+def default_rules(names=None):
+    """Fresh rule instances (rules carry per-run state for whole-program
+    passes).  ``names`` filters by rule id."""
+    rules = [cls() for cls in ALL_RULE_CLASSES]
+    if names:
+        wanted = set(names)
+        unknown = wanted - {r.name for r in rules}
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s) {sorted(unknown)}; known: "
+                f"{sorted(r.name for r in rules)}")
+        rules = [r for r in rules if r.name in wanted]
+    return rules
+
+
+__all__ = ["AnalysisResult", "Finding", "ModuleContext", "Rule",
+           "analyze", "default_rules", "load_baseline", "split_baseline",
+           "write_baseline", "ALL_RULE_CLASSES"]

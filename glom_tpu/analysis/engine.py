@@ -1,0 +1,415 @@
+"""glomlint — project-native AST static analysis: the rule engine.
+
+Seven PRs of review caught the same hazard classes by hand: donation
+aliasing of numpy-backed trees (the PR 6 SIGABRT), check-then-act outside
+the lock (the PR 7 commit-gate TOCTOU), raw ``time.time()`` in modules
+that elsewhere take injectable clocks, request-path compiles.  This
+module makes those reviews machine-checked:
+
+  * :class:`Finding` — one diagnostic: rule id, severity, ``path:line``,
+    message, and the stripped source line (``code``) the baseline keys on.
+  * :class:`Rule` — per-file ``check(ctx)`` over a parsed
+    :class:`ModuleContext`; whole-program rules (the lock-order graph)
+    additionally implement ``finalize()`` after every file is dispatched.
+  * Suppressions — ``# glomlint: disable=RULE[,RULE] -- reason`` on the
+    finding's line (or a standalone comment on the line above).  A
+    disable WITHOUT a reason does not suppress and is itself reported
+    (``lint-bad-suppression``): the acceptance bar is that every
+    suppression carries its justification.
+  * Baseline — a committed JSON file of pre-existing findings keyed on
+    ``(rule, path, stripped source line)`` (line-number free, so
+    unrelated edits don't invalidate it).  Baselined findings never gate;
+    anything beyond the baseline does.
+
+The engine is stdlib-only (``ast``): it runs identically on a laptop, in
+CI, and in the tier-1 suite with no accelerator and no jax import.  Rule
+packs live in :mod:`glom_tpu.analysis.rules_jax` and
+:mod:`glom_tpu.analysis.rules_concurrency`; ``tools/lint.py`` is the CLI.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+BASELINE_SCHEMA = 1
+
+SEVERITIES = ("error", "warning")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic.  ``path`` is root-relative POSIX; ``code`` is the
+    stripped source line (the baseline fingerprint component)."""
+
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+    code: str = ""
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+class ModuleContext:
+    """One parsed file: source, line table, AST, suppression map."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            self.parse_error = e
+        self.suppressions = _parse_suppressions(source)
+
+    def source_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: "Rule", node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule=rule.name, severity=rule.severity,
+                       path=self.relpath, line=line, col=col,
+                       message=message, code=self.source_line(line))
+
+
+class Rule:
+    """Base rule: override :meth:`check`; whole-program rules accumulate
+    state in ``check`` and emit from :meth:`finalize`."""
+
+    name = "rule"
+    severity = "warning"
+    #: one line naming the historical bug this rule encodes (docs catalog)
+    description = ""
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        return []
+
+    def finalize(self) -> List[Finding]:
+        return []
+
+
+# -- suppressions ----------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*glomlint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+    r"(?:\s+--\s*(\S.*)?)?\s*$"  # '-- <nothing>' parses as reasonless,
+)                                # so it is reported, not silently ignored
+
+
+def _parse_suppressions(source: str):
+    """Map lineno -> (rules, reason, standalone).  ``standalone`` marks a
+    comment-only line, which also covers the NEXT line (pylint style);
+    an end-of-line disable covers only its own line.  Only actual COMMENT
+    tokens count — a disable marker inside a string/docstring (e.g.
+    documentation of the syntax) is never a suppression."""
+    out: Dict[int, Tuple[Tuple[str, ...], Optional[str], bool]] = {}
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return out  # unparseable files surface as lint-parse-error anyway
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        lineno = tok.start[0]
+        rules = tuple(r.strip() for r in m.group(1).split(","))
+        reason = m.group(2).strip() if m.group(2) else None
+        standalone = tok.line.strip().startswith("#")
+        out[lineno] = (rules, reason, standalone)
+    return out
+
+
+class _BadSuppressionRule(Rule):
+    """Internal: a disable comment without a ``-- reason`` (it does not
+    suppress; the reason IS the contract)."""
+
+    name = "lint-bad-suppression"
+    severity = "error"
+    description = ("suppressions must carry a reason: "
+                   "# glomlint: disable=RULE -- why this is safe")
+
+
+_BAD_SUPPRESSION = _BadSuppressionRule()
+
+
+def apply_suppressions(ctx: ModuleContext,
+                       findings: List[Finding]) -> Tuple[List[Finding],
+                                                         List[Finding]]:
+    """Split into (kept, suppressed); reasonless disables additionally
+    yield a ``lint-bad-suppression`` finding per comment."""
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        entry = None
+        ent_here = ctx.suppressions.get(f.line)
+        if ent_here is not None and (f.rule in ent_here[0] or "all" in ent_here[0]):
+            entry = ent_here
+        else:
+            ent_above = ctx.suppressions.get(f.line - 1)
+            if (ent_above is not None and ent_above[2]
+                    and (f.rule in ent_above[0] or "all" in ent_above[0])):
+                entry = ent_above
+        if entry is not None and entry[1]:
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    # every reasonless disable is reported, matched or not: a comment that
+    # LOOKS like a suppression but silently isn't one is worse than none
+    for lineno, (_rules, reason, _standalone) in sorted(ctx.suppressions.items()):
+        if reason is None:
+            kept.append(Finding(
+                rule=_BAD_SUPPRESSION.name, severity=_BAD_SUPPRESSION.severity,
+                path=ctx.relpath, line=lineno, col=0,
+                message="glomlint disable without '-- reason' (not honored): "
+                        "every suppression must say why it is safe",
+                code=ctx.source_line(lineno)))
+    return kept, suppressed
+
+
+# -- file discovery + dispatch ---------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    """Ordered, deduplicated by absolute path: overlapping arguments
+    (``lint.py glom_tpu glom_tpu/serving``) must not analyze a file twice
+    — duplicates would double-count against baseline budgets."""
+    out: List[str] = []
+    seen: set = set()
+
+    def add(path: str) -> None:
+        key = os.path.abspath(path)
+        if key not in seen:
+            seen.add(key)
+            out.append(path)
+
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                add(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in sorted(dirnames)
+                           if d not in _SKIP_DIRS and not d.startswith(".")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    add(os.path.join(dirpath, fn))
+    return out
+
+
+class _ParseErrorRule(Rule):
+    name = "lint-parse-error"
+    severity = "error"
+    description = "file does not parse; nothing else can be checked"
+
+
+_PARSE_ERROR = _ParseErrorRule()
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    findings: List[Finding]          # post-suppression, pre-baseline
+    suppressed: List[Finding]
+    files: int = 0
+
+    def by_rule(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for f in self.findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return dict(sorted(counts.items()))
+
+
+def analyze(paths: Sequence[str], rules: Sequence[Rule],
+            root: Optional[str] = None) -> AnalysisResult:
+    """Dispatch every ``.py`` under ``paths`` through every rule, apply
+    suppressions, then collect whole-program ``finalize()`` findings
+    (which are suppression-exempt: a graph cycle has no single line to
+    carry the comment — baseline those instead)."""
+    root = os.path.abspath(root or os.getcwd())
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    files = 0
+    for path in iter_py_files(paths):
+        files += 1
+        abspath = os.path.abspath(path)
+        rel = os.path.relpath(abspath, root)
+        try:
+            with open(abspath, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            findings.append(Finding(
+                rule=_PARSE_ERROR.name, severity=_PARSE_ERROR.severity,
+                path=rel.replace(os.sep, "/"), line=1, col=0,
+                message=f"unreadable: {type(e).__name__}: {e}"))
+            continue
+        ctx = ModuleContext(abspath, rel, source)
+        if ctx.parse_error is not None:
+            findings.append(Finding(
+                rule=_PARSE_ERROR.name, severity=_PARSE_ERROR.severity,
+                path=ctx.relpath, line=ctx.parse_error.lineno or 1, col=0,
+                message=f"syntax error: {ctx.parse_error.msg}",
+                code=ctx.source_line(ctx.parse_error.lineno or 1)))
+            continue
+        file_findings: List[Finding] = []
+        for rule in rules:
+            file_findings.extend(rule.check(ctx))
+        kept, supp = apply_suppressions(ctx, file_findings)
+        findings.extend(kept)
+        suppressed.extend(supp)
+    for rule in rules:
+        findings.extend(rule.finalize())
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return AnalysisResult(findings=findings, suppressed=suppressed,
+                          files=files)
+
+
+# -- baseline --------------------------------------------------------------
+
+def _fingerprint(f: Finding) -> Tuple[str, str, str]:
+    return (f.rule, f.path, f.code)
+
+
+def load_baseline(path: str) -> Dict[Tuple[str, str, str], int]:
+    """Baseline file -> fingerprint budget.  Missing file = empty."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for ent in data.get("findings", []):
+        key = (ent["rule"], ent["path"], ent.get("code", ""))
+        budget[key] = budget.get(key, 0) + int(ent.get("count", 1))
+    return budget
+
+
+def split_baseline(findings: Sequence[Finding],
+                   budget: Dict[Tuple[str, str, str], int]
+                   ) -> Tuple[List[Finding], List[Finding]]:
+    """(new, baselined): each baseline entry absorbs up to ``count``
+    findings with the same (rule, path, source-line) fingerprint — the
+    key survives pure line-number drift but not edits to the line."""
+    remaining = dict(budget)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        key = _fingerprint(f)
+        if remaining.get(key, 0) > 0:
+            remaining[key] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[_fingerprint(f)] = counts.get(_fingerprint(f), 0) + 1
+    entries = [{"rule": r, "path": p, "code": c, "count": n}
+               for (r, p, c), n in sorted(counts.items())]
+    payload = {"schema": BASELINE_SCHEMA, "findings": entries}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+# -- shared AST helpers (used by both rule packs) --------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """Last identifier of a Name/Attribute chain (``self.a.b`` -> ``b``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def is_self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` -> ``"X"``, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def is_lock_name(name: Optional[str]) -> bool:
+    return name is not None and "lock" in name.lower()
+
+
+def with_lock_attrs(node: ast.With) -> List[str]:
+    """Lock attribute names acquired by ``with self.<lock>:`` items."""
+    out = []
+    for item in node.items:
+        attr = is_self_attr(item.context_expr)
+        if is_lock_name(attr):
+            out.append(attr)
+    return out
+
+
+def child_blocks(stmt: ast.stmt) -> List[List[ast.stmt]]:
+    """Nested statement blocks of a compound statement: body/orelse/
+    finalbody, except-handler bodies, and match-case bodies.  The ONE
+    block-iteration helper every rule walker shares, so structural
+    recursion can't silently diverge between rules."""
+    blocks: List[List[ast.stmt]] = []
+    for field in ("body", "orelse", "finalbody"):
+        inner = getattr(stmt, field, None)
+        if isinstance(inner, list) and inner and isinstance(inner[0],
+                                                            ast.stmt):
+            blocks.append(inner)
+    for handler in getattr(stmt, "handlers", []) or []:
+        blocks.append(handler.body)
+    for case in getattr(stmt, "cases", []) or []:
+        blocks.append(case.body)
+    return blocks
+
+
+def is_compound(stmt: ast.stmt) -> bool:
+    return bool(child_blocks(stmt))
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents

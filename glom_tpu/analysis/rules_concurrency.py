@@ -1,0 +1,454 @@
+"""glomlint concurrency rule pack — the threaded-serving hazard classes.
+
+  * ``conc-lock-order`` — whole-program lock-acquisition-order graph over
+    ``serving/`` + ``resilience/``: an edge A→B for every ``with
+    self.B`` entered while ``self.A`` is held (including through
+    same-class method calls).  A cycle is a deadlock waiting for the
+    right thread interleaving; a self-edge is a re-acquisition that
+    deadlocks a plain ``threading.Lock`` outright.
+  * ``conc-check-then-act`` — the PR 7 commit-gate TOCTOU: an ``if`` on
+    lock-guarded state taken OUTSIDE the lock, acting under the lock
+    inside its body without re-checking.  The gate the check saw open can
+    close before the act.
+  * ``conc-raw-clock`` — ``time.time()``/``time.monotonic()`` in a module
+    whose classes accept ``clock=``: every such call is invisible to the
+    fake-clock tests the injectable pattern exists for (see
+    ``obs/tracing.py`` for the canonical form).
+  * ``conc-thread-daemon`` — ``threading.Thread`` created without
+    ``daemon=`` and never joined: shutdown hangs on it, or it dies
+    mid-write at interpreter teardown.
+  * ``conc-broad-except`` — ``except Exception`` that neither re-raises,
+    logs, nor even reads the exception: the failure class that turned
+    torn checkpoints into silent serving staleness before PR 5 made every
+    swallow observable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from glom_tpu.analysis.engine import (
+    Finding, ModuleContext, Rule, child_blocks, dotted_name, is_compound,
+    is_self_attr, terminal_name, with_lock_attrs,
+)
+
+
+class LockOrderRule(Rule):
+    name = "conc-lock-order"
+    severity = "error"
+    description = ("cycle in the lock-acquisition-order graph "
+                   "(serving/ + resilience/): deadlock under the right "
+                   "thread interleaving")
+
+    #: path components in scope for graph construction
+    SCOPE_DIRS: Tuple[str, ...] = ("serving", "resilience")
+
+    def __init__(self) -> None:
+        # class key -> {"edges": {(a, b): (path, line)},
+        #               "calls": [(caller, held tuple, callee, path, line)],
+        #               "acquires": {method: {lock: (path, line)}}}
+        self._classes: Dict[str, Dict] = {}
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        dirs = ctx.relpath.split("/")[:-1]
+        if not any(d in dirs for d in self.SCOPE_DIRS):
+            return []
+        for node in ctx.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._collect_class(node, ctx)
+        return []
+
+    def _collect_class(self, cls: ast.ClassDef, ctx: ModuleContext) -> None:
+        key = f"{ctx.relpath}::{cls.name}"
+        info = self._classes.setdefault(
+            key, {"edges": {}, "calls": [], "acquires": {}})
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                continue
+            acquires: Dict[str, Tuple[str, int]] = {}
+            info["acquires"][method.name] = acquires
+            self._walk(method.body, [], info, acquires, method.name, ctx)
+
+    def _walk(self, body: Sequence[ast.stmt], held: List[str], info: Dict,
+              acquires: Dict, method: str, ctx: ModuleContext) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            locks = (with_lock_attrs(stmt)
+                     if isinstance(stmt, ast.With) else [])
+            if locks:
+                for lock in locks:
+                    acquires.setdefault(lock, (ctx.relpath, stmt.lineno))
+                    for h in held:
+                        info["edges"].setdefault(
+                            (h, lock), (ctx.relpath, stmt.lineno))
+                self._record_calls(stmt, held, info, ctx, method,
+                                   header_only=True)
+                self._walk(stmt.body, held + locks, info, acquires, method,
+                           ctx)
+                continue
+            # record every self-call, even lock-free ones: a caller's
+            # effective acquisitions must include its callees' (the
+            # multi-hop chain a->m1->m2->lock)
+            self._record_calls(stmt, held, info, ctx, method,
+                               header_only=is_compound(stmt))
+            for block in child_blocks(stmt):
+                self._walk(block, held, info, acquires, method, ctx)
+
+    def _record_calls(self, stmt: ast.AST, held: List[str], info: Dict,
+                      ctx: ModuleContext, caller: str,
+                      header_only: bool) -> None:
+        """``self.m()`` call sites with the lock stack held at the call
+        (interprocedural edges are expanded in finalize)."""
+        if header_only:
+            roots = [getattr(stmt, f) for f in ("test", "iter", "subject")
+                     if isinstance(getattr(stmt, f, None), ast.AST)]
+            roots += [item.context_expr
+                      for item in getattr(stmt, "items", []) or []]
+        else:
+            roots = [stmt]
+        for root in roots:
+            for node in ast.walk(root):
+                if isinstance(node, ast.Call):
+                    callee = is_self_attr(node.func)
+                    if callee:
+                        info["calls"].append(
+                            (caller, tuple(held), callee, ctx.relpath,
+                             node.lineno))
+
+    def finalize(self) -> List[Finding]:
+        findings: List[Finding] = []
+        for key, info in sorted(self._classes.items()):
+            # interprocedural expansion, phase 1: propagate EFFECTIVE
+            # acquisitions (locks a method takes itself or through any
+            # chain of same-class callees) to a true fixpoint — a->m1,
+            # m1->m2, m2 takes B must give a an effective B
+            eff: Dict[str, Dict[str, Tuple[str, int]]] = {
+                m: dict(locks) for m, locks in info["acquires"].items()}
+            changed = True
+            while changed:
+                changed = False
+                for caller, _held, callee, _path, _line in info["calls"]:
+                    for lock, loc in eff.get(callee, {}).items():
+                        cur = eff.setdefault(caller, {})
+                        if lock not in cur:
+                            cur[lock] = loc
+                            changed = True
+            # phase 2: while holding A, a self.m() call contributes edges
+            # A -> every lock m effectively acquires
+            for _caller, held, callee, path, line in info["calls"]:
+                for lock in eff.get(callee, {}):
+                    for h in held:
+                        info["edges"].setdefault((h, lock), (path, line))
+
+            graph: Dict[str, Set[str]] = {}
+            for (a, b) in info["edges"]:
+                graph.setdefault(a, set()).add(b)
+            for cycle in _find_cycles(graph):
+                locs = []
+                for a, b in zip(cycle, cycle[1:] + cycle[:1]):
+                    loc = info["edges"].get((a, b))
+                    if loc:
+                        locs.append(f"{a}->{b} at {loc[0]}:{loc[1]}")
+                first = cycle[1] if len(cycle) > 1 else cycle[0]
+                path, line = info["edges"][(cycle[0], first)]
+                order = " -> ".join(cycle + [cycle[0]])
+                kind = ("re-acquired while already held (plain "
+                        "threading.Lock self-deadlocks)"
+                        if len(cycle) == 1 else "acquisition-order cycle")
+                findings.append(Finding(
+                    rule=self.name, severity=self.severity, path=path,
+                    line=line, col=0,
+                    message=f"{key}: lock {kind}: {order} "
+                            f"({'; '.join(locs)})"))
+        return findings
+
+
+def _find_cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Elementary cycles, each reported once (canonicalized rotation)."""
+    cycles: List[List[str]] = []
+    seen: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str], visiting: Set[str]):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                rot = min(range(len(path)),
+                          key=lambda i: path[i:] + path[:i])
+                canon = tuple(path[rot:] + path[:rot])
+                if canon not in seen:
+                    seen.add(canon)
+                    cycles.append(list(canon))
+            elif nxt not in visiting and nxt > start:
+                visiting.add(nxt)
+                dfs(start, nxt, path + [nxt], visiting)
+                visiting.discard(nxt)
+
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return cycles
+
+
+class CheckThenActRule(Rule):
+    name = "conc-check-then-act"
+    severity = "error"
+    description = ("if on lock-guarded state outside the lock, acting "
+                   "under the lock inside the branch without re-checking "
+                   "(PR 7 commit-gate TOCTOU)")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for cls in ast.walk(ctx.tree):
+            if isinstance(cls, ast.ClassDef):
+                findings.extend(self._check_class(cls, ctx))
+        return findings
+
+    def _check_class(self, cls: ast.ClassDef, ctx: ModuleContext
+                     ) -> List[Finding]:
+        guarded = self._guarded_attrs(cls)
+        if not guarded:
+            return []
+        findings: List[Finding] = []
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                continue
+            self._walk(method.body, False, guarded, ctx, findings)
+        return findings
+
+    def _guarded_attrs(self, cls: ast.ClassDef) -> Set[str]:
+        """self-attributes written inside a ``with self.<lock>:`` block
+        anywhere in the class — the state the lock exists to guard."""
+        guarded: Set[str] = set()
+        for node in ast.walk(cls):
+            if not (isinstance(node, ast.With) and with_lock_attrs(node)):
+                continue
+            for inner in ast.walk(node):
+                if (isinstance(inner, ast.Attribute)
+                        and isinstance(inner.ctx, ast.Store)):
+                    attr = is_self_attr(inner)
+                    if attr:
+                        guarded.add(attr)
+        return guarded
+
+    def _walk(self, body: Sequence[ast.stmt], under_lock: bool,
+              guarded: Set[str], ctx: ModuleContext,
+              findings: List[Finding]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.With) and with_lock_attrs(stmt):
+                self._walk(stmt.body, True, guarded, ctx, findings)
+                continue
+            if isinstance(stmt, ast.If) and not under_lock:
+                checked = self._guarded_reads(stmt.test, guarded)
+                if checked:
+                    for branch in (stmt.body, stmt.orelse):
+                        w = self._first_lock_with(branch)
+                        if w is not None and not self._rechecks(w, checked):
+                            findings.append(ctx.finding(
+                                self, stmt,
+                                f"check of lock-guarded "
+                                f"{sorted('self.' + c for c in checked)} "
+                                f"outside the lock, then acting under "
+                                f"{'/'.join(with_lock_attrs(w))} at line "
+                                f"{w.lineno} without re-checking: the "
+                                f"state can change between check and act "
+                                f"— move the check inside the lock"))
+            for block in child_blocks(stmt):
+                self._walk(block, under_lock, guarded, ctx, findings)
+
+    def _guarded_reads(self, test: ast.AST, guarded: Set[str]) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(test):
+            attr = is_self_attr(node) if isinstance(node, ast.Attribute) else None
+            if attr and attr in guarded and isinstance(node.ctx, ast.Load):
+                out.add(attr)
+        return out
+
+    def _first_lock_with(self, body: Sequence[ast.stmt]
+                         ) -> Optional[ast.With]:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.With) and with_lock_attrs(node):
+                    return node
+        return None
+
+    def _rechecks(self, w: ast.With, checked: Set[str]) -> bool:
+        """Double-checked locking is fine: the with-body re-reads the
+        checked attribute in an if/while/assert test."""
+        for node in ast.walk(w):
+            test = None
+            if isinstance(node, (ast.If, ast.While)):
+                test = node.test
+            elif isinstance(node, ast.Assert):
+                test = node.test
+            if test is None:
+                continue
+            if self._guarded_reads(test, checked):
+                return True
+        return False
+
+
+class RawClockRule(Rule):
+    name = "conc-raw-clock"
+    severity = "warning"
+    description = ("time.time()/time.monotonic() in a module that takes "
+                   "injectable clock= — invisible to fake-clock tests; "
+                   "route through the injected clock (obs/tracing.py "
+                   "pattern)")
+
+    RAW_CLOCKS = {"time.time", "time.monotonic", "time.perf_counter"}
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        has_clock_param = any(
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and any(a.arg == "clock" for a in (node.args.posonlyargs
+                                               + node.args.args
+                                               + node.args.kwonlyargs))
+            for node in ast.walk(ctx.tree))
+        if not has_clock_param:
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and dotted_name(node.func) in self.RAW_CLOCKS):
+                findings.append(ctx.finding(
+                    self, node,
+                    f"{dotted_name(node.func)}() in a clock-injectable "
+                    f"module: fake-clock tests cannot see this timestamp "
+                    f"— route it through the injected clock"))
+        return findings
+
+
+class ThreadLifecycleRule(Rule):
+    name = "conc-thread-daemon"
+    severity = "warning"
+    description = ("threading.Thread without daemon= and never joined: "
+                   "shutdown hangs on it or it dies mid-write at "
+                   "teardown")
+
+    THREAD_CTORS = {"threading.Thread", "Thread"}
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        joined: Set[str] = set()
+        named: Dict[int, Optional[str]] = {}
+        aliases: Dict[str, str] = {}  # local name -> thread attr it aliases
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) and node.func.attr == "join":
+                t = terminal_name(node.func.value)
+                if t:
+                    joined.add(t)
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and tgt.attr == "daemon"):
+                        t = terminal_name(tgt.value)
+                        if t:
+                            joined.add(t)
+                if (isinstance(node.value, ast.Call)
+                        and dotted_name(node.value.func) in self.THREAD_CTORS
+                        and len(node.targets) == 1):
+                    named[id(node.value)] = terminal_name(node.targets[0])
+                elif len(node.targets) == 1 and isinstance(
+                        node.targets[0], ast.Name):
+                    # `t = self._thread` / `t = getattr(self, "_thread", ...)`
+                    # — a join on the alias credits the attribute
+                    src = None
+                    v = node.value
+                    if isinstance(v, ast.Attribute):
+                        src = v.attr
+                    elif (isinstance(v, ast.Call)
+                            and dotted_name(v.func) == "getattr"
+                            and len(v.args) >= 2
+                            and isinstance(v.args[1], ast.Constant)
+                            and isinstance(v.args[1].value, str)):
+                        src = v.args[1].value
+                    if src:
+                        aliases[node.targets[0].id] = src
+        for alias, attr in aliases.items():
+            if alias in joined:
+                joined.add(attr)
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and dotted_name(node.func) in self.THREAD_CTORS):
+                continue
+            if any(kw.arg == "daemon" for kw in node.keywords):
+                continue
+            name = named.get(id(node))
+            if name is not None and name in joined:
+                continue
+            findings.append(ctx.finding(
+                self, node,
+                "Thread created without daemon= and never joined (or "
+                "daemon-flagged) in this file: either pass daemon=, or "
+                "join it on the shutdown path"))
+        return findings
+
+
+_LOG_CALL_NAMES = {"warn", "warning", "error", "exception", "critical",
+                   "info", "debug", "log", "print", "print_exc", "write",
+                   "fail", "capture"}
+
+
+class BroadExceptRule(Rule):
+    name = "conc-broad-except"
+    severity = "warning"
+    description = ("except Exception that neither re-raises, logs, nor "
+                   "reads the exception: failures vanish (pre-PR 5 "
+                   "silent-staleness class)")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node.type):
+                continue
+            if self._handles(node):
+                continue
+            findings.append(ctx.finding(
+                self, node,
+                "broad `except Exception` swallows the failure: narrow "
+                "the exception type, log/count it with the error "
+                "attached, re-raise, or suppress with a reason"))
+        return findings
+
+    @staticmethod
+    def _is_broad(t: Optional[ast.AST]) -> bool:
+        if t is None:
+            return True  # bare except:
+        names = t.elts if isinstance(t, ast.Tuple) else [t]
+        for n in names:
+            if dotted_name(n) in {"Exception", "BaseException",
+                                  "builtins.Exception",
+                                  "builtins.BaseException"}:
+                return True
+        return False
+
+    @staticmethod
+    def _handles(handler: ast.ExceptHandler) -> bool:
+        bound = handler.name
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                t = terminal_name(node.func)
+                if t in _LOG_CALL_NAMES:
+                    return True
+            if (bound and isinstance(node, ast.Name) and node.id == bound
+                    and isinstance(node.ctx, ast.Load)):
+                return True
+        return False
+
+
+CONCURRENCY_RULES = (LockOrderRule, CheckThenActRule, RawClockRule,
+                     ThreadLifecycleRule, BroadExceptRule)

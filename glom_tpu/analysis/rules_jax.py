@@ -1,0 +1,377 @@
+"""glomlint JAX/TPU rule pack — each rule encodes a bug this repo shipped.
+
+  * ``jax-donation-aliasing`` — the PR 6 SIGABRT: a ``donate_argnums``
+    jit fed a numpy/npz-loaded tree.  On CPU the jit feed can zero-copy
+    alias the numpy heap allocation; donation then has XLA free memory
+    numpy still owns ("corrupted double-linked list", reliably fatal
+    under persistent-cache-deserialized executables).  Trainer.restore
+    now launders restored trees through a non-donating jit identity —
+    this rule keeps the next npz-into-donating-jit from shipping.
+  * ``jax-request-path-compile`` — the serving contract since PR 3: the
+    request path never compiles; all jit/lower/compile lives in
+    ``serving/compile_cache.py`` (AOT warmup).  A jit anywhere else under
+    ``serving/`` is a latency cliff waiting for the first unlucky request.
+  * ``jax-host-sync`` — ``float()`` / ``np.asarray()`` /
+    ``.block_until_ready()`` / ``jax.device_get`` inside the measured hot
+    paths (``_fit_loop``, the batcher, the execute path) stalls the
+    device pipeline; PR 1's phase-timed loop exists precisely because
+    untracked host syncs were eating step time.
+  * ``jax-traced-if`` — Python ``if`` on a traced value inside a jitted
+    function: TracerBoolConversionError at best, silent per-shape
+    recompile at worst (the recompile monitor's whole reason to exist).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from glom_tpu.analysis.engine import (
+    Finding, ModuleContext, Rule, child_blocks, dotted_name, is_compound,
+    parent_map, terminal_name,
+)
+
+_JIT_NAMES = {"jax.jit", "jax.pjit", "pjit", "jit"}
+_NUMPY_ROOTS = {"np", "numpy", "onp"}
+_NUMPY_HOST_FUNCS = {"load", "asarray", "array", "frombuffer", "fromfile",
+                     "copy", "ascontiguousarray"}
+
+
+def _donated_indices(call: ast.Call) -> Set[int]:
+    """Donated positional indices of a ``jax.jit(...)`` call; non-literal
+    ``donate_argnums`` (e.g. ``(0,) if donate else ()``) conservatively
+    reads as ``{0}``."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return {v.value}
+        if isinstance(v, (ast.Tuple, ast.List)):
+            idxs = {e.value for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int)}
+            return idxs  # empty literal () donates nothing
+        return {0}
+    return set()
+
+
+class DonationAliasingRule(Rule):
+    name = "jax-donation-aliasing"
+    severity = "error"
+    description = ("numpy/npz-loaded tree fed to a donate_argnums jit "
+                   "(PR 6 double-free SIGABRT); launder through a "
+                   "non-donating jit identity first")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        donating: Dict[str, Set[int]] = {}
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.value, ast.Call)
+                    and dotted_name(node.value.func) in _JIT_NAMES):
+                idxs = _donated_indices(node.value)
+                tgt = terminal_name(node.targets[0])
+                if idxs and tgt:
+                    donating[tgt] = idxs
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if (isinstance(dec, ast.Call)
+                            and dotted_name(dec.func) in _JIT_NAMES):
+                        idxs = _donated_indices(dec)
+                        if idxs:
+                            donating[node.name] = idxs
+        if not donating:
+            return []
+        findings: List[Finding] = []
+        # module scope, then each function scope with fresh taint
+        self._scan_body(ctx.tree.body, set(), donating, ctx, findings)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_body(node.body, set(), donating, ctx, findings)
+        return findings
+
+    # -- intra-scope, statement-ordered taint tracking ---------------------
+    def _tainted(self, e: ast.AST, taint: Set[str]) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id in taint
+        if isinstance(e, ast.Call):
+            d = dotted_name(e.func)
+            if d:
+                parts = d.split(".")
+                if (len(parts) >= 2 and parts[0] in _NUMPY_ROOTS
+                        and parts[-1] in _NUMPY_HOST_FUNCS):
+                    return True
+                if d == "dict":
+                    return any(self._tainted(a, taint) for a in e.args)
+            return False
+        if isinstance(e, (ast.Subscript, ast.Attribute, ast.Starred)):
+            return self._tainted(e.value, taint)
+        if isinstance(e, ast.Dict):
+            return any(v is not None and self._tainted(v, taint)
+                       for v in e.values)
+        if isinstance(e, (ast.Tuple, ast.List)):
+            return any(self._tainted(v, taint) for v in e.elts)
+        if isinstance(e, ast.IfExp):
+            return (self._tainted(e.body, taint)
+                    or self._tainted(e.orelse, taint))
+        return False
+
+    def _check_calls(self, root: ast.AST, taint: Set[str],
+                     donating: Dict[str, Set[int]], ctx: ModuleContext,
+                     findings: List[Finding]) -> None:
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = terminal_name(node.func)
+            if callee not in donating:
+                continue
+            for i in donating[callee]:
+                if i < len(node.args) and self._tainted(node.args[i], taint):
+                    findings.append(ctx.finding(
+                        self, node,
+                        f"argument {i} of donating jit {callee!r} derives "
+                        f"from a numpy/npz host buffer — donation frees "
+                        f"memory numpy owns; launder through a "
+                        f"non-donating jit identity first"))
+
+    def _scan_body(self, body: List[ast.stmt], taint: Set[str],
+                   donating: Dict[str, Set[int]], ctx: ModuleContext,
+                   findings: List[Finding]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # separate scope, scanned on its own
+            if not is_compound(stmt):
+                # simple statement: full walk with the current taint
+                self._check_calls(stmt, taint, donating, ctx, findings)
+                if isinstance(stmt, ast.Assign):
+                    is_tainted = self._tainted(stmt.value, taint)
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            (taint.add if is_tainted else taint.discard)(tgt.id)
+                elif (isinstance(stmt, ast.AnnAssign)
+                        and stmt.value is not None
+                        and isinstance(stmt.target, ast.Name)):
+                    is_tainted = self._tainted(stmt.value, taint)
+                    (taint.add if is_tainted else taint.discard)(stmt.target.id)
+                continue
+            # compound statement: check only header expressions here, then
+            # scan each branch from a COPY of the incoming taint and union
+            # the results — one branch's clean reassignment must not erase
+            # another branch's taint (the if-resuming/else-init restore
+            # pattern is exactly the PR 6 shape)
+            for field in ("test", "iter", "subject"):
+                expr = getattr(stmt, field, None)
+                if isinstance(expr, ast.AST):
+                    self._check_calls(expr, taint, donating, ctx, findings)
+            for item in getattr(stmt, "items", []) or []:
+                self._check_calls(item.context_expr, taint, donating, ctx,
+                                  findings)
+            merged: Set[str] = set()
+            for block in child_blocks(stmt):
+                branch_taint = set(taint)
+                self._scan_body(block, branch_taint, donating, ctx,
+                                findings)
+                merged |= branch_taint
+            taint |= merged
+
+
+class RequestPathCompileRule(Rule):
+    name = "jax-request-path-compile"
+    severity = "error"
+    description = ("jit/lower/compile under serving/ outside "
+                   "compile_cache.py — the request path never compiles "
+                   "(AOT warmup owns every executable)")
+
+    ALLOWED_BASENAME = "compile_cache.py"
+    SCOPE_DIR = "serving"
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        parts = ctx.relpath.split("/")
+        # component match, not substring: observing/ is not serving/
+        if self.SCOPE_DIR not in parts[:-1] or parts[-1] == self.ALLOWED_BASENAME:
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            if d in _JIT_NAMES:
+                findings.append(ctx.finding(
+                    self, node,
+                    f"{d}(...) in a serving module: only "
+                    f"{self.ALLOWED_BASENAME} may build executables "
+                    f"(AOT warmup); anything else can reach the request "
+                    f"path"))
+            elif isinstance(node.func, ast.Attribute):
+                recv = ast.unparse(node.func.value).lower()
+                if (node.func.attr == "lower" and "jit" in recv) or (
+                        node.func.attr == "compile" and "lower" in recv):
+                    findings.append(ctx.finding(
+                        self, node,
+                        f"{ast.unparse(node.func)}(...) in a serving "
+                        f"module: compile steps belong to "
+                        f"{self.ALLOWED_BASENAME}'s AOT warmup"))
+        return findings
+
+
+class HostSyncRule(Rule):
+    name = "jax-host-sync"
+    severity = "warning"
+    description = ("host sync (float()/np.asarray()/.block_until_ready()/"
+                   "device_get) inside a measured hot path stalls the "
+                   "device pipeline")
+
+    #: (relpath suffix, function name) pairs that are latency-critical
+    HOT_PATHS: Tuple[Tuple[str, str], ...] = (
+        ("training/trainer.py", "_fit_loop"),
+        ("serving/batcher.py", "submit"),
+        ("serving/batcher.py", "next_batch"),
+        ("serving/batcher.py", "_take_batch"),
+        ("serving/batcher.py", "_flush_reason"),
+        ("serving/compile_cache.py", "__call__"),
+        ("serving/engine.py", "_execute_batch"),
+        ("serving/engine.py", "_worker_loop"),
+    )
+    SYNC_ATTRS = {"block_until_ready", "item"}
+    SYNC_DOTTED = {"jax.device_get"}
+
+    def _is_sync_call(self, node: ast.Call) -> Optional[str]:
+        d = dotted_name(node.func)
+        if d == "float":
+            if node.args and not isinstance(node.args[0], ast.Constant):
+                return "float()"
+            return None
+        if d in self.SYNC_DOTTED:
+            return d
+        if d:
+            parts = d.split(".")
+            if (len(parts) >= 2 and parts[0] in _NUMPY_ROOTS
+                    and parts[-1] in {"asarray", "array"}):
+                return d
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in self.SYNC_ATTRS):
+            return "." + node.func.attr + "()"
+        return None
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        # component-anchored suffix match: preserving/batcher.py must not
+        # inherit serving/batcher.py's hot functions
+        hot = {fn for suffix, fn in self.HOT_PATHS
+               if ("/" + ctx.relpath).endswith("/" + suffix)}
+        if not hot:
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name in hot):
+                continue
+            seen_lines: Set[int] = set()
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                what = self._is_sync_call(call)
+                if what is None or call.lineno in seen_lines:
+                    continue
+                seen_lines.add(call.lineno)
+                findings.append(ctx.finding(
+                    self, call,
+                    f"{what} inside hot path {node.name!r}: host sync "
+                    f"stalls the device pipeline — move it off the "
+                    f"request/step path or justify with a suppression"))
+        return findings
+
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+
+
+def _jit_static_names(fn: ast.FunctionDef) -> Optional[Set[str]]:
+    """None when ``fn`` is not jit-decorated; else the set of static
+    parameter names (``static_argnums``/``static_argnames``)."""
+    args = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for dec in fn.decorator_list:
+        call = None
+        if dotted_name(dec) in _JIT_NAMES:
+            return set()
+        if isinstance(dec, ast.Call):
+            d = dotted_name(dec.func)
+            if d in _JIT_NAMES:
+                call = dec
+            elif d in {"partial", "functools.partial"} and dec.args and \
+                    dotted_name(dec.args[0]) in _JIT_NAMES:
+                call = dec
+        if call is None:
+            continue
+        static: Set[str] = set()
+        for kw in call.keywords:
+            v = kw.value
+            if kw.arg == "static_argnames":
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    static.add(v.value)
+                elif isinstance(v, (ast.Tuple, ast.List)):
+                    static |= {e.value for e in v.elts
+                               if isinstance(e, ast.Constant)}
+            elif kw.arg == "static_argnums":
+                idxs = ([v.value] if isinstance(v, ast.Constant) else
+                        [e.value for e in v.elts
+                         if isinstance(e, ast.Constant)]
+                        if isinstance(v, (ast.Tuple, ast.List)) else [])
+                static |= {args[i] for i in idxs
+                           if isinstance(i, int) and i < len(args)}
+        return static
+    return None
+
+
+class TracedIfRule(Rule):
+    name = "jax-traced-if"
+    severity = "error"
+    description = ("Python `if` on a traced value inside a jitted fn: "
+                   "TracerBoolConversionError or a silent per-value "
+                   "recompile; use lax.cond / jnp.where")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            static = _jit_static_names(fn)
+            if static is None:
+                continue
+            traced = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                      + fn.args.kwonlyargs)} - static - {"self"}
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.If):
+                    continue
+                offender = self._traced_test(node.test, traced)
+                if offender is not None:
+                    findings.append(ctx.finding(
+                        self, node,
+                        f"`if` on traced parameter {offender!r} inside "
+                        f"jitted {fn.name!r}: trace-time Python control "
+                        f"flow — use jax.lax.cond/select or mark the "
+                        f"argument static"))
+        return findings
+
+    def _traced_test(self, test: ast.AST, traced: Set[str]) -> Optional[str]:
+        parents = parent_map(test)
+        for node in ast.walk(test):
+            if not (isinstance(node, ast.Name) and node.id in traced
+                    and isinstance(node.ctx, ast.Load)):
+                continue
+            parent = parents.get(node)
+            # static facts about a traced array are fine in Python `if`
+            if isinstance(parent, ast.Attribute) and parent.attr in _STATIC_ATTRS:
+                continue
+            if (isinstance(parent, ast.Call)
+                    and dotted_name(parent.func) in {"isinstance", "len",
+                                                     "type", "id"}):
+                continue
+            if isinstance(parent, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot))
+                    for op in parent.ops):
+                continue
+            return node.id
+        return None
+
+
+JAX_RULES = (DonationAliasingRule, RequestPathCompileRule, HostSyncRule,
+             TracedIfRule)

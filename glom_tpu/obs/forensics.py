@@ -37,6 +37,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 from glom_tpu.obs.exporters import normalize_scalar
 
+Clock = Callable[[], float]
+
 BUNDLE_SCHEMA = 1
 MANIFEST = "manifest.json"
 _STAGING_PREFIX = ".tmp-"
@@ -55,7 +57,7 @@ def env_fingerprint(mesh=None) -> Dict[str, Any]:
             import jaxlib
 
             fp["jaxlib_version"] = jaxlib.__version__
-        except Exception:
+        except (ImportError, AttributeError):
             fp["jaxlib_version"] = None
         fp["backend"] = jax.default_backend()
         devs = jax.devices()
@@ -64,12 +66,12 @@ def env_fingerprint(mesh=None) -> Dict[str, Any]:
         fp["device_kind"] = devs[0].device_kind if devs else None
         fp["process_index"] = jax.process_index()
         fp["process_count"] = jax.process_count()
-    except Exception:
+    except Exception:  # glomlint: disable=conc-broad-except -- a fingerprint must be writable from any crash path; whatever jax raises here, None fields beat no bundle
         fp.setdefault("jax_version", None)
     if mesh is not None:
         try:
             fp["mesh_shape"] = {str(k): int(v) for k, v in dict(mesh.shape).items()}
-        except Exception:
+        except (TypeError, ValueError, AttributeError):
             fp["mesh_shape"] = None
     import platform
     import sys
@@ -91,7 +93,7 @@ def _git_sha() -> Optional[str]:
         )
         sha = out.stdout.decode().strip()
         return sha if out.returncode == 0 and sha else None
-    except Exception:
+    except (OSError, subprocess.SubprocessError, UnicodeDecodeError):
         return None
 
 
@@ -106,21 +108,25 @@ class FlightRecorder:
     normalize is stored as ``repr`` (losing a field beats losing the run).
     """
 
-    def __init__(self, capacity: int = 256):
+    def __init__(self, capacity: int = 256,
+                 clock: Optional[Clock] = None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = capacity
         self._ring: deque = deque(maxlen=capacity)
-        self._t0 = time.time()
+        # injectable clock, same pattern as obs.tracing.Tracer: tests
+        # drive record timestamps deterministically
+        self._clock: Clock = clock if clock is not None else time.time
+        self._t0 = self._clock()
         self.recorded = 0  # lifetime total (ring holds min(recorded, capacity))
 
     def record(self, step: int, scalars: Dict[str, Any]) -> None:
         rec: Dict[str, Any] = {"step": int(step),
-                               "time": round(time.time() - self._t0, 3)}
+                               "time": round(self._clock() - self._t0, 3)}
         for k, v in scalars.items():
             try:
                 rec[k] = normalize_scalar(v)
-            except Exception:
+            except Exception:  # glomlint: disable=conc-broad-except -- recording never raises: a value that won't normalize is stored as repr (losing a field beats losing the run)
                 rec[k] = repr(v)
         self._ring.append(rec)
         self.recorded += 1
@@ -196,10 +202,12 @@ class ForensicsManager:
                  config: Optional[Dict[str, Any]] = None, mesh=None,
                  trace_steps: int = 0,
                  snapshot_fn: Optional[Callable[[], Dict[str, Any]]] = None,
-                 registry=None):
+                 registry=None, clock: Optional[Clock] = None):
         if trace_steps < 0:
             raise ValueError(f"trace_steps must be >= 0, got {trace_steps}")
         self.root = root
+        # wall clock for manifest timestamps (injectable for tests)
+        self._clock: Clock = clock if clock is not None else time.time
         self.recorder = recorder
         self._config = config
         self._mesh = mesh
@@ -254,7 +262,7 @@ class ForensicsManager:
             "trigger": trigger,
             "step": int(step),
             "detail": detail,
-            "created_unix": time.time(),
+            "created_unix": self._clock(),
             "ring_records": len(self.recorder.snapshot()) if self.recorder else 0,
         }
         if snapshot and self._snapshot_fn is not None:
@@ -368,7 +376,7 @@ class ForensicsManager:
             self._fh_file = open(os.path.join(self.root, "faulthandler.log"), "a")
             faulthandler.enable(file=self._fh_file)
             return True
-        except Exception:
+        except (OSError, ValueError, RuntimeError):
             self._fh_file = None
             return False
 

@@ -128,7 +128,7 @@ def snapshot_from_compiled(lowered, compiled) -> Dict[str, Any]:
     render the optimized module."""
     try:
         hlo = compiled.as_text()
-    except Exception:
+    except Exception:  # glomlint: disable=conc-broad-except -- documented fallback: backends that won't render the optimized module get the StableHLO text instead
         hlo = lowered.as_text()
     return {
         "hlo": hlo,

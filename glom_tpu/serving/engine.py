@@ -515,9 +515,10 @@ class ServingEngine:
         for batcher in self.batchers.values():
             batcher.close(drain=drain)
         self._stop.set()
-        deadline = time.monotonic() + timeout
+        deadline = time.monotonic() + timeout  # glomlint: disable=conc-raw-clock -- the drain deadline must track wall time: under a fake test clock the joins would otherwise never time out
         for t in self._threads:
-            t.join(timeout=max(0.0, deadline - time.monotonic()))
+            t.join(timeout=max(0.0, deadline - time.monotonic()))  # glomlint: disable=conc-raw-clock -- paired with the wall-clock deadline above
+
         self._threads = []
         if self.tracer.exporter is not None:
             # deterministic trace-log lifecycle (a later emit reopens in

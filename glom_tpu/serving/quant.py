@@ -177,8 +177,8 @@ def accuracy_report(config, train_cfg, params, imgs,
     def run(mode):
         cfg = serving_config(config, mode)
         qp = jax.device_put(quantize_tree(params, mode))
-        embed = jax.jit(quantized_forward(_make_embed_fn(cfg, iters), mode))
-        recon = jax.jit(
+        embed = jax.jit(quantized_forward(_make_embed_fn(cfg, iters), mode))  # glomlint: disable=jax-request-path-compile -- offline accuracy harness (tools/quant_check), never reached by the serving request path
+        recon = jax.jit(  # glomlint: disable=jax-request-path-compile -- offline accuracy harness (tools/quant_check), never reached by the serving request path
             quantized_forward(_make_reconstruct_fn(cfg, train_cfg, iters), mode)
         )
         return np.asarray(embed(qp, imgs)), np.asarray(recon(qp, imgs))

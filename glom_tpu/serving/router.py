@@ -245,7 +245,7 @@ class FleetRouter:
                 return None
             health = json.loads(body)
             return health if health.get("status") == "ok" else None
-        except Exception:
+        except Exception:  # glomlint: disable=conc-broad-except -- any probe failure (refused, timeout, bad JSON, injected test fault) means unhealthy; the caller counts the streak and ejection makes it visible
             return None
 
     def _note_failure(self, replica: Replica) -> None:
@@ -294,7 +294,7 @@ class FleetRouter:
             # rollout anyone will roll back
             self._admin(replica, "finalize", timeout=self.commit_timeout_s)
             return True
-        except Exception:
+        except Exception:  # glomlint: disable=conc-broad-except -- a failed catch-up keeps the replica ejected (False); the next health pass retries and the fail streak stays observable
             return False
 
     def check_health_once(self, *, force: bool = False) -> None:
@@ -519,7 +519,7 @@ class FleetRouter:
                 timeout if timeout is not None else self.admin_timeout_s,
             )
             return json.loads(body) if status == 200 else None
-        except Exception:
+        except Exception:  # glomlint: disable=conc-broad-except -- admin helper contract: None for any failure; each rollout phase decides (abort/rollback/eject) and counts its own outcome
             return None
 
     def coordinated_reload(self, step: Optional[int] = None) -> dict:
@@ -735,11 +735,16 @@ class FleetRouter:
         while not self._stop.wait(self.rollout_poll_s):
             try:
                 self.coordinated_reload()
-            except Exception:  # the poll loop must outlive any rollout bug
+            except Exception as e:  # the poll loop must outlive any rollout bug
                 self.registry.counter(
                     "router_rollout_errors_total",
                     help="rollout poll iterations that raised",
                 ).inc()
+                warnings.warn(
+                    f"rollout poll iteration raised "
+                    f"({type(e).__name__}: {e}); router continues",
+                    stacklevel=2,
+                )
 
     # -- aggregate views ----------------------------------------------------
     def health(self) -> dict:
@@ -782,7 +787,7 @@ class FleetRouter:
             try:
                 return self._http("GET", f"{replica.url}/metrics", None,
                                   {}, self.health_timeout_s)
-            except Exception:
+            except Exception:  # glomlint: disable=conc-broad-except -- a dead replica's scrape is skipped from the aggregate; ejecting it is the health loop's job, not the scrape's
                 return None
 
         with ThreadPoolExecutor(

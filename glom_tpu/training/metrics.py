@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import sys
 import time
-from typing import IO, Optional
+from typing import IO, Callable, Optional
 
 import jax
 
@@ -34,7 +34,8 @@ from glom_tpu.obs.exporters import JsonlExporter, normalize_scalar
 
 class MetricLogger:
     def __init__(self, path: Optional[str] = None, stream: Optional[IO] = None,
-                 exporters=None, registry=None):
+                 exporters=None, registry=None,
+                 clock: Optional[Callable[[], float]] = None):
         self._emit = jax.process_index() == 0
         self.registry = registry
         self._exporters = []
@@ -44,7 +45,10 @@ class MetricLogger:
             )
             if exporters:
                 self._exporters.extend(exporters)
-        self._t0 = time.time()
+        # injectable clock (obs.tracing.Tracer pattern): record `time`
+        # fields are deterministic under a fake clock in tests
+        self._clock = clock if clock is not None else time.time
+        self._t0 = self._clock()
 
     def add_exporter(self, exporter) -> None:
         """Attach an additional sink (process-0 only — on other hosts this
@@ -65,7 +69,7 @@ class MetricLogger:
     def log(self, step: int, **scalars) -> None:
         if not self._emit:
             return
-        rec = {"step": int(step), "time": round(time.time() - self._t0, 3)}
+        rec = {"step": int(step), "time": round(self._clock() - self._t0, 3)}
         for k, v in scalars.items():
             rec[k] = normalize_scalar(v)
         for ex in self._exporters:

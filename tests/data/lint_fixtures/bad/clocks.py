@@ -1,0 +1,18 @@
+"""Must-flag: a module that takes ``clock=`` for injectability, then
+reads ``time.time()`` raw anyway — the timestamp fake-clock tests can
+never see (the drift class obs/forensics.py and training/metrics.py
+shipped with before the clock satellite fix)."""
+
+import time
+
+
+class Recorder:
+    def __init__(self, clock=None):
+        self._clock = clock if clock is not None else time.monotonic
+        self._t0 = self._clock()
+
+    def record(self, value):
+        return {"t": time.time(), "value": value}   # BAD: bypasses clock
+
+    def elapsed(self):
+        return time.monotonic() - self._t0          # BAD: bypasses clock

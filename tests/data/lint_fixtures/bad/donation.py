@@ -1,0 +1,26 @@
+"""Golden must-flag fixture: the PR 6 donation-aliasing crash shape.
+
+An npz-restored tree (numpy-owned host buffers) fed straight into a
+``donate_argnums`` jit.  On CPU the feed can zero-copy alias the numpy
+heap allocation; donation then has XLA free memory numpy still owns —
+glibc "corrupted double-linked list", SIGABRT, reliably fatal under
+persistent-cache-deserialized executables.
+"""
+
+import jax
+import numpy as np
+
+step = jax.jit(lambda state, batch: state, donate_argnums=(0,))
+
+
+def restore_and_step(path, batch):
+    trees = dict(np.load(path))          # numpy owns these buffers
+    return step(trees, batch)            # BAD: donates numpy-backed tree
+
+
+def resume_or_init(path, batch, resuming, init):
+    if resuming:
+        trees = dict(np.load(path))      # tainted on this branch...
+    else:
+        trees = init()                   # ...clean on this one
+    return step(trees, batch)            # BAD: the resume branch donates npz

@@ -1,0 +1,18 @@
+"""Must-flag: broad excepts that swallow the failure whole — no
+re-raise, no log, the exception never even read (the class that turned
+torn checkpoints into silent serving staleness pre-PR 5)."""
+
+
+def poll(fetch):
+    try:
+        return fetch()
+    except Exception:          # BAD: silent swallow
+        return None
+
+
+def drain(queue):
+    while True:
+        try:
+            queue.get_nowait()
+        except:                # BAD: bare except, silent
+            break

@@ -1,0 +1,20 @@
+"""Must-flag: Python ``if`` on a traced value inside a jitted function —
+TracerBoolConversionError at best, a silent per-value recompile at
+worst (the recompile monitor's founding bug class)."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clip_loss(loss, limit):
+    if loss > limit:            # BAD: `loss` is a tracer here
+        return limit
+    return loss
+
+
+@jax.jit
+def normalize(x):
+    if x.sum() > 0:             # BAD: traced reduction in Python if
+        return x / x.sum()
+    return jnp.zeros_like(x)

@@ -1,0 +1,14 @@
+"""Must-flag: compiles reachable from the serving request path.
+
+The PR 3 contract: every executable is built by compile_cache.py's AOT
+warmup; a request that triggers a compile is a multi-second latency
+cliff for whoever sent it.
+"""
+
+import jax
+
+
+def handle(params, img, model_fn):
+    fn = jax.jit(model_fn)               # BAD: request-path jit
+    lowered = fn.lower(params, img)      # BAD: request-path lower
+    return lowered.compile()(params, img)  # BAD: request-path compile

@@ -1,0 +1,60 @@
+"""Must-flag: A→B in one method, B→A in another — a deadlock under the
+right two-thread interleaving.  Also an interprocedural variant: a
+helper that takes the lock its caller already holds (plain
+``threading.Lock`` self-deadlocks)."""
+
+import threading
+
+
+class Gate:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._reload_lock = threading.Lock()
+
+    def swap(self):
+        with self._lock:              # A
+            with self._reload_lock:   # A -> B
+                pass
+
+    def reload(self):
+        with self._reload_lock:       # B
+            with self._lock:          # B -> A: cycle
+                pass
+
+
+class Reentrant:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            self._helper()            # helper re-takes self._lock: deadlock
+
+    def _helper(self):
+        with self._lock:
+            pass
+
+
+class Chain:
+    """The multi-hop variant: a() holds A and reaches B only through two
+    lock-free intermediate calls; d() takes B then A directly."""
+
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def a(self):
+        with self._a_lock:
+            self._m1()                # A -> (m1 -> m2 ->) B
+
+    def _m1(self):
+        self._m2()
+
+    def _m2(self):
+        with self._b_lock:
+            pass
+
+    def d(self):
+        with self._b_lock:
+            with self._a_lock:        # B -> A: closes the cycle
+                pass

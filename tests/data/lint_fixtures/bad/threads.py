@@ -1,0 +1,17 @@
+"""Must-flag: threads that are neither daemons nor ever joined —
+interpreter shutdown hangs on them, or they die mid-write at teardown."""
+
+import threading
+
+
+class Watcher:
+    def start(self):
+        self._thread = threading.Thread(target=self._loop)  # BAD: no daemon, no join
+        self._thread.start()
+
+    def _loop(self):
+        pass
+
+
+def fire_and_forget(fn):
+    threading.Thread(target=fn).start()  # BAD: anonymous, unjoined

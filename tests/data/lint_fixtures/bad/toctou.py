@@ -1,0 +1,26 @@
+"""Must-flag: the PR 7 commit-gate TOCTOU, minimized.
+
+The dispatcher checks the gate OUTSIDE the lock, then acts under it.
+Between check and act a commit can close the gate — the request is
+dispatched against a half-committed fleet.
+"""
+
+import threading
+
+
+class Dispatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._gate_open = True
+        self._inflight = 0
+
+    def dispatch(self, request):
+        if self._gate_open:               # BAD: check outside the lock
+            with self._lock:
+                self._inflight += 1       # act assumes the check held
+            return request.send()
+        raise RuntimeError("gate closed")
+
+    def close_gate(self):
+        with self._lock:
+            self._gate_open = False       # ...and it can stop holding here

@@ -1,0 +1,18 @@
+"""Must-flag: per-step host syncs inside the hot step loop.
+
+The PR 1 phase-timed loop exists because exactly these calls were
+silently eating step time: every float()/device_get inside the loop is
+a device-pipeline stall per iteration.
+"""
+
+import jax
+import numpy as np
+
+
+def _fit_loop(state, batches, log):
+    for i, batch in enumerate(batches):
+        state, metrics = state.step(batch)
+        loss = float(metrics["loss"])            # BAD: per-step host sync
+        log(i, loss=loss, grad=np.asarray(metrics["grad_norm"]))  # BAD
+        jax.block_until_ready(state.params)      # BAD: per-step drain
+    return state

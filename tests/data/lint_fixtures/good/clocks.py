@@ -1,0 +1,16 @@
+"""Must-pass: every timestamp flows through the injected clock; the raw
+time functions appear only as uncalled defaults."""
+
+import time
+
+
+class Recorder:
+    def __init__(self, clock=None):
+        self._clock = clock if clock is not None else time.monotonic
+        self._t0 = self._clock()
+
+    def record(self, value):
+        return {"t": self._clock(), "value": value}
+
+    def elapsed(self):
+        return self._clock() - self._t0

@@ -1,0 +1,26 @@
+"""Must-pass fixture: the laundered form of the PR 6 donation crash.
+
+The restored tree goes through a non-donating jit identity first — XLA
+allocates the output buffers, so donating them later frees XLA-owned
+memory, which is the whole point of donation.
+"""
+
+import jax
+import numpy as np
+
+step = jax.jit(lambda state, batch: state, donate_argnums=(0,))
+
+
+def restore_and_step(path, batch):
+    trees = dict(np.load(path))
+    trees = jax.jit(lambda t: t)(trees)  # launder: XLA-owned outputs
+    return step(trees, batch)            # OK: donation-safe by construction
+
+
+def resume_or_init(path, batch, resuming, init):
+    if resuming:
+        trees = dict(np.load(path))
+        trees = jax.jit(lambda t: t)(trees)  # launder before leaving branch
+    else:
+        trees = init()
+    return step(trees, batch)            # OK: both branches donation-safe

@@ -1,0 +1,35 @@
+"""Must-pass: failures are narrowed, logged with the error attached, or
+re-raised — nothing vanishes."""
+
+import queue as queue_lib
+import warnings
+
+
+def poll(fetch):
+    try:
+        return fetch()
+    except OSError:                      # narrowed: the expected failure
+        return None
+
+
+def poll_logged(fetch):
+    try:
+        return fetch()
+    except Exception as e:
+        warnings.warn(f"poll failed ({type(e).__name__}: {e})")
+        return None
+
+
+def drain(queue):
+    while True:
+        try:
+            queue.get_nowait()
+        except queue_lib.Empty:
+            break
+
+
+def strict(fetch):
+    try:
+        return fetch()
+    except Exception:
+        raise

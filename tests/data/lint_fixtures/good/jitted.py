@@ -1,0 +1,28 @@
+"""Must-pass: traced branching via lax, static facts via shape/static
+arguments — no Python control flow on tracers."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clip_loss(loss, limit):
+    return jnp.minimum(loss, limit)
+
+
+@jax.jit
+def normalize(x):
+    total = x.sum()
+    return jax.lax.cond(total > 0, lambda: x / total,
+                        lambda: jnp.zeros_like(x))
+
+
+@partial(jax.jit, static_argnames=("training",))
+def forward(x, training):
+    if training:                # OK: static argument
+        x = x * 2.0
+    if x.shape[0] > 1:          # OK: shapes are trace-time constants
+        x = x.reshape(-1)
+    return x

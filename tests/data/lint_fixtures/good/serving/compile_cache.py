@@ -1,0 +1,9 @@
+"""Must-pass: compile_cache.py is the ONE place serving may compile."""
+
+import jax
+
+
+def warm(fn, params_struct, img_struct):
+    jit_fn = jax.jit(fn)
+    lowered = jit_fn.lower(params_struct, img_struct)
+    return lowered.compile()

@@ -1,0 +1,5 @@
+"""Must-pass: the request path only ever calls pre-warmed executables."""
+
+
+def handle(params, img, cache):
+    return cache(params, img)  # AOT-compiled at warmup, never here

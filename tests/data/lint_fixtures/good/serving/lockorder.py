@@ -1,0 +1,34 @@
+"""Must-pass: every path acquires in the one documented order
+(_lock after _reload_lock, never the reverse), and helpers document
+"caller holds the lock" instead of re-taking it."""
+
+import threading
+
+
+class Gate:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._reload_lock = threading.Lock()
+
+    def swap(self):
+        with self._reload_lock:
+            with self._lock:
+                pass
+
+    def reload(self):
+        with self._reload_lock:
+            with self._lock:
+                pass
+
+
+class Reentrant:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            self._helper_locked()
+
+    def _helper_locked(self):
+        """Caller holds self._lock."""
+        pass

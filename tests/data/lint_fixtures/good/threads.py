@@ -1,0 +1,21 @@
+"""Must-pass: every thread is either a daemon or joined on the shutdown
+path (including joins through a local alias)."""
+
+import threading
+
+
+class Watcher:
+    def start(self):
+        self._thread = threading.Thread(target=self._loop)
+        self._thread.start()
+
+    def stop(self):
+        t = self._thread
+        t.join(timeout=5.0)
+
+    def _loop(self):
+        pass
+
+
+def fire_and_forget(fn):
+    threading.Thread(target=fn, daemon=True).start()

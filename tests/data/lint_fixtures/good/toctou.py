@@ -1,0 +1,32 @@
+"""Must-pass: the fixed commit gate — the check happens INSIDE the same
+lock acquisition that performs the act (plus the double-checked variant,
+which re-verifies under the lock)."""
+
+import threading
+
+
+class Dispatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._gate_open = True
+        self._inflight = 0
+
+    def dispatch(self, request):
+        with self._lock:
+            if not self._gate_open:       # check and act share the lock
+                raise RuntimeError("gate closed")
+            self._inflight += 1
+        return request.send()
+
+    def dispatch_fast(self, request):
+        if not self._gate_open:           # cheap early-out is fine...
+            raise RuntimeError("gate closed")
+        with self._lock:
+            if not self._gate_open:       # ...because it re-checks here
+                raise RuntimeError("gate closed")
+            self._inflight += 1
+        return request.send()
+
+    def close_gate(self):
+        with self._lock:
+            self._gate_open = False

@@ -1,0 +1,17 @@
+"""Must-pass: device scalars accumulate on device; the fetch happens at
+the logging boundary outside the hot loop body."""
+
+
+def _fit_loop(state, batches, window):
+    for i, batch in enumerate(batches):
+        state, metrics = state.step(batch)
+        window.append(metrics)  # device scalars; no host sync here
+    return state
+
+
+def flush_window(window, log):
+    import jax
+
+    fetched = jax.device_get(window)  # ONE sync at the boundary
+    for i, metrics in enumerate(fetched):
+        log(i, **metrics)

@@ -1,0 +1,454 @@
+"""glomlint (glom_tpu.analysis) — the static-analysis gate's own tests.
+
+Three layers:
+
+  * per-rule fixture tests — every rule must FLAG the minimized
+    reproduction of the historical bug it encodes
+    (tests/data/lint_fixtures/bad/, e.g. the PR 6 npz-into-donating-jit
+    crash shape) and must PASS the fixed form (…/good/);
+  * engine semantics — suppressions (reason required), baseline
+    absorb/drift behavior, rule filtering, the CLI's exit codes and
+    output formats;
+  * the self-lint gate — the repo itself (glom_tpu/ + tools/) is clean
+    modulo the committed baseline.  This is the tier-1 anchor: a change
+    that introduces a new hazard fails HERE, before review.
+
+Pure AST — no accelerator, no model import, fast.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "data", "lint_fixtures")
+BAD = os.path.join(FIXTURES, "bad")
+GOOD = os.path.join(FIXTURES, "good")
+
+sys.path.insert(0, REPO) if REPO not in sys.path else None
+
+from glom_tpu.analysis import (  # noqa: E402
+    analyze, default_rules, load_baseline, split_baseline, write_baseline,
+)
+
+
+def run_rules(paths, root, names=None):
+    return analyze(paths if isinstance(paths, list) else [paths],
+                   default_rules(names), root=root)
+
+
+def findings_for(result, rule):
+    return [f for f in result.findings if f.rule == rule]
+
+
+# -- per-rule fixtures: flag the historical bug, pass the fix --------------
+
+RULE_FIXTURES = [
+    # (rule id, bad fixture relpath, good fixture relpath)
+    ("jax-donation-aliasing", "donation.py", "donation.py"),
+    ("jax-request-path-compile", "serving/handlers.py",
+     "serving/handlers.py"),
+    ("jax-host-sync", "training/trainer.py", "training/trainer.py"),
+    ("jax-traced-if", "jitted.py", "jitted.py"),
+    ("conc-lock-order", "serving/lockorder.py", "serving/lockorder.py"),
+    ("conc-check-then-act", "toctou.py", "toctou.py"),
+    ("conc-raw-clock", "clocks.py", "clocks.py"),
+    ("conc-thread-daemon", "threads.py", "threads.py"),
+    ("conc-broad-except", "excepts.py", "excepts.py"),
+]
+
+
+@pytest.mark.parametrize("rule,bad_rel,good_rel", RULE_FIXTURES,
+                         ids=[r for r, _, _ in RULE_FIXTURES])
+def test_rule_flags_bug_and_passes_fix(rule, bad_rel, good_rel):
+    bad = run_rules(os.path.join(BAD, bad_rel), root=BAD)
+    hits = findings_for(bad, rule)
+    assert hits, f"{rule} must flag its historical-bug fixture {bad_rel}"
+    assert all(f.path == bad_rel.replace(os.sep, "/") for f in hits)
+    good = run_rules(os.path.join(GOOD, good_rel), root=GOOD)
+    assert not findings_for(good, rule), (
+        f"{rule} must pass the fixed form {good_rel}: "
+        f"{findings_for(good, rule)}")
+
+
+def test_donation_golden_case_details():
+    """The PR 6 regression shape: findings land on the donating call
+    lines (straight-line AND the if-resuming/else-init branch form) and
+    name the laundering fix."""
+    result = run_rules(os.path.join(BAD, "donation.py"), root=BAD)
+    hits = findings_for(result, "jax-donation-aliasing")
+    assert len(hits) == 2, hits
+    for f in hits:
+        assert f.severity == "error"
+        assert "step(trees, batch)" in f.code
+        assert "launder" in f.message
+
+
+def test_donation_branch_taint_is_unioned(tmp_path):
+    """A clean reassignment in one branch must not erase another branch's
+    taint; laundering inside the tainting branch must."""
+    flagged = _lint_source(tmp_path, """
+        import jax
+        import numpy as np
+
+        step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+        def f(path, batch, resuming, init):
+            if resuming:
+                t = np.load(path)
+            else:
+                t = init()
+            return step(t, batch)
+    """, names=["jax-donation-aliasing"])
+    assert len(flagged.findings) == 1
+    clean = _lint_source(tmp_path, """
+        import jax
+        import numpy as np
+
+        step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+        def f(path, batch, resuming, init):
+            if resuming:
+                t = jax.jit(lambda x: x)(np.load(path))
+            else:
+                t = init()
+            return step(t, batch)
+    """, names=["jax-donation-aliasing"], filename="clean.py")
+    assert not clean.findings
+
+
+def test_compile_cache_is_allowed_to_compile():
+    """The one serving module that MAY build executables."""
+    result = run_rules(os.path.join(GOOD, "serving", "compile_cache.py"),
+                       root=GOOD)
+    assert not findings_for(result, "jax-request-path-compile")
+
+
+def test_lock_graph_cycle_synthetic_pair():
+    """A→B in one method, B→A in another: exactly the textbook deadlock;
+    the finding names both edges.  The reentrant helper (A while holding
+    A through a self-call) and the multi-hop chain (A held, B reached
+    through two lock-free intermediate calls) are the interprocedural
+    cycles."""
+    result = run_rules(os.path.join(BAD, "serving", "lockorder.py"),
+                       root=BAD)
+    hits = findings_for(result, "conc-lock-order")
+    assert len(hits) == 3
+    msgs = " | ".join(f.message for f in hits)
+    assert "_lock -> _reload_lock -> _lock" in msgs or \
+        "_reload_lock -> _lock -> _reload_lock" in msgs
+    assert "re-acquired while already held" in msgs
+    assert "Chain" in msgs and "_a_lock" in msgs and "_b_lock" in msgs
+
+
+def test_toctou_double_checked_variant_passes():
+    """dispatch_fast re-checks under the lock — recognized as safe."""
+    result = run_rules(os.path.join(GOOD, "toctou.py"), root=GOOD)
+    assert not findings_for(result, "conc-check-then-act")
+
+
+# -- suppressions ----------------------------------------------------------
+
+def _lint_source(tmp_path, source, names=None, filename="mod.py"):
+    p = tmp_path / filename
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    return run_rules(str(p), root=str(tmp_path), names=names)
+
+
+BROAD = """
+    def poll(fetch):
+        try:
+            return fetch()
+        except Exception:{comment}
+            return None
+"""
+
+
+def test_suppression_with_reason_suppresses(tmp_path):
+    result = _lint_source(tmp_path, BROAD.format(
+        comment="  # glomlint: disable=conc-broad-except -- probe: None is the contract"))
+    assert not result.findings
+    assert len(result.suppressed) == 1
+    assert result.suppressed[0].rule == "conc-broad-except"
+
+
+def test_suppression_without_reason_does_not_suppress(tmp_path):
+    result = _lint_source(tmp_path, BROAD.format(
+        comment="  # glomlint: disable=conc-broad-except"))
+    rules = {f.rule for f in result.findings}
+    assert "conc-broad-except" in rules, "reasonless disable must not honor"
+    assert "lint-bad-suppression" in rules, "and is itself reported"
+
+
+def test_suppression_empty_reason_after_dashes_is_reported(tmp_path):
+    """'-- <nothing>' is the forgot-the-reason shape: not honored AND
+    reported, same as omitting '--' entirely."""
+    result = _lint_source(tmp_path, BROAD.format(
+        comment="  # glomlint: disable=conc-broad-except --"))
+    rules = {f.rule for f in result.findings}
+    assert "conc-broad-except" in rules
+    assert "lint-bad-suppression" in rules
+
+
+def test_suppression_standalone_previous_line(tmp_path):
+    result = _lint_source(tmp_path, """
+        def poll(fetch):
+            try:
+                return fetch()
+            # glomlint: disable=conc-broad-except -- fixture: swallow is the contract
+            except Exception:
+                return None
+    """)
+    assert not result.findings
+    assert len(result.suppressed) == 1
+
+
+def test_suppression_marker_in_string_is_not_a_suppression(tmp_path):
+    """Only COMMENT tokens count: documentation of the syntax inside a
+    string/docstring must neither suppress nor report bad-suppression."""
+    result = _lint_source(tmp_path, '''
+        DOC = "write # glomlint: disable=conc-broad-except to suppress"
+
+        def poll(fetch):
+            try:
+                return fetch()
+            except Exception:
+                return None
+    ''')
+    rules = [f.rule for f in result.findings]
+    assert rules == ["conc-broad-except"], rules
+    assert not result.suppressed
+
+
+def test_scope_is_component_match_not_substring(tmp_path):
+    """observing/ is not serving/: directory scoping matches path
+    components, so unrelated modules never inherit serving-only rules."""
+    result = _lint_source(tmp_path, """
+        import jax
+
+        def build(fn):
+            return jax.jit(fn)
+    """, filename=os.path.join("observing", "mon.py"))
+    assert not findings_for(result, "jax-request-path-compile")
+    result = _lint_source(tmp_path, """
+        import jax
+
+        def build(fn):
+            return jax.jit(fn)
+    """, filename=os.path.join("serving", "mon.py"))
+    assert findings_for(result, "jax-request-path-compile")
+
+
+def test_overlapping_paths_analyze_each_file_once(tmp_path):
+    sub = tmp_path / "pkg"
+    sub.mkdir()
+    (sub / "mod.py").write_text(textwrap.dedent("""
+        def poll(fetch):
+            try:
+                return fetch()
+            except Exception:
+                return None
+    """))
+    result = run_rules([str(tmp_path), str(sub), str(sub / "mod.py")],
+                       root=str(tmp_path))
+    assert len(result.findings) == 1, result.findings
+
+
+def test_suppression_wrong_rule_does_not_suppress(tmp_path):
+    result = _lint_source(tmp_path, BROAD.format(
+        comment="  # glomlint: disable=jax-host-sync -- wrong rule entirely"))
+    assert findings_for(result, "conc-broad-except")
+
+
+# -- baseline --------------------------------------------------------------
+
+def test_baseline_absorbs_and_new_findings_gate(tmp_path):
+    src_dir = tmp_path / "src"
+    src_dir.mkdir()
+    mod = src_dir / "mod.py"
+    mod.write_text(textwrap.dedent("""
+        def poll(fetch):
+            try:
+                return fetch()
+            except Exception:
+                return None
+    """))
+    result = run_rules(str(src_dir), root=str(tmp_path))
+    assert len(result.findings) == 1
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), result.findings)
+
+    # unchanged repo: everything baselined, nothing new
+    new, old = split_baseline(
+        run_rules(str(src_dir), root=str(tmp_path)).findings,
+        load_baseline(str(bl)))
+    assert (len(new), len(old)) == (0, 1)
+
+    # pure line drift (a comment above) keeps the baseline match
+    mod.write_text("# a new leading comment\n" + mod.read_text())
+    new, old = split_baseline(
+        run_rules(str(src_dir), root=str(tmp_path)).findings,
+        load_baseline(str(bl)))
+    assert (len(new), len(old)) == (0, 1)
+
+    # a SECOND instance of the same hazard exceeds the budget and gates
+    mod.write_text(mod.read_text() + textwrap.dedent("""
+        def poll2(fetch):
+            try:
+                return fetch()
+            except Exception:
+                return None
+    """))
+    new, old = split_baseline(
+        run_rules(str(src_dir), root=str(tmp_path)).findings,
+        load_baseline(str(bl)))
+    assert (len(new), len(old)) == (1, 1)
+
+
+def test_rule_filter_and_unknown_rule():
+    only = default_rules(["conc-broad-except"])
+    assert [r.name for r in only] == ["conc-broad-except"]
+    with pytest.raises(ValueError, match="unknown rule"):
+        default_rules(["no-such-rule"])
+
+
+# -- CLI -------------------------------------------------------------------
+
+def _run_cli(args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py")] + args,
+        capture_output=True, text=True, cwd=cwd, timeout=120)
+
+
+def test_cli_json_bad_fixtures_nonzero_exit():
+    proc = _run_cli(["--format", "json", "--baseline", "none",
+                     "--root", FIXTURES, BAD])
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["summary"]["status"] == "failing"
+    by_rule = payload["summary"]["new_by_rule"]
+    # every shipped rule catches its fixture in one program-wide run
+    for rule, _, _ in RULE_FIXTURES:
+        assert by_rule.get(rule, 0) >= 1, f"{rule} missing from {by_rule}"
+
+
+def test_cli_good_fixtures_exit_zero():
+    proc = _run_cli(["--baseline", "none", "--root", FIXTURES, GOOD])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_rule_filter():
+    proc = _run_cli(["--format", "json", "--baseline", "none",
+                     "--rule", "conc-broad-except",
+                     "--root", FIXTURES, BAD])
+    payload = json.loads(proc.stdout)
+    assert set(payload["summary"]["new_by_rule"]) == {"conc-broad-except"}
+
+
+def test_cli_stats_prometheus_lines(tmp_path):
+    stats_file = tmp_path / "glomlint.prom"
+    proc = _run_cli(["--baseline", "none", "--root", FIXTURES,
+                     "--stats", "--stats-file", str(stats_file), BAD])
+    assert proc.returncode == 1
+    text = stats_file.read_text()
+    assert "# TYPE glomlint_findings_total gauge" in text
+    assert 'glomlint_findings_total{rule="jax-donation-aliasing"} 2' in text
+    assert "glomlint_suppressed_total 0" in text
+    # the same lines go to stdout with --stats
+    assert 'glomlint_findings_total{rule="jax-donation-aliasing"} 2' \
+        in proc.stdout
+
+
+def test_cli_usage_errors_exit_two_not_one(tmp_path):
+    """Usage errors must be distinguishable from 'findings exist': a
+    typo'd rule, a dead path, or a path with no .py files all exit 2."""
+    proc = _run_cli(["--rule", "conc-broadexcept"])  # typo
+    assert proc.returncode == 2
+    assert "unknown rule" in proc.stderr
+    proc = _run_cli(["glom_tpu/servng"])  # typo'd path
+    assert proc.returncode == 2
+    assert "do not exist" in proc.stderr
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    proc = _run_cli([str(empty)])  # exists, but nothing to analyze
+    assert proc.returncode == 2
+    assert "no .py files" in proc.stderr
+
+
+def test_cli_write_baseline_refuses_filtered_runs():
+    """A --rule or path-filtered run sees a slice of the findings; writing
+    that out would silently drop every other baseline entry."""
+    proc = _run_cli(["--write-baseline", "--rule", "jax-host-sync"])
+    assert proc.returncode == 2
+    assert "full run" in proc.stderr
+    proc = _run_cli(["--write-baseline", BAD])
+    assert proc.returncode == 2
+
+
+def test_cli_runs_without_jax(tmp_path):
+    """The gate must run on a jax-less machine (fresh venv, minimal CI
+    image): lint.py loads the stdlib-only analysis modules by file path
+    when the glom_tpu package root (which imports jax) won't import."""
+    blocker = tmp_path / "jax"
+    blocker.mkdir()
+    (blocker / "__init__.py").write_text(
+        "raise ImportError('jax blocked: simulating a jax-less machine')\n")
+    env = dict(os.environ, PYTHONPATH=str(tmp_path))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         "--format", "json", "--baseline", "none",
+         "--rule", "conc-broad-except",
+         "--root", FIXTURES, os.path.join(BAD, "excepts.py")],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["summary"]["new_by_rule"] == {"conc-broad-except": 2}
+    # and --stats works too (exporters helpers loaded by file path)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         "--baseline", "none", "--stats", "--root", FIXTURES,
+         os.path.join(BAD, "excepts.py")],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert 'glomlint_findings_total{rule="conc-broad-except"} 2' \
+        in proc.stdout, proc.stdout + proc.stderr
+
+
+def test_cli_list_rules():
+    proc = _run_cli(["--list-rules"])
+    assert proc.returncode == 0
+    for rule, _, _ in RULE_FIXTURES:
+        assert rule in proc.stdout
+
+
+# -- the gate itself: the repo is clean modulo the committed baseline ------
+
+def test_self_lint_repo_clean_modulo_baseline():
+    """The acceptance bar: tools/lint.py exits 0 on the repo.  Every
+    suppression carries a reason (reasonless ones surface as
+    lint-bad-suppression findings and fail here), and only the committed
+    baseline absorbs what remains."""
+    result = run_rules([os.path.join(REPO, "glom_tpu"),
+                        os.path.join(REPO, "tools")], root=REPO)
+    budget = load_baseline(
+        os.path.join(REPO, "tools", "glomlint_baseline.json"))
+    new, _old = split_baseline(result.findings, budget)
+    assert not new, "new lint findings:\n" + "\n".join(
+        f"  {f.location}: {f.rule} {f.message}" for f in new)
+
+
+def test_self_lint_baseline_is_small_and_honest():
+    """The baseline is debt, not a landfill: it must stay tiny and every
+    entry must still correspond to a live finding (no stale entries)."""
+    budget = load_baseline(
+        os.path.join(REPO, "tools", "glomlint_baseline.json"))
+    assert sum(budget.values()) <= 10
+    result = run_rules([os.path.join(REPO, "glom_tpu"),
+                        os.path.join(REPO, "tools")], root=REPO)
+    _new, old = split_baseline(result.findings, budget)
+    assert len(old) == sum(budget.values()), (
+        "stale baseline entries — re-run tools/lint.py --write-baseline")

@@ -1,0 +1,233 @@
+"""glomlint CLI — run the project's static analysis as a gate.
+
+  python tools/lint.py                         # lint glom_tpu/ + tools/
+  python tools/lint.py --format json           # machine output (CI)
+  python tools/lint.py --rule conc-broad-except glom_tpu/serving
+  python tools/lint.py --write-baseline        # absorb current findings
+  python tools/lint.py --stats                 # Prometheus gauges
+
+Exit code is nonzero iff there are NON-BASELINED findings: the committed
+baseline (``tools/glomlint_baseline.json``) lets pre-existing debt ride
+without blocking, while anything new gates.  Suppressions
+(``# glomlint: disable=RULE -- reason``) must carry a reason or they are
+ignored AND reported.  ``--stats`` renders per-rule
+``glomlint_findings_total{rule=...}`` gauges in the same Prometheus
+exposition format ``glom_tpu/obs/exporters.py`` emits, so lint debt is
+trackable like any other metric (point a textfile collector at
+``--stats-file``).
+
+The engine is stdlib-``ast`` only: no accelerator, no model import, safe
+for CI and the tier-1 suite.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _import_analysis():
+    """The engine is stdlib-only, but ``glom_tpu/__init__.py`` imports
+    jax — on a jax-less machine (fresh venv, minimal CI image) load the
+    analysis package directly from its files, never executing the
+    package root."""
+    try:
+        from glom_tpu import analysis
+        return analysis
+    except ImportError:
+        import importlib.util
+        import types
+
+        if "glom_tpu" not in sys.modules:
+            stub = types.ModuleType("glom_tpu")
+            stub.__path__ = [os.path.join(_REPO, "glom_tpu")]
+            sys.modules["glom_tpu"] = stub
+        pkg_dir = os.path.join(_REPO, "glom_tpu", "analysis")
+        spec = importlib.util.spec_from_file_location(
+            "glom_tpu.analysis", os.path.join(pkg_dir, "__init__.py"),
+            submodule_search_locations=[pkg_dir])
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["glom_tpu.analysis"] = mod
+        spec.loader.exec_module(mod)
+        return mod
+
+
+_analysis = _import_analysis()
+analyze = _analysis.analyze
+default_rules = _analysis.default_rules
+load_baseline = _analysis.load_baseline
+split_baseline = _analysis.split_baseline
+write_baseline = _analysis.write_baseline
+
+DEFAULT_PATHS = ("glom_tpu", "tools")
+DEFAULT_BASELINE = os.path.join("tools", "glomlint_baseline.json")
+
+
+def _prom_helpers():
+    """obs/exporters' name sanitizer + float formatter; loaded by file
+    path on jax-less machines (the obs package root imports jax)."""
+    try:
+        from glom_tpu.obs.exporters import _prom_fmt, prom_name
+        return prom_name, _prom_fmt
+    except ImportError:
+        import importlib.util
+
+        path = os.path.join(_REPO, "glom_tpu", "obs", "exporters.py")
+        spec = importlib.util.spec_from_file_location(
+            "_glomlint_exporters", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.prom_name, mod._prom_fmt
+
+
+def stats_lines(by_rule, baselined: int, suppressed: int) -> str:
+    """Per-rule finding gauges in the exporters' Prometheus line format
+    (same name sanitizer + float formatting as obs/exporters.py)."""
+    prom_name, _prom_fmt = _prom_helpers()
+
+    name = prom_name("glomlint_findings_total", prefix="")
+    lines = [f"# HELP {name} static-analysis findings by rule "
+             f"(includes baselined)",
+             f"# TYPE {name} gauge"]
+    for rule, count in sorted(by_rule.items()):
+        lines.append(f'{name}{{rule="{rule}"}} {_prom_fmt(float(count))}')
+    for extra, val, help_ in (
+            ("glomlint_baselined_total", baselined,
+             "findings absorbed by the committed baseline"),
+            ("glomlint_suppressed_total", suppressed,
+             "findings suppressed inline with a reason")):
+        n = prom_name(extra, prefix="")
+        lines.append(f"# HELP {n} {help_}")
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {_prom_fmt(float(val))}")
+    return "\n".join(lines) + "\n"
+
+
+def run(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="lint.py", description="glomlint: project static analysis")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to lint (default: {DEFAULT_PATHS})")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline JSON (default {DEFAULT_BASELINE}; "
+                         f"'none' disables)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="absorb all current findings into the baseline "
+                         "file and exit 0")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this rule id (repeatable)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--root", default=_REPO,
+                    help="path findings are reported relative to")
+    ap.add_argument("--stats", action="store_true",
+                    help="print Prometheus-style per-rule gauges")
+    ap.add_argument("--stats-file", default=None,
+                    help="also write --stats output to this file "
+                         "(atomic; textfile-collector friendly)")
+    args = ap.parse_args(argv)
+
+    try:
+        rules = default_rules(args.rule)
+    except ValueError as e:
+        # a typo'd --rule must not exit 1 (which reads as "lint findings")
+        print(f"lint.py: {e}", file=sys.stderr)
+        return 2
+    if args.list_rules:
+        for r in sorted(rules, key=lambda r: r.name):
+            print(f"{r.name:26s} [{r.severity}] {r.description}")
+        return 0
+
+    paths = args.paths or [os.path.join(_REPO, p) for p in DEFAULT_PATHS]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"lint.py: path(s) do not exist: {missing}", file=sys.stderr)
+        return 2
+    result = analyze(paths, rules, root=args.root)
+    if result.files == 0:
+        # a gate that analyzed nothing must not report the repo clean
+        print(f"lint.py: no .py files under {paths}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline
+    if baseline_path is None:
+        baseline_path = os.path.join(_REPO, DEFAULT_BASELINE)
+    use_baseline = baseline_path != "none"
+
+    if args.write_baseline:
+        if not use_baseline:
+            print("--write-baseline needs a baseline path", file=sys.stderr)
+            return 2
+        if args.rule or args.paths:
+            # a filtered run sees only a slice of the findings; writing it
+            # out would silently drop every other baseline entry
+            print("--write-baseline requires a full run (no --rule, no "
+                  "explicit paths)", file=sys.stderr)
+            return 2
+        write_baseline(baseline_path, result.findings)
+        print(f"baseline: wrote {len(result.findings)} finding(s) to "
+              f"{baseline_path}")
+        return 0
+
+    budget = load_baseline(baseline_path) if use_baseline else {}
+    new, baselined = split_baseline(result.findings, budget)
+
+    by_rule_all = result.by_rule()
+    summary = {
+        "files": result.files,
+        "rules": sorted(r.name for r in rules),
+        "findings_total": len(result.findings),
+        "new": len(new),
+        "baselined": len(baselined),
+        "suppressed": len(result.suppressed),
+        "by_rule": by_rule_all,
+        "new_by_rule": _count_by_rule(new),
+        "status": "ok" if not new else "failing",
+    }
+
+    if args.format == "json":
+        print(json.dumps({
+            "summary": summary,
+            "findings": [f.to_dict() for f in new],
+            "baselined": [f.to_dict() for f in baselined],
+        }, indent=2))
+    else:
+        for f in new:
+            print(f"{f.location}: {f.rule} [{f.severity}] {f.message}")
+            if f.code:
+                print(f"    {f.code}")
+        print(f"glomlint: {result.files} files, {len(new)} new finding(s), "
+              f"{len(baselined)} baselined, {len(result.suppressed)} "
+              f"suppressed")
+        for rule, count in summary["new_by_rule"].items():
+            print(f"  {rule}: {count}")
+
+    if args.stats or args.stats_file:
+        text = stats_lines(by_rule_all, len(baselined),
+                           len(result.suppressed))
+        if args.stats:
+            sys.stdout.write(text)
+        if args.stats_file:
+            tmp = args.stats_file + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                fh.write(text)
+            os.replace(tmp, args.stats_file)
+
+    return 1 if new else 0
+
+
+def _count_by_rule(findings):
+    out = {}
+    for f in findings:
+        out[f.rule] = out.get(f.rule, 0) + 1
+    return dict(sorted(out.items()))
+
+
+if __name__ == "__main__":
+    sys.exit(run())

@@ -266,7 +266,7 @@ def _send(url, endpoint, body, n_images, timeout, results, t0,
                                     and echoed != request_id),
                        replica=replica_key(e.headers))
         return
-    except Exception:
+    except Exception:  # glomlint: disable=conc-broad-except -- recorded as an error sample; a load generator must keep offering load through any single-request failure
         results.record(error=True,
                        replica=url if multi_target else None)
         return
