@@ -9,8 +9,9 @@ from glom_tpu.analysis.engine import (  # noqa: F401
 )
 from glom_tpu.analysis.rules_concurrency import CONCURRENCY_RULES
 from glom_tpu.analysis.rules_jax import JAX_RULES
+from glom_tpu.analysis.rules_obs import OBS_RULES
 
-ALL_RULE_CLASSES = tuple(JAX_RULES) + tuple(CONCURRENCY_RULES)
+ALL_RULE_CLASSES = tuple(JAX_RULES) + tuple(CONCURRENCY_RULES) + tuple(OBS_RULES)
 
 
 def default_rules(names=None):

@@ -1,0 +1,75 @@
+"""glomlint observability rule pack.
+
+  * ``obs-debug-in-cache`` — the fleet-observatory boundary (PR 9): the
+    ``/debug/*`` pull plane (trace rings, forensics manifests, fleet
+    timeline) lives in the HTTP fronts and is POLLED by the collector;
+    ``serving/compile_cache.py`` is the request path's execute core,
+    where every millisecond is a served millisecond.  A debug-endpoint
+    reference or an HTTP client import appearing there means the data
+    plane grew a dependency on the observability plane — the exact
+    coupling the pull topology exists to forbid (a slow observer must
+    never be able to slow a request).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from glom_tpu.analysis.engine import Finding, ModuleContext, Rule, dotted_name
+
+_HTTP_CLIENT_ROOTS = {"urllib", "http", "requests", "socket"}
+
+
+class DebugPlaneInCacheRule(Rule):
+    name = "obs-debug-in-cache"
+    severity = "error"
+    description = ("/debug/* endpoint reference or HTTP client inside "
+                   "serving/compile_cache.py — the execute core must "
+                   "never touch the observability pull plane")
+
+    TARGET_BASENAME = "compile_cache.py"
+    SCOPE_DIR = "serving"
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        parts = ctx.relpath.split("/")
+        # component match, not substring (the request-path-compile rule's
+        # convention): only serving/compile_cache.py is in scope
+        if (self.SCOPE_DIR not in parts[:-1]
+                or parts[-1] != self.TARGET_BASENAME):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and node.value.startswith("/debug")):
+                findings.append(ctx.finding(
+                    self, node,
+                    f"debug-plane endpoint {node.value!r} referenced in "
+                    f"the execute core: /debug/* is pulled by the "
+                    f"observatory from the HTTP fronts, never from the "
+                    f"request path"))
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                mod = (node.module or "" if isinstance(node, ast.ImportFrom)
+                       else "")
+                roots = ([mod.split(".")[0]] if mod
+                         else [a.name.split(".")[0] for a in node.names])
+                for root in roots:
+                    if root in _HTTP_CLIENT_ROOTS:
+                        findings.append(ctx.finding(
+                            self, node,
+                            f"HTTP/network import {root!r} in the execute "
+                            f"core: network I/O (a /debug pull, a metrics "
+                            f"push) has no place on the request path"))
+            elif isinstance(node, ast.Call):
+                d = dotted_name(node.func)
+                if d and d.split(".")[0] in {"urllib", "requests"}:
+                    findings.append(ctx.finding(
+                        self, node,
+                        f"network call {d}(...) in the execute core: the "
+                        f"observability plane pulls; the data plane never "
+                        f"calls out"))
+        return findings
+
+
+OBS_RULES = (DebugPlaneInCacheRule,)

@@ -33,6 +33,13 @@ report through.  Four pieces, each usable on its own:
   * :mod:`glom_tpu.obs.slo` — declarative SLO targets with multi-window
     burn-rate evaluation, fired through the trigger engine (``slo_burn``)
     into forensics bundles naming the offending trace IDs.
+  * :mod:`glom_tpu.obs.observatory` — the fleet observatory: pulls every
+    replica's (and the router's) ``/debug/traces`` ring, stitches spans
+    across the hop into single cross-process traces, tail-samples them
+    (errors/SLO-violations/slow always kept), resolves histogram
+    exemplars to stored traces, and correlates ``slo_burn``/ejection
+    signals into ONE cross-replica incident bundle
+    (``tools/observatory.py`` is the CLI: serve / watch / report).
 
 ``training/metrics.py``'s ``MetricLogger`` is the facade the Trainer
 logs through; it fans records out to the configured exporters.
@@ -96,6 +103,14 @@ from glom_tpu.obs.forensics import (  # noqa: F401
     env_fingerprint,
     is_bundle_dir,
     write_bundle,
+)
+from glom_tpu.obs.observatory import (  # noqa: F401
+    FleetObservatory,
+    TailSampler,
+    critical_path,
+    make_observatory_server,
+    parse_exemplars,
+    stitch,
 )
 from glom_tpu.obs.perfgate import (  # noqa: F401
     GATE_FAIL,

@@ -125,6 +125,12 @@ class CsvExporter:
 
 
 _PROM_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+# exemplar ids rendered into the exposition must not be able to break the
+# line: X-Request-Id admits any printable ASCII, so an id like `ab"} 9`
+# would otherwise splice itself into the sample syntax and fail the whole
+# scrape.  Ids outside this safe set simply lose their exemplar link (the
+# metrics themselves must never be poisonable by one request header).
+_EXEMPLAR_ID_OK = re.compile(r"[A-Za-z0-9_.:/+=\-]{1,128}")
 
 
 def prom_name(name: str, prefix: str = "glom_") -> str:
@@ -137,8 +143,10 @@ def prom_name(name: str, prefix: str = "glom_") -> str:
 
 def registry_families(registry, prefix: str = "glom_"):
     """Flatten a :class:`~glom_tpu.obs.registry.MetricRegistry` into the
-    Prometheus family form ``(state, types, help)`` — sanitized metric name
-    to value, declared type, and help string.  The ONE registry->Prometheus
+    Prometheus family form ``(state, types, help, exemplars)`` — sanitized
+    metric name to value, declared type, help string, and per-bucket-line
+    exemplars (``{sample_name: (exemplar_id, value)}``, OpenMetrics
+    rendering is the renderer's choice).  The ONE registry->Prometheus
     mapping, shared by :class:`PrometheusTextfileExporter` (node-exporter
     textfile contract) and the serving subsystem's live ``/metrics``
     endpoint so the two outputs can never drift."""
@@ -147,6 +155,7 @@ def registry_families(registry, prefix: str = "glom_"):
     state: Dict[str, float] = {}
     types: Dict[str, str] = {}
     help_: Dict[str, str] = {}
+    exemplars: Dict[str, tuple] = {}
     for m in registry:
         hist = m.hist if isinstance(m, Timer) else m
         if isinstance(hist, Counter):
@@ -176,13 +185,20 @@ def registry_families(registry, prefix: str = "glom_"):
             types[base] = "histogram"
             if hist.help:
                 help_[base] = hist.help
+            hist_exemplars = hist.exemplars()
             for bound, cum in zip(hist.bucket_bounds,
                                   hist.bucket_cumulative()):
-                state[f'{base}_bucket{{le="{_prom_fmt(bound)}"}}'] = float(cum)
-            state[f'{base}_bucket{{le="+Inf"}}'] = float(hist.count)
+                sample = f'{base}_bucket{{le="{_prom_fmt(bound)}"}}'
+                state[sample] = float(cum)
+                if bound in hist_exemplars:
+                    exemplars[sample] = hist_exemplars[bound]
+            inf_sample = f'{base}_bucket{{le="+Inf"}}'
+            state[inf_sample] = float(hist.count)
+            if math.inf in hist_exemplars:
+                exemplars[inf_sample] = hist_exemplars[math.inf]
             state[base + "_sum"] = hist.sum
             state[base + "_count"] = float(hist.count)
-    return state, types, help_
+    return state, types, help_, exemplars
 
 
 def _prom_fmt(v: float) -> str:
@@ -212,7 +228,9 @@ def _family_key(name: str, types: Dict[str, str]):
 
 
 def _prom_render(state: Dict[str, float], types: Dict[str, str],
-                 help_: Dict[str, str]) -> str:
+                 help_: Dict[str, str],
+                 exemplars: Optional[Dict[str, tuple]] = None,
+                 openmetrics: bool = False) -> str:
     keys = {name: _family_key(name, types) for name in state}
     lines = []
     declared = set()
@@ -220,18 +238,127 @@ def _prom_render(state: Dict[str, float], types: Dict[str, str],
         family = keys[name][0]
         if family not in declared:
             declared.add(family)
+            # OpenMetrics reserves the `_total` suffix for counter SAMPLE
+            # names: the metric family is declared without it (a strict
+            # parser rejects `# TYPE x_total counter` + sample `x_total`)
+            declared_as = family
+            if (openmetrics and types.get(family) == "counter"
+                    and family.endswith("_total")):
+                declared_as = family[: -len("_total")]
             if family in help_:
-                lines.append(f"# HELP {family} {help_[family]}")
-            lines.append(f"# TYPE {family} {types.get(family, 'gauge')}")
-        lines.append(f"{name} {_prom_fmt(state[name])}")
+                lines.append(f"# HELP {declared_as} {help_[family]}")
+            lines.append(f"# TYPE {declared_as} {types.get(family, 'gauge')}")
+        line = f"{name} {_prom_fmt(state[name])}"
+        if exemplars and name in exemplars:
+            # OpenMetrics exemplar syntax: `<sample> # {labels} <value>` —
+            # the per-bucket link from a latency histogram to the trace id
+            # that landed there (resolved by the fleet observatory into the
+            # stored stitched trace).  Ids that could splice the line
+            # (quotes, braces, spaces — any client-supplied X-Request-Id
+            # reaches here) are dropped, not escaped: a malformed scrape
+            # costs every metric, a missing exemplar costs one link.
+            ex_id, ex_val = exemplars[name]
+            if _EXEMPLAR_ID_OK.fullmatch(str(ex_id)):
+                line += (f' # {{trace_id="{ex_id}"}} '
+                         f'{_prom_fmt(float(ex_val))}')
+        lines.append(line)
     return "\n".join(lines) + "\n"
 
 
-def prometheus_lines(registry, prefix: str = "glom_") -> str:
+#: content types a /metrics endpoint serves: exemplars are ONLY legal in
+#: OpenMetrics — a classic text-format (0.0.4) parser reads the exemplar
+#: suffix as a malformed timestamp and discards the whole scrape, so the
+#: endpoint must negotiate via the Accept header, never emit them blind
+PROM_TEXT_CONTENT_TYPE = "text/plain; version=0.0.4"
+OPENMETRICS_CONTENT_TYPE = "application/openmetrics-text; version=1.0.0"
+
+
+def wants_openmetrics(accept_header) -> bool:
+    """Did the scraper's ``Accept`` header opt into OpenMetrics (and with
+    it, exemplars)?"""
+    return bool(accept_header) and "application/openmetrics-text" in accept_header
+
+
+def prometheus_lines(registry, prefix: str = "glom_",
+                     exemplars: bool = False) -> str:
     """Render the registry's CURRENT state in Prometheus exposition format
     (the live-scrape companion to :class:`PrometheusTextfileExporter` —
-    same families, no file)."""
-    return _prom_render(*registry_families(registry, prefix))
+    same families, no file).  ``exemplars=True`` renders the OpenMetrics
+    dialect: ``# {trace_id="..."}`` exemplars on histogram bucket lines
+    and spec counter-family naming — pass it ONLY when the response is
+    served as ``OPENMETRICS_CONTENT_TYPE`` with a trailing ``# EOF``
+    (see :func:`wants_openmetrics`); the classic text format has no
+    exemplar syntax and a 0.0.4 parser rejects the whole scrape on the
+    first annotated line."""
+    state, types, help_, ex = registry_families(registry, prefix)
+    return _prom_render(state, types, help_, ex if exemplars else None,
+                        openmetrics=exemplars)
+
+
+def regroup_families(text: str) -> str:
+    """Regroup a concatenated exposition text (several sources' families,
+    possibly interleaved — the router's aggregate) so every family's
+    metadata and samples are contiguous, which OpenMetrics requires and a
+    strict parser enforces.  HELP/TYPE lines register their family;
+    sample lines join their family by name (histogram ``_bucket``/
+    ``_sum``/``_count`` suffixes fold onto the declared base; an
+    OpenMetrics-stripped counter TYPE group sits directly before its
+    ``_total`` sample group by first-seen adjacency).  Non-metadata
+    comments are dropped — OpenMetrics has no free-form comments."""
+    types: Dict[str, str] = {}
+    order: list = []
+    meta: Dict[str, list] = {}
+    samples: Dict[str, list] = {}
+
+    def group(key):
+        if key not in meta:
+            order.append(key)
+            meta[key] = []
+            samples[key] = []
+        return key
+
+    for line in text.splitlines():
+        if not line.strip() or line.strip() == "# EOF":
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] in ("HELP", "TYPE", "UNIT"):
+                fam = parts[2]
+                if parts[1] == "TYPE" and len(parts) >= 4:
+                    types[fam] = parts[3].strip()
+                if line not in meta.get(fam, ()):  # dedupe across sources
+                    meta[group(fam)].append(line)
+            continue  # free-form comment: invalid in OpenMetrics, drop
+        m = _SAMPLE_RE_EXPORT.match(line)
+        if not m:
+            continue
+        name = m.group(1)
+        fam = name
+        base = None
+        if name.endswith("_bucket"):
+            base = name[: -len("_bucket")]
+        else:
+            for suffix in ("_sum", "_count"):
+                if name.endswith(suffix):
+                    base = name[: -len(suffix)]
+        if base is not None and types.get(base) == "histogram":
+            fam = base
+        elif name.endswith("_total") and name[: -len("_total")] in types:
+            # counter declared under the OpenMetrics-stripped family name
+            fam = name[: -len("_total")]
+        samples[group(fam)].append(line)
+
+    out = []
+    for fam in order:
+        out.extend(meta[fam])
+        out.extend(samples[fam])
+    return "\n".join(out) + "\n"
+
+
+# one exposition sample line: name[{labels}] value [rest] (shared with
+# the router's relabeler, which keeps its own copy to stay import-light)
+_SAMPLE_RE_EXPORT = re.compile(
+    r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{[^}]*\})?( .+)$")
 
 
 class PrometheusTextfileExporter:
@@ -265,7 +392,11 @@ class PrometheusTextfileExporter:
             self._state[name] = float(v)
             self._types.setdefault(name, "gauge")
         if registry is not None:
-            state, types, help_ = registry_families(registry, self.prefix)
+            # exemplars deliberately dropped: the textfile collector is
+            # parsed as PLAIN Prometheus text, where an exemplar suffix is
+            # a syntax error — the live /metrics endpoint carries them
+            state, types, help_, _exemplars = registry_families(
+                registry, self.prefix)
             self._state.update(state)
             self._types.update(types)
             self._help.update(help_)

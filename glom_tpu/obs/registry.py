@@ -17,7 +17,8 @@ from __future__ import annotations
 import bisect
 import math
 import threading
-from typing import Dict, List, Optional
+import warnings
+from typing import Dict, List, Optional, Tuple
 
 # -- event vocabulary (the `event` field of JSONL records) ----------------
 EVENT_RESUME = "resume"              # checkpoint auto-resume at fit start
@@ -86,8 +87,12 @@ class Histogram:
         self.bucket_bounds = tuple(sorted(float(b) for b in buckets))
         # per-bin counts (NOT cumulative; exporters cumsum at render time)
         self._bucket_counts = [0] * len(self.bucket_bounds)
+        # newest exemplar per bucket: bin index -> (exemplar_id, value).
+        # Bounded by the fixed ladder (one slot per bin + one for +Inf), so
+        # exemplar retention can never grow with traffic.
+        self._exemplars: Dict[int, Tuple[str, float]] = {}
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
         value = float(value)
         self.count += 1
         self.sum += value
@@ -97,6 +102,11 @@ class Histogram:
         if i < len(self._bucket_counts):
             self._bucket_counts[i] += 1
         # values past the last bound live only in the implicit +Inf bucket
+        if exemplar is not None:
+            # one slot per bucket, newest wins: "show me a trace that
+            # landed in this latency bucket" always answers with a trace
+            # the sink plausibly still retains
+            self._exemplars[i] = (str(exemplar), value)
         if len(self._reservoir) < self._cap:
             self._reservoir.append(value)
         else:
@@ -104,6 +114,22 @@ class Histogram:
             # reservoir always reflects a recent window (no RNG in the
             # logging path)
             self._reservoir[self.count % self._cap] = value
+
+    def exemplars(self) -> Dict[float, Tuple[str, float]]:
+        """Newest exemplar per bucket, keyed by the bucket's ``le`` bound
+        (``math.inf`` for the implicit +Inf bucket): ``{le: (exemplar_id,
+        observed_value)}``.  The exemplar id is a trace id when fed by
+        :class:`~glom_tpu.obs.tracing.Tracer` — the link a scrape follows
+        from a p99 bucket to the request behind it."""
+        out: Dict[float, Tuple[str, float]] = {}
+        # snapshot first: a request thread's observe() can insert a
+        # bucket's FIRST exemplar while a /metrics scrape iterates here —
+        # dict growth during iteration raises RuntimeError mid-scrape
+        for i, ex in list(self._exemplars.items()):
+            bound = (self.bucket_bounds[i] if i < len(self.bucket_bounds)
+                     else math.inf)
+            out[bound] = ex
+        return out
 
     def bucket_cumulative(self) -> List[int]:
         """Cumulative count at each bound (the ``le`` semantics); the
@@ -162,9 +188,60 @@ class MetricRegistry:
     Individual metric updates stay unlocked (GIL-atomic enough for
     telemetry; a lock per ``observe`` would tax the hot path)."""
 
-    def __init__(self):
+    #: distinct label values one dynamic family may mint before collapsing
+    #: to ``__other__`` (see :meth:`labeled`)
+    DEFAULT_MAX_LABEL_VALUES = 64
+
+    def __init__(self, max_label_values: int = DEFAULT_MAX_LABEL_VALUES):
+        if max_label_values < 1:
+            raise ValueError(
+                f"max_label_values must be >= 1, got {max_label_values}"
+            )
         self._metrics: Dict[str, object] = {}
         self._lock = threading.Lock()
+        self.max_label_values = max_label_values
+        # family -> distinct label values seen (bounded at the cap; the
+        # collapsed __other__ name is not counted against it)
+        self._label_values: Dict[str, set] = {}
+        self._label_warned: set = set()
+
+    # -- cardinality guard -------------------------------------------------
+    OVERFLOW_LABEL = "__other__"
+
+    def labeled(self, family: str, value) -> str:
+        """Bound a dynamic metric family's cardinality: returns the derived
+        metric name ``<family><value>`` while the family has minted fewer
+        than ``max_label_values`` distinct values, and the one collapsed
+        name ``<family>__other__`` afterwards (with a one-time warning per
+        family and a ``registry_cardinality_overflows_total`` count per
+        collapsed observation).  Every dynamic-suffix site — per-bucket
+        span histograms, per-replica fleet gauges — must mint names
+        through here, so a misbehaving label (a bucketless fallback batch
+        size, a replica name echoed from config) can no longer grow
+        ``/metrics`` without bound."""
+        value = str(value)
+        with self._lock:
+            seen = self._label_values.setdefault(family, set())
+            if value in seen:
+                return family + value
+            if len(seen) < self.max_label_values:
+                seen.add(value)
+                return family + value
+            warn = family not in self._label_warned
+            self._label_warned.add(family)
+        # the counter takes the registry lock itself — inc it outside
+        self.counter(
+            "registry_cardinality_overflows_total",
+            help="labeled-metric observations collapsed to __other__ "
+                 "(a family hit max_label_values)",
+        ).inc()
+        if warn:
+            warnings.warn(
+                f"metric family {family!r} reached {self.max_label_values} "
+                f"distinct label values; further values collapse to "
+                f"{family}{self.OVERFLOW_LABEL}", stacklevel=2,
+            )
+        return family + self.OVERFLOW_LABEL
 
     def _get(self, name: str, cls, **kwargs):
         with self._lock:

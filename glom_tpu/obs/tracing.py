@@ -250,7 +250,10 @@ class Tracer:
 
     def __init__(self, clock: Optional[Callable[[], float]] = None,
                  sink: Optional[TraceSink] = None, registry=None,
-                 exporter=None):
+                 exporter=None, completed_max: int = 256):
+        if completed_max < 1:
+            raise ValueError(
+                f"completed_max must be >= 1, got {completed_max}")
         self.clock = clock if clock is not None else time.monotonic
         self.sink = sink if sink is not None else TraceSink()
         self.registry = registry
@@ -258,6 +261,14 @@ class Tracer:
         # root spans end on whichever thread served the request; the
         # JSONL exporter underneath is not internally locked
         self._emit_lock = threading.Lock()
+        # bounded ring of COMPLETED trace records (the per-trace JSONL
+        # shape), each tagged with a monotone sequence number so a puller
+        # (/debug/traces -> the fleet observatory) reads incrementally:
+        # "give me everything since cursor N" costs one list slice, and a
+        # slow puller loses the oldest records, never the newest
+        self._completed: "OrderedDict[int, Dict[str, Any]]" = OrderedDict()
+        self._completed_max = completed_max
+        self._completed_seq = 0
 
     # -- span lifecycle ----------------------------------------------------
     def start_trace(self, name: str, trace_id: Optional[str] = None,
@@ -291,7 +302,9 @@ class Tracer:
         if attrs:
             span.attrs.update(attrs)
         self._observe(span)
-        if span.root and self.exporter is not None:
+        if span.root:
+            # always build the completed record (the /debug/traces pull
+            # ring wants it even with no JSONL exporter attached)
             self.emit_trace(span.trace_id)
         return span
 
@@ -335,20 +348,28 @@ class Tracer:
         metric = SPAN_METRICS.get(span.name)
         if metric is None:
             return
+        # each observation carries its trace id as the bucket exemplar:
+        # the /metrics scrape then links a p99 bucket straight to a trace
+        # the sink (or the fleet observatory) can still resolve
         self.registry.histogram(
             metric, unit="ms", help=f"{span.name} span duration",
-        ).observe(span.duration_ms)
+        ).observe(span.duration_ms, exemplar=span.trace_id)
         bucket = span.attrs.get("bucket")
         if span.name == SPAN_EXECUTE and bucket is not None:
+            # per-bucket family minted through the cardinality guard: a
+            # bucketless fallback path labeling raw batch sizes would
+            # otherwise grow one histogram per distinct size
+            name = self.registry.labeled(f"{metric}_b", int(bucket))
             self.registry.histogram(
-                f"{metric}_b{int(bucket)}", unit="ms",
+                name, unit="ms",
                 help=f"{span.name} span duration, batch bucket {int(bucket)}",
-            ).observe(span.duration_ms)
+            ).observe(span.duration_ms, exemplar=span.trace_id)
 
     def emit_trace(self, trace_id: str) -> Optional[Dict[str, Any]]:
         """Emit one per-trace JSONL record through the attached exporter
         (and return it): the whole trace, spans oldest-first — the feed
-        ``tools/trace_report.py`` reads."""
+        ``tools/trace_report.py`` reads.  The record also lands in the
+        bounded completed-trace ring served by ``/debug/traces``."""
         spans = self.sink.trace(trace_id)
         if not spans:
             return None
@@ -360,10 +381,43 @@ class Tracer:
             "duration_ms": root.duration_ms,
             "spans": [s.to_dict() for s in spans],
         }
-        if self.exporter is not None:
-            with self._emit_lock:
+        with self._emit_lock:
+            self._completed[self._completed_seq] = rec
+            self._completed_seq += 1
+            while len(self._completed) > self._completed_max:
+                self._completed.popitem(last=False)
+            if self.exporter is not None:
                 self.exporter.emit(rec)
         return rec
+
+    def completed_since(self, cursor: int = 0):
+        """Incremental pull of completed trace records: ``(next_cursor,
+        records)`` for every record with sequence >= ``cursor`` still in
+        the ring.  Feeding ``next_cursor`` back reads only what completed
+        since — the ``/debug/traces`` contract the fleet observatory polls
+        (a cursor older than the ring's tail silently skips the evicted
+        records; the puller was too slow for them either way)."""
+        with self._emit_lock:
+            records = [rec for seq, rec in self._completed.items()
+                       if seq >= cursor]
+            return self._completed_seq, records
+
+
+def debug_traces_payload(tracer: Tracer, query_string: str, **extra):
+    """The ONE ``GET /debug/traces`` handler body, shared by the engine
+    server and the router front so the two halves of the observatory's
+    pull protocol can never drift: parses ``since=<cursor>`` from the
+    query string and returns ``(status, payload)`` — 400 with an error
+    payload on a malformed cursor, else 200 with ``{**extra, "next":
+    cursor, "traces": [...]}``."""
+    from urllib.parse import parse_qs
+
+    try:
+        since = int((parse_qs(query_string).get("since") or ["0"])[0])
+    except ValueError:
+        return 400, {"error": "since must be an integer"}
+    next_cursor, traces = tracer.completed_since(since)
+    return 200, {**extra, "next": next_cursor, "traces": traces}
 
 
 # -- coverage (the acceptance math, shared with tools/trace_report.py) ----

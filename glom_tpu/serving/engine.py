@@ -929,6 +929,48 @@ class ServingEngine:
             self._slo.observe(endpoint, latency_ms, error,
                               trace_id=trace_id, step=self.request_count)
 
+    # -- debug plane (pulled by glom_tpu.obs.observatory) ------------------
+    def debug_forensics(self) -> dict:
+        """The ``/debug/forensics`` payload: this replica's bundle
+        manifests, registry snapshot, and recent SLO firings — the
+        evidence the fleet observatory correlates into ONE cross-replica
+        incident bundle.  Read-only and cheap: a directory listing plus
+        small JSON reads; never touches the request path."""
+        import json as _json
+        import os
+
+        from glom_tpu.obs.forensics import MANIFEST, is_bundle_dir
+
+        bundles = []
+        root = self._forensics.root if self._forensics is not None else None
+        if root and os.path.isdir(root):
+            for name in sorted(os.listdir(root)):
+                path = os.path.join(root, name)
+                if not is_bundle_dir(path):
+                    continue
+                try:
+                    with open(os.path.join(path, MANIFEST)) as f:
+                        manifest = _json.load(f)
+                except (OSError, ValueError):
+                    continue  # torn/mid-write manifest: next poll sees it
+                bundles.append({"name": name, "manifest": manifest})
+        # copy `fired` under the SLO lock: request threads append to the
+        # deque inside _slo.observe(), and iterating a deque concurrent
+        # with appends raises RuntimeError — precisely during the burn
+        # incident this endpoint exists to document
+        if self._slo is not None:
+            with self._slo_lock:
+                slo_fired = list(self._slo.fired)
+        else:
+            slo_fired = []
+        return {
+            "role": "engine",
+            "step": int(self.step),
+            "bundles": bundles,
+            "registry": self.registry.snapshot(),
+            "slo_fired": slo_fired,
+        }
+
     # -- health ------------------------------------------------------------
     def health(self) -> dict:
         """The ``/healthz`` payload: liveness plus the config a client

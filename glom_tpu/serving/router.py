@@ -48,7 +48,10 @@ and ``tools/trace_report.py`` shows the whole hop.  ``/metrics`` serves
 the router's own families plus every replica's families relabeled with
 ``replica="<name>"``; ``/healthz`` aggregates per-replica state and the
 model's input contract (``tools/loadgen.py`` reads the router exactly
-like a single engine).
+like a single engine).  ``/debug/traces`` (completed-trace ring) and
+``/debug/timeline`` (bounded ejection/re-admission/rollout event ring +
+rollout state-machine position) are the pull plane the fleet observatory
+(:mod:`glom_tpu.obs.observatory`) stitches and correlates.
 """
 
 from __future__ import annotations
@@ -62,17 +65,26 @@ import time
 import urllib.error
 import urllib.request
 import warnings
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from glom_tpu.obs import MetricRegistry
-from glom_tpu.obs.exporters import prometheus_lines
+from glom_tpu.obs.exporters import (
+    OPENMETRICS_CONTENT_TYPE,
+    PROM_TEXT_CONTENT_TYPE,
+    prometheus_lines,
+    wants_openmetrics,
+)
 from glom_tpu.obs.tracing import (
+    SPAN_PARSE,
     SPAN_PROXY,
+    SPAN_RESPOND,
     SPAN_ROUTE,
     SPAN_ROUTER_REQUEST,
     TraceSink,
     Tracer,
+    debug_traces_payload,
     format_traceparent,
     parse_traceparent,
     request_trace_id,
@@ -197,6 +209,19 @@ class FleetRouter:
         self._rollout_lock = threading.Lock()  # one rollout at a time
         self._rr = 0
         self.fleet_step: Optional[int] = None  # last coordinated commit
+        # -- event timeline (pulled via /debug/timeline) -------------------
+        # bounded ring of the fleet's state transitions — ejections,
+        # re-admissions, rollout phase outcomes — each with a monotone
+        # seq so the observatory reads incrementally and correlates them
+        # with replica-side forensics into one incident bundle.  Its own
+        # leaf lock: note_event never acquires another lock, so it is
+        # safely callable from under _lock or _rollout_lock.
+        self._timeline: "deque" = deque(maxlen=256)
+        self._timeline_lock = threading.Lock()
+        self._timeline_seq = 0
+        # coarse rollout-state-machine position for the fleet console
+        # (plain str store/load — no lock needed for a telemetry read)
+        self.rollout_phase = "idle"
         # the commit gate: cleared only for the (short) commit phase of a
         # rollout; handler threads wait on it before picking a replica
         self._dispatch_open = threading.Event()
@@ -223,6 +248,24 @@ class FleetRouter:
         )
         self._ring_keys = [h for h, _ in self._ring]
         self._gauge_replicas()
+
+    # -- event timeline -----------------------------------------------------
+    def note_event(self, kind: str, **fields) -> None:
+        """Append one fleet state transition to the bounded timeline
+        (``/debug/timeline``).  Leaf operation: takes only its own lock,
+        callable from anywhere including under the dispatch lock."""
+        with self._timeline_lock:
+            self._timeline.append({
+                "seq": self._timeline_seq,
+                "t": round(self._clock(), 6),
+                "event": kind,
+                **fields,
+            })
+            self._timeline_seq += 1
+
+    def timeline(self) -> List[dict]:
+        with self._timeline_lock:
+            return list(self._timeline)
 
     # -- metrics helpers ----------------------------------------------------
     def _gauge_replicas(self) -> None:
@@ -260,6 +303,8 @@ class FleetRouter:
                 help="replicas removed from dispatch after failures",
             ).inc()
             self._gauge_replicas()
+            self.note_event("ejection", replica=replica.name,
+                            fail_streak=replica.fail_streak)
         # backoff: probes of a persistently-dead replica stretch out
         # (doubling per failure past ejection, capped), so a downed box
         # costs one cheap probe per backoff window, not per interval
@@ -365,6 +410,8 @@ class FleetRouter:
                 help="ejected replicas restored to dispatch",
             ).inc()
             self._gauge_replicas()
+            self.note_event("readmission", replica=replica.name,
+                            step=replica.step)
 
     def _health_loop(self) -> None:
         while not self._stop.wait(self.health_interval_s):
@@ -526,206 +573,225 @@ class FleetRouter:
         """Roll the whole healthy fleet to one checkpoint step; see module
         docstring for the two-phase protocol.  Returns a report dict with
         ``status`` in {"noop", "no_replicas", "aborted", "committed",
-        "rolled_back"}."""
+        "rolled_back"}.  The rollout's state-machine position is published
+        as ``rollout_phase`` (healthz/console) and each outcome lands on
+        the event timeline."""
         with self._rollout_lock:
-            with self._lock:
-                fleet = [r for r in self.replicas if r.healthy]
-            if not fleet:
-                return {"status": "no_replicas"}
+            self.rollout_phase = "prepare"
+            try:
+                report = self._coordinated_reload_locked(step)
+            finally:
+                self.rollout_phase = "idle"
+        if report["status"] != "noop":
+            self.note_event(
+                "rollout_" + report["status"],
+                **{k: v for k, v in report.items()
+                   if k in ("step", "replica", "phase", "detail",
+                            "replicas")})
+        return report
 
-            # -- phase 1: stage the SAME step everywhere ------------------
-            # With no pinned step, DISCOVER the target first: walk the
-            # fleet until some replica stages something newer than what it
-            # serves.  One replica saying "nothing newer" is NOT a fleet
-            # noop — a replica started earlier may serve an older step,
-            # and the rollout is also the convergence mechanism for a
-            # mixed fleet: if nobody stages but serving steps disagree,
-            # the newest serving step becomes the target.
-            target = step
-            # the CONSERVATIVE pre-rollout version: the MINIMUM serving
-            # step seen in phase 1.  It is only used to pin fleet_step on
-            # a rolled-back rollout (so a suspect replica's re-admission
-            # catch-up can never be steered to the new target) — on a
-            # mixed fleet the first response's step could BE the target,
-            # which would defeat the pin entirely.
-            old_step: Optional[int] = None
+    def _coordinated_reload_locked(self, step: Optional[int] = None) -> dict:
+        with self._lock:
+            fleet = [r for r in self.replicas if r.healthy]
+        if not fleet:
+            return {"status": "no_replicas"}
 
-            def note_serving(resp) -> None:
-                nonlocal old_step
-                s = resp.get("serving_step")
-                if s is not None and (old_step is None or s < old_step):
-                    old_step = int(s)
+        # -- phase 1: stage the SAME step everywhere ------------------
+        # With no pinned step, DISCOVER the target first: walk the
+        # fleet until some replica stages something newer than what it
+        # serves.  One replica saying "nothing newer" is NOT a fleet
+        # noop — a replica started earlier may serve an older step,
+        # and the rollout is also the convergence mechanism for a
+        # mixed fleet: if nobody stages but serving steps disagree,
+        # the newest serving step becomes the target.
+        target = step
+        # the CONSERVATIVE pre-rollout version: the MINIMUM serving
+        # step seen in phase 1.  It is only used to pin fleet_step on
+        # a rolled-back rollout (so a suspect replica's re-admission
+        # catch-up can never be steered to the new target) — on a
+        # mixed fleet the first response's step could BE the target,
+        # which would defeat the pin entirely.
+        old_step: Optional[int] = None
 
-            prepared: List[Replica] = []
-            trivial: List[Replica] = []  # already serving the target
-            if target is None:
-                serving: Dict[str, Optional[int]] = {}
-                for replica in fleet:
-                    resp = self._admin(replica, "prepare", {})
-                    if resp is None:
-                        # the failed replica gets an abort too: a router-
-                        # side timeout with engine-side success would
-                        # strand a full staged param tree there
-                        self._abort(prepared + [replica])
-                        return {"status": "aborted", "phase": "prepare",
-                                "replica": replica.name,
-                                "detail": "prepare failed"}
-                    note_serving(resp)
-                    serving[replica.name] = resp.get("serving_step")
-                    staged = resp.get("staged_step")
-                    if staged is not None:
-                        target = int(staged)
-                        prepared.append(replica)
-                        break  # pin the rest to this step below
-                if target is None:
-                    distinct = {v for v in serving.values()}
-                    if len(distinct) <= 1:
-                        return {"status": "noop",
-                                "step": next(iter(distinct), None)}
-                    target = max(v for v in distinct if v is not None)
+        def note_serving(resp) -> None:
+            nonlocal old_step
+            s = resp.get("serving_step")
+            if s is not None and (old_step is None or s < old_step):
+                old_step = int(s)
 
+        prepared: List[Replica] = []
+        trivial: List[Replica] = []  # already serving the target
+        if target is None:
+            serving: Dict[str, Optional[int]] = {}
             for replica in fleet:
-                if replica in prepared:
-                    continue
-                resp = self._admin(replica, "prepare", {"step": target})
+                resp = self._admin(replica, "prepare", {})
                 if resp is None:
+                    # the failed replica gets an abort too: a router-
+                    # side timeout with engine-side success would
+                    # strand a full staged param tree there
                     self._abort(prepared + [replica])
                     return {"status": "aborted", "phase": "prepare",
                             "replica": replica.name,
                             "detail": "prepare failed"}
                 note_serving(resp)
+                serving[replica.name] = resp.get("serving_step")
                 staged = resp.get("staged_step")
-                if staged is None:
-                    if resp.get("serving_step") == target:
-                        trivial.append(replica)
-                        continue
-                    self._abort(prepared + [replica])
-                    return {"status": "aborted", "phase": "prepare",
-                            "replica": replica.name,
-                            "detail": f"could not stage step {target}"}
-                if int(staged) != target:
-                    self._abort(prepared + [replica])
-                    return {"status": "aborted", "phase": "prepare",
-                            "replica": replica.name,
-                            "detail": f"staged {staged} != target {target}"}
-                prepared.append(replica)
-            if not prepared and not trivial:
-                return {"status": "noop", "step": target}
+                if staged is not None:
+                    target = int(staged)
+                    prepared.append(replica)
+                    break  # pin the rest to this step below
+            if target is None:
+                distinct = {v for v in serving.values()}
+                if len(distinct) <= 1:
+                    return {"status": "noop",
+                            "step": next(iter(distinct), None)}
+                target = max(v for v in distinct if v is not None)
 
-            # -- phase 2: gate dispatch, drain, commit everywhere ---------
-            # the gate closes UNDER the dispatch lock: _pick_locked checks
-            # it in the same critical section that increments inflight, so
-            # once clear() returns, every admitted request is visible to
-            # the drain below and every unadmitted one re-waits
-            with self._lock:
-                self._dispatch_open.clear()
-            try:
-                # drain in-flight work before the first commit: a response
-                # computed DURING the commit window would expose a half-
-                # committed fleet — or, worse, a dirty read of the new
-                # step that a later rollback retracts.  With the gate
-                # closed and in-flight at zero, every response completes
-                # strictly before (all-old) or strictly after (all-new,
-                # or all-old on rollback) the swap.
-                drain_deadline = self._clock() + self.drain_timeout_s
-                while True:
-                    with self._lock:
-                        if all(r.inflight == 0 for r in self.replicas):
-                            break
-                    if self._clock() >= drain_deadline:
-                        # proceeding with stragglers in flight weakens the
-                        # ordering guarantee for exactly those requests —
-                        # never silently: the counter + warning make an
-                        # undersized drain_timeout_s visible
-                        self.registry.counter(
-                            "router_drain_timeouts_total",
-                            help="rollouts that committed with requests "
-                                 "still in flight (drain deadline hit)",
-                        ).inc()
-                        warnings.warn(
-                            f"rollout drain did not reach zero in-flight "
-                            f"within {self.drain_timeout_s}s; committing "
-                            f"anyway — in-flight responses may interleave "
-                            f"with the version flip", stacklevel=2,
-                        )
-                        break
-                    self._sleep(0.005)
-                committed: List[Replica] = []
-                for replica in prepared:
-                    resp = self._admin(replica, "commit",
-                                       timeout=self.commit_timeout_s)
-                    if resp is None or resp.get("step") != target:
-                        # roll the fleet back BEFORE the gate reopens: no
-                        # post-gate dispatch may ever see the new step.
-                        # The failed replica gets an abort too — an HTTP-
-                        # level commit failure may have left it staged.
-                        for done in committed:
-                            if self._admin(done, "rollback",
-                                           timeout=self.commit_timeout_s
-                                           ) is None:
-                                # the rollback itself failed: this replica
-                                # may still serve the NEW step in a fleet
-                                # that reverted — eject it; re-admission
-                                # catch-up (fleet_step pinned below) rolls
-                                # it back before it takes traffic again
-                                with self._lock:
-                                    done.fail_streak = max(
-                                        done.fail_streak,
-                                        self.eject_after - 1)
-                                    self._note_failure(done)
-                                self.registry.counter(
-                                    "router_rollback_failures_total",
-                                    help="replicas whose rollback call "
-                                         "failed (ejected until catch-up)",
-                                ).inc()
-                        self._abort([r for r in prepared
-                                     if r not in committed])
-                        # the failed replica may have committed server-side
-                        # with the response lost: eject it, and pin the
-                        # fleet step to the OLD version so re-admission
-                        # catch-up forces it back into agreement before it
-                        # takes traffic again
-                        with self._lock:
-                            replica.fail_streak = max(
-                                replica.fail_streak, self.eject_after - 1)
-                            self._note_failure(replica)
-                        if old_step is not None:
-                            self.fleet_step = int(old_step)
-                        self.registry.counter(
-                            "router_rollbacks_total",
-                            help="coordinated rollouts reverted after a "
-                                 "commit failure",
-                        ).inc()
-                        return {"status": "rolled_back",
-                                "replica": replica.name,
-                                "step": target,
-                                "detail": "commit failed; fleet reverted"}
-                    committed.append(replica)
-                self.fleet_step = target
+        for replica in fleet:
+            if replica in prepared:
+                continue
+            resp = self._admin(replica, "prepare", {"step": target})
+            if resp is None:
+                self._abort(prepared + [replica])
+                return {"status": "aborted", "phase": "prepare",
+                        "replica": replica.name,
+                        "detail": "prepare failed"}
+            note_serving(resp)
+            staged = resp.get("staged_step")
+            if staged is None:
+                if resp.get("serving_step") == target:
+                    trivial.append(replica)
+                    continue
+                self._abort(prepared + [replica])
+                return {"status": "aborted", "phase": "prepare",
+                        "replica": replica.name,
+                        "detail": f"could not stage step {target}"}
+            if int(staged) != target:
+                self._abort(prepared + [replica])
+                return {"status": "aborted", "phase": "prepare",
+                        "replica": replica.name,
+                        "detail": f"staged {staged} != target {target}"}
+            prepared.append(replica)
+        if not prepared and not trivial:
+            return {"status": "noop", "step": target}
+
+        # -- phase 2: gate dispatch, drain, commit everywhere ---------
+        # the gate closes UNDER the dispatch lock: _pick_locked checks
+        # it in the same critical section that increments inflight, so
+        # once clear() returns, every admitted request is visible to
+        # the drain below and every unadmitted one re-waits
+        with self._lock:
+            self._dispatch_open.clear()
+        self.rollout_phase = "drain"
+        try:
+            # drain in-flight work before the first commit: a response
+            # computed DURING the commit window would expose a half-
+            # committed fleet — or, worse, a dirty read of the new
+            # step that a later rollback retracts.  With the gate
+            # closed and in-flight at zero, every response completes
+            # strictly before (all-old) or strictly after (all-new,
+            # or all-old on rollback) the swap.
+            drain_deadline = self._clock() + self.drain_timeout_s
+            while True:
                 with self._lock:
-                    for replica in prepared + trivial:
-                        replica.step = target
-                self.registry.counter(
-                    "router_rollouts_total",
-                    help="coordinated fleet reloads committed",
-                ).inc()
-                self.registry.gauge(
-                    "router_fleet_step",
-                    help="checkpoint step the fleet serves",
-                ).set(target)
-            finally:
-                self._dispatch_open.set()
-            # the rollout landed everywhere: release each replica's
-            # rollback point (a full second device param tree) AFTER the
-            # gate reopened — memory hygiene must not extend the gated
-            # window, and the rollback window is over by definition here.
-            # A failed finalize only delays the release to the next
-            # rollout; never worth failing the rollout over.
+                    if all(r.inflight == 0 for r in self.replicas):
+                        break
+                if self._clock() >= drain_deadline:
+                    # proceeding with stragglers in flight weakens the
+                    # ordering guarantee for exactly those requests —
+                    # never silently: the counter + warning make an
+                    # undersized drain_timeout_s visible
+                    self.registry.counter(
+                        "router_drain_timeouts_total",
+                        help="rollouts that committed with requests "
+                             "still in flight (drain deadline hit)",
+                    ).inc()
+                    warnings.warn(
+                        f"rollout drain did not reach zero in-flight "
+                        f"within {self.drain_timeout_s}s; committing "
+                        f"anyway — in-flight responses may interleave "
+                        f"with the version flip", stacklevel=2,
+                    )
+                    self.note_event("drain_timeout")
+                    break
+                self._sleep(0.005)
+            self.rollout_phase = "commit"
+            committed: List[Replica] = []
             for replica in prepared:
-                self._admin(replica, "finalize",
-                            timeout=self.commit_timeout_s)
-            return {"status": "committed", "step": target,
-                    "replicas": [r.name for r in prepared + trivial]}
+                resp = self._admin(replica, "commit",
+                                   timeout=self.commit_timeout_s)
+                if resp is None or resp.get("step") != target:
+                    # roll the fleet back BEFORE the gate reopens: no
+                    # post-gate dispatch may ever see the new step.
+                    # The failed replica gets an abort too — an HTTP-
+                    # level commit failure may have left it staged.
+                    for done in committed:
+                        if self._admin(done, "rollback",
+                                       timeout=self.commit_timeout_s
+                                       ) is None:
+                            # the rollback itself failed: this replica
+                            # may still serve the NEW step in a fleet
+                            # that reverted — eject it; re-admission
+                            # catch-up (fleet_step pinned below) rolls
+                            # it back before it takes traffic again
+                            with self._lock:
+                                done.fail_streak = max(
+                                    done.fail_streak,
+                                    self.eject_after - 1)
+                                self._note_failure(done)
+                            self.registry.counter(
+                                "router_rollback_failures_total",
+                                help="replicas whose rollback call "
+                                     "failed (ejected until catch-up)",
+                            ).inc()
+                    self._abort([r for r in prepared
+                                 if r not in committed])
+                    # the failed replica may have committed server-side
+                    # with the response lost: eject it, and pin the
+                    # fleet step to the OLD version so re-admission
+                    # catch-up forces it back into agreement before it
+                    # takes traffic again
+                    with self._lock:
+                        replica.fail_streak = max(
+                            replica.fail_streak, self.eject_after - 1)
+                        self._note_failure(replica)
+                    if old_step is not None:
+                        self.fleet_step = int(old_step)
+                    self.registry.counter(
+                        "router_rollbacks_total",
+                        help="coordinated rollouts reverted after a "
+                             "commit failure",
+                    ).inc()
+                    return {"status": "rolled_back",
+                            "replica": replica.name,
+                            "step": target,
+                            "detail": "commit failed; fleet reverted"}
+                committed.append(replica)
+            self.fleet_step = target
+            with self._lock:
+                for replica in prepared + trivial:
+                    replica.step = target
+            self.registry.counter(
+                "router_rollouts_total",
+                help="coordinated fleet reloads committed",
+            ).inc()
+            self.registry.gauge(
+                "router_fleet_step",
+                help="checkpoint step the fleet serves",
+            ).set(target)
+        finally:
+            self._dispatch_open.set()
+        # the rollout landed everywhere: release each replica's
+        # rollback point (a full second device param tree) AFTER the
+        # gate reopened — memory hygiene must not extend the gated
+        # window, and the rollback window is over by definition here.
+        # A failed finalize only delays the release to the next
+        # rollout; never worth failing the rollout over.
+        for replica in prepared:
+            self._admin(replica, "finalize",
+                        timeout=self.commit_timeout_s)
+        return {"status": "committed", "step": target,
+                "replicas": [r.name for r in prepared + trivial]}
 
     def _abort(self, prepared: Sequence[Replica]) -> None:
         for replica in prepared:
@@ -761,6 +827,7 @@ class FleetRouter:
             "role": "router",
             "healthy_replicas": n,
             "fleet_step": self.fleet_step,
+            "rollout_phase": self.rollout_phase,
             "replicas": replicas,
         }
         if model:
@@ -772,21 +839,27 @@ class FleetRouter:
                     out[key] = model[key]
         return out
 
-    def metrics_text(self) -> str:
+    def metrics_text(self, *, openmetrics: bool = False) -> str:
         """Router families verbatim + every reachable replica's families
         relabeled with ``replica="<name>"`` (HELP/TYPE deduped across
         replicas — Prometheus rejects repeated metadata).  Replica
         scrapes run CONCURRENTLY: serial fetches would stack one
         ``health_timeout_s`` per blackholed replica and blow a typical
-        Prometheus scrape_timeout exactly when replicas are unhealthy."""
+        Prometheus scrape_timeout exactly when replicas are unhealthy.
+        ``openmetrics=True`` (the front negotiated it via Accept)
+        forwards the negotiation to each replica scrape and renders the
+        router's own exemplars; a plain 0.0.4 client gets (and causes the
+        replicas to emit) exemplar-free text."""
         from concurrent.futures import ThreadPoolExecutor
 
         replicas = list(self.replicas)
+        fetch_headers = ({"Accept": OPENMETRICS_CONTENT_TYPE}
+                         if openmetrics else {})
 
         def fetch(replica):
             try:
                 return self._http("GET", f"{replica.url}/metrics", None,
-                                  {}, self.health_timeout_s)
+                                  fetch_headers, self.health_timeout_s)
             except Exception:  # glomlint: disable=conc-broad-except -- a dead replica's scrape is skipped from the aggregate; ejecting it is the health loop's job, not the scrape's
                 return None
 
@@ -795,7 +868,7 @@ class FleetRouter:
         ) as pool:
             fetched = list(pool.map(fetch, replicas))
 
-        parts = [prometheus_lines(self.registry)]
+        parts = [prometheus_lines(self.registry, exemplars=openmetrics)]
         seen_meta = set()
         for replica, result in zip(replicas, fetched):
             if result is None:
@@ -809,7 +882,8 @@ class FleetRouter:
             out = []
             for line in body.decode(errors="replace").splitlines():
                 if line.startswith("#"):
-                    if line not in seen_meta:
+                    # replica EOF terminators must not land mid-aggregate
+                    if line.strip() != "# EOF" and line not in seen_meta:
                         seen_meta.add(line)
                         out.append(line)
                     continue
@@ -822,7 +896,16 @@ class FleetRouter:
                     f",{inner}" if inner else "")
                 out.append(f"{name}{{{label}}}{rest}")
             parts.append("\n".join(out) + "\n")
-        return "".join(parts)
+        text = "".join(parts)
+        if openmetrics:
+            # strict OpenMetrics forbids interleaved metric families: the
+            # per-replica blocks repeat family names (and the router now
+            # shares serving-span families with its replicas), so the
+            # aggregate is regrouped family-contiguous and terminated
+            from glom_tpu.obs.exporters import regroup_families
+
+            text = regroup_families(text) + "# EOF\n"
+        return text
 
     # -- lifecycle ----------------------------------------------------------
     def start(self, *, health: bool = True) -> None:
@@ -910,11 +993,29 @@ class _RouterHandler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802
         self._request_id = None
         router = self.server.router
-        if self.path == "/healthz":
+        from urllib.parse import urlparse
+
+        parsed = urlparse(self.path)
+        if parsed.path == "/healthz":
             self._reply(200, router.health())
-        elif self.path == "/metrics":
-            self._reply(200, router.metrics_text(),
-                        content_type="text/plain; version=0.0.4")
+        elif parsed.path == "/metrics":
+            # see server.py: exemplars only under negotiated OpenMetrics
+            om = wants_openmetrics(self.headers.get("Accept"))
+            self._reply(200, router.metrics_text(openmetrics=om),
+                        content_type=(OPENMETRICS_CONTENT_TYPE if om
+                                      else PROM_TEXT_CONTENT_TYPE))
+        # -- debug plane: the fleet observatory's pull endpoints -----------
+        elif parsed.path == "/debug/traces":
+            status, payload = debug_traces_payload(
+                router.tracer, parsed.query, role="router")
+            self._reply(status, payload)
+        elif parsed.path == "/debug/timeline":
+            self._reply(200, {
+                "role": "router",
+                "fleet_step": router.fleet_step,
+                "rollout_phase": router.rollout_phase,
+                "events": router.timeline(),
+            })
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 
@@ -962,6 +1063,14 @@ class _RouterHandler(BaseHTTPRequestHandler):
             tracer.end(root, attrs={"status": 400})
             return
         body = self.rfile.read(length)
+        # tile the router handler exactly like the engine handler: parse
+        # (headers + body read) and respond (reply write) recorded with
+        # SHARED edges around the dispatch window, so the stitched trace's
+        # coverage has no router-side instrumentation gap — the reply
+        # write scales with the response body and was the uncovered tail
+        # that dragged big-batch traces under the coverage bar
+        t_read = tracer.clock()
+        tracer.record(SPAN_PARSE, root, root.start, t_read)
         fwd = {"Content-Type": self.headers.get("Content-Type",
                                                 "application/json")}
         if rid_header:
@@ -980,9 +1089,13 @@ class _RouterHandler(BaseHTTPRequestHandler):
         router.registry.counter(
             "router_requests_total", help="requests proxied to replicas",
         ).inc()
+        t_done = tracer.clock()
         self._reply(status, resp_body,
                     extra_headers={"X-Served-By": replica.name})
-        tracer.end(root, attrs={"status": status, "replica": replica.name})
+        t_end = tracer.clock()
+        tracer.record(SPAN_RESPOND, root, t_done, t_end)
+        tracer.end(root, attrs={"status": status, "replica": replica.name},
+                   at=t_end)
 
 
 def make_router_server(router: FleetRouter, host: str = "127.0.0.1",

@@ -12,7 +12,12 @@
     reads it to build valid payloads);
   * ``GET /metrics`` — the shared ``glom_tpu.obs`` registry in Prometheus
     exposition format (same families the trainer's textfile exporter
-    writes).
+    writes), with OpenMetrics trace-id exemplars on the latency bucket
+    lines;
+  * ``GET /debug/traces?since=N`` / ``GET /debug/forensics`` — the pull
+    plane the fleet observatory (:mod:`glom_tpu.obs.observatory`) polls:
+    the tracer's completed-trace ring (incremental by cursor) and this
+    replica's forensics bundle manifests + registry snapshot.
 
 ``ThreadingHTTPServer`` gives one handler thread per connection; handlers
 only parse JSON and park on the engine's future, so the thread count
@@ -42,12 +47,18 @@ from typing import Optional
 
 import numpy as np
 
-from glom_tpu.obs.exporters import prometheus_lines
+from glom_tpu.obs.exporters import (
+    OPENMETRICS_CONTENT_TYPE,
+    PROM_TEXT_CONTENT_TYPE,
+    prometheus_lines,
+    wants_openmetrics,
+)
 from glom_tpu.obs.tracing import (
     SPAN_DISPATCH_WAIT,
     SPAN_PARSE,
     SPAN_REQUEST,
     SPAN_RESPOND,
+    debug_traces_payload,
     format_traceparent,
     parse_traceparent,
     request_trace_id,
@@ -149,11 +160,35 @@ class _Handler(BaseHTTPRequestHandler):
         # a GET must not echo the PREVIOUS request's trace identity
         self._request_id = None
         engine = self.server.engine
-        if self.path == "/healthz":
+        from urllib.parse import urlparse
+
+        parsed = urlparse(self.path)
+        if parsed.path == "/healthz":
             self._reply(200, engine.health())
-        elif self.path == "/metrics":
-            self._reply(200, prometheus_lines(engine.registry),
-                        content_type="text/plain; version=0.0.4")
+        elif parsed.path == "/metrics":
+            # exemplars only under a NEGOTIATED OpenMetrics response: a
+            # classic 0.0.4 parser reads the exemplar suffix as a bad
+            # timestamp and rejects the ENTIRE scrape.  The OpenMetrics
+            # body must end with the spec's `# EOF` terminator or a
+            # strict parser rejects it as truncated.
+            om = wants_openmetrics(self.headers.get("Accept"))
+            body = prometheus_lines(engine.registry, exemplars=om)
+            if om:
+                body += "# EOF\n"
+            self._reply(200, body,
+                        content_type=(OPENMETRICS_CONTENT_TYPE if om
+                                      else PROM_TEXT_CONTENT_TYPE))
+        # -- debug plane: pulled by the fleet observatory ------------------
+        # (glom_tpu.obs.observatory).  Read-only, bounded, never on the
+        # request path: traces come from the tracer's completed ring,
+        # forensics from a directory listing.
+        elif parsed.path == "/debug/traces":
+            status, payload = debug_traces_payload(
+                engine.tracer, parsed.query,
+                role="engine", step=int(engine.step))
+            self._reply(status, payload)
+        elif parsed.path == "/debug/forensics":
+            self._reply(200, engine.debug_forensics())
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 

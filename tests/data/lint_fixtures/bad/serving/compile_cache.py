@@ -1,0 +1,17 @@
+"""Must-flag: the observability pull plane leaking into the execute core.
+
+The PR 9 boundary: /debug/* endpoints are POLLED by the fleet
+observatory from the HTTP fronts; the compile cache is the request
+path's execute core.  An HTTP client or a debug-endpoint reference here
+couples request latency to observer behavior.
+"""
+
+import urllib.request  # BAD: HTTP client import in the execute core
+
+DEBUG_TRACES = "/debug/traces"  # BAD: debug-plane endpoint reference
+
+
+def execute(compiled, params, img, collector_url):
+    out = compiled(params, img)
+    urllib.request.urlopen(collector_url + DEBUG_TRACES)  # BAD: calls out
+    return out

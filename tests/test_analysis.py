@@ -59,6 +59,8 @@ RULE_FIXTURES = [
     ("conc-raw-clock", "clocks.py", "clocks.py"),
     ("conc-thread-daemon", "threads.py", "threads.py"),
     ("conc-broad-except", "excepts.py", "excepts.py"),
+    ("obs-debug-in-cache", "serving/compile_cache.py",
+     "serving/compile_cache.py"),
 ]
 
 

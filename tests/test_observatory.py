@@ -1,0 +1,870 @@
+"""Fleet observatory tests (glom_tpu/obs/observatory.py, the /debug pull
+plane, exemplars, the cardinality guard, tools/observatory.py).
+
+Tier-1 (CPU): stitching/alignment and tail sampling run on synthetic
+segments with injectable clocks and rngs; the collector is driven against
+a FakeFleet (injected http) for deterministic incident correlation; the
+acceptance criteria — ONE stitched trace across the router hop at >= 95%
+coverage, exemplar -> stored stitched trace, slo_burn -> exactly one
+cross-replica incident bundle — run against a REAL router + two engines
+on ephemeral ports, plus the tools/observatory.py --smoke subprocess gate
+(the chaos.py pattern).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+from glom_tpu.obs.observatory import (
+    FleetObservatory,
+    TailSampler,
+    critical_path,
+    parse_exemplars,
+    stitch,
+)
+from glom_tpu.obs.registry import Histogram, MetricRegistry
+from glom_tpu.obs.tracing import Tracer
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, seconds):
+        self.t += seconds
+
+
+# ---------------------------------------------------------------------------
+# synthetic segments (router epoch ~1000s, engine epoch ~5s: the clocks
+# are deliberately incomparable, as two real processes' monotonics are)
+# ---------------------------------------------------------------------------
+def _router_segment(tid="t1", start=1000.0):
+    return {"trace_id": tid, "root": "router_request", "duration_ms": 100.0,
+            "spans": [
+                {"name": "router_request", "trace_id": tid, "span_id": "r1",
+                 "parent_id": None, "start": start, "end": start + 0.100,
+                 "duration_ms": 100.0, "root_span": True},
+                {"name": "route", "trace_id": tid, "span_id": "r2",
+                 "parent_id": "r1", "start": start, "end": start + 0.001,
+                 "duration_ms": 1.0},
+                {"name": "proxy", "trace_id": tid, "span_id": "r3",
+                 "parent_id": "r1", "start": start + 0.001,
+                 "end": start + 0.099, "duration_ms": 98.0,
+                 "attrs": {"replica": "r0"}},
+            ]}
+
+
+def _engine_segment(tid="t1", start=5.0, parent="r3"):
+    return {"trace_id": tid, "root": "request", "duration_ms": 96.0,
+            "spans": [
+                {"name": "request", "trace_id": tid, "span_id": "e1",
+                 "parent_id": parent, "start": start, "end": start + 0.096,
+                 "duration_ms": 96.0, "root_span": True},
+                {"name": "queue_wait", "trace_id": tid, "span_id": "e3",
+                 "parent_id": "e1", "start": start + 0.004,
+                 "end": start + 0.030, "duration_ms": 26.0},
+                {"name": "execute", "trace_id": tid, "span_id": "e4",
+                 "parent_id": "e1", "start": start + 0.030,
+                 "end": start + 0.090, "duration_ms": 60.0,
+                 "attrs": {"bucket": 4, "images": 3,
+                           "padding_waste": 0.25}},
+                {"name": "respond", "trace_id": tid, "span_id": "e5",
+                 "parent_id": "e1", "start": start + 0.090,
+                 "end": start + 0.096, "duration_ms": 6.0},
+                {"name": "parse", "trace_id": tid, "span_id": "e2",
+                 "parent_id": "e1", "start": start, "end": start + 0.004,
+                 "duration_ms": 4.0},
+            ]}
+
+
+class TestStitch:
+    def test_cross_process_join_aligns_clocks(self):
+        rec = stitch([("router", _router_segment()),
+                      ("replica0", _engine_segment())])
+        assert rec["root"] == "router_request"
+        assert rec["stitched"] is True
+        assert rec["sources"] == ["router", "replica0"]
+        # the engine segment landed INSIDE the proxy span on the router's
+        # clock, despite the wildly different monotonic epoch
+        by_name = {s["name"]: s for s in rec["spans"]}
+        proxy, req = by_name["proxy"], by_name["request"]
+        assert proxy["start"] <= req["start"] <= req["end"] <= proxy["end"]
+        assert rec["span_coverage"] >= 0.95
+        assert rec["clock_offset_ms"]["router"] == 0.0
+        assert abs(rec["clock_offset_ms"]["replica0"]) > 1e5  # ~995s shift
+
+    def test_engine_only_trace_passes_through(self):
+        rec = stitch([("replica0", _engine_segment(parent=None))])
+        assert rec["root"] == "request"
+        assert rec["stitched"] is False
+        assert rec["span_coverage"] >= 0.99
+
+    def test_unanchored_segment_cannot_fake_coverage(self):
+        """A child segment whose forwarding (router) segment never
+        arrived is included unshifted; its foreign-epoch intervals must
+        not inflate the anchor's coverage."""
+        router = _router_segment()
+        # drop the proxy span so there is nothing to align against
+        router["spans"] = [s for s in router["spans"]
+                           if s["name"] != "proxy"]
+        rec = stitch([("router", router), ("replica0", _engine_segment())])
+        assert rec["clock_offset_ms"]["replica0"] is None
+        # only route (1ms) covers the 100ms root
+        assert rec["span_coverage"] < 0.05
+
+    def test_raw_start_preserved_for_batch_dedupe(self):
+        rec = stitch([("router", _router_segment()),
+                      ("replica0", _engine_segment())])
+        execute = next(s for s in rec["spans"] if s["name"] == "execute")
+        assert execute["raw_start"] == 5.030
+        assert execute["start"] != execute["raw_start"]
+
+    def test_critical_path_excludes_containers(self):
+        rec = stitch([("router", _router_segment()),
+                      ("replica0", _engine_segment())])
+        path = critical_path(rec["spans"])
+        names = [n for n, _ in path]
+        assert "proxy" not in names and "request" not in names
+        assert path[0] == ("execute", 60.0)
+
+
+# ---------------------------------------------------------------------------
+# tail-based sampling
+# ---------------------------------------------------------------------------
+def _healthy(i, ms=5.0):
+    return {"trace_id": f"h{i}", "duration_ms": ms, "spans": []}
+
+
+def _error(i):
+    return {"trace_id": f"e{i}", "duration_ms": 5.0,
+            "spans": [{"name": "request", "attrs": {"status": 503}}]}
+
+
+class TestTailSampler:
+    def test_same_seed_same_stream_identical_decisions(self):
+        def run(seed):
+            s = TailSampler(0.1, seed=seed, clock=FakeClock(),
+                            min_window=10_000)
+            return [s.decide(_healthy(i)) for i in range(300)]
+
+        assert run(7) == run(7)
+        assert run(7) != run(8)  # a different seed moves the kept set
+
+    def test_errors_and_slo_kept_at_zero_rate(self):
+        s = TailSampler(0.0, seed=0, slo_ms=50.0, clock=FakeClock())
+        for i in range(50):
+            assert s.decide(_healthy(i)) is None
+        assert s.decide(_error(0)) == TailSampler.KEEP_ERROR
+        slow = {"trace_id": "s", "duration_ms": 80.0, "spans": []}
+        assert s.decide(slow) == TailSampler.KEEP_SLO
+        assert s.stats()["kept"] == {"error": 1, "slo_violation": 1}
+
+    def test_healthy_fraction_bounded_within_one(self):
+        for seed in range(5):
+            s = TailSampler(0.1, seed=seed, min_window=10_000,
+                            clock=FakeClock())
+            kept = sum(s.decide(_healthy(i)) is not None
+                       for i in range(200))
+            assert abs(kept - 20) <= 1, (seed, kept)
+
+    def test_rolling_p99_slow_always_kept(self):
+        s = TailSampler(0.0, seed=0, min_window=30, clock=FakeClock())
+        for i in range(100):
+            s.decide(_healthy(i, ms=10.0))
+        tail = {"trace_id": "slow", "duration_ms": 500.0, "spans": []}
+        assert s.decide(tail) == TailSampler.KEEP_SLOW
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            TailSampler(1.5)
+        with pytest.raises(ValueError):
+            TailSampler(0.1, slow_percentile=10.0)
+
+
+# ---------------------------------------------------------------------------
+# cardinality guard + exemplars (registry/exporters satellites)
+# ---------------------------------------------------------------------------
+class TestCardinalityGuard:
+    def test_under_cap_names_unchanged(self):
+        reg = MetricRegistry(max_label_values=8)
+        assert reg.labeled("serving_execute_ms_b", 4) == \
+            "serving_execute_ms_b4"
+        assert reg.labeled("serving_execute_ms_b", 4) == \
+            "serving_execute_ms_b4"  # repeat costs nothing
+
+    def test_overflow_collapses_with_counter_and_one_warning(self):
+        reg = MetricRegistry(max_label_values=4)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            names = [reg.labeled("fam_", i) for i in range(10)]
+        assert names[:4] == ["fam_0", "fam_1", "fam_2", "fam_3"]
+        assert all(n == "fam___other__" for n in names[4:])
+        assert reg.snapshot()["registry_cardinality_overflows_total"] == 6.0
+        assert len([w for w in caught
+                    if "fam_" in str(w.message)]) == 1  # one-time warning
+
+    def test_tracer_per_bucket_histograms_are_guarded(self):
+        reg = MetricRegistry(max_label_values=2)
+        clock = FakeClock()
+        tracer = Tracer(clock=clock, registry=reg)
+        for bucket in (1, 2, 4, 8):
+            root = tracer.start_trace("request")
+            span = tracer.start_span("execute", root,
+                                     attrs={"bucket": bucket})
+            clock.advance(0.01)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                tracer.end(span)
+            tracer.end(root)
+        snap = reg.snapshot()
+        assert "serving_execute_ms_b1_count" in snap
+        assert "serving_execute_ms_b2_count" in snap
+        assert "serving_execute_ms_b4_count" not in snap
+        assert "serving_execute_ms_b__other___count" in snap
+
+
+class TestExemplars:
+    def test_histogram_records_newest_exemplar_per_bucket(self):
+        h = Histogram("lat")
+        h.observe(0.3, exemplar="a")
+        h.observe(0.4, exemplar="b")   # same 0.5 bucket: newest wins
+        h.observe(900.0, exemplar="c")
+        h.observe(1e6, exemplar="inf")
+        ex = h.exemplars()
+        assert ex[0.5] == ("b", 0.4)
+        assert ex[1000.0] == ("c", 900.0)
+        assert ex[float("inf")] == ("inf", 1e6)
+
+    def test_prometheus_lines_render_openmetrics_exemplars(self):
+        from glom_tpu.obs.exporters import prometheus_lines
+
+        reg = MetricRegistry()
+        reg.histogram("lat").observe(0.3, exemplar="trace42")
+        text = prometheus_lines(reg, exemplars=True)
+        assert '# {trace_id="trace42"} 0.3' in text
+        # the DEFAULT is plain Prometheus text: exemplar syntax is only
+        # legal under a negotiated OpenMetrics response — a 0.0.4 parser
+        # rejects the whole scrape on the first annotated line
+        assert "# {trace_id=" not in prometheus_lines(reg)
+
+    def test_textfile_exporter_stays_plain(self, tmp_path):
+        from glom_tpu.obs.exporters import PrometheusTextfileExporter
+
+        reg = MetricRegistry()
+        reg.histogram("lat").observe(0.3, exemplar="t")
+        path = str(tmp_path / "prom.txt")
+        PrometheusTextfileExporter(path).emit({}, registry=reg)
+        assert "# {trace_id=" not in open(path).read()
+
+    def test_parse_exemplars_round_trip(self):
+        from glom_tpu.obs.exporters import prometheus_lines
+
+        reg = MetricRegistry()
+        reg.histogram("serving_request_ms").observe(12.0, exemplar="tid9")
+        parsed = parse_exemplars(prometheus_lines(reg, exemplars=True))
+        assert {"family": "glom_serving_request_ms", "le": "25",
+                "trace_id": "tid9", "value": 12.0} in parsed
+
+    def test_unsafe_exemplar_id_never_reaches_the_exposition(self):
+        """X-Request-Id admits any printable ASCII; an id that could
+        splice the sample line (quotes, braces, spaces) is DROPPED from
+        the render — one request must not be able to poison /metrics."""
+        from glom_tpu.obs.exporters import prometheus_lines
+
+        reg = MetricRegistry()
+        reg.histogram("lat").observe(0.3, exemplar='ab"} 9 evil')
+        reg.histogram("lat").observe(9.0, exemplar="good-id")
+        text = prometheus_lines(reg, exemplars=True)
+        assert "evil" not in text
+        assert '# {trace_id="good-id"}' in text
+
+    def test_openmetrics_counter_family_and_regroup(self):
+        """OpenMetrics render declares counter families without the
+        reserved _total suffix, and regroup_families makes interleaved
+        families contiguous with no stray EOF/comments."""
+        from glom_tpu.obs.exporters import prometheus_lines, regroup_families
+
+        reg = MetricRegistry()
+        reg.counter("reqs_total", help="requests").inc(3)
+        text = prometheus_lines(reg, exemplars=True)
+        assert "# TYPE glom_reqs counter" in text
+        assert "glom_reqs_total 3" in text
+        interleaved = (
+            "# TYPE a counter\na_total 1\n# TYPE b gauge\nb 2\n"
+            '# EOF\na_total{replica="r0"} 5\n# not-a-meta comment\n')
+        grouped = regroup_families(interleaved)
+        lines = grouped.splitlines()
+        assert lines.index('a_total{replica="r0"} 5') < lines.index("b 2")
+        assert "# EOF" not in grouped and "not-a-meta" not in grouped
+
+    def test_tracer_feeds_trace_id_exemplars(self):
+        reg = MetricRegistry()
+        clock = FakeClock()
+        tracer = Tracer(clock=clock, registry=reg)
+        root = tracer.start_trace("request", trace_id="req-77")
+        clock.advance(0.010)
+        tracer.end(root)
+        ex = reg.histogram("serving_request_ms").exemplars()
+        assert ("req-77", 10.0) in [
+            (tid, round(v, 6)) for tid, v in ex.values()]
+
+
+class TestCompletedRing:
+    def test_cursor_semantics_and_bound(self):
+        clock = FakeClock()
+        tracer = Tracer(clock=clock, completed_max=4)
+        for i in range(6):
+            root = tracer.start_trace("request", trace_id=f"t{i}")
+            clock.advance(0.001)
+            tracer.end(root)
+        cursor, recs = tracer.completed_since(0)
+        assert cursor == 6
+        assert [r["trace_id"] for r in recs] == ["t2", "t3", "t4", "t5"]
+        cursor2, recs2 = tracer.completed_since(cursor)
+        assert cursor2 == 6 and recs2 == []
+        root = tracer.start_trace("request", trace_id="t6")
+        clock.advance(0.001)
+        tracer.end(root)
+        _, recs3 = tracer.completed_since(cursor)
+        assert [r["trace_id"] for r in recs3] == ["t6"]
+
+
+# ---------------------------------------------------------------------------
+# FakeFleet-driven collector: deterministic incident correlation
+# ---------------------------------------------------------------------------
+class FakeFleetHTTP:
+    """Canned /healthz + /debug/* sources behind the injected http fn."""
+
+    def __init__(self):
+        self.router_health = {
+            "status": "ok", "role": "router", "healthy_replicas": 2,
+            "fleet_step": 3, "rollout_phase": "idle",
+            "replicas": [
+                {"name": "r0", "url": "http://fleet/r0", "healthy": True,
+                 "step": 3, "inflight": 0, "requests": 10, "errors": 0},
+                {"name": "r1", "url": "http://fleet/r1", "healthy": True,
+                 "step": 3, "inflight": 0, "requests": 10, "errors": 0},
+            ]}
+        self.traces = {"http://fleet/router": [], "http://fleet/r0": [],
+                       "http://fleet/r1": []}
+        self.timeline = []
+        self.bundles = {"r0": [], "r1": []}
+
+    def __call__(self, method, url, body, headers, timeout):
+        base, _, rest = url.partition("/debug/")
+        if url.endswith("/healthz"):
+            return 200, {}, json.dumps(self.router_health).encode()
+        if rest.startswith("traces"):
+            recs = self.traces.get(base, [])
+            return 200, {}, json.dumps(
+                {"next": len(recs), "traces": recs}).encode()
+        if rest == "timeline":
+            return 200, {}, json.dumps({"events": self.timeline}).encode()
+        if rest == "forensics":
+            name = base.rsplit("/", 1)[-1]
+            return 200, {}, json.dumps({
+                "role": "engine", "step": 3,
+                "bundles": self.bundles.get(name, []),
+                "registry": {"serving_requests_total": 10.0,
+                             "slo_burn_rate_embed_p95_250ms": 14.0},
+                "slo_fired": [],
+            }).encode()
+        return 404, {}, b"{}"
+
+
+def _fake_collector(tmp_path, **kwargs):
+    fleet = FakeFleetHTTP()
+    obs = FleetObservatory(
+        "http://fleet/router", http=fleet, clock=FakeClock(),
+        wall_clock=FakeClock(1.7e9),
+        sampler=TailSampler(1.0, seed=0, clock=FakeClock()),
+        incident_dir=str(tmp_path / "incidents"), linger_polls=1,
+        **kwargs)
+    return fleet, obs
+
+
+class TestCollectorFakeFleet:
+    def test_discovers_replicas_from_router_health(self, tmp_path):
+        fleet, obs = _fake_collector(tmp_path)
+        obs.poll_once()
+        assert set(obs.sources) == {"router", "r0", "r1"}
+        assert obs.sources["r0"]["role"] == "replica"
+
+    def test_stitches_across_pull_rounds(self, tmp_path):
+        """Engine segment arrives one poll before the router segment (the
+        real completion order): the group lingers, then stitches whole."""
+        fleet, obs = _fake_collector(tmp_path)
+        obs.poll_once()
+        fleet.traces["http://fleet/r0"].append(_engine_segment())
+        obs.poll_once()
+        assert obs.traces == {}  # waiting for the router segment
+        fleet.traces["http://fleet/router"].append(_router_segment())
+        obs.poll_once()
+        assert "t1" in obs.traces
+        rec = obs.traces["t1"]
+        assert rec["stitched"] and rec["span_coverage"] >= 0.95
+
+    def test_straggler_of_finalized_trace_not_resampled(self, tmp_path):
+        fleet, obs = _fake_collector(tmp_path)
+        obs.poll_once()
+        fleet.traces["http://fleet/r0"].append(_engine_segment())
+        for _ in range(3):
+            obs.poll_once()  # lingers out as an engine-only trace
+        decided = obs.sampler.decided
+        fleet.traces["http://fleet/router"].append(_router_segment())
+        obs.poll_once()
+        assert obs.sampler.decided == decided  # no second decision
+
+    def test_slo_burn_bundle_produces_exactly_one_incident(self, tmp_path):
+        fleet, obs = _fake_collector(tmp_path)
+        obs.poll_once()  # attach: absorbs pre-existing state
+        burn = {"name": "slo_burn-40", "manifest": {
+            "trigger": "slo_burn", "step": 40,
+            "detail": {"slo": "embed:p95<250ms", "trace_ids": ["t1"]}}}
+        fleet.bundles["r0"].append(burn)
+        # BOTH replicas burn in the same window — still ONE incident
+        fleet.bundles["r1"].append(dict(burn, name="slo_burn-41"))
+        obs.poll_once()
+        incident_dir = str(tmp_path / "incidents")
+        bundles = sorted(os.listdir(incident_dir))
+        assert len(bundles) == 1, bundles
+        bundle = os.path.join(incident_dir, bundles[0])
+        manifest = json.load(open(os.path.join(bundle, "manifest.json")))
+        assert manifest["trigger"] == "slo_burn"
+        assert manifest["replicas"] == ["r0", "r1"]
+        # evidence from EVERY replica
+        for name in ("r0", "r1"):
+            rep = json.load(open(os.path.join(bundle,
+                                              f"replica_{name}.json")))
+            assert rep["registry"]["serving_requests_total"] == 10.0
+        assert os.path.exists(os.path.join(bundle, "timeline.json"))
+        assert os.path.exists(os.path.join(bundle, "traces.json"))
+        snap = obs.registry.snapshot()
+        assert snap["observatory_incidents_total"] == 1.0
+        assert snap["observatory_incidents_deduped_total"] == 1.0
+
+    def test_preexisting_bundles_absorbed_on_attach(self, tmp_path):
+        fleet, obs = _fake_collector(tmp_path)
+        fleet.bundles["r0"].append({"name": "slo_burn-1", "manifest": {
+            "trigger": "slo_burn", "step": 1, "detail": {}}})
+        obs.poll_once()
+        obs.poll_once()
+        assert not os.path.exists(str(tmp_path / "incidents"))
+
+    def test_late_discovered_replica_backlog_absorbed(self, tmp_path):
+        """A replica that joins (or returns) on poll N > 1 must have its
+        HISTORICAL bundles absorbed at first sighting — absorption is
+        per-replica, not a global first-poll flag."""
+        fleet, obs = _fake_collector(tmp_path)
+        obs.poll_once()
+        obs.poll_once()  # collector is well past attach
+        fleet.router_health["replicas"].append(
+            {"name": "r2", "url": "http://fleet/r2", "healthy": True,
+             "step": 3, "inflight": 0, "requests": 0, "errors": 0})
+        fleet.traces["http://fleet/r2"] = []
+        fleet.bundles["r2"] = [{"name": "slo_burn-old", "manifest": {
+            "trigger": "slo_burn", "step": 2, "detail": {}}}]
+        obs.poll_once()  # first sighting of r2: backlog absorbed
+        assert not os.path.exists(str(tmp_path / "incidents"))
+        fleet.bundles["r2"].append({"name": "slo_burn-new", "manifest": {
+            "trigger": "slo_burn", "step": 99, "detail": {}}})
+        obs.poll_once()  # a bundle it WITNESSED fires normally
+        assert len(os.listdir(str(tmp_path / "incidents"))) == 1
+
+    def test_departed_replica_dropped_from_sources(self, tmp_path):
+        """A replica removed from the router's /healthz table stops being
+        polled (no permanent per-poll timeout tax, no phantom source in
+        the console); ctor-pinned sources survive discovery."""
+        fleet, obs = _fake_collector(tmp_path)
+        obs.poll_once()
+        assert set(obs.sources) == {"router", "r0", "r1"}
+        fleet.router_health["replicas"] = [
+            r for r in fleet.router_health["replicas"]
+            if r["name"] != "r1"]
+        obs.poll_once()
+        assert set(obs.sources) == {"router", "r0"}
+        # seen-bundle memory survives the drop, so the return below is
+        # NOT a first sighting — bundles r1 already showed never refire
+        assert "r1" in obs._seen_bundles
+        fleet.router_health["replicas"].append(
+            {"name": "r1", "url": "http://fleet/r1", "healthy": True,
+             "step": 3, "inflight": 0, "requests": 10, "errors": 0})
+        obs.poll_once()
+        assert set(obs.sources) == {"router", "r0", "r1"}
+
+    def test_console_readable_while_a_source_blackholes(self, tmp_path):
+        """poll_once must not hold the state lock across network pulls: a
+        hanging source delays the POLL, never a /console read."""
+        import time as _time
+
+        fleet, obs = _fake_collector(tmp_path)
+        obs.poll_once()
+        slow_started = threading.Event()
+
+        def slow_http(method, url, body, headers, timeout):
+            if "/debug/" in url:
+                slow_started.set()
+                _time.sleep(0.5)  # a blackholed source mid-poll
+            return fleet(method, url, body, headers, timeout)
+
+        obs._http = slow_http
+        poller = threading.Thread(target=obs.poll_once, daemon=True)
+        poller.start()
+        assert slow_started.wait(2.0)
+        t0 = _time.monotonic()
+        con = obs.console()  # must answer while the poll is parked
+        elapsed = _time.monotonic() - t0
+        poller.join(timeout=5.0)
+        assert con["fleet"]["healthy_replicas"] == 2
+        assert elapsed < 0.3, f"console blocked {elapsed:.2f}s on the poll"
+
+    def test_ejection_event_triggers_incident(self, tmp_path):
+        fleet, obs = _fake_collector(tmp_path)
+        obs.poll_once()
+        fleet.timeline.append({"seq": 0, "t": 12.0, "event": "ejection",
+                               "replica": "r1", "fail_streak": 2})
+        obs.poll_once()
+        bundles = sorted(os.listdir(str(tmp_path / "incidents")))
+        assert len(bundles) == 1
+        manifest = json.load(open(os.path.join(
+            str(tmp_path / "incidents"), bundles[0], "manifest.json")))
+        assert manifest["trigger"] == "replica_ejection"
+        assert manifest["origin"] == "r1"
+
+    def test_console_shape(self, tmp_path):
+        fleet, obs = _fake_collector(tmp_path)
+        fleet.traces["http://fleet/router"].append(_router_segment())
+        fleet.traces["http://fleet/r0"].append(_engine_segment())
+        obs.poll_once()
+        obs.flush()
+        con = obs.console()
+        assert con["fleet"]["healthy_replicas"] == 2
+        assert con["fleet"]["rollout_phase"] == "idle"
+        assert [r["name"] for r in con["replicas"]] == ["r0", "r1"]
+        assert con["slo_burn_rates"]["r0"] == {
+            "slo_burn_rate_embed_p95_250ms": 14.0}
+        assert con["padding_waste"]["4"]["batches"] == 1
+        assert con["slowest_traces"][0]["trace_id"] == "t1"
+        assert con["slowest_traces"][0]["critical_path"][0]["span"] == \
+            "execute"
+
+    def test_incident_report_renders(self, tmp_path):
+        fleet, obs = _fake_collector(tmp_path)
+        obs.poll_once()
+        fleet.bundles["r0"].append({"name": "slo_burn-9", "manifest": {
+            "trigger": "slo_burn", "step": 9, "detail": {}}})
+        obs.poll_once()
+        bundle = obs.incidents[0]
+        sys.path.insert(0, os.path.join(ROOT, "tools"))
+        try:
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                "observatory_cli", os.path.join(ROOT, "tools",
+                                                "observatory.py"))
+            cli = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(cli)
+        finally:
+            sys.path.pop(0)
+        rep = cli.render_report(bundle)
+        assert rep["manifest"]["trigger"] == "slo_burn"
+        assert set(rep["replicas"]) == {"r0", "r1"}
+
+
+# ---------------------------------------------------------------------------
+# real fleet: the HTTP acceptance criteria
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    from glom_tpu.serving.engine import ServingEngine, make_demo_checkpoint
+    from glom_tpu.serving.router import FleetRouter, make_router_server
+    from glom_tpu.serving.server import make_server
+
+    ckpt = str(tmp_path_factory.mktemp("obs_ckpt"))
+    make_demo_checkpoint(ckpt)
+    members, urls = [], []
+    for i in range(2):
+        engine = ServingEngine(ckpt, buckets=(1, 2, 4), max_wait_ms=1.0,
+                               reload_poll_s=0)
+        engine.start(workers=True, watch=False)
+        server = make_server(engine)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        host, port = server.server_address[:2]
+        urls.append(f"http://{host}:{port}")
+        members.append((engine, server))
+    router = FleetRouter(urls, health_interval_s=0.2)
+    router.start()
+    router_server = make_router_server(router)
+    threading.Thread(target=router_server.serve_forever,
+                     daemon=True).start()
+    rhost, rport = router_server.server_address[:2]
+    yield f"http://{rhost}:{rport}", router, members
+    router.shutdown()
+    router_server.shutdown()
+    router_server.server_close()
+    for engine, server in members:
+        server.shutdown()
+        engine.shutdown(drain=True)
+        server.server_close()
+
+
+def _post_embed(url, batch, rid, seed=0):
+    from glom_tpu.serving.engine import DEMO_CONFIG as c
+
+    imgs = np.random.RandomState(seed).randn(
+        batch, c.channels, c.image_size, c.image_size).astype(np.float32)
+    req = urllib.request.Request(
+        f"{url}/embed", data=json.dumps({"images": imgs.tolist()}).encode(),
+        headers={"Content-Type": "application/json", "X-Request-Id": rid})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.status, dict(r.headers), json.loads(r.read())
+
+
+class TestFleetHTTPAcceptance:
+    def test_one_stitched_trace_across_the_hop(self, fleet, tmp_path):
+        """Acceptance: a request through the router to a replica appears
+        in the collector as ONE stitched trace — router_request -> proxy
+        -> engine request -> queue_wait -> execute — with >= 95% span
+        coverage across the hop."""
+        url, router, members = fleet
+        obs = FleetObservatory(
+            url, sampler=TailSampler(1.0, seed=0), linger_polls=1)
+        obs.poll_once()  # attach + discover
+        status, headers, _ = _post_embed(url, 1, "accept-hop")
+        assert status == 200 and headers.get("X-Served-By")
+        import time
+
+        deadline = time.monotonic() + 5.0
+        rec = None
+        while time.monotonic() < deadline and rec is None:
+            obs.poll_once()
+            obs.flush()
+            rec = obs.traces.get("accept-hop")
+            time.sleep(0.02)
+        assert rec is not None, "trace never reached the collector"
+        assert rec["stitched"] is True
+        names = {s["name"] for s in rec["spans"]}
+        assert {"router_request", "proxy", "request", "queue_wait",
+                "execute"} <= names
+        assert rec["span_coverage"] >= 0.95, rec["span_coverage"]
+        assert len(rec["sources"]) == 2 and "router" in rec["sources"]
+
+    def test_exemplar_resolves_to_stitched_trace(self, fleet):
+        """Acceptance: a histogram exemplar from /metrics resolves via
+        the collector to a stored stitched trace whose critical path
+        names the offending phase."""
+        url, router, members = fleet
+        obs = FleetObservatory(
+            url, sampler=TailSampler(1.0, seed=0), linger_polls=1)
+        obs.poll_once()
+        for i in range(4):
+            _post_embed(url, 1, f"accept-ex-{i}", seed=i)
+        _post_embed(url, 4, "accept-ex-slow")  # the induced slow request
+        import time
+
+        time.sleep(0.2)
+        obs.poll_once()
+        obs.flush()
+        exemplars = [ex for ex in obs.pull_exemplars()
+                     if ex["family"].endswith("router_request_ms")
+                     and ex["trace_id"].startswith("accept-ex")]
+        assert exemplars, "no router latency exemplars on /metrics"
+        resolved = None
+        for ex in sorted(exemplars, key=lambda e: -e["value"]):
+            resolved = obs.resolve_exemplar(ex["trace_id"])
+            if resolved is not None:
+                break
+        assert resolved is not None
+        path = resolved["critical_path"]
+        assert path, "stitched trace has no critical path"
+        assert path[0]["span"] in {"execute", "queue_wait", "respond",
+                                   "parse", "batch_assembly", "pad",
+                                   "route"}
+
+    def test_debug_endpoints_over_http(self, fleet):
+        url, router, members = fleet
+        payload = json.loads(urllib.request.urlopen(
+            f"{url}/debug/traces?since=0", timeout=10).read())
+        assert payload["role"] == "router" and "traces" in payload
+        timeline = json.loads(urllib.request.urlopen(
+            f"{url}/debug/timeline", timeout=10).read())
+        assert timeline["rollout_phase"] == "idle"
+        engine_url = members[0][1]
+        host, port = engine_url.server_address[:2]
+        forensics = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/debug/forensics", timeout=10).read())
+        assert forensics["role"] == "engine"
+        assert "registry" in forensics and "bundles" in forensics
+        traces = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/debug/traces?since=0",
+            timeout=10).read())
+        assert traces["role"] == "engine" and "next" in traces
+
+    def test_metrics_exemplars_are_openmetrics_negotiated(self, fleet):
+        """A plain scrape gets 0.0.4 text with NO exemplar suffixes (a
+        classic parser would reject the whole scrape on one); only an
+        Accept: application/openmetrics-text client gets them."""
+        url, router, members = fleet
+        _post_embed(url, 1, "accept-om")
+        plain = urllib.request.urlopen(f"{url}/metrics", timeout=10)
+        assert "version=0.0.4" in plain.headers["Content-Type"]
+        assert "# {trace_id=" not in plain.read().decode()
+        req = urllib.request.Request(f"{url}/metrics", headers={
+            "Accept": "application/openmetrics-text; version=1.0.0"})
+        om = urllib.request.urlopen(req, timeout=10)
+        assert "openmetrics-text" in om.headers["Content-Type"]
+        body = om.read().decode()
+        assert "# {trace_id=" in body
+        # the negotiation is forwarded to replica scrapes too: relabeled
+        # replica families keep their exemplars in the aggregate
+        assert any("replica=" in line and "# {trace_id=" in line
+                   for line in body.splitlines())
+        # strict-parser shape: ONE terminal `# EOF`, and every family's
+        # samples contiguous (the shared serving-span families appear in
+        # the router's own block AND each replica's — regrouped)
+        lines = [line for line in body.splitlines() if line.strip()]
+        assert lines[-1] == "# EOF" and body.count("# EOF") == 1
+        seen_families, closed = [], set()
+        for line in lines[:-1]:
+            if line.startswith("#"):
+                continue
+            fam = line.split("{")[0].split(" ")[0]
+            for suffix in ("_bucket", "_sum", "_count"):
+                if fam.endswith(suffix):
+                    fam = fam[: -len(suffix)]
+            if seen_families and seen_families[-1] == fam:
+                continue
+            assert fam not in closed, f"family {fam} interleaved"
+            if seen_families:
+                closed.add(seen_families[-1])
+            seen_families.append(fam)
+
+
+# ---------------------------------------------------------------------------
+# trace_report fleet join (satellite)
+# ---------------------------------------------------------------------------
+def _trace_report():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(ROOT, "tools", "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestTraceReportFleet:
+    def _write_feeds(self, tmp_path):
+        router_log = tmp_path / "router.jsonl"
+        replica_log = tmp_path / "replica0.jsonl"
+        router_log.write_text(json.dumps(_router_segment()) + "\n")
+        # the engine feed holds the engine half of t1 plus one standalone
+        # engine-only trace
+        solo = _engine_segment(tid="solo", start=9.0, parent=None)
+        replica_log.write_text(json.dumps(_engine_segment()) + "\n"
+                               + json.dumps(solo) + "\n")
+        return str(router_log), str(replica_log)
+
+    def test_multi_file_join_by_traceparent(self, tmp_path):
+        tr = _trace_report()
+        router_log, replica_log = self._write_feeds(tmp_path)
+        traces = tr.read_many([router_log, replica_log])
+        assert len(traces) == 2  # t1 joined, solo passes through
+        joined = next(t for t in traces if t["trace_id"] == "t1")
+        assert joined["root"] == "router_request"
+        assert joined.get("stitched") is True
+        assert tr.coverage(joined["spans"]) >= 0.95
+
+    def test_summary_counts_joined_requests(self, tmp_path):
+        tr = _trace_report()
+        router_log, replica_log = self._write_feeds(tmp_path)
+        s = tr.summarize(tr.read_many([router_log, replica_log]))
+        assert s["requests"] == 2
+        # containers excluded: the joined trace attributes to the
+        # pipeline spans, not the proxy/request wrappers
+        span_names = {r["span"] for r in s["spans"]}
+        assert "execute" in span_names and "proxy" not in span_names
+
+    def test_cross_file_batches_not_deduped(self, tmp_path):
+        """Two replicas' clocks are independent: identical (bucket,
+        start) across files are DIFFERENT physical batches."""
+        tr = _trace_report()
+        a = tmp_path / "ra.jsonl"
+        b = tmp_path / "rb.jsonl"
+        seg_a = _engine_segment(tid="a1", parent=None)
+        seg_b = _engine_segment(tid="b1", parent=None)  # same timestamps
+        a.write_text(json.dumps(seg_a) + "\n")
+        b.write_text(json.dumps(seg_b) + "\n")
+        s = tr.summarize(tr.read_many([str(a), str(b)]))
+        assert s["buckets"][0]["batches"] == 2
+
+    def test_single_file_behavior_unchanged(self, tmp_path):
+        tr = _trace_report()
+        golden = os.path.join(ROOT, "tests", "data", "golden_trace.jsonl")
+        assert (tr.summarize(tr.read_many([golden]))
+                == tr.summarize(tr.read_traces(golden)))
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 subprocess gates (the chaos.py pattern)
+# ---------------------------------------------------------------------------
+class TestObservatorySmoke:
+    def test_smoke_suite(self):
+        """tools/observatory.py --smoke: in-process router + 2 replicas,
+        one induced slow request => stitched trace retained, exemplar
+        resolves, exactly one cross-replica incident bundle."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "observatory.py"),
+             "--smoke"],
+            capture_output=True, text=True, timeout=280, env=env, cwd=ROOT,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        summary = json.loads(proc.stdout)
+        assert summary["smoke"] == "ok"
+        assert summary["stitched_coverage"] >= 0.95
+        assert len(summary["incidents"]) == 1
+        assert len(summary["replica_evidence_files"]) == 2
+
+    def test_loadgen_fleet_smoke(self):
+        """tools/loadgen.py --smoke --fleet asserts coverage on the
+        STITCHED trace (the engine-side-only number would overstate it)."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "loadgen.py"),
+             "--smoke", "--fleet"],
+            capture_output=True, text=True, timeout=280, env=env, cwd=ROOT,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        summary = json.loads(proc.stdout)
+        assert summary["smoke_mode"] == "fleet-stitched"
+        assert summary["trace_coverage"] >= 0.95
+        assert "router_request" in summary["trace_span_names"]
+
+    def test_report_mode_cli(self, tmp_path):
+        """tools/observatory.py report renders an incident bundle."""
+        fleet, obs = _fake_collector(tmp_path)
+        obs.poll_once()
+        fleet.bundles["r0"].append({"name": "slo_burn-5", "manifest": {
+            "trigger": "slo_burn", "step": 5, "detail": {}}})
+        obs.poll_once()
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "observatory.py"),
+             "report", obs.incidents[0]],
+            capture_output=True, text=True, timeout=60, cwd=ROOT,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "incident: slo_burn" in proc.stdout
+        assert "replica r0" in proc.stdout and "replica r1" in proc.stdout

@@ -1,0 +1,41 @@
+"""The ONE jax-free loader for the stdlib obs modules (the tools/lint.py
+pattern, extracted so trace_report.py and observatory.py cannot drift):
+on a machine with no jax, the ``glom_tpu`` package root cannot import, so
+``glom_tpu``/``glom_tpu.obs`` are stubbed with bare path-carrying modules
+and ``observatory.py`` (plus the stdlib-only modules it imports —
+tracing, registry, exporters, forensics) load from their files without
+ever executing a jax-backed package ``__init__``."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_observatory():
+    """Return the :mod:`glom_tpu.obs.observatory` module, via the normal
+    import when the environment has jax, else via stub packages + file
+    loading."""
+    try:
+        from glom_tpu.obs import observatory
+        return observatory
+    except ImportError:
+        import importlib.util
+        import types
+
+        for name, path in (("glom_tpu", os.path.join(REPO, "glom_tpu")),
+                           ("glom_tpu.obs",
+                            os.path.join(REPO, "glom_tpu", "obs"))):
+            if name not in sys.modules:
+                stub = types.ModuleType(name)
+                stub.__path__ = [path]
+                sys.modules[name] = stub
+        spec = importlib.util.spec_from_file_location(
+            "glom_tpu.obs.observatory",
+            os.path.join(REPO, "glom_tpu", "obs", "observatory.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["glom_tpu.obs.observatory"] = mod
+        spec.loader.exec_module(mod)
+        return mod

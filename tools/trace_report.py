@@ -4,6 +4,7 @@
   python tools/trace_report.py traces.jsonl --slowest 10
   python tools/trace_report.py traces.jsonl --trace <request-id>
   python tools/trace_report.py traces.jsonl --suggest-buckets [--ladder-size 4]
+  python tools/trace_report.py router.jsonl replica0.jsonl replica1.jsonl
 
 Reads the per-trace JSONL the serving engine emits (``--trace-log``: one
 JSON object per COMPLETED trace — ``trace_id``, root span name, duration,
@@ -24,8 +25,19 @@ and the span list) and prints:
     image-slots), printed as JSON the serving front accepts verbatim via
     ``--buckets-file`` — close the loop: measure waste, re-ladder, serve.
 
+**Fleet feeds**: pass several trace logs (the router's plus each
+replica's) and records sharing a trace id are JOINED into one
+cross-process trace — clock-aligned over the router hop by the same
+stitching the fleet observatory uses — so the report works on fleet
+output even with no collector running.  Mirrored batch spans stay
+deduped per file (the padding-waste key carries the source file: two
+replicas' clocks are independent, so identical timestamps across files
+are different physical batches, never duplicates).
+
 Stdlib-only on purpose (like obs_report.py / forensics_report.py): it
-must run on a machine with no jax, straight off a scp'd trace log.
+must run on a machine with no jax, straight off a scp'd trace log (the
+stitcher is file-loaded from glom_tpu/obs/observatory.py without
+touching any jax-backed package root).
 """
 
 from __future__ import annotations
@@ -33,7 +45,20 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import sys
+
+
+def _load_observatory():
+    """File-load the stitcher (glom_tpu/obs/observatory.py, stdlib-only)
+    without executing the jax-backed glom_tpu package root — the shared
+    ``tools/_obsload.py`` loader."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import _obsload
+    finally:
+        sys.path.pop(0)
+    return _obsload.load_observatory()
 
 
 def _percentile(xs, q):
@@ -45,9 +70,11 @@ def _percentile(xs, q):
     return ordered[rank]
 
 
-def read_traces(path):
+def read_traces(path, source=None):
     """One dict per line; truncated/garbage lines are skipped (a killed
-    server must not make its own evidence unreadable)."""
+    server must not make its own evidence unreadable).  With ``source``
+    set (multi-file fleet mode), every record and span is tagged so the
+    join and the per-batch dedupe know which process emitted what."""
     traces = []
     with open(path) as f:
         for line in f:
@@ -59,8 +86,52 @@ def read_traces(path):
             except ValueError:
                 continue
             if isinstance(rec, dict) and rec.get("spans"):
+                if source is not None:
+                    rec["_src"] = source
+                    for s in rec["spans"]:
+                        s.setdefault("source", source)
                 traces.append(rec)
     return traces
+
+
+def read_many(paths):
+    """Read several trace logs (router + N replicas) and JOIN records
+    sharing a trace id into single cross-process traces — clock-aligned
+    over the router hop by the fleet observatory's stitcher.  Single-file
+    groups pass through untouched, so a one-log run is byte-identical to
+    the historical report."""
+    labels = []
+    for path in paths:
+        base = os.path.basename(path)
+        label = base
+        k = 2
+        while label in labels:
+            label = f"{base}#{k}"
+            k += 1
+        labels.append(label)
+    if len(paths) == 1:
+        return read_traces(paths[0])
+    groups = {}
+    order = []
+    for path, label in zip(paths, labels):
+        for rec in read_traces(path, source=label):
+            tid = rec.get("trace_id")
+            if tid not in groups:
+                order.append(tid)
+            groups.setdefault(tid, []).append(rec)
+    stitch = None
+    out = []
+    for tid in order:
+        recs = groups[tid]
+        if len(recs) == 1:
+            out.append(recs[0])
+            continue
+        if stitch is None:
+            stitch = _load_observatory().stitch
+        merged = stitch([(r["_src"], r) for r in recs])
+        if merged is not None:
+            out.append(merged)
+    return out
 
 
 def find_root(spans):
@@ -112,23 +183,37 @@ def coverage(spans):
 # into a share-of-wall table would double-count the pipeline and always
 # "win" the breakdown
 _OVERLAP_SPANS = {"dispatch_wait"}
+# container spans in a STITCHED trace: the router's proxy wraps the whole
+# downstream hop and the engine's request wraps its pipeline — when their
+# children are present in the same (joined) trace, the children carry the
+# attribution; in a single-process feed they have no children here and
+# keep reporting themselves
+_CONTAINER_SPANS = {"proxy", "request"}
 
 
 def _breakdown(spans):
     """Per-span-name total ms within one trace (mirrored batch spans
-    appear once per trace by construction; overlap spans excluded)."""
+    appear once per trace by construction; overlap spans excluded,
+    containers excluded exactly when their children are in the trace)."""
     root = find_root(spans)
+    parent_ids = {s.get("parent_id") for s in spans}
     out = {}
     for s in spans:
         if (s is root or s.get("duration_ms") is None
                 or s["name"] in _OVERLAP_SPANS):
+            continue
+        if (s["name"] in _CONTAINER_SPANS
+                and s.get("span_id") in parent_ids):
             continue
         out[s["name"]] = out.get(s["name"], 0.0) + s["duration_ms"]
     return out
 
 
 def summarize(traces, slowest=5):
-    requests = [t for t in traces if t.get("root") == "request"
+    # "request" for an engine feed, "router_request" for a router feed or
+    # a multi-file stitched join — either is one client-visible request
+    requests = [t for t in traces
+                if t.get("root") in ("request", "router_request")
                 and t.get("duration_ms") is not None]
     durations = [t["duration_ms"] for t in requests]
     coverages = [c for t in requests
@@ -166,7 +251,10 @@ def summarize(traces, slowest=5):
     # per-bucket padding waste, from execute-span annotations.  Every
     # member trace mirrors its batch's execute span, so per-REQUEST rows
     # would overcount batches; dedupe by span_id-free identity: count only
-    # one execute span per (bucket, start) edge.
+    # one execute span per (source, bucket, start) edge — the SOURCE file
+    # is part of the key because two replicas' monotonic clocks are
+    # independent: identical (bucket, start) across files are different
+    # physical batches, and deduping them would undercount fleet batches.
     seen = set()
     buckets = {}
     for t in traces:
@@ -176,7 +264,8 @@ def summarize(traces, slowest=5):
             attrs = s.get("attrs") or {}
             if "bucket" not in attrs:
                 continue
-            key = (attrs["bucket"], s["start"])
+            key = (s.get("source"), attrs["bucket"],
+                   s.get("raw_start", s["start"]))
             if key in seen:
                 continue
             seen.add(key)
@@ -228,7 +317,8 @@ def observed_batch_sizes(traces):
             attrs = s.get("attrs") or {}
             if s["name"] != "execute" or "bucket" not in attrs:
                 continue
-            key = (attrs["bucket"], s["start"])
+            key = (s.get("source"), attrs["bucket"],
+                   s.get("raw_start", s["start"]))
             if key in seen:
                 continue
             seen.add(key)
@@ -388,7 +478,10 @@ def print_trace(traces, trace_id) -> int:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("jsonl", help="per-trace JSONL feed (engine --trace-log)")
+    p.add_argument("jsonl", nargs="+",
+                   help="per-trace JSONL feed(s) (engine/router "
+                        "--trace-log); several feeds are joined by trace "
+                        "id into cross-process traces")
     p.add_argument("--format", choices=["text", "json"], default="text")
     p.add_argument("--slowest", type=int, default=5,
                    help="how many slowest traces to list")
@@ -402,7 +495,7 @@ def main(argv=None) -> int:
                         "many as the feed's current ladder)")
     args = p.parse_args(argv)
     try:
-        traces = read_traces(args.jsonl)
+        traces = read_many(args.jsonl)
     except OSError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
