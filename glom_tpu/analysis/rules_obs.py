@@ -9,6 +9,17 @@
     plane grew a dependency on the observability plane — the exact
     coupling the pull topology exists to forbid (a slow observer must
     never be able to slow a request).
+
+  * ``obs-state-in-cache`` — the session-state boundary (PR 10): per-
+    session column state is OWNED by :mod:`glom_tpu.serving.sessions`
+    and threaded through the compile cache as an opaque array.  The
+    cache must stay a pure ``shape -> executable`` map: a session-store
+    import, a ``SessionStore`` reference, or a store mutation call
+    (``.put``/``.reset``/``.spill``/...) inside ``compile_cache.py``
+    would put TTL/LRU/byte accounting — locks, eviction sweeps,
+    spill I/O — onto the execute core's hot path, and make the one
+    jit-owning module stateful (its executables could then differ by
+    WHEN they ran, the property the AOT warmup contract forbids).
 """
 
 from __future__ import annotations
@@ -72,4 +83,63 @@ class DebugPlaneInCacheRule(Rule):
         return findings
 
 
-OBS_RULES = (DebugPlaneInCacheRule,)
+_STORE_MUTATORS = {"put", "reset", "sweep", "spill", "restore", "pop",
+                   "clear", "update", "note_session"}
+
+
+class SessionStateInCacheRule(Rule):
+    name = "obs-state-in-cache"
+    severity = "error"
+    description = ("session-store reference or mutation inside "
+                   "serving/compile_cache.py — the execute core threads "
+                   "state as an opaque array; the state plane (TTL/LRU/"
+                   "spill bookkeeping) must never enter the hot path")
+
+    TARGET_BASENAME = "compile_cache.py"
+    SCOPE_DIR = "serving"
+
+    @staticmethod
+    def _names_session(dotted: str) -> bool:
+        return any("session" in part.lower() for part in dotted.split("."))
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        parts = ctx.relpath.split("/")
+        # component match, not substring (the obs-debug-in-cache
+        # convention): only serving/compile_cache.py is in scope
+        if (self.SCOPE_DIR not in parts[:-1]
+                or parts[-1] != self.TARGET_BASENAME):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                mod = (node.module or "" if isinstance(node, ast.ImportFrom)
+                       else "")
+                names = [a.name for a in node.names]
+                dotted_all = ([mod] if mod else []) + names
+                if any("sessions" in d.split(".") or "SessionStore" in d
+                       for d in dotted_all):
+                    findings.append(ctx.finding(
+                        self, node,
+                        "session-store import in the execute core: the "
+                        "cache receives state as an opaque argument from "
+                        "the engine; it must not know the store exists"))
+            elif isinstance(node, ast.Call):
+                d = dotted_name(node.func)
+                if (d and "." in d
+                        and d.rsplit(".", 1)[1] in _STORE_MUTATORS
+                        and self._names_session(d.rsplit(".", 1)[0])):
+                    findings.append(ctx.finding(
+                        self, node,
+                        f"session-store mutation {d}(...) in the execute "
+                        f"core: store bookkeeping (locks, eviction, "
+                        f"spill I/O) has no place on the request path — "
+                        f"the ENGINE owns get/put around the cache call"))
+            elif isinstance(node, ast.Name) and node.id == "SessionStore":
+                findings.append(ctx.finding(
+                    self, node,
+                    "SessionStore referenced in the execute core: the "
+                    "cache must stay a pure shape -> executable map"))
+        return findings
+
+
+OBS_RULES = (DebugPlaneInCacheRule, SessionStateInCacheRule)

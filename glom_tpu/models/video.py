@@ -81,15 +81,36 @@ def rollout_varied(
 ):
     """README's exact pattern — per-frame iteration counts (e.g. [12, 10, 6])
     with carried state.  Each distinct count compiles once.  ``frames`` is a
-    sequence of ``(b, c, H, W)`` arrays; returns the final state."""
-    if len(frames) != len(iters_schedule):
+    sequence of ``(b, c, H, W)`` arrays or one stacked ``(t, b, c, H, W)``
+    array; returns the final state.
+
+    The schedule is validated UP FRONT, against ``frames.shape[0]`` for a
+    stacked clip: the frame loop is ``zip``-driven, and zip truncates at
+    the shorter operand — an unvalidated short schedule (or an exhausted
+    generator, which has no ``len``) would silently drop the clip's tail
+    frames rather than erroring."""
+    schedule = [int(it) for it in iters_schedule]
+    bad = [it for it in schedule if it < 1]
+    if bad:
+        raise ValueError(f"iteration counts must be >= 1, got {bad}")
+    if getattr(frames, "ndim", None) is not None:
+        if frames.ndim != 5:
+            raise ValueError(
+                f"stacked frames must be (t, b, c, H, W), got "
+                f"{tuple(frames.shape)}"
+            )
+        n_frames = int(frames.shape[0])
+    else:
+        frames = list(frames)
+        n_frames = len(frames)
+    if n_frames != len(schedule):
         raise ValueError(
-            f"{len(frames)} frames but {len(iters_schedule)} iteration counts"
+            f"{n_frames} frames but {len(schedule)} iteration counts"
         )
     state = levels
-    for frame, it in zip(frames, iters_schedule):
+    for frame, it in zip(frames, schedule):
         state = glom_model.apply(
-            params, frame, config=config, iters=int(it), levels=state,
+            params, frame, config=config, iters=it, levels=state,
             consensus_fn=consensus_fn,
         )
     return state

@@ -264,3 +264,10 @@ def export_to_registry(result: dict, registry) -> None:
             "bench_gate_fleet_p95_ms",
             help="fresh router-fronted loadgen p95 the gate evaluated",
         ).set(float(fleet["p95_ms"]))
+    session = result.get("session_p95") or {}
+    if session.get("p95_ms") is not None:
+        registry.gauge(
+            "bench_gate_session_p95_ms",
+            help="fresh warm-frame (stateful session) p95 the gate "
+                 "evaluated (tools/session_check.py steady state)",
+        ).set(float(session["p95_ms"]))

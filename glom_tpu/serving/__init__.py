@@ -18,6 +18,10 @@ telemetry/forensics stack (PRs 1-2) on the request path:
   * :mod:`glom_tpu.serving.server` — stdlib ``ThreadingHTTPServer``
     front: ``/embed``, ``/reconstruct``, ``/healthz``, ``/metrics``, plus
     the ``/admin/reload/*`` staged-swap API the fleet router drives;
+  * :mod:`glom_tpu.serving.sessions` — per-session column-state cache
+    for the stateful (video/streaming) workload: TTL + LRU eviction,
+    byte-bounded, spill/restore through the checkpoint npz format; the
+    state behind ``/session/embed``'s warm-started frames;
   * :mod:`glom_tpu.serving.sharded` — mesh-sharded serving: buckets
     AOT-compile against explicit in/out shardings so TP/EP-sharded
     configs serve from the ``parallel/`` stack with zero request-path
@@ -52,6 +56,10 @@ from glom_tpu.serving.engine import (  # noqa: F401
 from glom_tpu.serving.router import (  # noqa: F401
     FleetRouter,
     NoHealthyReplica,
+)
+from glom_tpu.serving.sessions import (  # noqa: F401
+    SessionStore,
+    valid_session_id,
 )
 
 # glom_tpu.serving.server is intentionally NOT imported here: the package

@@ -76,7 +76,11 @@ class BucketedCompileCache:
                  name: str = "forward", quant: str = "f32",
                  donate: Optional[bool] = None,
                  shardings: Optional[Tuple[Any, Any, Any]] = None,
-                 mesh_axes: Optional[dict] = None):
+                 mesh_axes: Optional[dict] = None,
+                 carries_state: bool = False,
+                 takes_state: bool = False,
+                 state_sharding: Optional[Any] = None,
+                 iters: Optional[int] = None):
         buckets = sorted(set(int(b) for b in buckets))
         if not buckets:
             raise ValueError("need at least one bucket size")
@@ -111,11 +115,43 @@ class BucketedCompileCache:
         # stated — exactly the parallel/inference.py recipe, AOT-compiled.
         # ``mesh_axes`` ({"data": 4, ...}) labels snapshots and /healthz.
         self.mesh_axes = dict(mesh_axes) if mesh_axes else None
+        # -- stateful (levels-in/levels-out) buckets -----------------------
+        # The session-serving shapes (glom_tpu.serving.sessions is the
+        # OWNER of that state; this cache only threads an opaque array
+        # through the executable):
+        #   carries_state: fn returns (out, new_state) — `out` is sliced
+        #     back to the real batch, the state stays BUCKET-shaped so the
+        #     next frame feeds it straight back in with zero reshaping
+        #     (a per-frame device pad would be a request-path compile);
+        #   takes_state: fn is (params, imgs, state) and __call__ requires
+        #     a bucket-shaped `state`.
+        # Effectively the executables are keyed on (batch-bucket, stateful)
+        # — a warm 4-batch graph and a cold 4-batch graph are distinct
+        # entries that never collide.
+        if takes_state and not carries_state:
+            raise ValueError("takes_state requires carries_state "
+                             "(a warm step must return the next state)")
+        self.carries_state = bool(carries_state)
+        self.takes_state = bool(takes_state)
+        # `iters`/`stateful` label every execute span: the trace feed is
+        # where warm-start savings become visible (tools/trace_report.py
+        # splits warm vs cold execute time on exactly these attrs)
+        self.iters = None if iters is None else int(iters)
+        self.stateful = self.takes_state
         jit_kwargs = {"donate_argnums": (1,) if donate else ()}
         if shardings is not None:
             params_sh, img_sh, out_sh = shardings
-            jit_kwargs.update(in_shardings=(params_sh, img_sh),
-                              out_shardings=out_sh)
+            if carries_state:
+                # the state rides the batch-axis layout (img_sh is a
+                # leading-axis-only spec, rank-agnostic by construction)
+                st_sh = state_sharding if state_sharding is not None else img_sh
+                in_sh = ((params_sh, img_sh, st_sh) if takes_state
+                         else (params_sh, img_sh))
+                jit_kwargs.update(in_shardings=in_sh,
+                                  out_shardings=(out_sh, st_sh))
+            else:
+                jit_kwargs.update(in_shardings=(params_sh, img_sh),
+                                  out_shardings=out_sh)
         self._jit_fn = jax.jit(fn, **jit_kwargs)
         self._compiled: Dict[int, Any] = {}
         self.monitor = RecompileMonitor(self._jit_fn)
@@ -131,20 +167,29 @@ class BucketedCompileCache:
 
     # -- warmup ------------------------------------------------------------
     def warmup(self, params, img_struct_fn: Callable[[int], jax.ShapeDtypeStruct],
-               *, keep_hlo: bool = True) -> None:
+               *, state_struct_fn: Optional[Callable] = None,
+               keep_hlo: bool = True) -> None:
         """AOT-compile every bucket.  ``params`` may be real arrays or a
         matching pytree of ``ShapeDtypeStruct`` — only shapes/dtypes reach
-        the lowering; ``img_struct_fn(bucket)`` supplies the batch aval.
+        the lowering; ``img_struct_fn(bucket)`` supplies the batch aval,
+        and a ``takes_state`` cache additionally needs
+        ``state_struct_fn(bucket)`` for the carried-state aval.
 
         Idempotent per bucket; records a compile snapshot (HLO optional via
         ``keep_hlo`` — it can run to MBs for big models) for each."""
+        if self.takes_state and state_struct_fn is None:
+            raise ValueError(f"cache {self.name!r} takes_state: warmup "
+                             f"needs state_struct_fn")
         params_struct = jax.tree_util.tree_map(
             lambda p: jax.ShapeDtypeStruct(np.shape(p), p.dtype), params
         )
         for bucket in self.buckets:
             if bucket in self._compiled:
                 continue
-            lowered = self._jit_fn.lower(params_struct, img_struct_fn(bucket))
+            args = (params_struct, img_struct_fn(bucket))
+            if self.takes_state:
+                args += (state_struct_fn(bucket),)
+            lowered = self._jit_fn.lower(*args)
             compiled = lowered.compile()
             self._compiled[bucket] = compiled
             snap = profiling.snapshot_from_compiled(lowered, compiled)
@@ -154,6 +199,10 @@ class BucketedCompileCache:
             # reading warmup bundles can tell an int8 executable's cost
             # model from the f32 one's at a glance
             snap["quant"] = self.quant
+            if self.carries_state:
+                snap["stateful"] = self.takes_state
+            if self.iters is not None:
+                snap["iters"] = self.iters
             if self.mesh_axes:
                 snap["mesh"] = dict(self.mesh_axes)
             self.snapshots[bucket] = snap
@@ -164,7 +213,44 @@ class BucketedCompileCache:
         self.warmed = True
 
     # -- request path ------------------------------------------------------
-    def __call__(self, params, imgs: np.ndarray, *, tracer=None,
+    def _fallback_imgs(self, imgs, state):
+        """Batch axis for the jit-dispatch fallback: a carried state may
+        be BUCKET-shaped (a spill restored under --no-warmup, or a
+        warmed-then-fallback mix) while ``imgs`` is the raw request
+        batch — the two must agree or apply() rejects the mismatched
+        axes, so the fallback pads images up to the state's batch."""
+        if (self.takes_state and state is not None
+                and state.shape[0] != imgs.shape[0]):
+            return pad_to_bucket(imgs, state.shape[0])
+        return imgs
+
+    def _run(self, params, imgs, state, bucket):
+        """One executable dispatch (AOT when warmed, jit fallback
+        otherwise) — the state, when this cache takes one, is already
+        bucket-shaped by the caller's contract."""
+        aot = bucket is not None and bucket in self._compiled
+        if aot:
+            args = (params, pad_to_bucket(imgs, bucket))
+        else:
+            args = (params, self._fallback_imgs(imgs, state))
+        if self.takes_state:
+            args += (state,)
+        fn = self._compiled[bucket] if aot else self._jit_fn
+        return fn(*args), aot
+
+    def _slice_back(self, out, b):
+        """Slice the batch axis back to the real ``b``.  A carries_state
+        output is ``(y, new_state)``: only ``y`` is sliced — the state
+        stays bucket-shaped on purpose (it is the next frame's executable
+        input; see the class docstring)."""
+        if self.carries_state:
+            y, new_state = out
+            if y.shape[0] != b:
+                y = y[:b]
+            return y, new_state
+        return out[:b] if out.shape[0] != b else out
+
+    def __call__(self, params, imgs: np.ndarray, *, state=None, tracer=None,
                  contexts: Sequence = ()):
         """Pad ``imgs`` to its bucket, run, slice the batch axis back.
 
@@ -174,21 +260,23 @@ class BucketedCompileCache:
         by capping the batcher's ``max_batch`` at the largest bucket.
 
         With a ``tracer``, records ``bucket_select`` / ``pad`` /
-        ``execute`` spans — annotated with the bucket shape and padding
-        waste — under every span context in ``contexts`` (the batch-level
-        span first, then each member request: one physical operation
-        fans into every trace that paid for it; only the first context
-        feeds the duration histograms).  Tracing makes ``execute`` block
-        until the device result is ready — the span must hold device
-        time, not dispatch time; the untraced path keeps async dispatch."""
+        ``execute`` spans — annotated with the bucket shape, padding
+        waste, ``iters`` and ``stateful`` — under every span context in
+        ``contexts`` (the batch-level span first, then each member
+        request: one physical operation fans into every trace that paid
+        for it; only the first context feeds the duration histograms).
+        Tracing makes ``execute`` block until the device result is ready
+        — the span must hold device time, not dispatch time; the untraced
+        path keeps async dispatch."""
         b = imgs.shape[0]
         bucket = self.pick(b)
+        if self.takes_state and state is None:
+            raise ValueError(f"cache {self.name!r} takes_state: __call__ "
+                             f"needs state=")
+        extra = (state,) if self.takes_state else ()
         if tracer is None or not contexts:
-            if bucket is None or bucket not in self._compiled:
-                out = self._jit_fn(params, imgs)
-            else:
-                out = self._compiled[bucket](params, pad_to_bucket(imgs, bucket))
-            return out[:b] if out.shape[0] != b else out
+            out, _ = self._run(params, imgs, state, bucket)
+            return self._slice_back(out, b)
 
         clock = tracer.clock
         t0 = clock()          # bucket already picked above: charge ~0
@@ -196,15 +284,15 @@ class BucketedCompileCache:
         if aot:
             padded = pad_to_bucket(imgs, bucket)
             t_pad = clock()
-            out = self._compiled[bucket](params, padded)
+            out = self._compiled[bucket](params, padded, *extra)
         else:
             t_pad = t0
-            out = self._jit_fn(params, imgs)
+            out = self._jit_fn(params, self._fallback_imgs(imgs, state),
+                               *extra)
         # slice INSIDE the execute span: the batch-axis slice is a jax op
         # (it pays a one-off compile per new output shape) and the span
         # must hold everything between padded input and usable result
-        if out.shape[0] != b:
-            out = out[:b]
+        out = self._slice_back(out, b)
         jax.block_until_ready(out)  # glomlint: disable=jax-host-sync -- the execute span's contract: latency is recorded only once the result is device-complete
 
         t_done = clock()
@@ -212,7 +300,10 @@ class BucketedCompileCache:
         # batch size would mint one serving_execute_ms_b<n> metric per
         # distinct fallback size (unbounded cardinality) and fake rows in
         # the per-bucket padding-waste table
-        attrs = {"images": b, "aot": aot, "endpoint": self.name}
+        attrs = {"images": b, "aot": aot, "endpoint": self.name,
+                 "stateful": self.stateful}
+        if self.iters is not None:
+            attrs["iters"] = self.iters
         if aot:
             attrs["bucket"] = bucket
             attrs["padding_waste"] = round((bucket - b) / bucket, 4)

@@ -86,11 +86,16 @@ from glom_tpu.obs.triggers import (
 )
 from glom_tpu.resilience import faultinject, integrity
 from glom_tpu.serving import quant as serving_quant
+from glom_tpu.serving import sessions as serving_sessions
 from glom_tpu.serving.batcher import Closed, DynamicBatcher, Overloaded  # noqa: F401
 from glom_tpu.serving.compile_cache import BucketedCompileCache
 from glom_tpu.training import denoise
 
 ENDPOINTS = ("embed", "reconstruct")
+# endpoints an SLO may target: the batched stateless pair plus the
+# session (stateful streaming) path, which has no batcher but the same
+# outcome-observation contract
+SLO_ENDPOINTS = ENDPOINTS + ("session",)
 
 DEMO_CONFIG = GlomConfig(dim=16, levels=3, image_size=16, patch_size=8)
 
@@ -163,6 +168,37 @@ def _make_reconstruct_fn(config: GlomConfig, train_cfg: TrainConfig,
     return f
 
 
+def _make_session_fns(config: GlomConfig, cold_iters: int, warm_iters: int,
+                      *, ff_fn=None, fused_fn=None):
+    """The stateful (streaming) forwards — ``models/video.py``'s
+    carried-levels semantics, split into the two request-path graphs:
+
+      * ``cold(params, imgs) -> (emb, levels)`` — full settle from
+        ``init_levels`` at ``cold_iters`` (a session's first frame, or a
+        cold restart after eviction/failover);
+      * ``warm(params, imgs, levels) -> (emb, levels)`` — warm-start from
+        the previous frame's equilibrium at the reduced ``warm_iters``.
+
+    Both return the final column state alongside the mean-pooled
+    per-level embeddings, so ``k`` chained calls reproduce
+    ``video.rollout`` over the same ``k`` frames exactly (same
+    ``glom_model.apply``, same carried-levels dtype rule)."""
+
+    def cold(params, imgs):
+        levels = glom_model.apply(params["glom"], imgs, config=config,
+                                  iters=cold_iters, ff_fn=ff_fn,
+                                  fused_fn=fused_fn)
+        return jnp.mean(levels, axis=1), levels
+
+    def warm(params, imgs, levels):
+        new = glom_model.apply(params["glom"], imgs, config=config,
+                               iters=warm_iters, levels=levels,
+                               ff_fn=ff_fn, fused_fn=fused_fn)
+        return jnp.mean(new, axis=1), new
+
+    return cold, warm
+
+
 class ServingEngine:
     """One loaded model + per-endpoint batchers, workers, and caches.
 
@@ -202,6 +238,10 @@ class ServingEngine:
         mesh_shape: Optional[Sequence[int]] = None,
         param_sharding: str = "replicated",
         mesh_axis_names: Sequence[str] = ("data", "model", "seq"),
+        warm_iters=None,
+        session_ttl_s: float = 600.0,
+        session_max_bytes: int = 256 * 2 ** 20,
+        session_spill_dir: Optional[str] = None,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.registry = registry if registry is not None else MetricRegistry()
@@ -339,6 +379,65 @@ class ServingEngine:
         }
         max_bucket = self.caches["embed"].max_bucket
 
+        # -- stateful session serving (glom_tpu.serving.sessions) ----------
+        # warm_iters enables it: a per-session column-state cache plus two
+        # extra compile-cache entries per bucket — the (batch, stateful)
+        # bucket matrix.  Cold settles from init_levels at the full
+        # iteration count; warm starts from the previous frame's
+        # equilibrium at warm_iters (video.rollout's carried-levels
+        # semantics, AOT-compiled so levels-in/levels-out signatures never
+        # compile on the request path).
+        self._session_cold_iters = int(
+            iters if iters is not None else self.config.default_iters)
+        self.sessions: Optional[serving_sessions.SessionStore] = None
+        self._session_spill_dir = session_spill_dir
+        self._state_sharding = img_sh  # leading-axis spec: rank-agnostic
+        if warm_iters is not None:
+            if warm_iters == "auto":
+                warm_iters = max(1, self._session_cold_iters // 2)
+            warm_iters = int(warm_iters)
+            if warm_iters < 1:
+                raise ValueError(f"warm_iters must be >= 1, got {warm_iters}")
+            self._session_warm_iters = warm_iters
+            cold_fn, warm_fn = _make_session_fns(
+                serve_cfg, self._session_cold_iters, warm_iters,
+                ff_fn=ff_fn, fused_fn=fused_fn,
+            )
+            self.caches["session_cold"] = BucketedCompileCache(
+                serving_quant.quantized_forward(cold_fn, quant),
+                buckets, name="session_cold", quant=quant,
+                donate=donate_inputs, shardings=shardings,
+                mesh_axes=mesh_axes, carries_state=True,
+                iters=self._session_cold_iters)
+            self.caches["session_warm"] = BucketedCompileCache(
+                serving_quant.quantized_forward(warm_fn, quant),
+                buckets, name="session_warm", quant=quant,
+                donate=donate_inputs, shardings=shardings,
+                mesh_axes=mesh_axes, carries_state=True, takes_state=True,
+                state_sharding=img_sh, iters=warm_iters)
+            # the carried-state aval: what apply() returns under the
+            # serving config (compute dtype; quantized trees dequantize
+            # in-graph and never change the activation dtype)
+            c = serve_cfg
+            self._state_dtype = jnp.dtype(c.compute_dtype or c.param_dtype)
+            self._state_tail = (c.num_patches, c.levels, c.dim)
+            self.sessions = serving_sessions.SessionStore(
+                max_bytes=session_max_bytes, ttl_s=session_ttl_s,
+                registry=self.registry, clock=self._clock,
+            )
+            if session_spill_dir:
+                # warm-boot: a drained replica's spilled states come back
+                # resident, so the fleet survives a reload without every
+                # client paying a cold re-settle (serving_session_restores
+                # counts what came back; invalid entries are dropped)
+                self.sessions.restore(
+                    session_spill_dir,
+                    validate=self._valid_spilled_state,
+                    place=self._place_state,
+                )
+        else:
+            self._session_warm_iters = None
+
         # -- batchers (admission control) ----------------------------------
         self.batchers: Dict[str, DynamicBatcher] = {
             ep: DynamicBatcher(
@@ -392,10 +491,10 @@ class ServingEngine:
                 # fail loud at startup: a typoed endpoint would be
                 # accepted and then silently never evaluate — the worst
                 # failure mode for an alerting layer
-                if s.endpoint is not None and s.endpoint not in ENDPOINTS:
+                if s.endpoint is not None and s.endpoint not in SLO_ENDPOINTS:
                     raise ValueError(
                         f"SLO {s.name!r} names unknown endpoint "
-                        f"{s.endpoint!r}; valid endpoints: {ENDPOINTS}"
+                        f"{s.endpoint!r}; valid endpoints: {SLO_ENDPOINTS}"
                     )
             self._slo = SloManager(
                 parsed,
@@ -416,6 +515,11 @@ class ServingEngine:
         self._reload_lock = threading.Lock()
 
         self._lock = threading.Lock()  # params swap + counters + saturation
+        # session-frame drain accounting: /session/* bypasses the
+        # batchers, so shutdown needs its own barrier to know every
+        # acknowledged frame's state has been put before the spill
+        self._session_inflight = 0
+        self._session_cv = threading.Condition()
         self._threads: list = []
         self._stop = threading.Event()
         self._started = False
@@ -444,6 +548,13 @@ class ServingEngine:
                 lambda b: jax.ShapeDtypeStruct(
                     (b, c.channels, c.image_size, c.image_size), np.float32,
                 ),
+                # the warm (takes_state) session cache additionally needs
+                # the carried-state aval per bucket — this is what makes
+                # the (batch, stateful) matrix fully AOT: a session's
+                # levels-in/levels-out signature never compiles on the
+                # request path
+                state_struct_fn=(self._session_state_struct
+                                 if cache.takes_state else None),
             )
             if self._warmup_dir:
                 self._write_warmup_snapshots(ep, cache)
@@ -520,6 +631,28 @@ class ServingEngine:
             t.join(timeout=max(0.0, deadline - time.monotonic()))  # glomlint: disable=conc-raw-clock -- paired with the wall-clock deadline above
 
         self._threads = []
+        if self.sessions is not None and self._session_spill_dir:
+            # spill AFTER the workers drained AND in-flight session
+            # frames completed their put (admission is gated on _stop, so
+            # the count only goes down): an acknowledged frame's state
+            # must be in the spill — "nothing accepted is dropped" covers
+            # sessions too.  A crash mid-spill leaves the previous spill
+            # intact (atomic tmp+rename).
+            with self._session_cv:
+                drained = self._session_cv.wait_for(
+                    lambda: self._session_inflight == 0,
+                    timeout=max(0.0, deadline - time.monotonic()),  # glomlint: disable=conc-raw-clock -- paired with the wall-clock drain deadline above
+                )
+            if not drained:
+                warnings.warn(
+                    f"{self._session_inflight} session frame(s) still in "
+                    f"flight at the drain deadline; spilling without them",
+                    stacklevel=2)
+            try:
+                self.sessions.spill(self._session_spill_dir)
+            except OSError as e:
+                warnings.warn(f"session spill failed ({e}); fleet reboots "
+                              f"cold", stacklevel=2)
         if self.tracer.exporter is not None:
             # deterministic trace-log lifecycle (a later emit reopens in
             # append mode, matching the MetricLogger contract)
@@ -776,6 +909,10 @@ class ServingEngine:
             * min(2 ** self._reload_failstreak, self._reload_backoff_max)
         ):
             self.check_reload()
+            if self.sessions is not None:
+                # abandoned streams age out on the watcher cadence rather
+                # than waiting for byte pressure to reclaim their HBM
+                self.sessions.sweep()
 
     # -- request path ------------------------------------------------------
     def submit(self, endpoint: str, imgs: np.ndarray, *, ctx=None):
@@ -855,6 +992,156 @@ class ServingEngine:
             served = self.process_once(endpoint, block=True, timeout=0.25)
             if served == 0 and batcher.closed and batcher.depth == 0:
                 return
+
+    # -- stateful session serving (the /session/* request path) ------------
+    @property
+    def sessions_enabled(self) -> bool:
+        return self.sessions is not None
+
+    def _session_state_struct(self, bucket: int) -> jax.ShapeDtypeStruct:
+        return jax.ShapeDtypeStruct((bucket,) + self._state_tail,
+                                    self._state_dtype)
+
+    def _valid_spilled_state(self, shape, dtype) -> bool:
+        # the spill normalizes dtype to float32 for npz portability;
+        # _place_state casts back to the serving state dtype — so only
+        # the SHAPE gates restore: it must be a bucket this engine
+        # actually compiled (a ladder change makes old state unservable)
+        del dtype
+        return (len(shape) == 4
+                and shape[0] in self.caches["session_cold"].buckets
+                and tuple(shape[1:]) == self._state_tail)
+
+    def _place_state(self, host_levels):
+        arr = jnp.asarray(host_levels, dtype=self._state_dtype)
+        if self._state_sharding is not None:
+            return jax.device_put(arr, self._state_sharding)
+        return jax.device_put(arr)
+
+    def session_embed(self, session_id: str, imgs: np.ndarray, *, ctx=None):
+        """One frame of a stateful session: warm-start from the session's
+        resident column state at ``warm_iters`` when it exists, full cold
+        settle otherwise.  Returns ``(embeddings, info)`` where ``info``
+        carries ``cold`` / ``frames`` / ``iters`` (the response contract).
+
+        Runs synchronously on the caller's thread — a session's frames
+        are inherently ordered (frame k+1 consumes frame k's state), so
+        micro-batching across requests buys nothing within a session;
+        across sessions the per-session locks let the device interleave
+        frames freely.  Everything device-side is an AOT bucket
+        executable; the state never leaves the device between frames."""
+        if self.sessions is None:
+            raise RuntimeError(
+                "sessions disabled on this engine (construct with "
+                "warm_iters= to enable /session/embed)")
+        if not serving_sessions.valid_session_id(session_id):
+            raise ValueError(
+                f"invalid session id {session_id!r} (want "
+                f"{serving_sessions.SESSION_ID_RE.pattern})")
+        imgs = np.ascontiguousarray(imgs, dtype=np.float32)
+        b = imgs.shape[0]
+        cold_cache = self.caches["session_cold"]
+        warm_cache = self.caches["session_warm"]
+        bucket = cold_cache.pick(b)
+        if bucket is None:
+            raise ValueError(
+                f"session frame batch {b} exceeds the largest bucket "
+                f"{cold_cache.max_bucket}")
+        contexts = [ctx] if ctx is not None else []
+        restart = None
+        # admission + drain accounting: a draining engine rejects new
+        # frames (the server maps Closed to the structured 503), and the
+        # spill waits for every admitted frame's put — check and count
+        # under one condition so no frame slips between them
+        with self._session_cv:
+            if self._stop.is_set():
+                raise Closed("engine draining; session frame rejected")
+            self._session_inflight += 1
+        try:
+            with self.sessions.locked(session_id):
+                entry = self.sessions.get(session_id)
+                if entry is not None and entry.batch != b:
+                    # documented cold-restart: the state's aval is pinned
+                    # to the session's original batch size; a client
+                    # changing its per-frame image count starts a fresh
+                    # equilibrium
+                    self.sessions.reset(session_id)
+                    entry, restart = None, "batch_changed"
+                params = self.params  # snapshot: this frame runs whole on it
+                t0 = self._clock()
+                if entry is None:
+                    out, new_levels = cold_cache(
+                        params, imgs, tracer=self.tracer, contexts=contexts)
+                    cold, frames = True, 1
+                else:
+                    out, new_levels = warm_cache(
+                        params, imgs, state=entry.levels,
+                        tracer=self.tracer, contexts=contexts)
+                    cold, frames = False, entry.frames + 1
+                elapsed = self._clock() - t0
+                self.sessions.put(session_id, new_levels, batch=b,
+                                  bucket=bucket, step=self.step,
+                                  frames=frames)
+        finally:
+            with self._session_cv:
+                self._session_inflight -= 1
+                self._session_cv.notify_all()
+        out = np.asarray(out)
+        self._account_session(cold, b, elapsed, restart)
+        info = {"cold": cold, "frames": frames,
+                "iters": (self._session_cold_iters if cold
+                          else self._session_warm_iters)}
+        if restart is not None:
+            info["restart"] = restart
+        return out, info
+
+    def session_reset(self, session_id: str) -> bool:
+        """Drop a session's state (``/session/reset``); the next frame
+        settles cold.  Returns whether state existed.  Taken under the
+        session's frame-ordering lock: a reset racing an in-flight frame
+        must order as reset-then-frame or frame-then-reset — never "the
+        frame's put silently undoes the acknowledged reset"."""
+        if self.sessions is None:
+            raise RuntimeError("sessions disabled on this engine")
+        with self.sessions.locked(session_id):
+            return self.sessions.reset(session_id)
+
+    def _account_session(self, cold: bool, images: int, elapsed_s: float,
+                         restart) -> None:
+        reg = self.registry
+        with self._lock:
+            self.request_count += 1
+        reg.counter("serving_requests_total",
+                    help="images served across endpoints").inc(images)
+        mode = "cold" if cold else "warm"
+        reg.counter(
+            f"serving_session_{mode}_frames",
+            help=f"session frames served {mode} "
+                 + ("(full settle)" if cold else "(warm-started)"),
+        ).inc()
+        reg.histogram(
+            f"serving_session_frame_seconds_{mode}",
+            help=f"device time per {mode} session frame", unit="seconds",
+        ).observe(elapsed_s)
+        if restart is not None:
+            reg.counter(
+                "serving_session_cold_restarts",
+                help="sessions restarted cold after a per-frame batch-size "
+                     "change (eviction/failover colds surface as "
+                     "serving_session_misses)",
+            ).inc()
+        for cache_name in ("session_cold", "session_warm"):
+            new_compiles = self.caches[cache_name].poll_compiles()
+            if new_compiles:
+                reg.counter(
+                    "serving_xla_compiles",
+                    help="request-path XLA compiles after warmup "
+                         "(must stay 0)",
+                ).inc(new_compiles)
+        # fleet replicas disable the reload watcher (the router owns
+        # rollouts), so TTL reclamation rides the traffic itself:
+        # interval-gated, O(entries) only when it actually fires
+        self.sessions.sweep(min_interval=max(1.0, self.sessions.ttl_s / 10.0))
 
     # -- accounting / overload forensics -----------------------------------
     def _account_batch(self, endpoint, cache, n, batch_s) -> None:
@@ -993,6 +1280,11 @@ class ServingEngine:
             "donate_inputs": self.caches["embed"].donates_input,
             "mesh": mesh_axes_dict(self.mesh),
             "param_sharding": self.param_sharding,
+            "sessions": (None if self.sessions is None else {
+                "warm_iters": self._session_warm_iters,
+                "cold_iters": self._session_cold_iters,
+                **self.sessions.snapshot(),
+            }),
             "staged_step": None if staged is None else int(staged[0]),
             "image_size": c.image_size,
             "channels": c.channels,

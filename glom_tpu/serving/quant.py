@@ -130,14 +130,17 @@ def serving_config(config, mode: str):
 
 
 def quantized_forward(fn, mode: str):
-    """Wrap an endpoint forward ``fn(params, imgs)`` so it accepts the
-    quantized tree: dequantization happens INSIDE the traced graph (the
-    whole point — the executable's weight inputs stay int8/bf16)."""
+    """Wrap an endpoint forward ``fn(params, imgs, *rest)`` so it accepts
+    the quantized tree: dequantization happens INSIDE the traced graph
+    (the whole point — the executable's weight inputs stay int8/bf16).
+    Extra positional args (the stateful session forwards' carried
+    ``levels``) pass through untouched — state is activations, never
+    weights, and must not be quantized."""
     if mode == "f32":
         return fn
 
-    def f(qparams, imgs):
-        return fn(dequantize_tree(qparams), imgs)
+    def f(qparams, imgs, *rest):
+        return fn(dequantize_tree(qparams), imgs, *rest)
 
     return f
 

@@ -2,8 +2,8 @@
 
 Within a replica, :mod:`glom_tpu.serving.sharded` scales the MODEL (mesh-
 sharded buckets); this module scales THROUGHPUT: a stdlib HTTP front that
-dispatches ``/embed`` / ``/reconstruct`` across N independent engine
-replicas — the TPU serving playbook (arXiv:2204.06514, the Gemma serving
+dispatches ``/embed`` / ``/reconstruct`` / ``/session/*`` across N
+independent engine replicas — the TPU serving playbook (arXiv:2204.06514, the Gemma serving
 comparison arXiv:2605.25645): shard within a slice for size, replicate
 across slices for load.
 
@@ -91,6 +91,18 @@ from glom_tpu.obs.tracing import (
 )
 
 ENDPOINTS = ("embed", "reconstruct")
+# proxied POST routes: the stateless pair plus the stateful session
+# endpoints.  Session requests SHOULD carry ``X-Affinity-Key: <session
+# id>`` — the consistent-hash ring then pins the whole stream to one
+# replica, where its column state is resident (the router never parses
+# request bodies to recover the id: body parsing on the proxy hot path
+# would tax every request for the session feature).  Without the header a
+# session still WORKS — least-loaded dispatch just scatters its frames,
+# and each replica that sees one cold-settles (correct, but the warm-
+# start savings are lost).  On ejection the ring moves only the dead
+# replica's keys: those sessions cold-restart on their new replica — the
+# documented cold-restart contract (docs/SERVING.md).
+ROUTED_PATHS = ("/embed", "/reconstruct", "/session/embed", "/session/reset")
 _VNODES = 64
 _HEX_ID = re.compile(r"[0-9a-f]{1,32}")
 # one Prometheus sample line: name[{labels}] value [timestamp]
@@ -1040,7 +1052,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
             code = 200 if report["status"] in ("committed", "noop") else 502
             self._reply(code, report)
             return
-        if self.path not in ("/embed", "/reconstruct"):
+        if self.path not in ROUTED_PATHS:
             self._reply(404, {"error": f"no route {self.path}"})
             return
         endpoint = self.path[1:]
@@ -1128,6 +1140,8 @@ def _spawn_fleet(n: int, args) -> Tuple[List[str], list]:
             # rollout is the only param-swap path in a fleet
             reload_poll_s=0,
             quant=args.quant,
+            # passed through raw: the engine normalizes None/'auto'/int
+            warm_iters=args.warm_iters,
         )
         engine.start(watch=False)
         server = make_server(engine, args.host, 0)
@@ -1164,6 +1178,10 @@ def main(argv=None) -> int:
                    help="with --spawn: per-replica serving precision")
     p.add_argument("--max-wait-ms", type=float, default=5.0,
                    help="with --spawn: per-replica micro-batch deadline")
+    p.add_argument("--warm-iters", default=None, metavar="N|auto",
+                   help="with --spawn: enable stateful sessions on every "
+                        "replica (clients pin a session with "
+                        "X-Affinity-Key: <session id>)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8800)
     p.add_argument("--health-interval-s", type=float, default=1.0,

@@ -225,10 +225,132 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._reply(404, {"error": f"no admin action {action!r}"})
 
+    # -- stateful session endpoints ----------------------------------------
+    # POST /session/embed: one frame of a stateful stream — warm-starts
+    # from the session's resident column state (docs/SERVING.md sessions
+    # section).  POST /session/reset drops the state.  Both need the
+    # engine constructed with warm_iters=.
+    def _do_session(self):
+        engine = self.server.engine
+        tracer = engine.tracer
+        if not engine.sessions_enabled:
+            self._reply(404, {"error": "sessions disabled on this engine "
+                                       "(start the server with --warm-iters)"})
+            return
+        from glom_tpu.serving.sessions import valid_session_id
+
+        if self.path == "/session/reset":
+            # control-plane call, untraced (the /admin/reload convention)
+            payload = self._read_json()
+            if payload is None:
+                return
+            session_id = payload.get("session")
+            if not valid_session_id(session_id):
+                self._reply(400, {"error": (
+                    f"bad 'session' field {session_id!r}: want 1-128 chars "
+                    f"of [A-Za-z0-9._:-]"
+                )})
+                return
+            self._reply(200, {"session": session_id,
+                              "reset": engine.session_reset(session_id)})
+            return
+
+        # /session/embed: the trace starts BEFORE the body read, exactly
+        # like the stateless handler — the parse span must hold the
+        # socket read + json.loads (for big frames that IS the parse)
+        rid_header = request_trace_id(self.headers.get("X-Request-Id"))
+        remote = parse_traceparent(self.headers.get("traceparent"))
+        root = tracer.start_trace(
+            SPAN_REQUEST,
+            trace_id=rid_header or (remote[0] if remote else None),
+            parent_id=remote[1] if remote else None,
+            attrs={"endpoint": "session"},
+        )
+        self._trace_root = root
+        self._request_id = rid_header or root.trace_id
+
+        def _finish(status: int, latency_ms=None, at=None):
+            tracer.end(root, attrs={"status": status}, at=at)
+            engine.observe_outcome("session", latency_ms, status >= 500,
+                                   trace_id=root.trace_id)
+
+        payload = self._read_json()
+        session_id = payload.get("session") if payload is not None else None
+        if payload is not None:
+            if not valid_session_id(session_id):
+                self._reply(400, {"error": (
+                    f"bad 'session' field {session_id!r}: want 1-128 chars "
+                    f"of [A-Za-z0-9._:-]"
+                )})
+                payload = None
+            else:
+                root.attrs["session"] = session_id
+        imgs = self._parse_images(payload) if payload is not None else None
+        t_parsed = tracer.clock()
+        tracer.record(SPAN_PARSE, root, root.start, t_parsed)
+        if imgs is None:
+            _finish(400)
+            return
+        import time as _time
+
+        t0 = _time.monotonic()
+        try:
+            out, info = engine.session_embed(session_id, imgs, ctx=root)
+        except Closed:
+            self._reply(503, {"error": "shutting_down",
+                              "detail": "server is draining; retry elsewhere"})
+            _finish(503)
+            return
+        except ValueError as e:  # oversize frame batch
+            self._reply(400, {"error": str(e)})
+            _finish(400)
+            return
+        except Exception as e:
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            _finish(500)
+            return
+        latency = _time.monotonic() - t0
+        # tile the handler exactly like the stateless path: dispatch_wait
+        # spans the whole inline execute window (the cache's execute span
+        # overlaps inside it; union coverage dedupes) so a session trace
+        # explains its wall time with no instrumentation gap
+        t_done = tracer.clock()
+        tracer.record(SPAN_DISPATCH_WAIT, root, t_parsed, t_done)
+        engine.registry.histogram(
+            "serving_latency_seconds_session",
+            help="session frame latency, admission to response",
+            unit="seconds",
+        ).observe(latency)
+        level = payload.get("level")
+        if level is not None:
+            try:
+                out = out[:, int(level)]
+            except (IndexError, TypeError, ValueError):
+                self._reply(400, {"error": (
+                    f"level {level!r} outside this model's "
+                    f"{engine.config.levels} levels"
+                )})
+                _finish(400)
+                return
+        self._reply(200, {
+            "step": int(engine.step),
+            "latency_ms": round(latency * 1e3, 3),
+            "request_id": self._request_id,
+            "session": session_id,
+            "embeddings": out.tolist(),
+            **info,
+        })
+        t_end = tracer.clock()
+        tracer.record(SPAN_RESPOND, root, t_done, t_end)
+        _finish(200, latency_ms=latency * 1e3, at=t_end)
+
     def do_POST(self):  # noqa: N802
         self._request_id = None  # reset before routing (keep-alive reuse)
         if self.path.startswith("/admin/reload/"):
             self._do_admin()
+            return
+        if self.path in ("/session/embed", "/session/reset"):
+            self._do_session()
             return
         if self.path not in ("/embed", "/reconstruct"):
             self._reply(404, {"error": f"no route {self.path}"})
@@ -388,6 +510,21 @@ def main(argv=None) -> int:
                    help="queued-image bound; beyond it requests shed (503)")
     p.add_argument("--iters", type=int, default=None,
                    help="GLOM iterations (default: the model's)")
+    p.add_argument("--warm-iters", default=None, metavar="N|auto",
+                   help="enable stateful sessions (/session/embed + "
+                        "/session/reset): warm frames settle from the "
+                        "previous frame's equilibrium in N iterations "
+                        "('auto' = half the cold count).  Gate the value "
+                        "with tools/session_check.py first")
+    p.add_argument("--session-ttl-s", type=float, default=600.0,
+                   help="idle sessions older than this are evicted")
+    p.add_argument("--session-max-mb", type=float, default=256.0,
+                   help="byte bound on resident session state; LRU "
+                        "sessions evict beyond it")
+    p.add_argument("--session-spill-dir", default=None,
+                   help="spill session state here on drain and restore it "
+                        "at startup (checkpoint npz format) — a rolling "
+                        "restart keeps the fleet warm")
     p.add_argument("--reload-poll-s", type=float, default=2.0,
                    help="checkpoint hot-reload poll period; 0 disables")
     p.add_argument("--no-warmup", action="store_true",
@@ -457,6 +594,11 @@ def main(argv=None) -> int:
         mesh_shape=(tuple(int(s) for s in args.mesh_shape.split(","))
                     if args.mesh_shape else None),
         param_sharding=args.param_sharding,
+        # passed through raw: the engine normalizes None/'auto'/int
+        warm_iters=args.warm_iters,
+        session_ttl_s=args.session_ttl_s,
+        session_max_bytes=int(args.session_max_mb * 2 ** 20),
+        session_spill_dir=args.session_spill_dir,
     )
     engine.start()
     server = make_server(engine, args.host, args.port, quiet=not args.verbose)

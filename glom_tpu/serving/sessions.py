@@ -1,0 +1,402 @@
+"""Per-session column-state cache for stateful (video/streaming) serving.
+
+GLOM's stateful recipe carries the column ``levels`` state across
+consecutive frames (``models/video.py``; PAPER.md §layer map).  Serving
+that recipe means the state must live SOMEWHERE between two HTTP
+requests — this module is that somewhere:
+
+  * one :class:`SessionEntry` per client session, holding the session's
+    settled ``(bucket, n, L, d)`` levels **on device** (the whole point
+    of O(1) incremental serving is that the state never crosses the
+    host/device boundary between frames — arXiv:2603.09555's fixed-size
+    carried state, GLOM-shaped);
+  * the state is stored at its compile-cache **bucket** batch size, not
+    the request's real batch: the next frame feeds it straight back into
+    the bucket's AOT executable with zero padding/reshaping work (a
+    per-frame device pad would be a new shape — a request-path compile);
+  * **TTL + LRU eviction, size-bounded in bytes**: abandoned streams age
+    out on ``ttl_s``, and when the resident set exceeds ``max_bytes``
+    the least-recently-used sessions are dropped (the newest entry is
+    always retained, so an over-budget single session degrades to
+    cold-per-frame rather than erroring);
+  * **per-session locks**: frame k+1 depends on frame k, so two racing
+    requests for one session serialize; distinct sessions never contend;
+  * optional **spill/restore** in the checkpoint npz format
+    (``sessions.npz`` + ``sessions.json`` manifest, atomic tmp+rename
+    writes) so a drained replica's warm state survives a process
+    restart — the fleet reloads warm instead of paying every client a
+    cold re-settle.
+
+Everything is observable through the shared registry:
+``serving_session_count`` / ``serving_session_bytes`` gauges plus
+hit/miss/eviction/reset/spill counters (``serving_session_*``).
+
+The store is deliberately ignorant of jax beyond ``device_put``/
+``device_get`` at the spill boundary: entries hold whatever array object
+the engine gives them.  All clocks are injectable (tests drive TTL
+deterministically); ``time.monotonic`` is the default.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import re
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+# the session-id contract, enforced at the HTTP boundary and re-checked
+# here (ids become npz keys and affinity-hash inputs; a hostile id must
+# not be able to traverse paths or splice the spill manifest)
+SESSION_ID_RE = re.compile(r"^[A-Za-z0-9._:-]{1,128}$")
+
+SPILL_NPZ = "sessions.npz"
+SPILL_MANIFEST = "sessions.json"
+_SPILL_FORMAT = 1
+
+
+def valid_session_id(session_id: str) -> bool:
+    return isinstance(session_id, str) and bool(SESSION_ID_RE.match(session_id))
+
+
+@dataclass
+class SessionEntry:
+    """One session's carried state.  ``levels`` is bucket-shaped (the AOT
+    executable's aval), ``batch`` is the session's real per-frame image
+    count — embeddings are sliced to it host-side, the state never is."""
+
+    levels: Any                 # (bucket, n, L, d) device array
+    batch: int                  # real images per frame for this session
+    bucket: int                 # compile-cache bucket the state is shaped for
+    step: int                   # checkpoint step at the last update
+    frames: int = 0             # frames processed so far
+    last_used: float = 0.0      # store-clock timestamp of the last touch
+    nbytes: int = 0
+
+    def meta(self) -> dict:
+        return {"batch": int(self.batch), "bucket": int(self.bucket),
+                "step": int(self.step), "frames": int(self.frames)}
+
+
+@dataclass
+class SessionStats:
+    hits: int = 0
+    misses: int = 0
+    resets: int = 0
+    evicted_ttl: int = 0
+    evicted_lru: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+def _leaf_nbytes(levels) -> int:
+    nbytes = getattr(levels, "nbytes", None)
+    if nbytes is not None:
+        return int(nbytes)
+    return int(np.asarray(levels).nbytes)
+
+
+class SessionStore:
+    """TTL + LRU, byte-bounded map ``session_id -> SessionEntry``.
+
+    The map lock covers only dict bookkeeping (O(1) per op); per-session
+    locks (:meth:`lock`) are held by the engine across a frame's whole
+    get-execute-put so one session's frames serialize while the device
+    pipelines other sessions' work.
+    """
+
+    def __init__(self, *, max_bytes: int = 256 * 2 ** 20,
+                 ttl_s: float = 600.0, registry=None, clock=None):
+        if max_bytes < 1:
+            raise ValueError(f"max_bytes must be >= 1, got {max_bytes}")
+        if ttl_s <= 0:
+            raise ValueError(f"ttl_s must be > 0, got {ttl_s}")
+        self.max_bytes = int(max_bytes)
+        self.ttl_s = float(ttl_s)
+        self.registry = registry
+        self._clock = clock if clock is not None else time.monotonic
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, SessionEntry]" = OrderedDict()
+        self._locks: Dict[str, threading.Lock] = {}
+        self._bytes = 0
+        self._last_sweep = self._clock()
+        self.stats = SessionStats()
+
+    # -- registry plumbing -------------------------------------------------
+    def _counter(self, name: str, help: str):
+        if self.registry is not None:
+            self.registry.counter(name, help=help).inc()
+
+    def _export_gauges(self) -> None:
+        if self.registry is None:
+            return
+        self.registry.gauge(
+            "serving_session_count", help="resident session states",
+        ).set(len(self._entries))
+        self.registry.gauge(
+            "serving_session_bytes",
+            help="bytes of resident session state", unit="bytes",
+        ).set(self._bytes)
+
+    # -- per-session serialization ----------------------------------------
+    def lock(self, session_id: str) -> threading.Lock:
+        """The session's frame-ordering lock object.  Callers serializing
+        a frame must use :meth:`locked` — a bare ``lock().acquire()``
+        races lock cleanup (the object can be dropped and re-minted
+        between the fetch and the acquire, leaving two threads holding
+        two distinct locks for one session)."""
+        with self._lock:
+            lock = self._locks.get(session_id)
+            if lock is None:
+                lock = self._locks[session_id] = threading.Lock()
+            return lock
+
+    @contextlib.contextmanager
+    def locked(self, session_id: str):
+        """Hold the session's frame-ordering lock for one frame's whole
+        get-execute-put.  Acquisition re-validates that the acquired
+        object is STILL the session's mapped lock (an eviction's
+        idle-lock cleanup may have dropped and re-minted it in the
+        fetch→acquire window) — once validated it cannot be dropped out
+        from under us, because cleanup skips held locks."""
+        while True:
+            lock = self.lock(session_id)
+            lock.acquire()
+            with self._lock:
+                if self._locks.get(session_id) is lock:
+                    break
+            lock.release()
+        try:
+            yield
+        finally:
+            lock.release()
+
+    def _drop_lock_if_idle(self, session_id: str) -> None:
+        # caller holds self._lock; never drop a lock a frame is holding
+        lock = self._locks.get(session_id)
+        if lock is not None and not lock.locked():
+            del self._locks[session_id]
+
+    # -- core map ops ------------------------------------------------------
+    def get(self, session_id: str) -> Optional[SessionEntry]:
+        """TTL-checked lookup; a hit refreshes both recency orders."""
+        now = self._clock()
+        with self._lock:
+            entry = self._entries.get(session_id)
+            if entry is None:
+                with self.stats._lock:
+                    self.stats.misses += 1
+                self._counter("serving_session_misses",
+                              "session lookups that found no state")
+                return None
+            if now - entry.last_used > self.ttl_s:
+                self._evict_locked(session_id, "ttl")
+                self._export_gauges()
+                with self.stats._lock:
+                    self.stats.misses += 1
+                self._counter("serving_session_misses",
+                              "session lookups that found no state")
+                return None
+            entry.last_used = now
+            self._entries.move_to_end(session_id)
+            with self.stats._lock:
+                self.stats.hits += 1
+            self._counter("serving_session_hits",
+                          "session lookups served from resident state")
+            return entry
+
+    def put(self, session_id: str, levels, *, batch: int, bucket: int,
+            step: int, frames: int) -> SessionEntry:
+        """Insert/replace a session's state, then enforce the byte bound
+        (LRU-evicting OTHER sessions; the entry just written always
+        stays — see module docstring)."""
+        if not valid_session_id(session_id):
+            raise ValueError(f"invalid session id {session_id!r}")
+        now = self._clock()
+        entry = SessionEntry(
+            levels=levels, batch=int(batch), bucket=int(bucket),
+            step=int(step), frames=int(frames), last_used=now,
+            nbytes=_leaf_nbytes(levels),
+        )
+        with self._lock:
+            old = self._entries.pop(session_id, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[session_id] = entry
+            self._bytes += entry.nbytes
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                oldest = next(iter(self._entries))
+                if oldest == session_id:
+                    break
+                self._evict_locked(oldest, "lru")
+            self._export_gauges()
+        return entry
+
+    def reset(self, session_id: str) -> bool:
+        """Client-requested forget (``/session/reset``)."""
+        with self._lock:
+            entry = self._entries.pop(session_id, None)
+            if entry is None:
+                return False
+            self._bytes -= entry.nbytes
+            self._drop_lock_if_idle(session_id)
+            with self.stats._lock:
+                self.stats.resets += 1
+            self._counter("serving_session_resets",
+                          "client-requested session resets")
+            self._export_gauges()
+            return True
+
+    def _evict_locked(self, session_id: str, why: str) -> None:
+        entry = self._entries.pop(session_id)
+        self._bytes -= entry.nbytes
+        self._drop_lock_if_idle(session_id)
+        with self.stats._lock:
+            if why == "ttl":
+                self.stats.evicted_ttl += 1
+            else:
+                self.stats.evicted_lru += 1
+        self._counter(
+            f"serving_session_evictions_{why}",
+            "sessions evicted by " + ("TTL expiry" if why == "ttl"
+                                      else "LRU byte-bound pressure"),
+        )
+
+    def sweep(self, *, min_interval: Optional[float] = None) -> int:
+        """Evict every TTL-expired session so abandoned streams don't
+        wait for the next byte-pressure event to free their HBM.  Called
+        from the engine's reload watcher when one runs, AND interval-
+        gated from the session request path itself (``min_interval``
+        no-ops the call when a sweep ran recently) — fleet replicas run
+        with the watcher disabled (the router owns reloads), so traffic
+        must be able to drive TTL reclamation on its own."""
+        now = self._clock()
+        evicted = 0
+        with self._lock:
+            if (min_interval is not None
+                    and now - self._last_sweep < min_interval):
+                return 0
+            self._last_sweep = now
+            for sid in [sid for sid, e in self._entries.items()
+                        if now - e.last_used > self.ttl_s]:
+                self._evict_locked(sid, "ttl")
+                evicted += 1
+            if evicted:
+                self._export_gauges()
+        return evicted
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def nbytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def snapshot(self) -> dict:
+        """Health/debug payload: counts only, never the state itself."""
+        with self._lock:
+            count, nbytes = len(self._entries), self._bytes
+        with self.stats._lock:
+            s = {"hits": self.stats.hits, "misses": self.stats.misses,
+                 "resets": self.stats.resets,
+                 "evicted_ttl": self.stats.evicted_ttl,
+                 "evicted_lru": self.stats.evicted_lru}
+        return {"count": count, "bytes": nbytes,
+                "max_bytes": self.max_bytes, "ttl_s": self.ttl_s, **s}
+
+    # -- spill / restore (checkpoint npz format) ---------------------------
+    def spill(self, directory: str) -> int:
+        """Write every resident session to ``directory`` in the checkpoint
+        npz layout: one ``sessions.npz`` ('levels/<sid>' keys) plus a
+        ``sessions.json`` manifest, both atomic tmp+rename writes (the
+        shared :func:`glom_tpu.checkpoint._atomic_write` — a SIGKILL
+        mid-spill leaves the previous spill intact, never a torn one).
+        Returns the number of sessions written."""
+        import os
+
+        import jax
+
+        from glom_tpu import checkpoint as ckpt_lib
+
+        os.makedirs(directory, exist_ok=True)
+        with self._lock:
+            items = list(self._entries.items())  # oldest -> newest (LRU order)
+        arrays = {}
+        manifest: Dict[str, dict] = {}
+        for sid, entry in items:
+            arrays[f"levels/{sid}"] = np.asarray(jax.device_get(entry.levels))
+            manifest[sid] = entry.meta()
+        payload = json.dumps(
+            {"format": _SPILL_FORMAT, "sessions": manifest}, indent=2,
+        ).encode()
+        ckpt_lib._atomic_write(directory, SPILL_NPZ,
+                               lambda f: np.savez(f, **arrays))
+        ckpt_lib._atomic_write(directory, SPILL_MANIFEST,
+                               lambda f: f.write(payload))
+        if self.registry is not None:
+            self.registry.counter(
+                "serving_session_spills",
+                help="session-store spills to the checkpoint npz format",
+            ).inc()
+        return len(items)
+
+    def restore(self, directory: str, *,
+                validate: Optional[Callable[[tuple, Any], bool]] = None,
+                place: Optional[Callable[[np.ndarray], Any]] = None) -> int:
+        """Reload a spill written by :meth:`spill`.  Missing/torn files
+        are a clean no-op (a cold boot is always safe); entries whose
+        shape/dtype ``validate(shape, dtype)`` rejects are dropped (the
+        model or bucket ladder changed — a cold re-settle is correct,
+        stale state silently fed to a new graph is not).  ``place`` maps
+        each host array onto the device (the engine's placement rule).
+        Ages do not survive a restart (the store clock is monotonic), so
+        restored sessions count as freshly used.  Returns sessions
+        restored."""
+        import os
+
+        npz_path = os.path.join(directory, SPILL_NPZ)
+        man_path = os.path.join(directory, SPILL_MANIFEST)
+        try:
+            with open(man_path) as f:
+                manifest = json.load(f)
+            data = np.load(npz_path, allow_pickle=False)
+        except (OSError, ValueError):
+            return 0
+        if not isinstance(manifest, dict) or manifest.get("format") != _SPILL_FORMAT:
+            return 0
+        restored = 0
+        try:
+            sessions = manifest.get("sessions") or {}
+            # iterate in manifest (spill LRU) order: oldest first, so the
+            # byte bound applied by put() keeps the NEWEST spilled state
+            for sid, meta in sessions.items():
+                if not valid_session_id(sid):
+                    continue
+                key = f"levels/{sid}"
+                if key not in getattr(data, "files", []):
+                    continue
+                levels = data[key]
+                if validate is not None and not validate(
+                        tuple(levels.shape), levels.dtype):
+                    continue
+                placed = place(levels) if place is not None else levels
+                self.put(sid, placed,
+                         batch=int(meta.get("batch", levels.shape[0])),
+                         bucket=int(meta.get("bucket", levels.shape[0])),
+                         step=int(meta.get("step", 0)),
+                         frames=int(meta.get("frames", 0)))
+                restored += 1
+        finally:
+            data.close()
+        if restored and self.registry is not None:
+            self.registry.counter(
+                "serving_session_restores",
+                help="sessions restored warm from a spill at startup",
+            ).inc(restored)
+        return restored

@@ -1,12 +1,20 @@
-"""Must-flag: the observability pull plane leaking into the execute core.
+"""Must-flag: the observability pull plane AND the session-state plane
+leaking into the execute core.
 
 The PR 9 boundary: /debug/* endpoints are POLLED by the fleet
 observatory from the HTTP fronts; the compile cache is the request
 path's execute core.  An HTTP client or a debug-endpoint reference here
 couples request latency to observer behavior.
+
+The PR 10 boundary: per-session column state is OWNED by
+glom_tpu.serving.sessions; the cache threads it through as an opaque
+array.  A store import or mutation here puts TTL/LRU/spill bookkeeping
+on the hot path.
 """
 
 import urllib.request  # BAD: HTTP client import in the execute core
+
+from glom_tpu.serving import sessions  # BAD: state-plane import in the execute core
 
 DEBUG_TRACES = "/debug/traces"  # BAD: debug-plane endpoint reference
 
@@ -15,3 +23,11 @@ def execute(compiled, params, img, collector_url):
     out = compiled(params, img)
     urllib.request.urlopen(collector_url + DEBUG_TRACES)  # BAD: calls out
     return out
+
+
+def execute_stateful(compiled, params, img, session_store, sid):
+    emb, levels = compiled(params, img)
+    session_store.put(sid, levels, batch=img.shape[0],  # BAD: store mutation on the request path
+                      bucket=img.shape[0], step=0, frames=1)
+    session_store.sweep()  # BAD: eviction sweep inside the execute core
+    return emb

@@ -1,4 +1,5 @@
-"""Must-pass: compile_cache.py is the ONE place serving may compile."""
+"""Must-pass: compile_cache.py is the ONE place serving may compile, and
+state crosses it only as an opaque array (the engine owns the store)."""
 
 import jax
 
@@ -7,3 +8,10 @@ def warm(fn, params_struct, img_struct):
     jit_fn = jax.jit(fn)
     lowered = jit_fn.lower(params_struct, img_struct)
     return lowered.compile()
+
+
+def execute_stateful(compiled, params, img, state):
+    # state in, state out — no store reference, no bookkeeping: the
+    # ENGINE gets/puts around this call
+    emb, new_state = compiled(params, img, state)
+    return emb, new_state

@@ -61,6 +61,8 @@ RULE_FIXTURES = [
     ("conc-broad-except", "excepts.py", "excepts.py"),
     ("obs-debug-in-cache", "serving/compile_cache.py",
      "serving/compile_cache.py"),
+    ("obs-state-in-cache", "serving/compile_cache.py",
+     "serving/compile_cache.py"),
 ]
 
 

@@ -176,7 +176,7 @@ class TestEvaluate:
 def test_bench_gate_check_fixtures(capsys):
     code, out, _ = _run_tool("bench_gate.py", ["--check"], capsys)
     assert code == 0
-    assert "check ok" in out and "10 fixtures" in out
+    assert "check ok" in out and "12 fixtures" in out
 
 
 def test_bench_gate_record_fail_and_skip(tmp_path, capsys):

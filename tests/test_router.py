@@ -584,9 +584,20 @@ class TestFleetIntegration:
         assert {"router_request", "route", "proxy"} <= names
         proxy = next(s for s in router_spans if s["name"] == "proxy")
 
+        # the engine records respond AFTER writing the reply, so the
+        # client can observe the response before the handler thread logs
+        # the span — poll briefly instead of racing it (the loadgen
+        # --smoke pattern)
         engine_spans = []
-        for eng, _ in members:
-            engine_spans += [s.to_dict() for s in eng.tracer.sink.trace(rid)]
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            engine_spans = []
+            for eng, _ in members:
+                engine_spans += [s.to_dict()
+                                 for s in eng.tracer.sink.trace(rid)]
+            if {"respond"} <= {s["name"] for s in engine_spans}:
+                break
+            time.sleep(0.01)
         root = next(s for s in engine_spans if s["name"] == "request")
         assert root["trace_id"] == rid
         assert root["parent_id"] == proxy["span_id"]

@@ -1,0 +1,795 @@
+"""Stateful session serving tests (glom_tpu/serving/sessions.py + the
+engine/server/router session path + tools/session_check.py).
+
+Tier-1 (CPU): the session store's TTL/LRU/byte-bound eviction runs
+against an injectable fake clock (no sleeps); the warm-start path is
+pinned BITWISE against ``video.rollout`` (the carried-levels recipe the
+sessions serve); the zero-request-path-compile invariant is asserted
+under mixed stateful/stateless load AND across a hot reload with live
+sessions; router affinity keeps a session on one replica through a
+coordinated rollout; and ``tools/session_check.py --smoke`` runs as the
+tier-1 subprocess gate (the chaos.py pattern).
+"""
+
+import functools
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from glom_tpu import checkpoint as ckpt_lib
+from glom_tpu.serving.engine import (
+    DEMO_CONFIG,
+    ServingEngine,
+    make_demo_checkpoint,
+)
+from glom_tpu.serving.sessions import SessionStore, valid_session_id
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, seconds):
+        self.t += seconds
+
+
+def _imgs(n, seed=0):
+    c = DEMO_CONFIG
+    return np.random.RandomState(seed).randn(
+        n, c.channels, c.image_size, c.image_size).astype(np.float32)
+
+
+def _levels(b=2, seed=0, dtype=np.float32):
+    c = DEMO_CONFIG
+    return np.random.RandomState(seed).randn(
+        b, c.num_patches, c.levels, c.dim).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# session store: TTL / LRU / byte bound, deterministic under a fake clock
+# ---------------------------------------------------------------------------
+class TestSessionStore:
+    def _store(self, **kw):
+        clock = FakeClock()
+        kw.setdefault("max_bytes", 1 << 30)
+        kw.setdefault("ttl_s", 10.0)
+        return SessionStore(clock=clock, **kw), clock
+
+    def test_session_id_contract(self):
+        assert valid_session_id("cam-1.front:a_b")
+        assert not valid_session_id("")
+        assert not valid_session_id("has space")
+        assert not valid_session_id("a/b")       # path traversal
+        assert not valid_session_id("x" * 129)
+        store, _ = self._store()
+        with pytest.raises(ValueError, match="invalid session id"):
+            store.put("a/b", _levels(), batch=2, bucket=2, step=0, frames=1)
+
+    def test_put_get_roundtrip_and_meta(self):
+        store, _ = self._store()
+        lv = _levels()
+        store.put("s1", lv, batch=1, bucket=2, step=7, frames=3)
+        entry = store.get("s1")
+        assert entry is not None
+        assert entry.batch == 1 and entry.bucket == 2
+        assert entry.step == 7 and entry.frames == 3
+        assert entry.nbytes == lv.nbytes
+        np.testing.assert_array_equal(entry.levels, lv)
+
+    def test_ttl_expiry_is_a_miss_and_counts(self):
+        store, clock = self._store(ttl_s=10.0)
+        store.put("s1", _levels(), batch=2, bucket=2, step=0, frames=1)
+        clock.advance(9.9)
+        assert store.get("s1") is not None      # refreshes last_used
+        clock.advance(9.9)
+        assert store.get("s1") is not None      # the refresh held it alive
+        clock.advance(10.1)
+        assert store.get("s1") is None
+        assert store.stats.evicted_ttl == 1
+        assert len(store) == 0
+
+    def test_sweep_evicts_only_expired(self):
+        store, clock = self._store(ttl_s=10.0)
+        store.put("old", _levels(seed=1), batch=2, bucket=2, step=0, frames=1)
+        clock.advance(8.0)
+        store.put("new", _levels(seed=2), batch=2, bucket=2, step=0, frames=1)
+        clock.advance(5.0)                      # old at 13s, new at 5s
+        assert store.sweep() == 1
+        assert store.get("old") is None and store.get("new") is not None
+        assert store.stats.evicted_ttl == 1
+
+    def test_lru_byte_bound_evicts_oldest_first(self):
+        entry_bytes = _levels().nbytes
+        store, _ = self._store(max_bytes=2 * entry_bytes)
+        for sid in ("a", "b", "c"):
+            store.put(sid, _levels(), batch=2, bucket=2, step=0, frames=1)
+        assert store.get("a") is None           # LRU, evicted
+        assert store.get("b") is not None and store.get("c") is not None
+        assert store.stats.evicted_lru == 1
+        assert store.nbytes <= 2 * entry_bytes
+
+    def test_get_refreshes_lru_order(self):
+        entry_bytes = _levels().nbytes
+        store, _ = self._store(max_bytes=2 * entry_bytes)
+        store.put("a", _levels(), batch=2, bucket=2, step=0, frames=1)
+        store.put("b", _levels(), batch=2, bucket=2, step=0, frames=1)
+        store.get("a")                          # a is now the most recent
+        store.put("c", _levels(), batch=2, bucket=2, step=0, frames=1)
+        assert store.get("b") is None           # b was LRU
+        assert store.get("a") is not None
+
+    def test_overweight_newest_entry_always_stays(self):
+        lv = _levels()
+        store, _ = self._store(max_bytes=lv.nbytes // 2)
+        store.put("big", lv, batch=2, bucket=2, step=0, frames=1)
+        assert store.get("big") is not None     # degraded, not erroring
+
+    def test_reset(self):
+        store, _ = self._store()
+        store.put("s1", _levels(), batch=2, bucket=2, step=0, frames=1)
+        assert store.reset("s1") is True
+        assert store.reset("s1") is False
+        assert store.get("s1") is None
+        assert store.stats.resets == 1
+
+    def test_sweep_interval_gate(self):
+        store, clock = self._store(ttl_s=10.0)
+        store.put("s1", _levels(), batch=2, bucket=2, step=0, frames=1)
+        clock.advance(11.0)
+        # gated call inside the interval window: no-op
+        assert store.sweep(min_interval=100.0) == 0
+        assert len(store) == 1
+        clock.advance(100.0)
+        assert store.sweep(min_interval=100.0) == 1
+        assert len(store) == 0
+
+    def test_lock_cleanup_cannot_split_a_session(self):
+        """Entry cleanup drops idle lock objects; locked() must never
+        leave two threads holding two distinct locks for one session."""
+        store, _ = self._store()
+        store.put("s", _levels(), batch=2, bucket=2, step=0, frames=1)
+        stale = store.lock("s")
+        store.reset("s")                    # idle lock dropped with entry
+        assert store.lock("s") is not stale  # re-minted object
+        with store.locked("s"):
+            held = store._locks["s"]
+            assert held.locked()
+            # cleanup skips HELD locks: an eviction mid-frame cannot
+            # re-mint the lock out from under the frame holding it
+            store.put("s", _levels(), batch=2, bucket=2, step=0, frames=1)
+            store.reset("s")
+            assert store._locks["s"] is held and held.locked()
+
+    def test_registry_gauges_track_store(self):
+        from glom_tpu.obs import MetricRegistry
+
+        reg = MetricRegistry()
+        clock = FakeClock()
+        store = SessionStore(max_bytes=1 << 30, ttl_s=10.0,
+                             registry=reg, clock=clock)
+        store.put("s1", _levels(), batch=2, bucket=2, step=0, frames=1)
+        snap = reg.snapshot()
+        assert snap["serving_session_count"] == 1.0
+        assert snap["serving_session_bytes"] == float(_levels().nbytes)
+        clock.advance(11.0)
+        store.sweep()
+        snap = reg.snapshot()
+        assert snap["serving_session_count"] == 0.0
+        assert snap["serving_session_evictions_ttl"] == 1.0
+
+    def test_spill_restore_roundtrip(self, tmp_path):
+        store, _ = self._store()
+        lv = _levels(seed=3)
+        store.put("s1", lv, batch=1, bucket=2, step=5, frames=4)
+        assert store.spill(str(tmp_path)) == 1
+        assert (tmp_path / "sessions.npz").exists()
+        assert (tmp_path / "sessions.json").exists()
+
+        fresh, _ = self._store()
+        assert fresh.restore(str(tmp_path)) == 1
+        entry = fresh.get("s1")
+        assert entry is not None
+        assert (entry.batch, entry.bucket, entry.step, entry.frames) == (
+            1, 2, 5, 4)
+        np.testing.assert_array_equal(entry.levels, lv)
+
+    def test_restore_validates_shape_and_tolerates_absence(self, tmp_path):
+        store, _ = self._store()
+        store.put("ok", _levels(), batch=2, bucket=2, step=0, frames=1)
+        store.put("stale", np.zeros((2, 3, 3, 8), np.float32),
+                  batch=2, bucket=2, step=0, frames=1)
+        store.spill(str(tmp_path))
+
+        fresh, _ = self._store()
+        c = DEMO_CONFIG
+        expect = (c.num_patches, c.levels, c.dim)
+        n = fresh.restore(str(tmp_path),
+                          validate=lambda shape, dtype:
+                          tuple(shape[1:]) == expect)
+        assert n == 1
+        assert fresh.get("ok") is not None and fresh.get("stale") is None
+        # a never-spilled directory is a clean cold boot, not an error
+        empty, _ = self._store()
+        assert empty.restore(str(tmp_path / "nowhere")) == 0
+
+
+# ---------------------------------------------------------------------------
+# engine session path
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def demo_ckpt(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("sess_ckpt"))
+    make_demo_checkpoint(d)
+    return d
+
+
+@pytest.fixture(scope="module")
+def engine(demo_ckpt):
+    """Warmed session engine, no threads.  bucket (2,) on purpose: a
+    1-image session pads to the bucket, exercising the padded state
+    path; iters == warm_iters == 2 so the parity test compares like for
+    like against ``video.rollout``."""
+    eng = ServingEngine(demo_ckpt, buckets=(2,), max_wait_ms=0.0,
+                        warmup=True, reload_poll_s=0,
+                        iters=2, warm_iters=2)
+    yield eng
+    eng.shutdown(drain=False)
+
+
+class TestSessionServing:
+    def test_cold_then_warm(self, engine):
+        out, info = engine.session_embed("flow-1", _imgs(2, seed=1))
+        assert info["cold"] is True and info["frames"] == 1
+        assert out.shape == (2, DEMO_CONFIG.levels, DEMO_CONFIG.dim)
+        out, info = engine.session_embed("flow-1", _imgs(2, seed=2))
+        assert info["cold"] is False and info["frames"] == 2
+        assert info["iters"] == 2
+        snap = engine.registry.snapshot()
+        assert snap["serving_session_cold_frames"] >= 1.0
+        assert snap["serving_session_warm_frames"] >= 1.0
+
+    def test_state_is_bucket_shaped_on_device(self, engine):
+        engine.session_embed("shape-1", _imgs(1, seed=3))
+        entry = engine.sessions.get("shape-1")
+        c = DEMO_CONFIG
+        assert entry.levels.shape == (2, c.num_patches, c.levels, c.dim)
+        assert entry.batch == 1 and entry.bucket == 2
+        assert isinstance(entry.levels, jax.Array)
+
+    @pytest.mark.parametrize("b", [1, 2])
+    def test_bitwise_parity_with_video_rollout(self, engine, b):
+        """Acceptance: k session frames == one ``video.rollout`` over the
+        same k frames, BITWISE — the serving stack (store, bucket
+        padding, slicing, HTTP-free path) adds state plumbing, not
+        numerics.  b=1 additionally proves the padded state rows never
+        contaminate the real ones."""
+        from glom_tpu.models.video import rollout
+
+        sid = f"parity-{b}"
+        frames = np.stack([_imgs(b, seed=10 + t) for t in range(4)])
+        for t in range(4):
+            out, _ = engine.session_embed(sid, frames[t])
+        entry = engine.sessions.get(sid)
+
+        roll = jax.jit(functools.partial(rollout, config=DEMO_CONFIG, iters=2))
+        ref = np.asarray(roll(engine.params["glom"], jax.numpy.asarray(frames)))
+        np.testing.assert_array_equal(
+            np.asarray(entry.levels)[:b], ref)
+        # the pooled embedding's mean is fused IN the session graph; a
+        # host-side mean over the rollout state sums in a different order
+        # (1-ulp): the state itself is the bitwise contract
+        np.testing.assert_allclose(out, ref.mean(axis=1), atol=1e-6)
+
+    def test_mixed_stateful_stateless_zero_compiles(self, engine):
+        """Acceptance: interleaved /embed batches and session frames
+        never touch the jit dispatch path once warmed."""
+        for n in (1, 2, 1, 2):
+            engine.submit("embed", _imgs(n, seed=n))
+            engine.process_once("embed")
+            engine.session_embed("mix-1", _imgs(1, seed=n))
+            engine.session_embed("mix-2", _imgs(2, seed=n))
+        for cache in engine.caches.values():
+            assert cache.poll_compiles() == 0
+        assert "serving_xla_compiles" not in engine.registry.snapshot()
+
+    def test_batch_change_cold_restarts(self, engine):
+        engine.session_embed("resize-1", _imgs(1, seed=1))
+        out, info = engine.session_embed("resize-1", _imgs(2, seed=2))
+        assert info["cold"] is True and info["restart"] == "batch_changed"
+        assert info["frames"] == 1
+        assert engine.registry.snapshot()[
+            "serving_session_cold_restarts"] >= 1.0
+
+    def test_reset_forces_cold(self, engine):
+        engine.session_embed("rst-1", _imgs(2, seed=1))
+        assert engine.session_reset("rst-1") is True
+        _, info = engine.session_embed("rst-1", _imgs(2, seed=2))
+        assert info["cold"] is True
+
+    def test_reset_serializes_with_in_flight_frame(self, engine):
+        """A reset racing a frame must order as one of the two valid
+        serializations — never 'the frame's put silently undoes the
+        acknowledged reset'.  Holding the session's lock from another
+        thread proves reset waits for it."""
+        import threading as _threading
+
+        engine.session_embed("race-1", _imgs(2, seed=1))
+        entered = _threading.Event()
+        release = _threading.Event()
+
+        def hold():
+            with engine.sessions.locked("race-1"):
+                entered.set()
+                release.wait(timeout=10)
+
+        holder = _threading.Thread(target=hold, daemon=True)
+        holder.start()
+        assert entered.wait(timeout=10)
+        resetter = _threading.Thread(
+            target=engine.session_reset, args=("race-1",), daemon=True)
+        resetter.start()
+        resetter.join(timeout=0.2)
+        assert resetter.is_alive()          # parked on the session lock
+        release.set()
+        resetter.join(timeout=10)
+        assert not resetter.is_alive()
+        assert engine.sessions.get("race-1") is None
+
+    def test_shutdown_rejects_new_frames(self, demo_ckpt):
+        from glom_tpu.serving.batcher import Closed
+
+        eng = ServingEngine(demo_ckpt, buckets=(1,), warmup=True,
+                            reload_poll_s=0, iters=2, warm_iters=1)
+        eng.session_embed("drain-1", _imgs(1, seed=1))
+        eng.shutdown(drain=True)
+        with pytest.raises(Closed, match="draining"):
+            eng.session_embed("drain-1", _imgs(1, seed=2))
+
+    def test_oversize_frame_batch_rejected(self, engine):
+        with pytest.raises(ValueError, match="exceeds the largest bucket"):
+            engine.session_embed("big-1", _imgs(3))
+
+    def test_invalid_session_id_rejected(self, engine):
+        with pytest.raises(ValueError, match="invalid session id"):
+            engine.session_embed("no/slash", _imgs(1))
+
+    def test_sessions_disabled_engine_raises(self, demo_ckpt):
+        eng = ServingEngine(demo_ckpt, buckets=(1,), warmup=False,
+                            reload_poll_s=0)
+        try:
+            assert eng.sessions_enabled is False
+            with pytest.raises(RuntimeError, match="sessions disabled"):
+                eng.session_embed("s", _imgs(1))
+            assert eng.health()["sessions"] is None
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_hot_reload_keeps_sessions_warm_and_compile_free(self, tmp_path):
+        """Acceptance: a hot reload with live sessions swaps params
+        without a request-path compile, and the next frame warm-starts
+        against the new params."""
+        d = str(tmp_path / "ckpt")
+        make_demo_checkpoint(d)
+        eng = ServingEngine(d, buckets=(2,), warmup=True, reload_poll_s=0,
+                            iters=2, warm_iters=1)
+        try:
+            eng.session_embed("live-1", _imgs(2, seed=1))
+            ckpt_lib.save(d, 1, {"params": eng._template})
+            assert eng.check_reload() is True
+            assert eng.step == 1
+            out, info = eng.session_embed("live-1", _imgs(2, seed=2))
+            assert info["cold"] is False and info["frames"] == 2
+            eng.submit("embed", _imgs(1))
+            eng.process_once("embed")
+            for cache in eng.caches.values():
+                assert cache.poll_compiles() == 0
+            assert "serving_xla_compiles" not in eng.registry.snapshot()
+            # the state now carries the served step
+            assert eng.sessions.get("live-1").step == 1
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_restored_bucket_state_serves_under_no_warmup(self, tmp_path):
+        """A spill stores state BUCKET-shaped; a successor running
+        --no-warmup serves through the jit fallback, whose images must
+        pad up to the state's batch (unpadded, apply() would reject the
+        mismatched axes and 500 every frame until reset)."""
+        d = str(tmp_path / "ckpt")
+        spill = str(tmp_path / "spill")
+        make_demo_checkpoint(d)
+        kw = dict(buckets=(2,), reload_poll_s=0, iters=2, warm_iters=1,
+                  session_spill_dir=spill)
+        eng1 = ServingEngine(d, warmup=True, **kw)
+        eng1.session_embed("nw-1", _imgs(1, seed=1))   # b=1 -> bucket 2
+        eng1.shutdown(drain=False)
+
+        eng2 = ServingEngine(d, warmup=False, **kw)
+        try:
+            out, info = eng2.session_embed("nw-1", _imgs(1, seed=2))
+            assert info["cold"] is False and info["frames"] == 2
+            assert out.shape == (1, DEMO_CONFIG.levels, DEMO_CONFIG.dim)
+        finally:
+            eng2.shutdown(drain=False)
+
+    def test_traffic_drives_ttl_sweep_without_watcher(self, tmp_path):
+        """Fleet replicas run with the reload watcher disabled (the
+        router owns rollouts), so session traffic itself must reclaim
+        TTL-expired state — an abandoned stream's HBM must not wait for
+        byte pressure."""
+        d = str(tmp_path / "ckpt")
+        make_demo_checkpoint(d)
+        clock = FakeClock()
+        eng = ServingEngine(d, buckets=(1,), warmup=True, reload_poll_s=0,
+                            iters=2, warm_iters=1, clock=clock,
+                            session_ttl_s=10.0)
+        try:
+            eng.session_embed("abandoned", _imgs(1, seed=1))
+            assert len(eng.sessions) == 1
+            clock.advance(11.0)
+            eng.session_embed("active", _imgs(1, seed=2))
+            # the ACTIVE frame's accounting swept the abandoned one (a
+            # lookup-side eviction would leave it resident: len == 2)
+            assert len(eng.sessions) == 1
+            assert eng.sessions.stats.evicted_ttl == 1
+            assert eng.sessions.get("abandoned") is None
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_spill_on_shutdown_restore_on_boot_stays_warm(self, tmp_path):
+        """Acceptance: a drained engine's sessions survive the process —
+        the successor's first frame is WARM and numerically identical to
+        an uninterrupted session."""
+        d = str(tmp_path / "ckpt")
+        spill = str(tmp_path / "spill")
+        make_demo_checkpoint(d)
+        kw = dict(buckets=(2,), warmup=True, reload_poll_s=0,
+                  iters=2, warm_iters=2, session_spill_dir=spill)
+        eng1 = ServingEngine(d, **kw)
+        eng1.session_embed("persist-1", _imgs(2, seed=1))
+        eng1.shutdown(drain=False)
+        assert os.path.exists(os.path.join(spill, "sessions.npz"))
+
+        eng2 = ServingEngine(d, **kw)
+        try:
+            out, info = eng2.session_embed("persist-1", _imgs(2, seed=2))
+            assert info["cold"] is False and info["frames"] == 2
+            # numerically identical to the uninterrupted two-frame chain
+            from glom_tpu.models.video import rollout
+
+            frames = np.stack([_imgs(2, seed=1), _imgs(2, seed=2)])
+            roll = jax.jit(functools.partial(rollout, config=DEMO_CONFIG,
+                                             iters=2))
+            ref = np.asarray(roll(eng2.params["glom"],
+                                  jax.numpy.asarray(frames)))
+            np.testing.assert_allclose(out, ref.mean(axis=1), atol=1e-6)
+            # the restored STATE is exactly the spilled one re-fed: the
+            # resulting levels match the uninterrupted chain bitwise
+            np.testing.assert_array_equal(
+                np.asarray(eng2.sessions.get("persist-1").levels), ref)
+        finally:
+            eng2.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# HTTP front
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def served(demo_ckpt):
+    from glom_tpu.serving.server import make_server
+
+    eng = ServingEngine(demo_ckpt, buckets=(1, 2), max_wait_ms=1.0,
+                        warmup=True, reload_poll_s=0,
+                        iters=2, warm_iters=1)
+    eng.start(workers=True, watch=False)
+    server = make_server(eng)
+    host, port = server.server_address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://{host}:{port}", eng
+    server.shutdown()
+    eng.shutdown(drain=True)
+    server.server_close()
+
+
+def _post(url, path, payload, timeout=30, headers=None):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read()), dict(r.headers)
+
+
+class TestSessionHTTP:
+    def test_embed_cold_warm_reset_cycle(self, served):
+        url, eng = served
+        img = _imgs(1, seed=1).tolist()
+        _, body, _ = _post(url, "/session/embed",
+                           {"session": "http-1", "images": img})
+        assert body["cold"] is True and body["frames"] == 1
+        assert body["iters"] == 2 and body["session"] == "http-1"
+        emb = np.asarray(body["embeddings"])
+        assert emb.shape == (1, DEMO_CONFIG.levels, DEMO_CONFIG.dim)
+
+        _, body, _ = _post(url, "/session/embed",
+                           {"session": "http-1", "images": img})
+        assert body["cold"] is False and body["iters"] == 1
+
+        _, body, _ = _post(url, "/session/reset", {"session": "http-1"})
+        assert body == {"session": "http-1", "reset": True}
+        _, body, _ = _post(url, "/session/embed",
+                           {"session": "http-1", "images": img})
+        assert body["cold"] is True
+
+    def test_level_slice(self, served):
+        url, _ = served
+        _, body, _ = _post(url, "/session/embed",
+                           {"session": "http-lv", "level": 0,
+                            "images": _imgs(1).tolist()})
+        assert np.asarray(body["embeddings"]).shape == (1, DEMO_CONFIG.dim)
+
+    def test_bad_session_id_is_400(self, served):
+        url, _ = served
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(url, "/session/embed",
+                  {"session": "no spaces", "images": _imgs(1).tolist()})
+        assert e.value.code == 400
+
+    def test_health_reports_sessions(self, served):
+        url, _ = served
+        with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
+            health = json.loads(r.read())
+        assert health["sessions"]["warm_iters"] == 1
+        assert health["sessions"]["cold_iters"] == 2
+        assert health["sessions"]["count"] >= 1
+
+    def test_sessions_disabled_is_404(self, demo_ckpt):
+        from glom_tpu.serving.server import make_server
+
+        eng = ServingEngine(demo_ckpt, buckets=(1,), warmup=False,
+                            reload_poll_s=0)
+        server = make_server(eng)
+        host, port = server.server_address[:2]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(f"http://{host}:{port}", "/session/embed",
+                      {"session": "s", "images": _imgs(1).tolist()})
+            assert e.value.code == 404
+            assert "warm-iters" in json.loads(e.value.read())["error"]
+        finally:
+            server.shutdown()
+            eng.shutdown(drain=False)
+            server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# router affinity across a coordinated rollout
+# ---------------------------------------------------------------------------
+class TestSessionRouterAffinity:
+    def test_session_pinned_across_coordinated_rollout(self, tmp_path):
+        """Acceptance: every frame of a session lands on ONE replica
+        (consistent-hash on X-Affinity-Key) while the fleet rolls
+        forward mid-stream; post-rollout frames stay WARM on the new
+        step — the state survives the param swap in place."""
+        from glom_tpu.serving.router import FleetRouter, make_router_server
+        from glom_tpu.serving.server import make_server
+
+        d = str(tmp_path / "ckpt")
+        make_demo_checkpoint(d)
+        engines, servers, urls = [], [], []
+        for _ in range(2):
+            eng = ServingEngine(d, buckets=(1,), max_wait_ms=1.0,
+                                warmup=True, reload_poll_s=0,
+                                iters=2, warm_iters=1)
+            eng.start(workers=True, watch=False)
+            srv = make_server(eng)
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            h, p = srv.server_address[:2]
+            engines.append(eng)
+            servers.append(srv)
+            urls.append(f"http://{h}:{p}")
+        router = FleetRouter(urls, health_interval_s=0.2)
+        router.start()
+        rsrv = make_router_server(router)
+        threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+        rh, rp = rsrv.server_address[:2]
+        rurl = f"http://{rh}:{rp}"
+        try:
+            img = _imgs(1, seed=1).tolist()
+            served_by, bodies = [], []
+
+            def frame():
+                _, body, hdrs = _post(
+                    rurl, "/session/embed",
+                    {"session": "roll-1", "images": img},
+                    headers={"X-Affinity-Key": "roll-1"})
+                served_by.append(hdrs.get("X-Served-By"))
+                bodies.append(body)
+
+            for _ in range(3):
+                frame()
+            ckpt_lib.save(d, 1, {"params": engines[0]._template})
+            report = router.coordinated_reload()
+            assert report["status"] == "committed" and report["step"] == 1
+            for _ in range(3):
+                frame()
+
+            assert len(set(served_by)) == 1, served_by
+            assert [b["cold"] for b in bodies] == [True] + [False] * 5
+            assert [b["frames"] for b in bodies] == list(range(1, 7))
+            assert bodies[-1]["step"] == 1     # new params, same state
+            for eng in engines:
+                assert "serving_xla_compiles" not in eng.registry.snapshot()
+        finally:
+            router.shutdown()
+            rsrv.shutdown()
+            rsrv.server_close()
+            for srv in servers:
+                srv.shutdown()
+                srv.server_close()
+            for eng in engines:
+                eng.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# tools: loadgen session mode, trace_report warm/cold split, the CI gates
+# ---------------------------------------------------------------------------
+class TestSessionTools:
+    def test_loadgen_session_mode(self, served, capsys):
+        """--sessions N against a live server: cold/warm split populated,
+        affinity vacuous on a single engine (no X-Served-By), exit 0."""
+        import importlib.util
+
+        url, _ = served
+        spec = importlib.util.spec_from_file_location(
+            "loadgen_sess", os.path.join(ROOT, "tools", "loadgen.py"))
+        lg = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(lg)
+        rc = lg.main(["--url", url, "--sessions", "2", "--frames", "3",
+                      "--batch-sizes", "1"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        sess = out["session"]
+        assert sess["sessions"] == 2
+        assert sess["cold_ms"]["count"] == 2          # one cold per session
+        assert sess["warm_ms"]["count"] == 4          # the rest warm
+        assert sess["affinity"]["violations"] == []
+
+    def test_trace_report_splits_warm_cold_execute(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "trace_report_sess", os.path.join(ROOT, "tools",
+                                              "trace_report.py"))
+        tr = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tr)
+
+        def trace(tid, t0, name, attrs):
+            root_id, exe_id = f"{tid}-r", f"{tid}-e"
+            return {
+                "trace_id": tid, "root": "request", "duration_ms": 10.0,
+                "spans": [
+                    {"trace_id": tid, "span_id": root_id, "parent_id": None,
+                     "name": "request", "root_span": True,
+                     "start": t0, "end": t0 + 0.010, "duration_ms": 10.0,
+                     "attrs": {}},
+                    {"trace_id": tid, "span_id": exe_id,
+                     "parent_id": root_id, "name": "execute",
+                     "start": t0, "end": t0 + 0.008, "duration_ms": 8.0,
+                     "attrs": attrs},
+                ],
+            }
+
+        traces = [
+            trace("w1", 0.0, "execute",
+                  {"stateful": True, "iters": 2, "endpoint": "session_warm",
+                   "bucket": 2}),
+            trace("c1", 1.0, "execute",
+                  {"stateful": False, "iters": 6,
+                   "endpoint": "session_cold", "bucket": 2}),
+            trace("s1", 2.0, "execute",
+                  {"stateful": False, "endpoint": "embed", "bucket": 2}),
+        ]
+        s = tr.summarize(traces)
+        names = {r["span"] for r in s["spans"]}
+        assert {"execute_warm", "execute_cold", "execute"} <= names
+        wc = s["warm_cold"]
+        assert wc["warm"]["frames"] == 1 and wc["cold"]["frames"] == 1
+        assert wc["warm_over_cold_p50"] == 1.0
+        # feeds with no session traffic (incl. the golden fixture) report
+        # no split at all
+        assert tr.summarize([traces[2]])["warm_cold"] is None
+
+    def test_affinity_check_reads_router_event_key(self):
+        """A split session is EXCUSED exactly when the router timeline
+        shows an ejection — and the timeline keys the transition type as
+        'event' (FleetRouter.note_event), not 'kind'."""
+        import importlib.util
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        spec = importlib.util.spec_from_file_location(
+            "loadgen_aff", os.path.join(ROOT, "tools", "loadgen.py"))
+        lg = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(lg)
+
+        events = []
+
+        class _Timeline(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = json.dumps({"events": events}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), _Timeline)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            results = lg._Results()
+            for rep in ("r0", "r0", "r1"):   # split across two replicas
+                results.note_session("split-1", cold=False, latency_ms=1.0,
+                                     replica=rep)
+            # no ejection in the timeline: the split is a violation
+            verdict = lg.check_session_affinity([url], results, timeout=10)
+            assert verdict["timeline_checked"] is True
+            assert verdict["violations"] == ["split-1"]
+            # an ejection of one of the SESSION'S OWN replicas (router
+            # schema: type under 'event', replica named) excuses it
+            events.append({"seq": 0, "t": 1.0, "event": "ejection",
+                           "replica": "r0"})
+            verdict = lg.check_session_affinity([url], results, timeout=10)
+            assert verdict["ejection_events"] == 1
+            assert verdict["violations"] == []
+            # ...but only when it happened DURING the run: a stale
+            # pre-run ejection (seq <= the pre-run cursor) excuses nothing
+            assert lg.timeline_max_seq([url], timeout=10) == 0
+            verdict = lg.check_session_affinity([url], results, timeout=10,
+                                                after_seq=0)
+            assert verdict["ejection_events"] == 0
+            assert verdict["violations"] == ["split-1"]
+            # ...and an UNRELATED replica's ejection excuses nothing: the
+            # split session never touched r9
+            events.append({"seq": 1, "t": 2.0, "event": "ejection",
+                           "replica": "r9"})
+            verdict = lg.check_session_affinity([url], results, timeout=10,
+                                                after_seq=0)
+            assert verdict["ejection_events"] == 1
+            assert verdict["violations"] == ["split-1"]
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_session_check_smoke_subprocess_gate(self):
+        """tools/session_check.py --smoke: the tier-1 gate — some
+        warm_iters <= cold/2 reaches within-threshold equilibrium at a
+        <1 latency ratio, measured."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "session_check.py"),
+             "--smoke"],
+            capture_output=True, text=True, timeout=280, env=env, cwd=ROOT,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        report = json.loads(proc.stdout)
+        assert report["smoke"] == "ok"
+        assert report["half_target_met"] is True
+        assert report["best_warm_iters"] <= report["cold_iters"] // 2
+        assert report["latency_ratio"] < 1.0
+        passing = [r for r in report["sweep"] if r["pass"]]
+        assert all(r["rel_distance_max"] <= report["threshold"]
+                   for r in passing)

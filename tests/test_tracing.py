@@ -417,10 +417,19 @@ class TestHTTPTracePropagation:
         assert headers["traceparent"].split("-")[1] == "ab" * 16
         # the joined trace still reaches the JSONL feed (root detection
         # must not conflate root-ness with parent_id None) with a
-        # computable coverage
-        with open(trace_log) as f:
-            recs = [json.loads(line) for line in f if line.strip()]
-        mine = [r for r in recs if r["trace_id"] == "ab" * 16]
+        # computable coverage.  The file write trails the sink's root-end
+        # by a scheduling window — poll it like _wait_trace polls the sink
+        import time as _time
+
+        deadline = _time.monotonic() + 5.0
+        mine = []
+        while _time.monotonic() < deadline:
+            with open(trace_log) as f:
+                recs = [json.loads(line) for line in f if line.strip()]
+            mine = [r for r in recs if r["trace_id"] == "ab" * 16]
+            if mine:
+                break
+            _time.sleep(0.01)
         assert len(mine) == 1 and mine[0]["root"] == "request"
         assert span_coverage(mine[0]["spans"]) is not None
 

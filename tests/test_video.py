@@ -110,3 +110,50 @@ def test_rollout_validates_shapes():
         rollout(params, jnp.zeros((2, 3, 16, 16)), config=TINY)
     with pytest.raises(ValueError, match="iteration counts"):
         rollout_varied(params, [jnp.zeros((1, 3, 16, 16))], [2, 3], config=TINY)
+
+
+def test_rollout_varied_accepts_stacked_clip():
+    """A stacked (t, b, c, H, W) clip equals the equivalent frame list."""
+    params = glom_model.init(jax.random.PRNGKey(0), TINY)
+    clip = jax.random.normal(jax.random.PRNGKey(1), (3, 1, 3, 16, 16))
+    from_stack = rollout_varied(params, clip, [4, 3, 2], config=TINY)
+    from_list = rollout_varied(params, [clip[i] for i in range(3)],
+                               [4, 3, 2], config=TINY)
+    np.testing.assert_array_equal(np.asarray(from_stack),
+                                  np.asarray(from_list))
+
+
+def test_rollout_varied_rejects_short_schedule_up_front():
+    """The frame loop is zip-driven — an unvalidated short schedule would
+    silently drop the clip's TAIL frames.  Both clip forms must fail loud
+    before any compute, naming the counts."""
+    params = glom_model.init(jax.random.PRNGKey(0), TINY)
+    clip = jnp.zeros((3, 1, 3, 16, 16))
+    with pytest.raises(ValueError, match="3 frames but 2 iteration counts"):
+        rollout_varied(params, clip, [4, 3], config=TINY)
+    with pytest.raises(ValueError, match="3 frames but 2 iteration counts"):
+        rollout_varied(params, [clip[i] for i in range(3)], [4, 3],
+                       config=TINY)
+    # a stacked non-5d clip is a shape error, not a truncation
+    with pytest.raises(ValueError, match="stacked frames must be"):
+        rollout_varied(params, jnp.zeros((1, 3, 16, 16)), [4], config=TINY)
+
+
+def test_rollout_varied_materializes_generator_schedule():
+    """A generator schedule has no len(); it must be materialized and
+    validated, not zip-truncated or crashed on."""
+    params = glom_model.init(jax.random.PRNGKey(0), TINY)
+    f = [jax.random.normal(jax.random.PRNGKey(i), (1, 3, 16, 16))
+         for i in range(2)]
+    got = rollout_varied(params, f, (it for it in [2, 2]), config=TINY)
+    want = rollout_varied(params, f, [2, 2], config=TINY)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    with pytest.raises(ValueError, match="2 frames but 1 iteration count"):
+        rollout_varied(params, f, (it for it in [2]), config=TINY)
+
+
+def test_rollout_varied_rejects_nonpositive_iters():
+    params = glom_model.init(jax.random.PRNGKey(0), TINY)
+    f = [jnp.zeros((1, 3, 16, 16))]
+    with pytest.raises(ValueError, match=">= 1"):
+        rollout_varied(params, f, [0], config=TINY)

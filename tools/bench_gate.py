@@ -106,6 +106,15 @@ def main(argv=None) -> int:
     p.add_argument("--fleet-p95-baseline-ms", type=float, default=None,
                    help="recorded router-fronted p95 to gate against")
     p.add_argument("--fleet-p95-max-regression", type=float, default=0.10)
+    p.add_argument("--session-json", default=None,
+                   help="tools/session_check.py report; its steady-state "
+                        "warm-frame p95 gates against "
+                        "--session-p95-baseline-ms so the warm-start "
+                        "savings are tracked in the BENCH trajectory "
+                        "alongside throughput and request p95")
+    p.add_argument("--session-p95-baseline-ms", type=float, default=None,
+                   help="recorded warm-frame p95 to gate against")
+    p.add_argument("--session-p95-max-regression", type=float, default=0.10)
     p.add_argument("--prom-textfile", default=None,
                    help="write the verdict as Prometheus gauges via the obs "
                         "registry (textfile-collector format)")
@@ -143,28 +152,34 @@ def main(argv=None) -> int:
     throughput = perfgate.evaluate_throughput(
         rec, ref[0] if ref else None, max_regression=args.max_regression,
     )
-    def _p95_part(report_path, baseline, max_reg):
+    def _p95_part(report_path, baseline, max_reg,
+                  extract=lambda r: (r.get("latency_ms") or {}).get("p95")):
         if not report_path:
             return None
         with open(report_path) as f:
             report = json.load(f)
-        return perfgate.evaluate_p95(
-            (report.get("latency_ms") or {}).get("p95"), baseline,
-            max_regression=max_reg,
-        )
+        return perfgate.evaluate_p95(extract(report), baseline,
+                                     max_regression=max_reg)
 
     p95 = _p95_part(args.loadgen_json, args.p95_baseline_ms,
                     args.p95_max_regression)
     fleet_p95 = _p95_part(args.fleet_loadgen_json,
                           args.fleet_p95_baseline_ms,
                           args.fleet_p95_max_regression)
+    # the session report's headline number is the steady-state warm-frame
+    # p95 (tools/session_check.py), not a loadgen latency_ms block
+    session_p95 = _p95_part(args.session_json,
+                            args.session_p95_baseline_ms,
+                            args.session_p95_max_regression,
+                            extract=lambda r: r.get("steady_state_p95_ms"))
     verdict = perfgate.combine(
-        throughput, *[p for p in (p95, fleet_p95) if p])
+        throughput, *[p for p in (p95, fleet_p95, session_p95) if p])
     result = {
         "gate": verdict,
         "throughput": throughput,
         "p95": p95,
         "fleet_p95": fleet_p95,
+        "session_p95": session_p95,
         "reference_provenance": ref[1] if ref else None,
         "trajectory_rounds": len(trajectory),
         "bench_rc": bench_rc,
@@ -180,7 +195,8 @@ def main(argv=None) -> int:
             f.write(prometheus_lines(registry))
     skipped = [name for name, part in (("throughput", throughput),
                                        ("p95", p95),
-                                       ("fleet_p95", fleet_p95))
+                                       ("fleet_p95", fleet_p95),
+                                       ("session_p95", session_p95))
                if part and part["gate"] == perfgate.GATE_SKIP]
     if skipped:
         # Loud even when another component passed and the combined verdict

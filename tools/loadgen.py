@@ -10,7 +10,15 @@ Two driving modes against a running ``glom_tpu.serving.server``:
     schedule regardless of completions — measures latency under a target
     offered load, including the queueing/shedding behavior a closed loop
     hides (a closed loop slows its offered load down to whatever the
-    server sustains; real traffic doesn't).
+    server sustains; real traffic doesn't);
+  * **session mode** (``--sessions N --frames F``): N concurrent
+    stateful streams through ``/session/embed``, frames sequential
+    within a stream, each stream pinned with ``X-Affinity-Key: <session
+    id>``.  The report splits cold vs warm frame latency (the warm-start
+    savings, measured from the client) and computes the affinity hit
+    rate; a session whose frames landed on more than one replica with NO
+    ejection/re-admission in the router's ``/debug/timeline`` fails the
+    run — the consistent-hash pin is part of the serving contract.
 
 Batch sizes cycle through ``--batch-sizes`` so bucket padding and mixed
 shapes are exercised; the image contract (size/channels) is read from
@@ -79,6 +87,16 @@ def parse_args(argv=None):
                    help="open loop: seconds to run")
     p.add_argument("--batch-sizes", default="1,2,3",
                    help="per-request image counts, cycled")
+    p.add_argument("--sessions", type=int, default=0, metavar="N",
+                   help="session mode: N concurrent stateful sessions each "
+                        "replaying --frames frames through /session/embed "
+                        "with a per-session X-Affinity-Key; the report "
+                        "splits cold vs warm latency and checks affinity "
+                        "(a session whose frames landed on >1 replica "
+                        "without an ejection in the router timeline FAILS "
+                        "the run)")
+    p.add_argument("--frames", type=int, default=16,
+                   help="session mode: frames per session")
     p.add_argument("--timeout", type=float, default=60.0,
                    help="per-request HTTP timeout (seconds)")
     p.add_argument("--slow-n", type=int, default=0,
@@ -109,18 +127,23 @@ def _fetch_health(url, timeout):
         return json.loads(r.read())
 
 
-def _make_payloads(health, batch_sizes):
-    """One JSON-encoded request body per batch size (built once — the
-    loadgen must spend its time in the network path, not json.dumps)."""
+def _make_image_lists(health, batch_sizes):
+    """Raw nested image lists per batch size (shared by the stateless
+    bodies and the per-session bodies)."""
     import numpy as np
 
     c, s = health["channels"], health["image_size"]
     rng = np.random.RandomState(0)
+    return {b: rng.randn(b, c, s, s).astype("float32").tolist()
+            for b in batch_sizes}
+
+
+def _make_payloads(health, batch_sizes):
+    """One JSON-encoded request body per batch size (built once — the
+    loadgen must spend its time in the network path, not json.dumps)."""
     return {
-        b: json.dumps(
-            {"images": rng.randn(b, c, s, s).astype("float32").tolist()}
-        ).encode()
-        for b in batch_sizes
+        b: json.dumps({"images": imgs}).encode()
+        for b, imgs in _make_image_lists(health, batch_sizes).items()
     }
 
 
@@ -138,6 +161,12 @@ class _Results:
         # X-Served-By echo when present, else the target URL the request
         # was sprayed at.  {key: {"latencies_ms": [...], "ok": n, ...}}
         self.replicas = {}
+        # session mode: cold/warm latency split plus, per session, the
+        # ordered list of replicas that served its frames (the affinity
+        # evidence) — {sid: {"replicas": [...], "colds": n, "frames": n}}
+        self.cold_ms = []
+        self.warm_ms = []
+        self.sessions = {}
 
     def _replica(self, key):
         rec = self.replicas.get(key)
@@ -172,6 +201,20 @@ class _Results:
                     rep["ok"] += 1
                     rep["images_ok"] += images
                     rep["latencies_ms"].append(latency_ms)
+
+    def note_session(self, sid, *, cold=None, latency_ms=None, replica=None):
+        with self.lock:
+            rec = self.sessions.setdefault(
+                sid, {"replicas": [], "colds": 0, "frames": 0})
+            rec["frames"] += 1
+            if replica is not None:
+                rec["replicas"].append(replica)
+            if cold is not None and latency_ms is not None:
+                if cold:
+                    rec["colds"] += 1
+                    self.cold_ms.append(latency_ms)
+                else:
+                    self.warm_ms.append(latency_ms)
 
     def slowest(self, n):
         with self.lock:
@@ -282,6 +325,169 @@ def _send(url, endpoint, body, n_images, timeout, results, t0,
         id_mismatch=(request_id is not None and echoed != request_id),
         replica=replica,
     )
+
+
+# ---------------------------------------------------------------------------
+# session mode (--sessions): stateful streams through /session/embed
+# ---------------------------------------------------------------------------
+
+
+def _send_session(url, body, n_images, sid, timeout, results, request_id):
+    """One frame of one session: the session id rides both the body (the
+    engine's state key) and ``X-Affinity-Key`` (the router's pin)."""
+    headers = {"Content-Type": "application/json",
+               "X-Affinity-Key": sid,
+               "X-Request-Id": request_id}
+    req = urllib.request.Request(f"{url}/session/embed", data=body,
+                                 headers=headers)
+    t0 = time.monotonic()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            echoed = r.headers.get("X-Request-Id")
+            served = r.headers.get("X-Served-By")
+            resp = json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        e.read()
+        served = e.headers.get("X-Served-By") if e.headers else None
+        results.record(shed=(e.code == 503), error=(e.code != 503),
+                       id_mismatch=(e.headers.get("X-Request-Id")
+                                    != request_id if e.headers else True),
+                       replica=served)
+        results.note_session(sid, replica=served)
+        return
+    except Exception:  # glomlint: disable=conc-broad-except -- recorded as an error sample; a load generator must keep offering load through any single-request failure
+        results.record(error=True)
+        results.note_session(sid)
+        return
+    lat = (time.monotonic() - t0) * 1e3
+    results.record(latency_ms=lat, images=n_images, request_id=request_id,
+                   id_mismatch=(echoed != request_id), replica=served)
+    results.note_session(sid, cold=bool(resp.get("cold")), latency_ms=lat,
+                         replica=served)
+
+
+def run_sessions(urls, image_lists, batch_sizes, n_sessions, n_frames,
+                 timeout, results):
+    """N concurrent sessions, each replaying ``n_frames`` frames
+    SEQUENTIALLY (frame k+1 depends on frame k — a session is a stream,
+    not a request pool); sessions run in parallel threads."""
+    def worker(si):
+        sid = f"lg-sess-{os.getpid()}-{si}"
+        url = urls[si % len(urls)]
+        b = batch_sizes[si % len(batch_sizes)]
+        body = json.dumps({"session": sid,
+                           "images": image_lists[b]}).encode()
+        for fi in range(n_frames):
+            _send_session(url, body, b, sid, timeout, results,
+                          request_id=f"lg-{os.getpid()}-s{si}f{fi}")
+
+    threads = [threading.Thread(target=worker, args=(si,), daemon=True)
+               for si in range(n_sessions)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.monotonic() - t_start
+
+
+def timeline_max_seq(urls, timeout):
+    """The router timeline's newest sequence number BEFORE the run —
+    the cursor that keeps a stale pre-run ejection from excusing a
+    split observed now.  -1 when no target serves a timeline."""
+    seq = -1
+    for url in urls:
+        try:
+            with urllib.request.urlopen(f"{url}/debug/timeline",
+                                        timeout=timeout) as r:
+                events = json.loads(r.read()).get("events", [])
+        except Exception:  # glomlint: disable=conc-broad-except -- a non-router target has no timeline; the affinity check is vacuous there
+            continue
+        for e in events:
+            seq = max(seq, int(e.get("seq", -1)))
+    return seq
+
+
+def check_session_affinity(urls, results, timeout, after_seq=-1):
+    """The affinity verdict: every session's frames should land on ONE
+    replica (the router's consistent-hash pin).  A session that saw >1
+    replica is only legitimate when the router timeline shows an
+    ejection/re-admission of ONE OF THAT SESSION'S OWN REPLICAS during
+    the run (events with seq strictly after ``after_seq`` — the bounded
+    timeline keeps history, and a stale pre-run ejection must not excuse
+    today's split; an unrelated replica's ejection must not excuse a
+    split among healthy ones) — otherwise
+    the ring is broken and the run FAILS.  Direct engine targets (no
+    X-Served-By, no /debug/timeline) make the check vacuous, not
+    failing."""
+    from collections import Counter
+
+    with results.lock:
+        sessions = {sid: list(rec["replicas"])
+                    for sid, rec in results.sessions.items()}
+    served = {sid: [r for r in reps if r] for sid, reps in sessions.items()}
+    total = sum(len(reps) for reps in served.values())
+    modal = sum(max(Counter(reps).values()) for reps in served.values()
+                if reps)
+    split = {sid: sorted(set(reps)) for sid, reps in served.items()
+             if len(set(reps)) > 1}
+    ejections = 0
+    ejected_replicas = set()
+    timeline_checked = False
+    if split:
+        for url in urls:
+            try:
+                with urllib.request.urlopen(f"{url}/debug/timeline",
+                                            timeout=timeout) as r:
+                    events = json.loads(r.read()).get("events", [])
+            except Exception:  # glomlint: disable=conc-broad-except -- a non-router target has no timeline; the check degrades to reporting the split without a verdict
+                continue
+            timeline_checked = True
+            # the router timeline keys the transition type as "event"
+            # (FleetRouter.note_event), with the replica name alongside
+            for e in events:
+                if (e.get("event") in ("ejection", "readmission")
+                        and int(e.get("seq", -1)) > after_seq):
+                    ejections += 1
+                    if e.get("replica"):
+                        ejected_replicas.add(e["replica"])
+    violations = (sorted(
+        sid for sid, reps in split.items()
+        if not ejected_replicas.intersection(reps))
+        if timeline_checked else [])
+    return {
+        "hit_rate": round(modal / total, 4) if total else None,
+        "split_sessions": split,
+        "ejection_events": ejections if timeline_checked else None,
+        "timeline_checked": timeline_checked,
+        "violations": violations,
+    }
+
+
+def _lat_block(xs):
+    return {
+        "count": len(xs),
+        "p50": round(percentile(xs, 50), 3) if xs else None,
+        "p95": round(percentile(xs, 95), 3) if xs else None,
+        "mean": round(sum(xs) / len(xs), 3) if xs else None,
+    }
+
+
+def session_report(results, urls, timeout, after_seq=-1):
+    with results.lock:
+        cold, warm = list(results.cold_ms), list(results.warm_ms)
+        n_sessions = len(results.sessions)
+    cold_b, warm_b = _lat_block(cold), _lat_block(warm)
+    return {
+        "sessions": n_sessions,
+        "cold_ms": cold_b,
+        "warm_ms": warm_b,
+        "warm_over_cold_p50": (
+            round(warm_b["p50"] / cold_b["p50"], 4)
+            if warm_b["p50"] and cold_b["p50"] else None),
+        "affinity": check_session_affinity(urls, results, timeout,
+                                           after_seq=after_seq),
+    }
 
 
 def report(results, wall_s, mode, slow_n=0):
@@ -485,8 +691,30 @@ def main(argv=None) -> int:
     batch_sizes = [int(b) for b in args.batch_sizes.split(",")]
     urls = [u.rstrip("/") for u in (args.target or [args.url])]
     health = _fetch_health(urls[0], args.timeout)
-    payloads = _make_payloads(health, batch_sizes)
     results = _Results()
+    if args.sessions > 0:
+        image_lists = _make_image_lists(health, batch_sizes)
+        # timeline cursor BEFORE the run: only ejections that happen
+        # during it may excuse a split session
+        start_seq = timeline_max_seq(urls, args.timeout)
+        wall = run_sessions(urls, image_lists, batch_sizes, args.sessions,
+                            args.frames, args.timeout, results)
+        sess = session_report(results, urls, args.timeout,
+                              after_seq=start_seq)
+        out = report(results, wall,
+                     f"sessions(n={args.sessions},frames={args.frames})",
+                     slow_n=args.slow_n)
+        out["session"] = sess
+        print(json.dumps(out, indent=2))
+        ok = (results.errors == 0 and results.id_mismatches == 0
+              and not sess["affinity"]["violations"])
+        if sess["affinity"]["violations"]:
+            print(f"loadgen: AFFINITY VIOLATION — sessions "
+                  f"{sess['affinity']['violations']} split across replicas "
+                  f"with no ejection in the router timeline",
+                  file=sys.stderr)
+        return 0 if ok else 1
+    payloads = _make_payloads(health, batch_sizes)
     if args.rate > 0:
         wall = run_open(urls, args.endpoint, payloads, batch_sizes,
                         args.rate, args.duration, args.timeout, results)

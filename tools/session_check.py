@@ -1,0 +1,292 @@
+#!/usr/bin/env python
+"""Iso-quality harness for stateful session serving's warm-start savings.
+
+  python tools/session_check.py                       # demo model sweep
+  python tools/session_check.py --checkpoint-dir /ckpt --warm-iters 2,3,4,6
+  python tools/session_check.py --smoke               # CI gate (exit code)
+
+The stateful session path (``/session/embed``; docs/SERVING.md) trades a
+cold first-frame settle at the full iteration count for warm per-frame
+updates at ``warm_iters``.  That trade is only a win if the warm
+equilibrium stays CLOSE to the full-iteration one — otherwise the
+latency saved was quality spent.  This harness measures exactly that, on
+a synthetic smooth frame stream (AR(1): consecutive frames whose content
+— and therefore equilibrium — barely moves, the streaming workload the
+session path exists for):
+
+  * **reference trajectory**: carried column state, FULL ``cold_iters``
+    per frame (``video.rollout`` semantics at the cold count);
+  * **warm trajectory** per swept ``warm_iters``: same carried state,
+    reduced count — the serving warm path, run through freshly
+    AOT-compiled executables exactly like the serving compile cache;
+  * **equilibrium distance** per frame: ``‖levels_warm − levels_full‖_F
+    / ‖levels_full‖_F``; a sweep value passes iso-quality when its max
+    over the stream stays within ``--threshold``;
+  * **measured latency**: per-frame wall time of the warm executable vs
+    the full-iteration one (block-until-ready, warmed up first), p50/p95
+    and the warm/full ratio — the number ``tools/bench_gate.py
+    --session-json`` gates against (``steady_state_p95_ms``).
+
+The headline verdict: the smallest passing ``warm_iters`` and whether it
+meets the ``<= cold_iters/2`` target (the ROADMAP's measured-savings
+acceptance).  ``--smoke`` runs the demo model in seconds and exits
+nonzero unless a sweep value at or below half the cold count passes at a
+warm/full latency ratio < 1 — the tier-1 CI gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def percentile(xs, q):
+    """Nearest-rank percentile (the obs registry's rule)."""
+    if not xs:
+        return None
+    ordered = sorted(xs)
+    rank = min(len(ordered) - 1, max(0, math.ceil(q / 100.0 * len(ordered)) - 1))
+    return ordered[rank]
+
+
+def make_frames(rng, t, b, channels, size, drift):
+    """AR(1) frame stream with unit stationary variance: ``x_{t+1} =
+    rho x_t + sqrt(1-rho^2) n`` — ``drift`` is how far each frame moves
+    (0 = a static scene, 1 = i.i.d. noise, i.e. no temporal coherence
+    for the warm start to exploit)."""
+    import numpy as np
+
+    rho = 1.0 - drift
+    mix = math.sqrt(max(0.0, 1.0 - rho * rho))
+    frames = np.empty((t, b, channels, size, size), dtype=np.float32)
+    frames[0] = rng.randn(b, channels, size, size)
+    for i in range(1, t):
+        frames[i] = rho * frames[i - 1] + mix * rng.randn(
+            b, channels, size, size)
+    return frames
+
+
+def _aot(fn, *arg_structs):
+    """AOT-compile the way the serving compile cache does — the latencies
+    measured here are executable dispatches, not jit-dispatch overhead."""
+    import jax
+
+    return jax.jit(fn).lower(*arg_structs).compile()  # glomlint: disable=jax-request-path-compile -- offline measurement harness; compiles happen before any timing, mirroring the serving warmup
+
+
+def run_sweep(params, config, *, cold_iters, warm_candidates, frames,
+              threshold, burn_in=3):
+    """One reference trajectory + one warm trajectory per candidate;
+    returns the per-candidate report rows.
+
+    The pass criterion applies to STEADY-STATE frames (index >
+    ``burn_in``): the warm trajectory's distance to the full-iteration
+    one is a decaying transient after the cold start — the warm updates
+    keep pulling the state toward the same equilibrium, so the gap
+    shrinks frame over frame (measured: ~0.12 -> ~0.02 within 3 frames
+    at warm_iters=2 on the demo model).  The transient's own max is
+    still reported (``rel_distance_transient_max``): a client that needs
+    frame-1 accuracy reads that column, and the documented contract is
+    that warm-start quality is a steady-state property."""
+    import jax
+    import numpy as np
+
+    from glom_tpu.serving.engine import _make_session_fns
+
+    t, b = frames.shape[:2]
+    img_struct = jax.ShapeDtypeStruct(frames.shape[1:], np.float32)
+    cold_fn, full_fn = _make_session_fns(config, cold_iters, cold_iters)
+    cold_exe = _aot(cold_fn, params, img_struct)
+    _, state0 = cold_exe(params, frames[0])
+    state_struct = jax.ShapeDtypeStruct(state0.shape, state0.dtype)
+    full_exe = _aot(full_fn, params, img_struct, state_struct)
+
+    # reference trajectory (+ full-iteration per-frame latency)
+    ref_states = [state0]
+    full_ms = []
+    state = state0
+    jax.block_until_ready(state)
+    for i in range(1, t):
+        t0 = time.perf_counter()
+        _, state = full_exe(params, frames[i], state)
+        jax.block_until_ready(state)
+        full_ms.append((time.perf_counter() - t0) * 1e3)
+        ref_states.append(state)
+    ref_host = [np.asarray(s, dtype=np.float32) for s in ref_states]
+    ref_norms = [float(np.linalg.norm(r)) or 1.0 for r in ref_host]
+
+    rows = []
+    for w in warm_candidates:
+        _, warm_fn = _make_session_fns(config, cold_iters, int(w))
+        warm_exe = _aot(warm_fn, params, img_struct, state_struct)
+        state = state0  # frame 0 is cold on both paths by construction
+        warm_ms, dists = [], []
+        for i in range(1, t):
+            t0 = time.perf_counter()
+            _, state = warm_exe(params, frames[i], state)
+            jax.block_until_ready(state)
+            warm_ms.append((time.perf_counter() - t0) * 1e3)
+            d = float(np.linalg.norm(
+                np.asarray(state, dtype=np.float32) - ref_host[i]))
+            dists.append(d / ref_norms[i])
+        # drop each trajectory's first timed frame from the percentile
+        # pool: it pays one-off dispatch warmup, and with few frames one
+        # outlier IS the p95
+        pool_w = warm_ms[1:] or warm_ms
+        pool_f = full_ms[1:] or full_ms
+        p95_w = percentile(pool_w, 95)
+        p95_f = percentile(pool_f, 95)
+        # dists[i] is frame i+1; steady state starts after burn_in frames
+        steady = dists[burn_in:] or dists
+        rows.append({
+            "warm_iters": int(w),
+            "iters_frac": round(int(w) / cold_iters, 4),
+            "rel_distance_mean": round(sum(steady) / len(steady), 6),
+            "rel_distance_max": round(max(steady), 6),
+            "rel_distance_transient_max": round(max(dists), 6),
+            "pass": max(steady) <= threshold,
+            "warm_p50_ms": round(percentile(pool_w, 50), 3),
+            "warm_p95_ms": round(p95_w, 3),
+            "full_p50_ms": round(percentile(pool_f, 50), 3),
+            "full_p95_ms": round(p95_f, 3),
+            "latency_ratio": round(p95_w / p95_f, 4) if p95_f else None,
+        })
+    return rows
+
+
+def build_model(checkpoint_dir, iters):
+    """(params, config, cold_iters) from a real checkpoint, or the demo
+    model when no directory is given."""
+    import jax
+
+    from glom_tpu.training import denoise
+
+    if checkpoint_dir is None:
+        import tempfile
+
+        from glom_tpu.serving.engine import make_demo_checkpoint
+
+        checkpoint_dir = tempfile.mkdtemp(prefix="glom_session_check_")
+        make_demo_checkpoint(checkpoint_dir)
+    _, config, _, params = denoise.load_checkpoint_state(checkpoint_dir)
+    params = jax.device_put(params)
+    cold_iters = int(iters if iters is not None else config.default_iters)
+    return params, config, cold_iters
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="Trainer checkpoint to measure (default: a demo "
+                        "model — calibration of the harness, not of a "
+                        "deployment)")
+    p.add_argument("--iters", type=int, default=None,
+                   help="cold iteration count (default: the model's "
+                        "default_iters)")
+    p.add_argument("--warm-iters", default=None, metavar="K1,K2,...",
+                   help="sweep values (default: 1..cold_iters-1)")
+    p.add_argument("--frames", type=int, default=16,
+                   help="stream length (frame 0 settles cold)")
+    p.add_argument("--batch", type=int, default=2,
+                   help="images per frame")
+    p.add_argument("--drift", type=float, default=0.1,
+                   help="AR(1) per-frame content drift (0=static scene, "
+                        "1=i.i.d. frames)")
+    p.add_argument("--threshold", type=float, default=0.05,
+                   help="iso-quality bound on ‖levels_warm − levels_full‖"
+                        "/‖levels_full‖ per steady-state frame")
+    p.add_argument("--burn-in", type=int, default=3,
+                   help="frames excluded from the pass criterion (the "
+                        "decaying cold-start transient; still reported "
+                        "as rel_distance_transient_max)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--report", default=None, metavar="FILE",
+                   help="also write the JSON report here (bench_gate "
+                        "--session-json reads it)")
+    p.add_argument("--require-half", action="store_true",
+                   help="exit nonzero unless some warm_iters <= "
+                        "cold_iters/2 passes iso-quality (the ROADMAP "
+                        "acceptance; implied by --smoke)")
+    p.add_argument("--smoke", action="store_true",
+                   help="fast demo-model run wired as the tier-1 CI gate")
+    p.add_argument("--platform", default=None,
+                   help="force a JAX platform (e.g. 'cpu')")
+    args = p.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    import numpy as np
+
+    if args.smoke:
+        args.checkpoint_dir = None
+        args.frames = min(args.frames, 8)
+        args.require_half = True
+
+    params, config, cold_iters = build_model(args.checkpoint_dir, args.iters)
+    if args.warm_iters:
+        candidates = sorted({int(k) for k in args.warm_iters.split(",")})
+        bad = [k for k in candidates if not 1 <= k <= cold_iters]
+        if bad:
+            print(f"error: warm_iters {bad} outside [1, {cold_iters}]",
+                  file=sys.stderr)
+            return 2
+    else:
+        candidates = list(range(1, cold_iters))
+    rng = np.random.RandomState(args.seed)
+    frames = make_frames(rng, args.frames, args.batch, config.channels,
+                         config.image_size, args.drift)
+    rows = run_sweep(params, config, cold_iters=cold_iters,
+                     warm_candidates=candidates, frames=frames,
+                     threshold=args.threshold, burn_in=args.burn_in)
+
+    passing = [r for r in rows if r["pass"]]
+    best = min(passing, key=lambda r: r["warm_iters"]) if passing else None
+    half = cold_iters // 2
+    report = {
+        "cold_iters": cold_iters,
+        "frames": int(args.frames),
+        "batch": int(args.batch),
+        "drift": args.drift,
+        "threshold": args.threshold,
+        "burn_in": args.burn_in,
+        "sweep": rows,
+        "best_warm_iters": best["warm_iters"] if best else None,
+        "half_target_iters": half,
+        "half_target_met": bool(best and best["warm_iters"] <= half),
+        # the numbers bench_gate consumes: steady-state warm-frame p95 at
+        # the best iso-quality setting, and the measured savings vs the
+        # full-iteration carried path
+        "steady_state_p95_ms": best["warm_p95_ms"] if best else None,
+        "full_iter_p95_ms": best["full_p95_ms"] if best else None,
+        "latency_ratio": best["latency_ratio"] if best else None,
+    }
+    if args.smoke:
+        ok = (report["half_target_met"]
+              and report["latency_ratio"] is not None
+              and report["latency_ratio"] < 1.0)
+        report = {"smoke": "ok" if ok else "FAILED", **report}
+    out = json.dumps(report, indent=2)
+    print(out)
+    if args.report:
+        with open(args.report, "w") as f:
+            f.write(out + "\n")
+    if args.require_half and not report["half_target_met"]:
+        print(f"session_check: FAIL — no warm_iters <= {half} reaches "
+              f"within {args.threshold} of the full-iteration equilibrium",
+              file=sys.stderr)
+        return 1
+    if args.smoke and report.get("smoke") != "ok":
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -191,6 +191,23 @@ _OVERLAP_SPANS = {"dispatch_wait"}
 _CONTAINER_SPANS = {"proxy", "request"}
 
 
+def _execute_label(span):
+    """Split execute rows warm vs cold so the stateful session path's
+    savings are visible in the breakdown: a warm (state-carrying) execute
+    reports as ``execute_warm``, a session cold settle as
+    ``execute_cold``; everything else — stateless executes and feeds
+    recorded before the attrs existed — stays ``execute`` (golden feeds
+    are byte-compatible)."""
+    if span["name"] != "execute":
+        return span["name"]
+    attrs = span.get("attrs") or {}
+    if attrs.get("stateful") is True:
+        return "execute_warm"
+    if attrs.get("endpoint") == "session_cold":
+        return "execute_cold"
+    return "execute"
+
+
 def _breakdown(spans):
     """Per-span-name total ms within one trace (mirrored batch spans
     appear once per trace by construction; overlap spans excluded,
@@ -205,7 +222,8 @@ def _breakdown(spans):
         if (s["name"] in _CONTAINER_SPANS
                 and s.get("span_id") in parent_ids):
             continue
-        out[s["name"]] = out.get(s["name"], 0.0) + s["duration_ms"]
+        label = _execute_label(s)
+        out[label] = out.get(label, 0.0) + s["duration_ms"]
     return out
 
 
@@ -288,6 +306,44 @@ def summarize(traces, slowest=5):
         for k, v in sorted(buckets.items())
     ]
 
+    # warm vs cold execute split (stateful session serving): how much
+    # device time warm-started frames actually cost vs full settles, in
+    # the same (possibly stitched fleet) feed — deduped per physical
+    # execute exactly like the bucket table.  None when the feed has no
+    # session traffic (pre-session feeds are unchanged).
+    seen_wc = set()
+    wc = {"execute_warm": [], "execute_cold": []}
+    for t in traces:
+        for s in t["spans"]:
+            label = _execute_label(s)
+            if label not in wc or s.get("duration_ms") is None:
+                continue
+            key = (s.get("source"), s.get("raw_start", s["start"]))
+            if key in seen_wc:
+                continue
+            seen_wc.add(key)
+            wc[label].append(s["duration_ms"])
+
+    def _wc_block(xs):
+        return {
+            "frames": len(xs),
+            "total_ms": round(sum(xs), 3),
+            "p50_ms": (round(_percentile(xs, 50), 3) if xs else None),
+            "p95_ms": (round(_percentile(xs, 95), 3) if xs else None),
+        }
+
+    warm_cold = None
+    if wc["execute_warm"] or wc["execute_cold"]:
+        warm_b = _wc_block(wc["execute_warm"])
+        cold_b = _wc_block(wc["execute_cold"])
+        warm_cold = {
+            "warm": warm_b,
+            "cold": cold_b,
+            "warm_over_cold_p50": (
+                round(warm_b["p50_ms"] / cold_b["p50_ms"], 4)
+                if warm_b["p50_ms"] and cold_b["p50_ms"] else None),
+        }
+
     return {
         "traces": len(traces),
         "requests": len(requests),
@@ -299,6 +355,7 @@ def summarize(traces, slowest=5):
         "spans": span_rows,
         "slowest": slow_rows,
         "buckets": bucket_rows,
+        "warm_cold": warm_cold,
     }
 
 
@@ -444,6 +501,17 @@ def print_report(s):
             print(f"| {r['bucket']} | {r['batches']} | {r['images']} | "
                   f"{100 * r['mean_padding_waste']:.1f}% | "
                   f"{_fmt(r['p95_execute_ms'])} |")
+    if s.get("warm_cold"):
+        wc = s["warm_cold"]
+        print("\nstateful sessions — warm vs cold execute:")
+        for mode in ("warm", "cold"):
+            r = wc[mode]
+            print(f"  {mode}: {r['frames']} frames  "
+                  f"p50 {_fmt(r['p50_ms'])} ms  p95 {_fmt(r['p95_ms'])} ms  "
+                  f"total {_fmt(r['total_ms'])} ms")
+        if wc["warm_over_cold_p50"] is not None:
+            print(f"  warm/cold p50 ratio: {wc['warm_over_cold_p50']:.2f} "
+                  f"(the measured warm-start saving)")
 
 
 def print_trace(traces, trace_id) -> int:
