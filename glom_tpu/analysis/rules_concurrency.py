@@ -14,6 +14,11 @@
     whose classes accept ``clock=``: every such call is invisible to the
     fake-clock tests the injectable pattern exists for (see
     ``obs/tracing.py`` for the canonical form).
+  * ``conc-heartbeat-raw-clock`` — the stronger form of the clock rule
+    for ``resilience/`` modules implementing heartbeat/election logic
+    (``resilience/elastic.py``): raw clock reads AND real sleeps are
+    errors there even without a ``clock=`` param in scope, because
+    staleness/election/backoff decisions must replay under a fake clock.
   * ``conc-thread-daemon`` — ``threading.Thread`` created without
     ``daemon=`` and never joined: shutdown hangs on it, or it dies
     mid-write at interpreter teardown.
@@ -394,6 +399,51 @@ class ThreadLifecycleRule(Rule):
         return findings
 
 
+class HeartbeatRawClockRule(Rule):
+    name = "conc-heartbeat-raw-clock"
+    severity = "error"
+    description = ("raw time.*/sleep calls in a resilience/ module that "
+                   "implements heartbeat/election logic: the elastic "
+                   "recovery paths must stay replayable under a fake "
+                   "clock even where no clock= param is in scope")
+
+    # conc-raw-clock only fires where a `clock=` parameter already exists —
+    # the exact gap a new heartbeat helper without one slips through.  This
+    # rule pins the stronger contract on the modules whose CORRECTNESS
+    # depends on injected time (staleness judgments, election timing,
+    # backoff arithmetic): any raw clock READ or real sleep there is an
+    # error, clock= param or not.  time.sleep is included: a real sleep in
+    # a heartbeat path stalls the fake-clock simulation forever.
+    SCOPE_DIRS: Tuple[str, ...] = ("resilience",)
+    MARKERS: Tuple[str, ...] = ("heartbeat", "elect")
+    RAW_CALLS = {"time.time", "time.monotonic", "time.perf_counter",
+                 "time.sleep"}
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        dirs = ctx.relpath.split("/")[:-1]
+        if not any(d in dirs for d in self.SCOPE_DIRS):
+            return []
+        defines_heartbeat = any(
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef))
+            and any(m in node.name.lower() for m in self.MARKERS)
+            for node in ast.walk(ctx.tree))
+        if not defines_heartbeat:
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and dotted_name(node.func) in self.RAW_CALLS):
+                findings.append(ctx.finding(
+                    self, node,
+                    f"{dotted_name(node.func)}() in a heartbeat/election "
+                    f"module: staleness and election decisions must flow "
+                    f"through the injected clock/sleep "
+                    f"(resilience/elastic.py SimClock pattern) or "
+                    f"fake-clock chaos replay breaks"))
+        return findings
+
+
 _LOG_CALL_NAMES = {"warn", "warning", "error", "exception", "critical",
                    "info", "debug", "log", "print", "print_exc", "write",
                    "fail", "capture"}
@@ -451,4 +501,5 @@ class BroadExceptRule(Rule):
 
 
 CONCURRENCY_RULES = (LockOrderRule, CheckThenActRule, RawClockRule,
-                     ThreadLifecycleRule, BroadExceptRule)
+                     HeartbeatRawClockRule, ThreadLifecycleRule,
+                     BroadExceptRule)

@@ -556,6 +556,31 @@ def _load_arrays(directory: str, step: int) -> dict:
     raise FileNotFoundError(f"no checkpoint artifact for step {step} in {directory}")
 
 
+def load_tree(directory: str, step: int, name: str) -> Dict[str, np.ndarray]:
+    """Template-free read of one named tree's flat leaves:
+    ``{"leaf/path": ndarray}`` (a scalar tree saved as ``name`` alone comes
+    back under the key ``""``).  Raises KeyError when the step carries no
+    such tree.
+
+    This exists for readers that must inspect a checkpoint WITHOUT being
+    able to build the live template — the elastic supervisor reads the
+    ``data`` cursor at re-plan time (the stream object of the next attempt
+    does not exist yet, and after a host-count change its template would
+    not match anyway), and forensics bundles record it as evidence."""
+    arrays = _load_arrays(directory, step)
+    prefix = name + _SEP
+    out = {k[len(prefix):]: v for k, v in arrays.items()
+           if k.startswith(prefix)}
+    if name in arrays:
+        out[""] = arrays[name]
+    if not out:
+        raise KeyError(
+            f"checkpoint step {step} in {directory} holds no tree "
+            f"named {name!r}"
+        )
+    return out
+
+
 def restore(
     directory: str,
     templates: Dict[str, Any],

@@ -33,6 +33,13 @@ TRIGGER_SLO_BURN = "slo_burn"
 # verification and was quarantined; a supervised fit() crashed and restarted
 TRIGGER_CKPT_CORRUPT = "ckpt_corrupt"
 TRIGGER_CRASH_RESTART = "crash_restart"
+# elastic multi-host (glom_tpu.resilience.elastic): one fault domain was
+# preempted / the coordinator went silent and a successor was elected / a
+# restart came back with a different host count and the job re-planned its
+# mesh + data-plane partition — each bundle carries the before/after plan
+TRIGGER_HOST_PREEMPT = "host_preempt"
+TRIGGER_COORDINATOR_LOSS = "coordinator_loss"
+TRIGGER_ELASTIC_REPLAN = "elastic_replan"
 # terminal paths write bundles DIRECTLY (no debounce/budget — they fire at
 # most once per run by construction); named here so readers share the names
 TRIGGER_CRASH = "crash"

@@ -130,6 +130,78 @@ def make_hybrid_mesh(
     return Mesh(dev_array, tuple(axis_names))
 
 
+def elastic_mesh_shape(
+    host_count: int,
+    devices_per_host: int = 1,
+    *,
+    model: int = 1,
+    seq: int = 1,
+    axis_names: Sequence[str] = DEFAULT_AXES,
+) -> tuple:
+    """Re-plan arithmetic for an elastic restart (pure — touches no
+    devices, importable under a fake clock): the **data axis absorbs the
+    host-count change**, the model/seq axes are preserved — shrinking a
+    fleet must degrade throughput, never silently change the parameter
+    partitioning the checkpoint was written under.  Raises when the new
+    device total cannot cover the fixed model×seq block (the operator must
+    then change the sharding config explicitly, not have it re-derived
+    behind their back)."""
+    if host_count < 1 or devices_per_host < 1:
+        raise ValueError(
+            f"host_count ({host_count}) and devices_per_host "
+            f"({devices_per_host}) must be >= 1"
+        )
+    total = host_count * devices_per_host
+    fixed = model * seq
+    if total % fixed != 0:
+        raise ValueError(
+            f"{host_count} hosts x {devices_per_host} devices = {total} "
+            f"devices cannot preserve the model x seq = {model}x{seq} "
+            f"block; re-plan only re-derives the data axis"
+        )
+    shape = [total // fixed, model, seq]
+    # trailing axes past (data, model, seq) — expert factors — replicate
+    shape += [1] * (len(axis_names) - len(shape))
+    shape = tuple(shape[: len(axis_names)])
+    if int(np.prod(shape)) != total:
+        # fewer axis names than factors: truncating would silently drop a
+        # model/seq factor and under-cover the owned devices
+        raise ValueError(
+            f"axis_names {tuple(axis_names)} cannot carry the re-planned "
+            f"shape (data={total // fixed}, model={model}, seq={seq}) over "
+            f"{total} devices"
+        )
+    return shape
+
+
+def make_elastic_mesh(
+    host_count: int,
+    devices_per_host: int = 1,
+    *,
+    model: int = 1,
+    seq: int = 1,
+    axis_names: Sequence[str] = DEFAULT_AXES,
+) -> Mesh:
+    """Materialize an elastic re-plan: a mesh over the first
+    ``host_count * devices_per_host`` local devices.  Taking a device
+    PREFIX is the point — a shrink-restarted job owns fewer chips than the
+    process can see (on the faked-8-device CPU test harness this models
+    the dead host's chips exactly), and the serving engine's
+    ``resolve_mesh`` established the subset-mesh convention."""
+    shape = elastic_mesh_shape(
+        host_count, devices_per_host, model=model, seq=seq,
+        axis_names=axis_names,
+    )
+    n = int(np.prod(shape))
+    devices = jax.devices()
+    if len(devices) < n:
+        raise ValueError(
+            f"re-planned mesh {shape} needs {n} devices; only "
+            f"{len(devices)} visible"
+        )
+    return make_mesh(shape, axis_names, devices=devices[:n])
+
+
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -137,8 +209,10 @@ def initialize_distributed(
 ) -> None:
     """Multi-host bring-up: ``jax.distributed.initialize``.  On single-host
     (or under the test harness) this is a no-op.  A host failure means
-    restart-from-checkpoint (SURVEY.md §5 failure-detection note); there is
-    no elasticity in v1."""
+    restart-from-checkpoint; :mod:`glom_tpu.resilience.elastic` supplies
+    the elastic semantics on top (per-host fault domains, coordinator
+    election, and :func:`elastic_mesh_shape` re-planning when the restart
+    comes back with a different host count)."""
     if num_processes is None or num_processes <= 1:
         return
     jax.distributed.initialize(

@@ -19,8 +19,17 @@ detection into survival.  Four pieces, each usable on its own:
   * :mod:`glom_tpu.resilience.supervisor` — a self-healing training
     supervisor: runs ``fit()`` under a restart policy (exponential
     backoff with jitter, crash-loop detection, resume-from-latest-valid
-    on every attempt) with restart/giveup counters in the shared obs
-    registry and a forensics bundle per restart.
+    on every attempt) with restart/giveup counters (split by failure
+    reason) in the shared obs registry and a forensics bundle per
+    restart.
+  * :mod:`glom_tpu.resilience.elastic` — elastic MULTI-HOST semantics on
+    top of the supervisor: per-host fault domains (one crash-looping
+    host degrades the fleet by one domain, never kills the job),
+    heartbeat-based coordinator-loss detection with deterministic
+    successor election, and re-planning on device-count change (mesh
+    re-derived, params resharded from the last verified checkpoint, the
+    exactly-once data cursor re-partitioned).  All clocks injectable;
+    all failure paths driven through the seeded fault injector.
 
 ``tools/chaos.py`` is the acceptance harness: it runs every named fault
 against a tiny CPU train/serve loop and asserts recovery, reporting
@@ -45,6 +54,19 @@ from glom_tpu.resilience.integrity import (  # noqa: F401
 )
 from glom_tpu.resilience.supervisor import (  # noqa: F401
     GiveUp,
+    PreemptionError,
     RestartPolicy,
     Supervisor,
+    classify_failure,
+)
+from glom_tpu.resilience.elastic import (  # noqa: F401
+    CoordinatorLostError,
+    ElasticContext,
+    ElasticPlan,
+    ElasticSupervisor,
+    FaultDomain,
+    HeartbeatTracker,
+    HostPreemptedError,
+    SimClock,
+    elect_coordinator,
 )

@@ -36,6 +36,14 @@ Sites (the names production code passes to :func:`fire`):
                                             stalled input, pipeline crash)
   reload      io_error, corrupt_manifest    ``serving/engine.py`` hot-reload
                                             watcher polls
+  host_preempt       kill                   ``resilience/elastic.py`` per-step
+                                            tick (one fault domain dies)
+  coordinator_loss   lost                   elastic tick (coordinator stops
+                                            heartbeating; successor elected)
+  heartbeat_delay    delay                  elastic tick (a host misses beats
+                                            WITHOUT dying — must not eject)
+  shrink_restart     shrink, grow           elastic re-plan (the restart
+                                            comes back with fewer/more hosts)
   ==========  ============================  =================================
 
 Arming is process-global (:func:`arm` / :func:`disarm` / the
@@ -60,6 +68,13 @@ KINDS = {
     "ckpt_write": ("torn", "bitflip"),
     "data": ("nan_batch", "drop_batch", "delay", "crash"),
     "reload": ("io_error", "corrupt_manifest"),
+    # elastic multi-host sites (glom_tpu.resilience.elastic): fired from
+    # ElasticContext.tick (the per-global-step seam) and the supervisor's
+    # re-plan, so every recovery path is deterministic on CPU
+    "host_preempt": ("kill",),
+    "coordinator_loss": ("lost",),
+    "heartbeat_delay": ("delay",),
+    "shrink_restart": ("shrink", "grow"),
 }
 
 

@@ -46,6 +46,41 @@ class GiveUp(RuntimeError):
     final underlying failure is the ``__cause__``."""
 
 
+class PreemptionError(RuntimeError):
+    """A host/domain was preempted (the scheduler took the machine back) —
+    the restartable-by-definition failure class.  The elastic layer's
+    :class:`~glom_tpu.resilience.elastic.HostPreemptedError` subclasses
+    this; the base lives here so :func:`classify_failure` needs no import
+    of the elastic module."""
+
+
+# -- restart-reason taxonomy ------------------------------------------------
+# One undifferentiated `supervisor_restarts` count cannot answer the MTTR
+# questions chaos reports ask ("is the fleet dying to preemption or to our
+# own NaNs?").  Every restart is additionally counted under
+# `supervisor_restarts_<reason>` (minted through MetricRegistry.labeled so
+# a hostile reason string can never grow /metrics unboundedly).
+REASON_PREEMPT = "preempt"      # PreemptionError: scheduler reclaim
+REASON_NAN_HALT = "nan_halt"    # trainer halt_on_nan tripped
+REASON_IO_ERROR = "io_error"    # OSError class: filesystem/network (incl.
+                                # injected FaultError, an OSError subclass)
+REASON_CRASH = "crash"          # everything else: code/data bugs
+
+
+def classify_failure(exc: BaseException) -> str:
+    """Map a fit() failure to its restart-reason label.  NonFiniteError is
+    matched by NAME on purpose: importing the trainer (and with it jax)
+    into this stdlib-light module just for an isinstance would be the tail
+    wagging the dog."""
+    if isinstance(exc, PreemptionError):
+        return REASON_PREEMPT
+    if type(exc).__name__ == "NonFiniteError":
+        return REASON_NAN_HALT
+    if isinstance(exc, OSError):
+        return REASON_IO_ERROR
+    return REASON_CRASH
+
+
 @dataclass(frozen=True)
 class RestartPolicy:
     """Restart arithmetic.  ``max_failures`` failures within the sliding
@@ -148,10 +183,12 @@ class Supervisor:
                 while failures and now - failures[0] > self.policy.window_s:
                     failures.popleft()
                 n_fail = len(failures)
+                reason = classify_failure(e)
                 detail = {
                     "error": f"{type(e).__name__}: {e}",
                     "traceback": "".join(traceback.format_exception(
                         type(e), e, e.__traceback__)),
+                    "reason": reason,
                     "failures_in_window": n_fail,
                     "window_s": self.policy.window_s,
                     "restarts_so_far": self.restarts,
@@ -169,6 +206,16 @@ class Supervisor:
                     ) from e
                 self._count("supervisor_restarts",
                             "supervised fit() restarts after a crash")
+                if self.registry is not None:
+                    # per-reason split of the same count (labeled mint keeps
+                    # the family's cardinality bounded): chaos MTTR reports
+                    # read these to separate preemption from crash from
+                    # NaN-halt instead of one undifferentiated total
+                    self.registry.counter(
+                        self.registry.labeled("supervisor_restarts_", reason),
+                        help="supervised fit() restarts, split by failure "
+                             "reason (preempt|nan_halt|io_error|crash)",
+                    ).inc()
                 self._bundle(self.restarts, dict(detail, outcome="restart"))
                 if self.checkpoint_dir:
                     # quarantine torn/corrupt steps NOW so the retry's

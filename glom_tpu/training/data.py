@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import os
 import queue
+import sys
 import threading
 import time
 from typing import Iterator, Optional
@@ -228,33 +229,54 @@ class Prefetcher:
     deterministically: the worker is unblocked and joined, and an inner
     iterator exposing ``close()`` (generators; ``ImageFolderStream``'s
     decode pools) is closed too — nothing leaks until interpreter exit just
-    because a consumer stopped early."""
+    because a consumer stopped early.  The shutdown drain is REPEATED until
+    the worker exits (or a deadline passes): a single drain races a worker
+    mid-``put`` that refills the just-emptied queue, leaving ``join`` to
+    wait on a thread still parked against a full queue.  A worker exception
+    the consumer never got to see (it stopped drawing before the queue
+    reached the sentinel) is re-raised from ``close()`` — swallowing it
+    would let a dying pipeline impersonate a clean early exit."""
+
+    # class attribute (True on StatefulPrefetcher): the worker thread reads
+    # it from its first iteration, so it must be set before __init__ runs
+    _stateful = False
 
     def __init__(self, it: Iterator[np.ndarray], depth: int = 2):
+        self._depth = depth
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._it = it
         self._done = object()
         self._error: Optional[BaseException] = None
+        self._error_delivered = False
         self._stop = threading.Event()
         self._closed = False
+        self._exhausted = False  # the _done sentinel was consumed
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
     def _run(self):
         try:
-            for item in self._it:
+            while not self._stop.is_set():
+                try:
+                    item = next(self._it)
+                except StopIteration:
+                    break
+                # the inner cursor AFTER drawing this item rides the queue
+                # with it: state_dict() answers for what was consumed, not
+                # what the read-ahead produced
+                state = self._it.state_dict() if self._stateful else None
                 # bounded-wait put: a consumer that vanished (or called
                 # close()) must not leave this thread blocked forever on a
                 # full queue
                 while not self._stop.is_set():
                     try:
-                        self._q.put(item, timeout=0.1)
+                        self._q.put((item, state), timeout=0.1)
                         break
                     except queue.Full:
                         continue
                 if self._stop.is_set():
                     return
-        except BaseException as e:  # re-raised in __next__
+        except BaseException as e:  # re-raised in __next__ (or close())
             self._error = e
         finally:
             while not self._stop.is_set():
@@ -268,34 +290,58 @@ class Prefetcher:
         return self
 
     def __next__(self):
-        if self._closed:
+        if self._closed or self._exhausted:
+            # the sentinel is consumed exactly once; without this flag a
+            # second iteration would block forever in _q.get() on a queue
+            # the exited worker will never feed again (iterator protocol:
+            # an exhausted iterator raises StopIteration repeatedly)
             raise StopIteration
-        item = self._q.get()
-        if item is self._done:
+        payload = self._q.get()
+        if payload is self._done:
+            self._exhausted = True
             err = self._error
-            if err is not None:
+            if err is not None and not self._error_delivered:
                 # the original exception OBJECT, carrying the worker
                 # thread's traceback — the consumer sees where the
                 # pipeline actually died, not a generic queue poisoning
+                self._error_delivered = True
                 raise err
             raise StopIteration
+        item, state = payload
+        if state is not None:
+            self._last_state = state
         return item
 
-    def close(self) -> None:
-        """Deterministic shutdown (idempotent): stop the worker, drain the
-        queue so its bounded put unblocks, join, and close the inner
-        iterator.  After close(), iteration raises StopIteration."""
-        if self._closed:
-            return
-        self._closed = True
-        self._stop.set()
-        while True:  # unblock a worker waiting on a full queue
+    def _drain(self) -> None:
+        while True:
             try:
                 self._q.get_nowait()
             except queue.Empty:
-                break
-        self._thread.join(timeout=5.0)
-        if self._thread.is_alive():
+                return
+
+    def _stop_worker(self, timeout: float) -> bool:
+        """Stop + join the worker, draining REPEATEDLY so a put in flight
+        (the consumer exited with the queue full) always unblocks; True
+        when the thread actually exited."""
+        self._stop.set()
+        deadline = time.monotonic() + timeout
+        while True:
+            self._drain()
+            self._thread.join(timeout=0.05)
+            if not self._thread.is_alive():
+                return True
+            if time.monotonic() >= deadline:
+                return False
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Deterministic shutdown (idempotent): stop the worker, drain the
+        queue until its bounded put unblocks, join, close the inner
+        iterator — then surface a worker exception the consumer never saw.
+        After close(), iteration raises StopIteration."""
+        if self._closed:
+            return
+        self._closed = True
+        if not self._stop_worker(timeout):
             # the worker is wedged inside next(self._it) (hung decode or
             # network read): closing a generator mid-execution raises
             # "generator already executing" — and from finally blocks that
@@ -304,20 +350,313 @@ class Prefetcher:
             import warnings
 
             warnings.warn(
-                "Prefetcher.close(): worker did not stop within 5s; "
-                "skipping inner-iterator close",
+                f"Prefetcher.close(): worker did not stop within "
+                f"{timeout}s; skipping inner-iterator close",
                 stacklevel=2,
             )
             return
         close = getattr(self._it, "close", None)
         if callable(close):
             close()
+        err = self._error
+        if err is not None and not self._error_delivered:
+            self._error_delivered = True
+            if sys.exc_info()[0] is None:
+                raise err
+            # close() is running from a finally while another exception
+            # propagates (the supervisor's restart routing depends on THAT
+            # one): raising here would replace it and misclassify the
+            # restart reason — surface the worker's death as a warning
+            # instead of silently dropping it
+            import warnings
+
+            warnings.warn(
+                f"Prefetcher worker failed after close "
+                f"({type(err).__name__}: {err}); not re-raised because "
+                f"another exception is already propagating",
+                stacklevel=2,
+            )
 
     def __enter__(self) -> "Prefetcher":
         return self
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+class StatefulPrefetcher(Prefetcher):
+    """Prefetcher over a RESUMABLE stream (``state_dict``/
+    ``load_state_dict``): read-ahead without cursor desync.  The worker
+    snapshots the inner cursor alongside every item it enqueues, and
+    ``state_dict()`` answers with the snapshot of the last item the
+    CONSUMER took — so a checkpoint cut with ``depth`` batches in flight
+    records exactly the consumed position, and a restart neither replays
+    the in-flight batches nor skips them.
+
+    ``load_state_dict`` is a rewind: the worker has read ahead of the
+    restored cursor, so it is stopped and joined, the queue discarded,
+    the inner stream re-seeded, and a fresh worker started."""
+
+    _stateful = True
+
+    def __init__(self, it, depth: int = 2):
+        if not (hasattr(it, "state_dict") and hasattr(it, "load_state_dict")):
+            raise TypeError(
+                "StatefulPrefetcher needs a resumable inner stream "
+                "(state_dict/load_state_dict); use Prefetcher for "
+                "stateless iterators"
+            )
+        # the pre-iteration cursor: correct until the first item is consumed
+        self._last_state = it.state_dict()
+        super().__init__(it, depth)
+
+    def state_dict(self) -> dict:
+        return dict(self._last_state)
+
+    def load_state_dict(self, state: dict) -> None:
+        if self._closed:
+            raise RuntimeError("cannot rewind a closed Prefetcher")
+        if not self._stop_worker(5.0):
+            raise RuntimeError(
+                "prefetch worker did not stop for rewind; the inner "
+                "stream cannot be re-seeded safely"
+            )
+        self._it.load_state_dict(state)
+        self._last_state = self._it.state_dict()
+        self._error = None
+        self._error_delivered = False
+        self._exhausted = False  # a rewound stream iterates again
+        self._stop = threading.Event()
+        self._q = queue.Queue(maxsize=self._depth)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+
+# -- exactly-once elastic data plane ---------------------------------------
+
+def host_block(global_batch: int, host_index: int, host_count: int):
+    """The deterministic per-host shard of one global batch: the CONTIGUOUS
+    row block ``[host_index*B/H, (host_index+1)*B/H)``.  Contiguous (not
+    striped) on purpose: concatenating all hosts' blocks in host order
+    reconstructs the global batch in its original row order at ANY host
+    count, which is what makes a shrink/grow restart bitwise-neutral to
+    the loss (a striped layout would reorder rows — and float reductions —
+    whenever the host count changed)."""
+    if host_count < 1:
+        raise ValueError(f"host_count must be >= 1, got {host_count}")
+    if not 0 <= host_index < host_count:
+        raise ValueError(
+            f"host_index {host_index} out of range for host_count "
+            f"{host_count}"
+        )
+    if global_batch % host_count != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by host_count "
+            f"{host_count}"
+        )
+    k = global_batch // host_count
+    return host_index * k, (host_index + 1) * k
+
+
+class ElasticBatches:
+    """Exactly-once resumable stream with deterministic per-host shard
+    assignment, keyed on ``(seed, epoch, host_index, host_count)``.
+
+    **Global-slot addressing.**  The stream is an infinite sequence of
+    *global sample slots* ``0, 1, 2, ...``; one global step consumes
+    ``batch_size`` consecutive slots and this host materializes only its
+    :func:`host_block` of them.  Sample content is a pure function of the
+    slot: synthetic mode derives each sample's RNG from ``(seed, slot)``;
+    dataset mode maps ``slot -> (epoch=slot//N, perm_epoch[slot%N])``
+    where ``perm_epoch`` is the per-epoch shuffle keyed on
+    ``(seed, epoch)``.
+
+    **Exactly-once cursor.**  The entire resume state is one integer —
+    ``consumed``, the count of global slots drawn — checkpointed next to
+    the params (``state_dict``/``load_state_dict``, the
+    ``ImageFolderStream`` contract the trainer already persists).  Because
+    the cursor is host-count-free, a restart with a DIFFERENT host count
+    re-partitions trivially: every new host resumes at the same global
+    position and takes its new block.  No slot is ever replayed or
+    skipped.
+
+    **Packing.**  Batches address slots, never epoch-aligned chunks, so an
+    epoch tail short of a full batch is packed together with the next
+    epoch's head instead of padded or dropped — zero pad waste by
+    construction (``epochs_started`` tracks boundary crossings).
+    """
+
+    def __init__(
+        self,
+        batch_size: int,
+        image_size: int = 8,
+        channels: int = 3,
+        seed: int = 0,
+        *,
+        host_index: int = 0,
+        host_count: int = 1,
+        dataset: Optional[np.ndarray] = None,
+        perm_cache: Optional[dict] = None,
+    ):
+        host_block(batch_size, host_index, host_count)  # validate eagerly
+        if dataset is not None:
+            dataset = np.asarray(dataset)
+            if dataset.ndim != 4:
+                raise ValueError(
+                    f"dataset must be (N, C, H, W), got {dataset.shape}"
+                )
+        self._global_batch = int(batch_size)
+        self._image_size = int(image_size)
+        self._channels = int(channels)
+        self._seed = int(seed)
+        self._host_index = int(host_index)
+        self._host_count = int(host_count)
+        self._dataset = dataset
+        self._epoch_size = 0 if dataset is None else int(dataset.shape[0])
+        self._consumed = 0  # GLOBAL slots drawn (all hosts', not just ours)
+        # epoch -> permutation; shareable (HostShardedBatches hands one
+        # dict to all its host streams so the O(N) shuffle happens once per
+        # epoch, not once per host); bounded to the two epochs a batch can
+        # straddle
+        self._perm_cache: dict = perm_cache if perm_cache is not None else {}
+        self.repartitioned = False
+
+    # -- deterministic addressing -----------------------------------------
+    def sample_index(self, slot: int):
+        """Dataset row for a global slot (dataset mode), or the slot itself
+        (synthetic mode) — the identity the exactly-once audits assert on."""
+        if self._dataset is None:
+            return int(slot)
+        epoch, offset = divmod(int(slot), self._epoch_size)
+        perm = self._perm_cache.get(epoch)
+        if perm is None:
+            rng = np.random.default_rng(
+                np.random.SeedSequence([self._seed, epoch]))
+            perm = self._perm_cache[epoch] = rng.permutation(self._epoch_size)
+            for stale in [e for e in self._perm_cache
+                          if e < epoch - 1 or e > epoch + 1]:
+                del self._perm_cache[stale]
+        return int(perm[offset])
+
+    def _sample(self, slot: int) -> np.ndarray:
+        if self._dataset is not None:
+            return self._dataset[self.sample_index(slot)]
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self._seed, int(slot)]))
+        return rng.standard_normal(
+            (self._channels, self._image_size, self._image_size),
+            dtype=np.float32,
+        )
+
+    @property
+    def consumed(self) -> int:
+        return self._consumed
+
+    @property
+    def epochs_started(self) -> int:
+        """Epochs the stream has touched (0 before the first draw);
+        dataset mode only — synthetic streams have no epochs."""
+        if self._epoch_size == 0:
+            return 0
+        return -(-self._consumed // self._epoch_size)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> np.ndarray:
+        lo, hi = host_block(self._global_batch, self._host_index,
+                            self._host_count)
+        base = self._consumed
+        batch = np.stack([self._sample(base + j) for j in range(lo, hi)])
+        self._consumed += self._global_batch
+        return batch
+
+    # -- resume cursor (checkpointed via the trainer's data tree) ---------
+    def state_dict(self) -> dict:
+        """Flat int dict (the checkpoint data-tree convention).  Keys are
+        FIXED across host counts so the restore template always matches;
+        ``host_count`` is recorded for forensics and ignored on load."""
+        return {
+            "consumed": self._consumed,
+            "global_batch": self._global_batch,
+            "epoch_size": self._epoch_size,
+            "seed": self._seed,
+            "host_count": self._host_count,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        for key in ("seed", "global_batch", "epoch_size"):
+            if key in state and int(state[key]) != getattr(self, f"_{key}"):
+                raise ValueError(
+                    f"checkpointed data cursor was written by a different "
+                    f"stream: {key} {int(state[key])} != "
+                    f"{getattr(self, f'_{key}')} — exactly-once resume is "
+                    f"only defined within one (seed, dataset, batch) "
+                    f"identity"
+                )
+        if ("host_count" in state
+                and int(state["host_count"]) != self._host_count):
+            # the re-partition case: the cursor is global, so adopting it
+            # under a new host count IS the re-partition
+            self.repartitioned = True
+        self._consumed = int(state["consumed"])
+
+
+class HostShardedBatches:
+    """Single-process SIMULATION of the per-host elastic data plane: one
+    :class:`ElasticBatches` per host, drawn in host order and concatenated
+    into the global batch the real fleet's mesh would assemble.  Because
+    each host's share is a contiguous block, the concatenation is
+    bit-identical to a single global stream at ANY host count — the chaos
+    harness and the elastic acceptance tests drive training through this.
+
+    ``state_dict`` is the host-count-free global cursor, so a checkpoint
+    cut at H hosts restores into an assembler built with H' hosts (the
+    shrink/grow re-partition)."""
+
+    def __init__(
+        self,
+        batch_size: int,
+        image_size: int = 8,
+        channels: int = 3,
+        seed: int = 0,
+        *,
+        host_count: int = 1,
+        dataset: Optional[np.ndarray] = None,
+    ):
+        perm_cache: dict = {}  # one per-epoch shuffle shared by all hosts
+        self._streams = [
+            ElasticBatches(
+                batch_size, image_size, channels, seed,
+                host_index=i, host_count=host_count, dataset=dataset,
+                perm_cache=perm_cache,
+            )
+            for i in range(host_count)
+        ]
+
+    @property
+    def host_count(self) -> int:
+        return len(self._streams)
+
+    @property
+    def consumed(self) -> int:
+        return self._streams[0].consumed
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> np.ndarray:
+        return np.concatenate([next(s) for s in self._streams], axis=0)
+
+    def state_dict(self) -> dict:
+        return self._streams[0].state_dict()
+
+    def load_state_dict(self, state: dict) -> None:
+        for s in self._streams:
+            s.load_state_dict(state)
+
+    def close(self) -> None:
+        pass  # host-side numpy only; nothing to release
 
 
 class _StatefulAugmented:
@@ -356,7 +695,28 @@ def make_batches(
     data_dir: Optional[str] = None,
     prefetch: int = 2,
     augment: str = "none",
+    host_index: Optional[int] = None,
+    host_count: int = 1,
 ) -> Iterator[np.ndarray]:
+    if kind == "elastic":
+        # exactly-once resumable stream (host_index=None: the whole-fleet
+        # assembler the single-process elastic simulation trains on; an
+        # int: that one host's shard view).  batch_size is the GLOBAL
+        # batch.  No fault_injected wrap (it would break the state_dict
+        # forwarding contract — elastic faults fire at the supervisor's
+        # tick seam instead); prefetch rides the StatefulPrefetcher, whose
+        # consumer-exact cursor keeps checkpoints honest about in-flight
+        # read-ahead.
+        if host_index is None:
+            it = HostShardedBatches(batch_size, image_size, channels, seed,
+                                    host_count=host_count)
+        else:
+            it = ElasticBatches(batch_size, image_size, channels, seed,
+                                host_index=host_index,
+                                host_count=host_count)
+        if augment != "none":
+            it = _StatefulAugmented(it, augment, seed)
+        return StatefulPrefetcher(it, prefetch) if prefetch > 0 else it
     if kind == "synthetic":
         it = synthetic_batches(batch_size, image_size, channels, seed)
     elif kind == "folder":
@@ -372,10 +732,12 @@ def make_batches(
             data_dir, batch_size, image_size, channels=channels, seed=seed,
             prefetch=max(prefetch, 1),
         )
-        # internal per-file prefetch + a resumable cursor: no Prefetcher wrap
-        # (its read-ahead would desynchronize state_dict from the consumer);
-        # no fault_injected wrap either — it would break the state_dict
-        # forwarding contract (arm faults on the stateless sources instead)
+        # internal per-file prefetch + a resumable cursor: no extra wrap
+        # needed (its own read-ahead already reports a consumer-exact
+        # cursor; an additional StatefulPrefetcher layer would only stack
+        # queues); no fault_injected wrap either — it would break the
+        # state_dict forwarding contract (arm faults on the stateless
+        # sources instead)
         if augment == "none":
             return stream
         return _StatefulAugmented(stream, augment, seed)

@@ -1,0 +1,22 @@
+"""Must-flag: a resilience heartbeat/election module reading the real
+clock (and really sleeping) — staleness judgments a fake-clock chaos
+replay can never see, and a sleep that stalls the simulation forever."""
+
+import time
+
+
+class HeartbeatTable:
+    def __init__(self, timeout_s):
+        self.timeout_s = timeout_s
+        self._last = {}
+
+    def beat(self, host):
+        self._last[host] = time.monotonic()        # BAD: raw clock read
+
+    def stale(self, host):
+        return time.monotonic() - self._last[host] > self.timeout_s  # BAD
+
+
+def elect_after_grace(hosts, grace_s):
+    time.sleep(grace_s)                            # BAD: real sleep
+    return min(hosts)

@@ -1,0 +1,23 @@
+"""Must-pass: every timestamp and every wait flows through the injected
+clock/sleep (the resilience/elastic.py SimClock pattern); the raw time
+functions appear only as uncalled defaults."""
+
+import time
+
+
+class HeartbeatTable:
+    def __init__(self, timeout_s, clock=time.monotonic):
+        self.timeout_s = timeout_s
+        self._clock = clock
+        self._last = {}
+
+    def beat(self, host):
+        self._last[host] = self._clock()
+
+    def stale(self, host):
+        return self._clock() - self._last[host] > self.timeout_s
+
+
+def elect_after_grace(hosts, grace_s, sleep=time.sleep):
+    sleep(grace_s)
+    return min(hosts)

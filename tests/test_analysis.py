@@ -57,6 +57,8 @@ RULE_FIXTURES = [
     ("conc-lock-order", "serving/lockorder.py", "serving/lockorder.py"),
     ("conc-check-then-act", "toctou.py", "toctou.py"),
     ("conc-raw-clock", "clocks.py", "clocks.py"),
+    ("conc-heartbeat-raw-clock", "resilience/heartbeat.py",
+     "resilience/heartbeat.py"),
     ("conc-thread-daemon", "threads.py", "threads.py"),
     ("conc-broad-except", "excepts.py", "excepts.py"),
     ("obs-debug-in-cache", "serving/compile_cache.py",

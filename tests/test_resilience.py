@@ -728,6 +728,7 @@ class TestChaosCli:
         assert set(mod.SCENARIOS) == {
             "torn_ckpt_write", "corrupt_restore", "nan_batch",
             "reload_io_error", "train_crash", "replica_kill",
+            "host_preempt", "coordinator_loss", "shrink_restart",
         }
 
     def test_smoke_suite_recovers(self, tmp_path):
@@ -738,12 +739,12 @@ class TestChaosCli:
         proc = subprocess.run(
             [sys.executable, os.path.join(ROOT, "tools", "chaos.py"),
              "--smoke", "--json", out_json],
-            capture_output=True, text=True, timeout=300, env=env, cwd=ROOT,
+            capture_output=True, text=True, timeout=420, env=env, cwd=ROOT,
         )
         assert proc.returncode == 0, proc.stdout + proc.stderr
         with open(out_json) as f:
             summary = json.load(f)
-        assert summary["recovered"] == summary["total"] == 6
+        assert summary["recovered"] == summary["total"] == 9
         for rec in summary["results"]:
             assert rec["outcome"] == "recovered", rec
             assert rec["mttr_s"] >= 0.0
@@ -762,4 +763,4 @@ class TestChaosSoak:
         assert proc.returncode == 0, proc.stdout + proc.stderr
         with open(out_json) as f:
             summary = json.load(f)
-        assert summary["recovered"] == summary["total"] == 6
+        assert summary["recovered"] == summary["total"] == 9

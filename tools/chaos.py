@@ -9,7 +9,7 @@ fire, the serving watcher outlives its faults — reporting per-scenario
 outcome and MTTR (wall seconds from the fault's first observable impact to
 restored service) as JSON.
 
-    python tools/chaos.py --smoke          # fast variants, CI tier-1 (<90s)
+    python tools/chaos.py --smoke          # fast variants, CI tier-1 (<120s)
     python tools/chaos.py                  # soak variants (more steps/faults)
     python tools/chaos.py --scenario nan_batch --json out.json
 
@@ -34,6 +34,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def _force_cpu():
     # env alone is not enough under site plugins (see tests/conftest.py)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the elastic scenarios shrink/grow real (faked) device meshes; a bare
+    # single-device CPU cannot express a 3-host topology.  Respect an
+    # existing forced count (the pytest harness fakes 8) — standalone runs
+    # get 4, enough for every scenario's host_count x devices_per_host.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4"
+        ).strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -60,16 +69,21 @@ def _configs(steps, *, halt_on_nan=False, forensics_dir=None,
 _DEVNULL = None
 
 
+def _devnull():
+    """The one lazily-opened /dev/null sink every quiet logger shares."""
+    global _DEVNULL
+    if _DEVNULL is None:
+        _DEVNULL = open(os.devnull, "w")
+    return _DEVNULL
+
+
 def _quiet_trainer(glom, train):
     """A Trainer whose JSONL log goes to /dev/null: the chaos harness's
     stdout is the scenario JSON, not training telemetry."""
     from glom_tpu.training.metrics import MetricLogger
     from glom_tpu.training.trainer import Trainer
 
-    global _DEVNULL
-    if _DEVNULL is None:
-        _DEVNULL = open(os.devnull, "w")
-    return Trainer(glom, train, logger=MetricLogger(stream=_DEVNULL))
+    return Trainer(glom, train, logger=MetricLogger(stream=_devnull()))
 
 
 def _fit_once(glom, train, steps=None):
@@ -426,6 +440,150 @@ def scenario_replica_kill(soak):
                 "error_rate": round(errors / max(total, 1), 4)}
 
 
+# -- elastic multi-host scenarios (glom_tpu/resilience/elastic.py) ---------
+
+def _elastic_run(*, hosts, steps, batch, spec, ckpt_dir, slots=None, seed=0):
+    """Drive a real Trainer fleet-style under the ElasticSupervisor: each
+    attempt rebuilds trainer + mesh from the plan, trains on the per-host
+    sharded exactly-once stream (concatenated global batch), ticks the
+    elastic context once per step, and auto-resumes from the newest
+    verified checkpoint.  Returns the supervisor (plans/domains/MTTR all
+    inspectable).  The bitwise pinned-mesh variant lives with its
+    assertions in tests/test_elastic.py."""
+    import jax
+
+    from glom_tpu.parallel.mesh import make_elastic_mesh
+    from glom_tpu.resilience import faultinject
+    from glom_tpu.resilience.elastic import ElasticSupervisor, SimClock
+    from glom_tpu.resilience.supervisor import RestartPolicy
+    from glom_tpu.training.data import HostShardedBatches, StatefulPrefetcher
+    from glom_tpu.training.metrics import MetricLogger
+
+    sim = SimClock()
+
+    def attempt(plan, ctx):
+        import dataclasses
+
+        from glom_tpu.training.trainer import Trainer
+
+        glom, train = _configs(steps, checkpoint_dir=ckpt_dir)
+        train = dataclasses.replace(train, batch_size=batch)
+        mesh = make_elastic_mesh(plan.host_count, plan.devices_per_host)
+        trainer = Trainer(glom, train, mesh=mesh,
+                          logger=MetricLogger(stream=_devnull()))
+        inner = HostShardedBatches(batch, glom.image_size, glom.channels,
+                                   seed=seed, host_count=plan.host_count)
+        batches = ctx.wrap(StatefulPrefetcher(inner, 2), record=slots)
+        try:
+            trainer.fit(batches)
+        finally:
+            batches.close()
+        return int(jax.device_get(trainer.state.step))
+
+    sup = ElasticSupervisor(
+        attempt, hosts=hosts,
+        policy=RestartPolicy(max_failures=3, window_s=1000.0,
+                             backoff_base_s=0.01, backoff_max_s=0.05),
+        heartbeat_timeout_s=2.5, rejoin_grace_s=1.0,
+        step_dt=1.0, checkpoint_dir=ckpt_dir,
+        clock=sim, sleep=sim.sleep, advance=sim.advance, seed=seed,
+    )
+    if spec:
+        with faultinject.injected(spec, seed=seed):
+            result = sup.run()
+    else:
+        result = sup.run()
+    assert result == steps, f"elastic run stopped at {result}"
+    return sup
+
+
+def scenario_host_preempt(soak):
+    """One fault domain is preempted mid-run: the job restarts, the victim
+    rejoins after ITS backoff, the surviving domains' accounting and step
+    cadence are untouched, and the run completes with every sample
+    delivered exactly once."""
+    steps, kill_at = (6, 4) if not soak else (14, 8)
+    hosts, batch = 3, 6
+    with tempfile.TemporaryDirectory() as root:
+        t0 = time.monotonic()
+        slots = []
+        sup = _elastic_run(hosts=hosts, steps=steps, batch=batch,
+                           spec=f"host_preempt:kill@{kill_at}",
+                           ckpt_dir=os.path.join(root, "ckpt"), slots=slots)
+        wall = time.monotonic() - t0
+        assert sup.restarts == 1, sup.restarts
+        victim = max(h for h in sup.domains if h != sup.plan.coordinator)
+        assert sup.domains[victim].failures_total == 1
+        survivors = [h for h in sup.domains if h != victim]
+        for h in survivors:
+            d = sup.domains[h]
+            # zero impact on surviving domains: no failures charged, no
+            # backoff applied, and a step on every non-failing tick
+            assert d.failures_total == 0 and d.down_until == 0.0, (h, vars(d))
+            assert d.steps == sup.ticks_total - sup.restarts, (h, d.steps)
+        assert sup.plan.host_count == hosts, "victim never rejoined"
+        assert sorted(slots) == list(range(steps * batch)), (
+            "exactly-once violated across the preemption")
+        assert sup.mttr_s and sup.mttr_s[0] >= 0.0
+        return {"mttr_s": sup.mttr_s[0], "recovery_wall_s": round(wall, 3),
+                "restarts": sup.restarts, "victim": victim,
+                "survivor_steps": sup.domains[survivors[0]].steps}
+
+
+def scenario_coordinator_loss(soak):
+    """The coordinator goes silent: heartbeat staleness detects it, a
+    successor is deterministically elected (lowest surviving id), and the
+    run completes under the new coordinator."""
+    steps, lose_at = (6, 3) if not soak else (14, 7)
+    hosts, batch = 3, 6
+    with tempfile.TemporaryDirectory() as root:
+        t0 = time.monotonic()
+        slots = []
+        sup = _elastic_run(hosts=hosts, steps=steps, batch=batch,
+                           spec=f"coordinator_loss:lost@{lose_at}",
+                           ckpt_dir=os.path.join(root, "ckpt"), slots=slots)
+        wall = time.monotonic() - t0
+        assert sup.elections == 1, sup.elections
+        assert sup.plan.coordinator == 1, sup.plan  # successor = lowest live
+        assert sup.domains[0].failures_total == 1   # the lost coordinator
+        assert sorted(slots) == list(range(steps * batch)), (
+            "exactly-once violated across the election")
+        return {"mttr_s": sup.mttr_s[0] if sup.mttr_s else 0.0,
+                "recovery_wall_s": round(wall, 3), "elections": sup.elections,
+                "coordinator": sup.plan.coordinator}
+
+
+def scenario_shrink_restart(soak):
+    """A preempted host never comes back (shrink_restart:shrink): the
+    restart re-plans the mesh against the surviving host count, reshards
+    params from the last VERIFIED checkpoint, re-partitions the data
+    cursor, and completes — with every sample delivered exactly once."""
+    steps, kill_at = (6, 3) if not soak else (14, 7)
+    hosts, batch = 2, 8
+    with tempfile.TemporaryDirectory() as root:
+        t0 = time.monotonic()
+        slots = []
+        sup = _elastic_run(
+            hosts=hosts, steps=steps, batch=batch,
+            spec=f"host_preempt:kill@{kill_at}; shrink_restart:shrink",
+            ckpt_dir=os.path.join(root, "ckpt"), slots=slots)
+        wall = time.monotonic() - t0
+        assert sup.replans == 1, sup.replans
+        assert sup.plan.host_count == hosts - 1
+        assert sup.plan.mesh_shape == (hosts - 1, 1, 1), sup.plan
+        assert sup.domains[hosts - 1].dead, "shrunk host should stay gone"
+        # the restart anchored on the newest checkpoint that verifies:
+        # tick kill_at raised BEFORE that step's batch was drawn, so the
+        # last completed (and checkpointed) step is kill_at - 1
+        assert sup.plan.resume_step == kill_at - 1, sup.plan
+        assert sorted(slots) == list(range(steps * batch)), (
+            "exactly-once violated across the shrink re-plan")
+        return {"mttr_s": sup.mttr_s[0] if sup.mttr_s else 0.0,
+                "recovery_wall_s": round(wall, 3), "replans": sup.replans,
+                "mesh_shape": list(sup.plan.mesh_shape),
+                "resumed_from": sup.plan.resume_step}
+
+
 SCENARIOS = {
     "torn_ckpt_write": scenario_torn_ckpt_write,
     "corrupt_restore": scenario_corrupt_restore,
@@ -433,6 +591,9 @@ SCENARIOS = {
     "reload_io_error": scenario_reload_io_error,
     "train_crash": scenario_train_crash,
     "replica_kill": scenario_replica_kill,
+    "host_preempt": scenario_host_preempt,
+    "coordinator_loss": scenario_coordinator_loss,
+    "shrink_restart": scenario_shrink_restart,
 }
 
 
@@ -466,7 +627,7 @@ def run(names, *, soak, quiet=False):
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description="GLOM resilience chaos suite")
     p.add_argument("--smoke", action="store_true",
-                   help="fast variants of every scenario (CI tier-1, <60s)")
+                   help="fast variants of every scenario (CI tier-1, <120s)")
     p.add_argument("--scenario", action="append", choices=sorted(SCENARIOS),
                    help="run only this scenario (repeatable)")
     p.add_argument("--json", dest="json_out", default=None,
