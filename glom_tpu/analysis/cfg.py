@@ -1,0 +1,491 @@
+"""glomlint dataflow engine — intraprocedural control-flow graphs + solver.
+
+The v1 rule packs are flow-insensitive: they walk the AST and match
+shapes.  The review findings they kept missing are *path* bugs — a gate
+closed but never reopened on the exception path, a staged param tree
+stranded after a failed prepare, taint flowing around a loop back edge.
+This module supplies the machinery those rules need:
+
+  * :func:`build_cfg` — a statement-granularity CFG over ``ast`` for one
+    function body (or a module body): branches, loops (back edges,
+    ``else`` clauses, ``while True`` without a false edge), ``with``,
+    ``try/except/else/finally``, and the nonlocal exits — ``return``,
+    ``raise``, ``break``, ``continue``.  Two distinct exit nodes:
+    ``cfg.exit`` (return / fall-off-the-end) and ``cfg.raise_exit``
+    (uncaught exception), so a rule can say "the exception path misses
+    the release" and mean exactly that.
+  * ``finally`` landing pads — the finally body is laid down once per
+    continuation kind (normal, raise, return, break, continue) so its
+    semantics are exact: a ``return`` inside ``finally`` overrides the
+    pending continuation, a ``raise`` inside ``finally`` abandons it —
+    the "finally with return" edge case is graph structure, not a
+    special case in every rule.
+  * exception edges — any statement that *may raise* (contains a call,
+    subscript, ``raise``, ``assert``, or ``await``; compound statements
+    contribute only their header expressions) gets an edge to the
+    innermost handler dispatch, or to ``raise_exit`` through every
+    enclosing ``finally``.
+  * :func:`solve_forward` — a worklist gen/kill solver over frozensets:
+    ``may=True`` unions over paths (leak/taint analyses), ``may=False``
+    intersects (must-precede / already-released analyses).
+
+Stdlib-only (``ast``), same as the rest of the engine: no jax import, no
+accelerator, identical behavior in CI / tier-1 / a laptop.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+__all__ = ["CFG", "CFGNode", "build_cfg", "solve_forward", "may_raise",
+           "header_exprs"]
+
+
+class CFGNode:
+    """One CFG node.  ``stmt`` is the underlying AST statement (None for
+    synthetic nodes); ``kind`` is 'stmt', 'handler', or a synthetic kind
+    ('entry', 'exit', 'raise', 'dispatch', 'finally')."""
+
+    __slots__ = ("stmt", "kind", "succs", "preds", "index")
+
+    def __init__(self, stmt: Optional[ast.AST], kind: str, index: int):
+        self.stmt = stmt
+        self.kind = kind
+        self.index = index
+        self.succs: List[Tuple["CFGNode", str]] = []
+        self.preds: List[Tuple["CFGNode", str]] = []
+
+    @property
+    def lineno(self) -> Optional[int]:
+        return getattr(self.stmt, "lineno", None)
+
+    def __repr__(self) -> str:  # debugging aid, not output format
+        what = self.kind if self.stmt is None else ast.dump(self.stmt)[:40]
+        return f"<CFGNode {self.index} {what} @{self.lineno}>"
+
+
+class CFG:
+    """CFG for one function (or module) body."""
+
+    def __init__(self) -> None:
+        self.nodes: List[CFGNode] = []
+        self.entry = self._new(None, "entry")
+        self.exit = self._new(None, "exit")
+        self.raise_exit = self._new(None, "raise")
+
+    def _new(self, stmt: Optional[ast.AST], kind: str) -> CFGNode:
+        node = CFGNode(stmt, kind, len(self.nodes))
+        self.nodes.append(node)
+        return node
+
+    def _edge(self, src: CFGNode, dst: CFGNode, kind: str = "next") -> None:
+        src.succs.append((dst, kind))
+        dst.preds.append((src, kind))
+
+    def stmt_nodes(self) -> List[CFGNode]:
+        return [n for n in self.nodes if n.stmt is not None]
+
+
+# -- may-raise approximation ----------------------------------------------
+
+_RAISING = (ast.Call, ast.Subscript, ast.Raise, ast.Assert, ast.Await)
+
+
+def _walk_no_scopes(node: ast.AST):
+    """ast.walk that does not descend into nested function/class/lambda
+    bodies — a contained lambda's calls don't execute at this statement."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def header_exprs(stmt: ast.stmt) -> List[ast.AST]:
+    """The expressions a compound statement evaluates AT its own node
+    (its body statements are separate nodes): the if/while test, the for
+    iterable, the with context expressions.  Simple statements evaluate
+    themselves."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, (ast.Try, ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return []
+    return [stmt]
+
+
+def may_raise(stmt: ast.stmt) -> bool:
+    """Whether this node's own evaluation can raise: contains a call,
+    subscript, raise, assert, or await in its header expressions.  Plain
+    attribute loads/stores and name binds are treated as non-raising —
+    treating *everything* as raising would make every release demand a
+    ``finally`` and drown the path rules in noise."""
+    for expr in header_exprs(stmt):
+        for node in _walk_no_scopes(expr):
+            if isinstance(node, _RAISING):
+                return True
+    return False
+
+
+def _is_const_true(test: ast.AST) -> bool:
+    return isinstance(test, ast.Constant) and bool(test.value) is True
+
+
+def _catches_everything(handlers: Sequence[ast.ExceptHandler]) -> bool:
+    """True when some handler is ``except:`` / ``except BaseException`` /
+    ``except Exception`` — for lint purposes the dispatch then has no
+    fall-through to the outer raise path (KeyboardInterrupt pedantry
+    would only add noise paths every rule has to ignore)."""
+    for h in handlers:
+        if h.type is None:
+            return True
+        names = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+        for n in names:
+            base = n
+            while isinstance(base, ast.Attribute):
+                base = base.value  # builtins.Exception
+            tail = n.attr if isinstance(n, ast.Attribute) else getattr(
+                n, "id", None)
+            if tail in ("Exception", "BaseException"):
+                return True
+    return False
+
+
+# -- builder ---------------------------------------------------------------
+
+_Preds = List[Tuple[CFGNode, str]]
+
+
+@dataclasses.dataclass
+class _Ctx:
+    """Where nonlocal control transfers go from the current position.
+    Each field wires an edge from the source node to the right target —
+    through every enclosing ``finally`` landing pad (the wrapping happens
+    in :meth:`_Builder._build_try`)."""
+
+    raise_to: Callable[[CFGNode], None]
+    return_to: Callable[[CFGNode], None]
+    break_to: Optional[Callable[[CFGNode], None]] = None
+    continue_to: Optional[Callable[[CFGNode], None]] = None
+
+
+class _Builder:
+    def __init__(self, cfg: CFG):
+        self.cfg = cfg
+
+    # each _build_* returns the dangling (node, edge-kind) pairs that fall
+    # through to whatever statement comes next
+
+    def build_block(self, body: Sequence[ast.stmt], preds: _Preds,
+                    ctx: _Ctx) -> _Preds:
+        for stmt in body:
+            preds = self._build_stmt(stmt, preds, ctx)
+        return preds
+
+    def _connect(self, preds: _Preds, node: CFGNode) -> None:
+        for src, kind in preds:
+            self.cfg._edge(src, node, kind)
+
+    def _build_stmt(self, stmt: ast.stmt, preds: _Preds,
+                    ctx: _Ctx) -> _Preds:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            # a nested def is just a binding here; its body is its own CFG
+            node = self.cfg._new(stmt, "stmt")
+            self._connect(preds, node)
+            return [(node, "next")]
+        if isinstance(stmt, ast.If):
+            return self._build_if(stmt, preds, ctx)
+        if isinstance(stmt, (ast.While,)):
+            return self._build_while(stmt, preds, ctx)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return self._build_for(stmt, preds, ctx)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._build_with(stmt, preds, ctx)
+        if isinstance(stmt, ast.Try):
+            return self._build_try(stmt, preds, ctx)
+        # simple statement
+        node = self.cfg._new(stmt, "stmt")
+        self._connect(preds, node)
+        if isinstance(stmt, ast.Return):
+            if may_raise(stmt):  # evaluating the return value can raise
+                ctx.raise_to(node)
+            ctx.return_to(node)
+            return []
+        if isinstance(stmt, ast.Raise):
+            ctx.raise_to(node)
+            return []
+        if isinstance(stmt, ast.Break):
+            if ctx.break_to is not None:
+                ctx.break_to(node)
+            return []
+        if isinstance(stmt, ast.Continue):
+            if ctx.continue_to is not None:
+                ctx.continue_to(node)
+            return []
+        if may_raise(stmt):
+            ctx.raise_to(node)
+        return [(node, "next")]
+
+    def _build_if(self, stmt: ast.If, preds: _Preds, ctx: _Ctx) -> _Preds:
+        node = self.cfg._new(stmt, "stmt")
+        self._connect(preds, node)
+        if may_raise(stmt):
+            ctx.raise_to(node)
+        out = self.build_block(stmt.body, [(node, "true")], ctx)
+        if stmt.orelse:
+            out += self.build_block(stmt.orelse, [(node, "false")], ctx)
+        else:
+            out += [(node, "false")]
+        return out
+
+    def _loop_ctx(self, ctx: _Ctx, head: CFGNode,
+                  breaks: _Preds) -> _Ctx:
+        return dataclasses.replace(
+            ctx,
+            break_to=lambda n: breaks.append((n, "break")),
+            continue_to=lambda n: self.cfg._edge(n, head, "continue"),
+        )
+
+    def _build_while(self, stmt: ast.While, preds: _Preds,
+                     ctx: _Ctx) -> _Preds:
+        head = self.cfg._new(stmt, "stmt")
+        self._connect(preds, head)
+        if may_raise(stmt):
+            ctx.raise_to(head)
+        breaks: _Preds = []
+        body_out = self.build_block(stmt.body, [(head, "true")],
+                                    self._loop_ctx(ctx, head, breaks))
+        for n, kind in body_out:
+            self.cfg._edge(n, head, "loop")
+        if _is_const_true(stmt.test):
+            # `while True`: no false edge — code after the loop is only
+            # reachable via break, and the else clause never runs
+            return breaks
+        out: _Preds = []
+        if stmt.orelse:
+            out += self.build_block(stmt.orelse, [(head, "false")], ctx)
+        else:
+            out += [(head, "false")]
+        return out + breaks
+
+    def _build_for(self, stmt, preds: _Preds, ctx: _Ctx) -> _Preds:
+        head = self.cfg._new(stmt, "stmt")
+        self._connect(preds, head)
+        if may_raise(stmt):
+            ctx.raise_to(head)
+        breaks: _Preds = []
+        body_out = self.build_block(stmt.body, [(head, "iter")],
+                                    self._loop_ctx(ctx, head, breaks))
+        for n, kind in body_out:
+            self.cfg._edge(n, head, "loop")
+        out: _Preds = []
+        if stmt.orelse:
+            out += self.build_block(stmt.orelse, [(head, "exhausted")], ctx)
+        else:
+            out += [(head, "exhausted")]
+        return out + breaks
+
+    def _build_with(self, stmt, preds: _Preds, ctx: _Ctx) -> _Preds:
+        node = self.cfg._new(stmt, "stmt")
+        self._connect(preds, node)
+        if may_raise(stmt):  # the context-manager construction/__enter__
+            ctx.raise_to(node)
+        # body exceptions propagate (conservative: __exit__ not assumed to
+        # suppress); break/continue/return inside the body use ctx as-is
+        return self.build_block(stmt.body, [(node, "enter")], ctx)
+
+    # -- try/except/else/finally ------------------------------------------
+
+    def _pad(self, finalbody: Sequence[ast.stmt], outer_ctx: _Ctx,
+             kind: str, cont: Callable[[CFGNode], None]
+             ) -> Callable[[CFGNode], None]:
+        """A lazy ``finally`` landing pad for one continuation kind: the
+        first transfer of that kind builds a dedicated copy of the
+        finally body; its normal exits resume the original continuation.
+        A raise/return/break/continue *inside* the finally body routes
+        through ``outer_ctx`` instead — overriding the pending
+        continuation, exactly Python's semantics."""
+        cell: Dict[str, CFGNode] = {}
+
+        def route(src: CFGNode) -> None:
+            if "pad" not in cell:
+                pad = self.cfg._new(None, "finally")
+                cell["pad"] = pad
+                outs = self.build_block(finalbody, [(pad, "fin")],
+                                        outer_ctx)
+                for n, _k in outs:
+                    cont(n)
+            self.cfg._edge(src, cell["pad"], kind)
+        return route
+
+    def _build_try(self, stmt: ast.Try, preds: _Preds,
+                   ctx: _Ctx) -> _Preds:
+        if stmt.finalbody:
+            inner = _Ctx(
+                raise_to=self._pad(stmt.finalbody, ctx, "exc",
+                                   ctx.raise_to),
+                return_to=self._pad(stmt.finalbody, ctx, "return",
+                                    ctx.return_to),
+                break_to=None if ctx.break_to is None else self._pad(
+                    stmt.finalbody, ctx, "break", ctx.break_to),
+                continue_to=None if ctx.continue_to is None else self._pad(
+                    stmt.finalbody, ctx, "continue", ctx.continue_to),
+            )
+        else:
+            inner = ctx
+        out = self._build_try_core(stmt, preds, inner)
+        if stmt.finalbody:
+            # normal completion runs the finally too
+            pad = self.cfg._new(None, "finally")
+            self._connect(out, pad)
+            out = self.build_block(stmt.finalbody, [(pad, "fin")], ctx)
+        return out
+
+    def _build_try_core(self, stmt: ast.Try, preds: _Preds,
+                        ctx: _Ctx) -> _Preds:
+        dispatch = self.cfg._new(None, "dispatch")
+        body_ctx = dataclasses.replace(
+            ctx, raise_to=lambda n: self.cfg._edge(n, dispatch, "exc"))
+        body_out = self.build_block(stmt.body, preds, body_ctx)
+        if stmt.orelse:
+            # else runs after the try completed; its exceptions are NOT
+            # caught by this try's handlers
+            body_out = self.build_block(stmt.orelse, body_out, ctx)
+        out: _Preds = list(body_out)
+        for h in stmt.handlers:
+            h_node = self.cfg._new(h, "handler")
+            self.cfg._edge(dispatch, h_node, "match")
+            out += self.build_block(h.body, [(h_node, "caught")], ctx)
+        if not _catches_everything(stmt.handlers):
+            # an exception no handler matches propagates out (through any
+            # enclosing finally — ctx.raise_to is already wrapped)
+            ctx.raise_to(dispatch)
+        return out
+
+
+def build_cfg(fn) -> CFG:
+    """CFG for ``fn`` — a FunctionDef/AsyncFunctionDef, or a plain list of
+    statements (a module body)."""
+    body = fn if isinstance(fn, list) else fn.body
+    cfg = CFG()
+    ctx = _Ctx(
+        raise_to=lambda n: cfg._edge(n, cfg.raise_exit, "exc"),
+        return_to=lambda n: cfg._edge(n, cfg.exit, "return"),
+    )
+    out = _Builder(cfg).build_block(body, [(cfg.entry, "next")], ctx)
+    for n, kind in out:
+        cfg._edge(n, cfg.exit, kind)
+    return cfg
+
+
+# -- forward dataflow solver ----------------------------------------------
+
+State = FrozenSet
+Transfer = Callable[[CFGNode, State], State]
+
+
+def solve_forward(cfg: CFG, transfer: Transfer, *, may: bool = True,
+                  entry_state: State = frozenset(),
+                  exc_transfer: Optional[Transfer] = None
+                  ) -> Dict[CFGNode, Tuple[State, State, State]]:
+    """Worklist fixpoint of a forward gen/kill analysis.
+
+    ``transfer(node, in_state) -> out_state`` must be monotone in the
+    facts it adds/removes.  ``may=True`` joins by union (a fact holds if
+    it holds on SOME path in), ``may=False`` by intersection (ALL paths).
+
+    ``exc_transfer``, when given, produces the state carried by the
+    node's OWN exception edges instead of ``transfer``'s — the standard
+    use: an acquire-like event must not be visible on its own
+    statement's exception edge (if the acquiring call raised, the
+    acquisition never happened), while a release-like event should be
+    (assuming the release failed too would flag every ``finally``).
+
+    Returns ``{node: (in_state, out_state, exc_out_state)}`` for
+    reachable nodes only — unreachable code contributes no facts.
+    """
+    if exc_transfer is None:
+        exc_transfer = transfer
+    in_s: Dict[CFGNode, State] = {cfg.entry: entry_state}
+    out_s: Dict[CFGNode, State] = {}
+    exc_s: Dict[CFGNode, State] = {}
+    work = [cfg.entry]
+    on_work = {cfg.entry}
+
+    def edge_out(pred: CFGNode, kind: str) -> State:
+        return exc_s[pred] if kind == "exc" else out_s[pred]
+
+    while work:
+        node = work.pop()
+        on_work.discard(node)
+        out = transfer(node, in_s[node])
+        exc_out = exc_transfer(node, in_s[node])
+        if node in out_s and out_s[node] == out and exc_s[node] == exc_out:
+            continue
+        out_s[node] = out
+        exc_s[node] = exc_out
+        for succ, _kind in node.succs:
+            pred_outs = [edge_out(p, k) for p, k in succ.preds
+                         if p in out_s]
+            if may:
+                new_in: State = frozenset().union(*pred_outs)
+            else:
+                new_in = pred_outs[0]
+                for s in pred_outs[1:]:
+                    new_in = new_in & s
+            if succ not in in_s or in_s[succ] != new_in:
+                in_s[succ] = new_in
+                if succ not in on_work:
+                    work.append(succ)
+                    on_work.add(succ)
+            elif succ not in out_s:
+                if succ not in on_work:
+                    work.append(succ)
+                    on_work.add(succ)
+    return {n: (in_s[n], out_s[n], exc_s[n]) for n in cfg.nodes
+            if n in out_s}
+
+
+def witness_path(cfg: CFG, results: Dict[CFGNode, Tuple[State, State,
+                                                        State]],
+                 fact, source: CFGNode, sink: CFGNode
+                 ) -> List[CFGNode]:
+    """A shortest path source -> sink along which ``fact`` survives on
+    every traversed edge (the leak witness a finding cites).  Empty when
+    no such path exists."""
+    from collections import deque
+
+    if source not in results:
+        return []
+    prev: Dict[CFGNode, CFGNode] = {}
+    q = deque([source])
+    seen = {source}
+    while q:
+        cur = q.popleft()
+        if cur is sink:
+            path = [cur]
+            while path[-1] is not source:
+                path.append(prev[path[-1]])
+            return list(reversed(path))
+        for succ, kind in cur.succs:
+            if succ in seen or cur not in results:
+                continue
+            carried = (results[cur][2] if kind == "exc"
+                       else results[cur][1])
+            if fact not in carried:
+                continue
+            seen.add(succ)
+            prev[succ] = cur
+            q.append(succ)
+    return []

@@ -1,0 +1,540 @@
+"""glomlint path-sensitive rule pack — the review-finding classes that
+per-file, flow-insensitive rules provably cannot catch.
+
+Four PRs of review findings were *path* bugs: the resource was released,
+just not on the path that mattered — a commit gate reopened on success
+but not when a replica's commit raised (PR 7), a staged param tree
+committed on the happy path and stranded after a failed prepare (PR 7),
+a session spill that forgot to wait the in-flight drain barrier on one
+shutdown route (PR 10).  These rules run the :mod:`cfg` dataflow engine
+over every function and check *paths*, exception edges included:
+
+  * ``res-leak-on-raise`` — a resource is acquired and released in the
+    same function, but SOME path to an exit (normal or exceptional)
+    misses the release and no ``finally`` guarantees it.  Recognized
+    resource shapes: ``X.acquire()``/``X.release()`` pairs, gate events
+    (``X.clear()``/``X.set()`` where X names a gate: *open/gate/admit/
+    ready/dispatch/accept*), in-flight counters (``X.inflight += 1`` /
+    ``-= 1`` style), and ``f = open(...)``/``f.close()``.  The
+    inconsistency filter keeps it honest: a function that NEVER releases
+    (a close-only helper — the reopen lives elsewhere by design) is not
+    flagged; releasing on some paths but not others is the bug.
+  * ``proto-paired-call`` — declarative protocol specs
+    (:data:`PROTOCOL_SPECS`): a *begin* call must reach one of its
+    *settle* calls on every path to an exit (``kind="settle"``), or a
+    guarded action must be preceded by its barrier on every incoming
+    path (``kind="precede"``).  Spec entries are ``"name"`` or
+    ``"name:literal"`` — the latter additionally requires a string
+    literal argument, so ``_admin(replica, "prepare")`` and
+    ``_admin(replica, "commit")`` are different protocol events of the
+    same callee.  Future subsystems register their pairing contracts by
+    adding a spec row, not a rule class.
+  * ``res-double-release`` — a release that is already-released on ALL
+    incoming paths (must-analysis, so an `if`-guarded re-close or a
+    release inside a loop body does not fire it).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from glom_tpu.analysis.cfg import (
+    CFG, CFGNode, build_cfg, header_exprs, solve_forward, witness_path,
+    _walk_no_scopes,
+)
+from glom_tpu.analysis.engine import (
+    Finding, ModuleContext, Rule, dotted_name, terminal_name,
+)
+
+_GATE_RE = re.compile(r"open|gate|admit|accept|ready|dispatch",
+                      re.IGNORECASE)
+_COUNTER_RE = re.compile(r"inflight|in_flight|pending|outstanding",
+                         re.IGNORECASE)
+
+
+@dataclasses.dataclass(frozen=True)
+class _Event:
+    action: str          # "acquire" | "release"
+    rid: str             # resource identity (dotted receiver / bound name)
+    kind: str            # "pair" | "gate" | "counter" | "file"
+    lineno: int
+
+
+_RELEASE_VERBS = {
+    "pair": "released (.release())",
+    "gate": "reopened (.set())",
+    "counter": "decremented",
+    "file": "closed (.close())",
+}
+_ACQUIRE_VERBS = {
+    "pair": "acquired",
+    "gate": "closed (.clear())",
+    "counter": "incremented",
+    "file": "opened",
+}
+
+
+def _receiver_id(node: ast.AST) -> Optional[str]:
+    """Stable resource identity for the receiver of a method call."""
+    return dotted_name(node)
+
+
+def _stmt_events(stmt: ast.stmt) -> List[_Event]:
+    """Resource events this CFG node performs (header expressions only —
+    body statements of compounds are their own nodes)."""
+    events: List[_Event] = []
+    # counter inc/dec: `X.inflight += 1` / `-= 1`
+    if isinstance(stmt, ast.AugAssign):
+        tgt = terminal_name(stmt.target)
+        if tgt and _COUNTER_RE.search(tgt) and isinstance(
+                stmt.op, (ast.Add, ast.Sub)):
+            rid = dotted_name(stmt.target) or tgt
+            action = "acquire" if isinstance(stmt.op, ast.Add) else "release"
+            events.append(_Event(action, rid, "counter", stmt.lineno))
+    # `f = open(...)` binds a closable resource
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+            isinstance(stmt.targets[0], ast.Name) and \
+            isinstance(stmt.value, ast.Call) and \
+            dotted_name(stmt.value.func) in ("open", "io.open"):
+        events.append(_Event("acquire", stmt.targets[0].id, "file",
+                             stmt.lineno))
+    for expr in header_exprs(stmt):
+        for node in _walk_no_scopes(expr):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            rid = _receiver_id(node.func.value)
+            if rid is None:
+                continue
+            recv_tail = rid.rsplit(".", 1)[-1]
+            if attr == "acquire" and not node.args and not node.keywords:
+                # acquire WITH arguments (blocking=False / timeout=) is
+                # conditional — whether the lock is held depends on the
+                # return value, which a gen/kill fact cannot track
+                events.append(_Event("acquire", rid, "pair", node.lineno))
+            elif attr == "release":
+                events.append(_Event("release", rid, "pair", node.lineno))
+            elif attr == "clear" and _GATE_RE.search(recv_tail):
+                events.append(_Event("acquire", rid, "gate", node.lineno))
+            elif attr == "set" and _GATE_RE.search(recv_tail):
+                events.append(_Event("release", rid, "gate", node.lineno))
+            elif attr == "close":
+                events.append(_Event("release", rid, "file", node.lineno))
+    return events
+
+
+def _cfg_events(cfg: CFG) -> Dict[int, List[_Event]]:
+    out: Dict[int, List[_Event]] = {}
+    for node in cfg.stmt_nodes():
+        if node.kind == "handler":
+            continue
+        ev = _stmt_events(node.stmt)
+        if ev:
+            out[node.index] = ev
+    return out
+
+
+def _escapes(fn: ast.AST, rid: str) -> bool:
+    """For a plain-name resource: ownership transfer out of the function
+    (returned, yielded, stored onto an object, or passed to another
+    call) — the caller releases, not this function."""
+    if "." in rid:
+        return False
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)) and \
+                node.value is not None:
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name) and sub.id == rid:
+                    return True
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Name) and sub.id == rid:
+                            return True
+        elif isinstance(node, ast.Call):
+            # passed as an argument to anything but its own method call
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id == rid:
+                    return True
+    return False
+
+
+def _iter_functions(tree: ast.Module):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _quick_events(fn) -> List[_Event]:
+    """Flat event scan over every statement of ``fn`` (nested defs
+    included — over-approximate, used only to decide whether building a
+    CFG can possibly pay off)."""
+    out: List[_Event] = []
+    for stmt in ast.walk(fn):
+        if isinstance(stmt, ast.stmt):
+            out.extend(_stmt_events(stmt))
+    return out
+
+
+class ResourceLeakRule(Rule):
+    name = "res-leak-on-raise"
+    severity = "error"
+    description = ("resource released on some paths but not all — a gate "
+                   "left closed / counter left high / handle left open on "
+                   "an exception or early-return path (PR 7 commit-gate "
+                   "class); release on every path or use try/finally")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for fn in _iter_functions(ctx.tree):
+            findings.extend(self._check_fn(fn, ctx))
+        return findings
+
+    def _check_fn(self, fn, ctx: ModuleContext) -> List[Finding]:
+        quick = _quick_events(fn)
+        # a CFG can only pay off when some resource is both acquired and
+        # released in this function (the inconsistency filter, applied
+        # before the expensive part)
+        if not ({e.rid for e in quick if e.action == "acquire"}
+                & {e.rid for e in quick if e.action == "release"}):
+            return []
+        cfg = build_cfg(fn)
+        events = _cfg_events(cfg)
+        if not events:
+            return []
+        acquired: Dict[str, Tuple[str, int, CFGNode]] = {}
+        released: Set[str] = set()
+        for idx, evs in events.items():
+            for e in evs:
+                if e.action == "acquire" and e.rid not in acquired:
+                    acquired[e.rid] = (e.kind, e.lineno, cfg.nodes[idx])
+                elif e.action == "release":
+                    released.add(e.rid)
+        # the inconsistency filter: a function that never releases is a
+        # deliberate one-way helper, not a path bug
+        rids = [r for r in acquired if r in released]
+        if not rids:
+            return []
+        rids = [r for r in rids if not _escapes(fn, r)]
+        if not rids:
+            return []
+
+        def transfer(node: CFGNode, state):
+            for e in events.get(node.index, ()):  # noqa: B023
+                if e.rid in rids:
+                    if e.action == "acquire":
+                        state = state | {e.rid}
+                    else:
+                        state = state - {e.rid}
+            return state
+
+        def exc_transfer(node: CFGNode, state):
+            # the node's own exception edge: a raising acquire never
+            # acquired; a release still counts (flagging the release's
+            # own hypothetical failure would damn every finally block)
+            for e in events.get(node.index, ()):
+                if e.rid in rids and e.action == "release":
+                    state = state - {e.rid}
+            return state
+
+        results = solve_forward(cfg, transfer, may=True,
+                                exc_transfer=exc_transfer)
+        findings: List[Finding] = []
+        for rid in rids:
+            kind, line, acq_node = acquired[rid]
+            leaks: List[str] = []
+            for exit_node, what in ((cfg.raise_exit, "an exception path"),
+                                    (cfg.exit, "a return path")):
+                if exit_node not in results:
+                    continue
+                if rid not in results[exit_node][0]:
+                    continue
+                path = witness_path(cfg, results, rid, acq_node, exit_node)
+                via = ""
+                concrete = [n for n in path[1:-1] if n.lineno is not None]
+                if concrete:
+                    via = f" (escapes via line {concrete[-1].lineno})"
+                leaks.append(what + via)
+            if leaks:
+                findings.append(Finding(
+                    rule=self.name, severity=self.severity,
+                    path=ctx.relpath, line=line, col=0,
+                    message=f"{kind} {rid!r} {_ACQUIRE_VERBS[kind]} in "
+                            f"{fn.name!r} is not {_RELEASE_VERBS[kind]} on "
+                            f"{' nor '.join(leaks)}: other paths release "
+                            f"it, so this path is a leak — release on "
+                            f"every path or wrap in try/finally",
+                    code=ctx.source_line(line)))
+        return findings
+
+
+class DoubleReleaseRule(Rule):
+    name = "res-double-release"
+    severity = "warning"
+    description = ("release of a resource that every incoming path has "
+                   "already released (no re-acquire in between): a "
+                   "double-close / double-decrement / double-reopen")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for fn in _iter_functions(ctx.tree):
+            findings.extend(self._check_fn(fn, ctx))
+        return findings
+
+    def _check_fn(self, fn, ctx: ModuleContext) -> List[Finding]:
+        quick = _quick_events(fn)
+        releases = [e.rid for e in quick if e.action == "release"]
+        # two releases of one resource are the cheapest possible
+        # precondition for a double-release
+        if len(releases) < 2 or len(set(releases)) == len(releases):
+            return []
+        cfg = build_cfg(fn)
+        events = _cfg_events(cfg)
+        if not events:
+            return []
+        rids = {e.rid for evs in events.values() for e in evs
+                if e.action == "release"}
+        if not rids:
+            return []
+
+        def transfer(node: CFGNode, state):
+            for e in events.get(node.index, ()):
+                if e.rid not in rids:
+                    continue
+                fact = "rel:" + e.rid
+                if e.action == "release":
+                    state = state | {fact}
+                else:
+                    state = state - {fact}
+            return state
+
+        results = solve_forward(cfg, transfer, may=False)
+        findings: List[Finding] = []
+        for node in cfg.stmt_nodes():
+            if node not in results:
+                continue
+            in_state = results[node][0]
+            for e in events.get(node.index, ()):
+                if e.action == "release" and ("rel:" + e.rid) in in_state:
+                    findings.append(Finding(
+                        rule=self.name, severity=self.severity,
+                        path=ctx.relpath, line=e.lineno, col=0,
+                        message=f"{e.kind} {e.rid!r} is already "
+                                f"{_RELEASE_VERBS[e.kind]} on every path "
+                                f"reaching this second release in "
+                                f"{fn.name!r}",
+                        code=ctx.source_line(e.lineno)))
+        return findings
+
+
+# -- declarative paired-call protocol specs --------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ProtocolSpec:
+    """One pairing contract.  ``begin``/``settle`` entries are call
+    matchers: ``"name"`` matches any call whose callee's terminal name is
+    ``name``; ``"name:literal"`` additionally requires a string-literal
+    argument equal to ``literal`` (so two admin verbs of the same callee
+    are distinct protocol events).
+
+    ``kind="settle"``: after a *begin* call, every path to a function
+    exit must pass a *settle* call.  ``kind="precede"``: every *begin*
+    call must have a *settle* call behind it on ALL incoming paths (the
+    barrier-before-action form).  ``scope`` restricts the spec to files
+    whose directory path contains one of the components (empty: all)."""
+
+    name: str
+    begin: Tuple[str, ...]
+    settle: Tuple[str, ...]
+    description: str
+    kind: str = "settle"
+    scope: Tuple[str, ...] = ()
+
+
+#: The registered protocols.  New subsystems add a row here (and a
+#: fixture pair under tests/data/lint_fixtures/) — not a new rule class.
+PROTOCOL_SPECS: Tuple[ProtocolSpec, ...] = (
+    ProtocolSpec(
+        name="staged-reload",
+        begin=("stage_reload",),
+        settle=("commit_staged", "abort_staged"),
+        description="a staged param tree must be committed or aborted on "
+                    "every path — a stranded stage is a full device-tree "
+                    "leak and a stale-commit hazard (PR 7)",
+    ),
+    ProtocolSpec(
+        name="rollout-prepare",
+        begin=("_admin:prepare",),
+        settle=("_abort", "_admin:commit", "_admin:rollback",
+                "_admin:abort"),
+        description="every replica the rollout coordinator prepared must "
+                    "be committed, rolled back, or aborted before the "
+                    "coordinator returns (PR 7: a router-side timeout "
+                    "with engine-side success stranded a staged tree)",
+        scope=("serving",),
+    ),
+    ProtocolSpec(
+        name="spill-after-drain",
+        kind="precede",
+        begin=("spill",),
+        settle=("wait_for",),
+        description="a session spill must happen behind the in-flight "
+                    "drain barrier: an acknowledged frame's state must be "
+                    "in the spill (PR 10)",
+        scope=("serving",),
+    ),
+)
+
+
+def _parse_matcher(entry: str) -> Tuple[str, Optional[str]]:
+    if ":" in entry:
+        name, lit = entry.split(":", 1)
+        return name, lit
+    return entry, None
+
+
+def _call_matches(call: ast.Call, entry: str) -> bool:
+    name, lit = _parse_matcher(entry)
+    if terminal_name(call.func) != name:
+        return False
+    if lit is None:
+        return True
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        if isinstance(arg, ast.Constant) and arg.value == lit:
+            return True
+    return False
+
+
+def _protocol_calls(stmt: ast.stmt, entries: Sequence[str]
+                    ) -> List[ast.Call]:
+    out: List[ast.Call] = []
+    for expr in header_exprs(stmt):
+        for node in _walk_no_scopes(expr):
+            if isinstance(node, ast.Call) and any(
+                    _call_matches(node, e) for e in entries):
+                out.append(node)
+    return out
+
+
+class PairedCallRule(Rule):
+    name = "proto-paired-call"
+    severity = "error"
+    description = ("a protocol's begin call has a path that never settles "
+                   "it (stage without commit/abort, action without its "
+                   "barrier) — see PROTOCOL_SPECS in rules_paths.py")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        dirs = ctx.relpath.split("/")[:-1]
+        specs = [s for s in PROTOCOL_SPECS
+                 if not s.scope or any(d in dirs for d in s.scope)]
+        if not specs:
+            return []
+        # cheap module-level pre-scan: a spec whose begin callee is never
+        # even named in the source can't fire in any function
+        specs = [s for s in specs
+                 if any(_parse_matcher(e)[0] in ctx.source
+                        for e in s.begin)]
+        if not specs:
+            return []
+        findings: List[Finding] = []
+        for fn in _iter_functions(ctx.tree):
+            called = {terminal_name(n.func) for n in ast.walk(fn)
+                      if isinstance(n, ast.Call)}
+            for spec in specs:
+                if not any(_parse_matcher(e)[0] in called
+                           for e in spec.begin):
+                    continue
+                findings.extend(self._check_fn(fn, spec, ctx))
+        return findings
+
+    def _check_fn(self, fn, spec: ProtocolSpec, ctx: ModuleContext
+                  ) -> List[Finding]:
+        begin_nodes: List[Tuple[CFGNode, int]] = []
+        cfg = build_cfg(fn)
+        settle_idx: Set[int] = set()
+        for node in cfg.stmt_nodes():
+            if node.kind == "handler":
+                continue
+            calls = _protocol_calls(node.stmt, spec.begin)
+            if calls:
+                begin_nodes.append((node, calls[0].lineno))
+            if _protocol_calls(node.stmt, spec.settle):
+                settle_idx.add(node.index)
+        if not begin_nodes:
+            return []
+
+        if spec.kind == "precede":
+            # must-analysis: the barrier fact holds only when EVERY path
+            # into the action has passed a settle call
+            def transfer(node: CFGNode, state):
+                if node.index in settle_idx:
+                    return state | {"barrier"}
+                return state
+
+            results = solve_forward(cfg, transfer, may=False,
+                                    exc_transfer=lambda n, s: s)
+            out: List[Finding] = []
+            for node, line in begin_nodes:
+                if node in results and "barrier" not in results[node][0]:
+                    out.append(Finding(
+                        rule=self.name, severity=self.severity,
+                        path=ctx.relpath, line=line, col=0,
+                        message=f"protocol {spec.name!r}: this call must "
+                                f"be behind {'/'.join(spec.settle)} on "
+                                f"every path — {spec.description}",
+                        code=ctx.source_line(line)))
+            return out
+
+        # settle kind: may-analysis of the unsettled fact
+        begin_idx = {n.index for n, _ in begin_nodes}
+
+        def transfer(node: CFGNode, state):
+            # a node that both settles and begins (retry shapes) begins
+            if node.index in settle_idx:
+                state = state - {"pending"}
+            if node.index in begin_idx:
+                state = state | {"pending"}
+            return state
+
+        def exc_transfer(node: CFGNode, state):
+            # a begin call that raises began nothing; a settle on the
+            # same node still settles
+            if node.index in settle_idx:
+                state = state - {"pending"}
+            return state
+
+        results = solve_forward(cfg, transfer, may=True,
+                                exc_transfer=exc_transfer)
+        out = []
+        for node, line in begin_nodes:
+            leaks = []
+            for exit_node, what in ((cfg.raise_exit, "an exception path"),
+                                    (cfg.exit, "a return path")):
+                if exit_node in results and \
+                        "pending" in results[exit_node][0]:
+                    path = witness_path(cfg, results, "pending", node,
+                                        exit_node)
+                    if path:
+                        concrete = [n for n in path[1:-1]
+                                    if n.lineno is not None]
+                        via = (f" via line {concrete[-1].lineno}"
+                               if concrete else "")
+                        leaks.append(what + via)
+            if leaks:
+                out.append(Finding(
+                    rule=self.name, severity=self.severity,
+                    path=ctx.relpath, line=line, col=0,
+                    message=f"protocol {spec.name!r}: begun here but "
+                            f"{' and '.join(leaks)} reach exit without "
+                            f"{'/'.join(_parse_matcher(s)[0] for s in spec.settle)}"
+                            f" — {spec.description}",
+                    code=ctx.source_line(line)))
+        return out
+
+
+PATH_RULES = (ResourceLeakRule, PairedCallRule, DoubleReleaseRule)

@@ -1,0 +1,360 @@
+"""glomlint sharding-consistency rule pack — mesh axes, spec arity, and
+flow-aware donation (the PR 6 SIGABRT family).
+
+pjit-scale systems (arXiv:2204.06514) keep PartitionSpecs and the mesh
+consistent by convention; when convention slips the failure is either a
+hard trace-time error in a config nobody tested, or — donation — a
+process abort.  These rules make the convention machine-checked:
+
+  * ``shard-unknown-axis`` — whole-program: the axis vocabulary is
+    DECLARED in ``parallel/mesh.py`` (tuple-of-string assignments to
+    ``*AXES`` names, e.g. ``DEFAULT_AXES``/``MESH_AXES``); every string
+    literal inside a ``P(...)``/``PartitionSpec(...)`` call, every
+    string default of a ``*_axis``/``axis_name`` parameter, and every
+    ``axis_name=`` string kwarg anywhere else must name a declared axis.
+    A spec axis no config can produce fails the first time that config
+    is actually run — this rule fails it at lint time.
+  * ``shard-spec-arity`` — a ``shard_map(fn, ..., in_specs=(...))``
+    whose in_specs tuple length differs from ``fn``'s positional arity
+    (and, when both sides are literal tuples, out_specs length vs the
+    returned tuple).  The mismatch is a trace-time TypeError that only
+    fires for the sharded config path, i.e. never on the CPU tests.
+  * ``shard-donation-flow`` — the CFG/dataflow upgrade of
+    ``jax-donation-aliasing``: numpy/npz host-buffer taint is propagated
+    over the control-flow graph (loop back edges, except-handler resume
+    paths) to the donated argument of a donating jit.  The v1 rule's
+    statement-ordered scan provably misses the retry shape — first
+    attempt laundered, the except handler reassigns from the raw npz,
+    the loop back edge feeds attempt two — which is exactly how the
+    PR 6 crash family recurs.  Laundering (any non-numpy call boundary,
+    e.g. the non-donating ``jax.jit(lambda t: t)`` identity or
+    ``jax.device_put``) breaks the taint, same as v1.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from glom_tpu.analysis.cfg import (
+    CFGNode, build_cfg, header_exprs, solve_forward, _walk_no_scopes,
+)
+from glom_tpu.analysis.engine import (
+    Finding, ModuleContext, Rule, dotted_name, terminal_name,
+)
+from glom_tpu.analysis.rules_jax import (
+    DonationAliasingRule, _JIT_NAMES, _donated_indices,
+)
+
+_PSPEC_NAMES = {"P", "PartitionSpec"}
+_AXES_DECL_RE = re.compile(r"AXES$")
+_AXIS_PARAM_RE = re.compile(r"(_axis|axis_name)$")
+
+
+def _str_elems(node: ast.AST) -> List[str]:
+    """All string constants inside a (possibly nested-tuple) literal."""
+    out: List[str] = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            out.append(sub.value)
+    return out
+
+
+class ShardingAxisRule(Rule):
+    name = "shard-unknown-axis"
+    severity = "error"
+    description = ("PartitionSpec / axis-param literal names a mesh axis "
+                   "parallel/mesh.py never declares (*AXES tuples): no "
+                   "buildable mesh can satisfy the spec — it fails at "
+                   "trace time for exactly the config nobody tested")
+
+    def __init__(self) -> None:
+        self._declared: Set[str] = set()
+        self._has_decl_file = False
+        #: (path, line, axis, where, code)
+        self._uses: List[Tuple[str, int, str, str, str]] = []
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        if ctx.relpath.split("/")[-1] == "mesh.py":
+            self._collect_declarations(ctx)
+        self._collect_uses(ctx)
+        return []
+
+    def _collect_declarations(self, ctx: ModuleContext) -> None:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and _AXES_DECL_RE.search(node.targets[0].id)):
+                continue
+            axes = _str_elems(node.value)
+            if axes:
+                self._has_decl_file = True
+                self._declared.update(axes)
+        # `DEFAULT_AXES + ("pipe",)` style: _str_elems over the BinOp value
+        # already picked up the literal part; the Name part was collected
+        # from its own assignment above.
+
+    def _collect_uses(self, ctx: ModuleContext) -> None:
+        rel = ctx.relpath
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                callee = terminal_name(node.func)
+                if callee in _PSPEC_NAMES:
+                    for arg in list(node.args) + [
+                            kw.value for kw in node.keywords]:
+                        for axis in _str_elems(arg):
+                            self._uses.append(
+                                (rel, node.lineno, axis,
+                                 f"{callee}(...)",
+                                 ctx.source_line(node.lineno)))
+                else:
+                    for kw in node.keywords:
+                        if kw.arg == "axis_name" and isinstance(
+                                kw.value, ast.Constant) and isinstance(
+                                kw.value.value, str):
+                            self._uses.append(
+                                (rel, node.lineno, kw.value.value,
+                                 "axis_name=",
+                                 ctx.source_line(node.lineno)))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if ctx.relpath.split("/")[-1] == "mesh.py":
+                    continue  # the declaration site itself
+                args = node.args
+                pos = args.posonlyargs + args.args
+                defaults = args.defaults
+                for arg, default in zip(pos[len(pos) - len(defaults):],
+                                        defaults):
+                    self._note_param_default(rel, arg, default, ctx)
+                for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+                    if default is not None:
+                        self._note_param_default(rel, arg, default, ctx)
+
+    def _note_param_default(self, rel: str, arg: ast.arg,
+                            default: ast.AST, ctx: ModuleContext) -> None:
+        if _AXIS_PARAM_RE.search(arg.arg) and isinstance(
+                default, ast.Constant) and isinstance(default.value, str):
+            self._uses.append(
+                (rel, default.lineno, default.value,
+                 f"default of {arg.arg!r}",
+                 ctx.source_line(default.lineno)))
+
+    def finalize(self) -> List[Finding]:
+        if not self._has_decl_file:
+            # no mesh.py in the analyzed set: nothing to be consistent
+            # WITH (targeted single-file runs must not mass-flag)
+            return []
+        findings: List[Finding] = []
+        for rel, line, axis, where, code in self._uses:
+            if axis in self._declared:
+                continue
+            findings.append(Finding(
+                rule=self.name, severity=self.severity, path=rel,
+                line=line, col=0,
+                message=f"axis {axis!r} in {where} is not declared in "
+                        f"parallel/mesh.py ({sorted(self._declared)}): "
+                        f"no mesh this project builds carries it — fix "
+                        f"the name or declare the axis in MESH_AXES",
+                code=code))
+        return findings
+
+
+class ShardMapArityRule(Rule):
+    name = "shard-spec-arity"
+    severity = "error"
+    description = ("shard_map in_specs tuple length != the wrapped "
+                   "function's positional arity (or literal out_specs vs "
+                   "returned tuple): a trace-time TypeError only the "
+                   "sharded config path ever hits")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        fns: Dict[str, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fns[node.name] = node
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Lambda):
+                fns[node.targets[0].id] = node.value
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and terminal_name(node.func) == "shard_map"
+                    and node.args):
+                continue
+            target = node.args[0]
+            fn: Optional[ast.AST] = None
+            if isinstance(target, ast.Lambda):
+                fn = target
+            elif isinstance(target, ast.Name):
+                fn = fns.get(target.id)
+            if fn is None:
+                continue
+            args = fn.args
+            if args.vararg is not None:
+                continue
+            pos = args.posonlyargs + args.args
+            arity = len(pos)
+            if pos and pos[0].arg == "self":
+                continue
+            for kw in node.keywords:
+                if kw.arg == "in_specs" and isinstance(kw.value, ast.Tuple):
+                    n = len(kw.value.elts)
+                    if n != arity:
+                        findings.append(ctx.finding(
+                            self, kw.value,
+                            f"in_specs has {n} spec(s) but the wrapped "
+                            f"function takes {arity} positional "
+                            f"argument(s): shard_map will reject this at "
+                            f"trace time — on the sharded config only"))
+                elif kw.arg == "out_specs" and isinstance(kw.value,
+                                                          ast.Tuple):
+                    n_out = self._returned_tuple_len(fn)
+                    if n_out is not None and n_out != len(kw.value.elts):
+                        findings.append(ctx.finding(
+                            self, kw.value,
+                            f"out_specs has {len(kw.value.elts)} spec(s) "
+                            f"but the wrapped function returns a "
+                            f"{n_out}-tuple"))
+        return findings
+
+    @staticmethod
+    def _returned_tuple_len(fn: ast.AST) -> Optional[int]:
+        """Length of the returned tuple when EVERY return is a literal
+        tuple of one consistent length; None otherwise (can't judge)."""
+        if isinstance(fn, ast.Lambda):
+            return (len(fn.body.elts)
+                    if isinstance(fn.body, ast.Tuple) else None)
+        lens: Set[int] = set()
+        for node in _walk_no_scopes_body(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                if not isinstance(node.value, ast.Tuple):
+                    return None
+                lens.add(len(node.value.elts))
+        return lens.pop() if len(lens) == 1 else None
+
+
+def _walk_no_scopes_body(fn):
+    """Walk a function's body without descending into nested defs."""
+    for stmt in fn.body:
+        yield from _walk_no_scopes(stmt)
+
+
+class DonationFlowRule(Rule):
+    name = "shard-donation-flow"
+    severity = "error"
+    description = ("numpy/npz host-buffer taint reaches a donating jit "
+                   "along a CFG path (loop back edge, except-handler "
+                   "resume) — the flow-aware form of jax-donation-"
+                   "aliasing (PR 6 SIGABRT family)")
+
+    def __init__(self) -> None:
+        # reuse v1's expression-taint semantics verbatim: a fact set of
+        # tainted names + the same numpy-constructor source set, so the
+        # two rules can never disagree about what taints an expression
+        self._v1 = DonationAliasingRule()
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        donating: Dict[str, Set[int]] = {}
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.value, ast.Call)
+                    and dotted_name(node.value.func) in _JIT_NAMES):
+                idxs = _donated_indices(node.value)
+                tgt = terminal_name(node.targets[0])
+                if idxs and tgt:
+                    donating[tgt] = idxs
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if (isinstance(dec, ast.Call)
+                            and dotted_name(dec.func) in _JIT_NAMES):
+                        idxs = _donated_indices(dec)
+                        if idxs:
+                            donating[node.name] = idxs
+        if not donating:
+            return []
+        findings: List[Finding] = []
+        scopes: List[Tuple[str, list]] = [("<module>", ctx.tree.body)]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append((node.name, node.body))
+        for scope_name, body in scopes:
+            findings.extend(self._check_scope(scope_name, body, donating,
+                                              ctx))
+        return findings
+
+    def _check_scope(self, scope_name: str, body: list,
+                     donating: Dict[str, Set[int]], ctx: ModuleContext
+                     ) -> List[Finding]:
+        cfg = build_cfg(body)
+
+        def transfer(node: CFGNode, state):
+            stmt = node.stmt
+            if stmt is None or node.kind == "handler":
+                return state
+            taint = set(state)
+            if isinstance(stmt, ast.Assign):
+                hot = self._v1._tainted(stmt.value, taint)
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        (taint.add if hot else taint.discard)(tgt.id)
+                    elif isinstance(tgt, (ast.Tuple, ast.List)):
+                        for el in tgt.elts:
+                            if isinstance(el, ast.Name):
+                                (taint.add if hot
+                                 else taint.discard)(el.id)
+            elif isinstance(stmt, ast.AnnAssign) and \
+                    stmt.value is not None and \
+                    isinstance(stmt.target, ast.Name):
+                hot = self._v1._tainted(stmt.value, taint)
+                (taint.add if hot else taint.discard)(stmt.target.id)
+            elif isinstance(stmt, ast.AugAssign) and \
+                    isinstance(stmt.target, ast.Name):
+                if self._v1._tainted(stmt.value, taint):
+                    taint.add(stmt.target.id)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                if self._v1._tainted(stmt.iter, taint):
+                    for el in ast.walk(stmt.target):
+                        if isinstance(el, ast.Name):
+                            taint.add(el.id)
+            return frozenset(taint)
+
+        # a raising assignment assigned nothing: exception edges carry
+        # the pre-statement taint
+        results = solve_forward(cfg, transfer, may=True,
+                                exc_transfer=lambda n, s: s)
+        findings: List[Finding] = []
+        seen: Set[Tuple[int, int]] = set()
+        for node in cfg.stmt_nodes():
+            if node not in results or node.kind == "handler":
+                continue
+            in_state = set(results[node][0])
+            for expr in header_exprs(node.stmt):
+                for call in _walk_no_scopes(expr):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    callee = terminal_name(call.func)
+                    if callee not in donating:
+                        continue
+                    for i in donating[callee]:
+                        if i < len(call.args) and self._v1._tainted(
+                                call.args[i], in_state) and \
+                                (call.lineno, i) not in seen:
+                            seen.add((call.lineno, i))
+                            findings.append(Finding(
+                                rule=self.name, severity=self.severity,
+                                path=ctx.relpath, line=call.lineno, col=0,
+                                message=f"argument {i} of donating jit "
+                                        f"{callee!r} in {scope_name!r} "
+                                        f"derives from a numpy/npz host "
+                                        f"buffer along a control-flow "
+                                        f"path — donation frees memory "
+                                        f"numpy owns; launder through a "
+                                        f"non-donating jit identity on "
+                                        f"EVERY path (including retry/"
+                                        f"except resume paths)",
+                                code=ctx.source_line(call.lineno)))
+        return findings
+
+
+SHARDING_RULES = (ShardingAxisRule, ShardMapArityRule, DonationFlowRule)

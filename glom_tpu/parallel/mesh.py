@@ -19,6 +19,15 @@ from jax.sharding import Mesh
 
 DEFAULT_AXES = ("data", "model", "seq")
 
+#: Every mesh-axis name any subsystem may shard over.  This is the
+#: declared vocabulary the glomlint ``shard-unknown-axis`` rule checks
+#: PartitionSpec/in_specs/out_specs literals against: a spec naming an
+#: axis outside this set can never match a mesh this module builds —
+#: adding an axis here is the deliberate act that admits new specs.
+#: ("pipe" is the pipeline-parallel stage axis: meshes carrying it are
+#: built by callers via ``make_mesh(..., axis_names=...)``.)
+MESH_AXES = DEFAULT_AXES + ("pipe",)
+
 
 def is_tpu_device(d: jax.Device) -> bool:
     """True when ``d`` is a TPU.  Matches device_kind as well as platform:

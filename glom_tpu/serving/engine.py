@@ -965,7 +965,7 @@ class ServingEngine:
                     SPAN_BATCH_ASSEMBLY, ctx, t_asm0, t_asm1,
                     attrs={"items": len(batch), "images": n}, observe=i == 0,
                 )
-        t0 = time.monotonic()
+        t0 = self._clock()
         try:
             out = np.asarray(cache(params, imgs, tracer=self.tracer,
                                    contexts=contexts))
@@ -976,7 +976,7 @@ class ServingEngine:
             if batch_span is not None:
                 self.tracer.end(batch_span, attrs={"error": repr(e)})
             return 0
-        batch_s = time.monotonic() - t0
+        batch_s = self._clock() - t0
         offset = 0
         for item in batch:
             item.future.set_result(out[offset:offset + item.size])

@@ -633,57 +633,79 @@ class FleetRouter:
 
         prepared: List[Replica] = []
         trivial: List[Replica] = []  # already serving the target
-        if target is None:
-            serving: Dict[str, Optional[int]] = {}
+        # the replica whose prepare response is mid-validation: its
+        # engine may have staged server-side before our validation
+        # raised, so the except below must abort it alongside `prepared`
+        inflight_prep: Optional[Replica] = None
+        try:
+            if target is None:
+                serving: Dict[str, Optional[int]] = {}
+                for replica in fleet:
+                    inflight_prep = replica
+                    # a prepare that stages nothing leaves nothing to
+                    # settle; every path that lands a replica in
+                    # `prepared` commits, rolls back, or aborts it below
+                    resp = self._admin(replica, "prepare", {})  # glomlint: disable=proto-paired-call -- the noop return (nothing staged fleet-wide) has nothing to settle; the except below aborts every other early exit
+                    if resp is None:
+                        # the failed replica gets an abort too: a router-
+                        # side timeout with engine-side success would
+                        # strand a full staged param tree there
+                        self._abort(prepared + [replica])
+                        return {"status": "aborted", "phase": "prepare",
+                                "replica": replica.name,
+                                "detail": "prepare failed"}
+                    note_serving(resp)
+                    serving[replica.name] = resp.get("serving_step")
+                    staged = resp.get("staged_step")
+                    if staged is not None:
+                        target = int(staged)
+                        prepared.append(replica)
+                        break  # pin the rest to this step below
+                if target is None:
+                    distinct = {v for v in serving.values()}
+                    if len(distinct) <= 1:
+                        return {"status": "noop",
+                                "step": next(iter(distinct), None)}
+                    target = max(v for v in distinct if v is not None)
+
             for replica in fleet:
-                resp = self._admin(replica, "prepare", {})
+                if replica in prepared:
+                    continue
+                inflight_prep = replica
+                resp = self._admin(replica, "prepare", {"step": target})  # glomlint: disable=proto-paired-call -- the noop return below is only reachable with `prepared` empty; every other early exit aborts (loop bodies + the except below)
                 if resp is None:
-                    # the failed replica gets an abort too: a router-
-                    # side timeout with engine-side success would
-                    # strand a full staged param tree there
                     self._abort(prepared + [replica])
                     return {"status": "aborted", "phase": "prepare",
                             "replica": replica.name,
                             "detail": "prepare failed"}
                 note_serving(resp)
-                serving[replica.name] = resp.get("serving_step")
                 staged = resp.get("staged_step")
-                if staged is not None:
-                    target = int(staged)
-                    prepared.append(replica)
-                    break  # pin the rest to this step below
-            if target is None:
-                distinct = {v for v in serving.values()}
-                if len(distinct) <= 1:
-                    return {"status": "noop",
-                            "step": next(iter(distinct), None)}
-                target = max(v for v in distinct if v is not None)
-
-        for replica in fleet:
-            if replica in prepared:
-                continue
-            resp = self._admin(replica, "prepare", {"step": target})
-            if resp is None:
-                self._abort(prepared + [replica])
-                return {"status": "aborted", "phase": "prepare",
-                        "replica": replica.name,
-                        "detail": "prepare failed"}
-            note_serving(resp)
-            staged = resp.get("staged_step")
-            if staged is None:
-                if resp.get("serving_step") == target:
-                    trivial.append(replica)
-                    continue
-                self._abort(prepared + [replica])
-                return {"status": "aborted", "phase": "prepare",
-                        "replica": replica.name,
-                        "detail": f"could not stage step {target}"}
-            if int(staged) != target:
-                self._abort(prepared + [replica])
-                return {"status": "aborted", "phase": "prepare",
-                        "replica": replica.name,
-                        "detail": f"staged {staged} != target {target}"}
-            prepared.append(replica)
+                if staged is None:
+                    if resp.get("serving_step") == target:
+                        trivial.append(replica)
+                        continue
+                    self._abort(prepared + [replica])
+                    return {"status": "aborted", "phase": "prepare",
+                            "replica": replica.name,
+                            "detail": f"could not stage step {target}"}
+                if int(staged) != target:
+                    self._abort(prepared + [replica])
+                    return {"status": "aborted", "phase": "prepare",
+                            "replica": replica.name,
+                            "detail": f"staged {staged} != target {target}"}
+                prepared.append(replica)
+        except Exception:
+            # an unexpected failure mid-prepare (a malformed replica
+            # response feeding int(), a raising transport) must not
+            # strand staged param trees — neither on the replicas
+            # already prepared NOR on the one whose response we were
+            # validating (its engine may have staged before the
+            # validation raised; an abort with nothing staged is a
+            # no-op engine-side)
+            extra = ([inflight_prep] if inflight_prep is not None
+                     and inflight_prep not in prepared else [])
+            self._abort(prepared + extra)
+            raise
         if not prepared and not trivial:
             return {"status": "noop", "step": target}
 

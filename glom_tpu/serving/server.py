@@ -205,6 +205,7 @@ class _Handler(BaseHTTPRequestHandler):
             if payload is None:
                 return
             step = payload.get("step")
+            # glomlint: disable=proto-paired-call -- transport shim: the commit/abort arrive as separate HTTP admin requests; the router's rollout coordinator owns the pairing (and its own lint coverage)
             staged = engine.stage_reload(
                 step=int(step) if step is not None else None)
             self._reply(200, {"staged_step": staged,

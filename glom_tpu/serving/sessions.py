@@ -165,7 +165,7 @@ class SessionStore:
         from under us, because cleanup skips held locks."""
         while True:
             lock = self.lock(session_id)
-            lock.acquire()
+            lock.acquire()  # glomlint: disable=res-leak-on-raise -- the only statement between acquire and the try/finally is the identity re-validation dict probe under self._lock; wrapping it would have to release-before-validate, re-opening the re-mint race this loop exists to close
             with self._lock:
                 if self._locks.get(session_id) is lock:
                     break

@@ -1,0 +1,31 @@
+"""shard-donation-flow must-flag fixture — the PR 6 donation-aliasing
+SIGABRT family in its RETRY shape, which glomlint v1 provably does not
+flag.
+
+PR 6: a ``donate_argnums`` jit fed a numpy/npz-backed tree; on CPU the
+jit feed zero-copy aliases the numpy heap, donation then has XLA free
+memory numpy still owns ("corrupted double-linked list", a hard
+process abort).  The original fix laundered the restored tree through a
+non-donating jit identity — but only on the FIRST attempt: the retry
+handler below reassigns from the raw npz, and the loop back edge feeds
+attempt two.  v1's ``jax-donation-aliasing`` scans statements in source
+order (branch-copy + union, no back edges), so at the ``step(...)``
+call it has only seen the laundered assignment — it provably cannot
+flag this.  The CFG dataflow carries the handler's taint around the
+loop and does.
+"""
+
+import jax
+import numpy as np
+
+step = jax.jit(lambda state, batch: state, donate_argnums=(0,))
+
+
+def restore_with_retry(path, batch):
+    trees = jax.jit(lambda t: t)(np.load(path))  # laundered: safe
+    for _ in range(2):
+        try:
+            return step(trees, batch)
+        except RuntimeError:
+            trees = np.load(path)  # BUG: the retry feeds the raw npz
+    return None

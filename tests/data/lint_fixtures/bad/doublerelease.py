@@ -1,0 +1,14 @@
+"""res-double-release must-flag fixture: the ``finally`` already closed
+the connection on every path, and the epilogue closes it again — on
+pooled transports the second close corrupts the pool's accounting (the
+slot is handed out twice), and on a plain ``threading.Lock`` the
+analogous double ``release()`` raises."""
+
+
+def fetch(conn, request):
+    try:
+        payload = conn.send(request)
+    finally:
+        conn.close()
+    conn.close()  # BUG: every path reaching here has already closed
+    return payload

@@ -1,0 +1,16 @@
+"""shard-unknown-axis must-flag fixture — the PR 6-family config-drift
+shape: a PartitionSpec naming a mesh axis no declared vocabulary
+carries ("modle" for "model").  The spec traces fine on the replicated
+CPU test path and explodes at trace time for exactly the sharded config
+nobody ran.  The declared vocabulary is the ``*AXES`` tuple literals in
+``mesh.py`` (this file plays that role for the fixture tree)."""
+
+DEFAULT_AXES = ("data", "model", "seq")
+
+
+def batch_spec(P):
+    return P("data", None)
+
+
+def param_spec(P):
+    return P(None, "modle")  # BUG: typo'd axis — no mesh declares it

@@ -1,0 +1,29 @@
+"""proto-paired-call must-flag fixture — the PR 7 stranded-staged-tree
+review finding, reduced.
+
+PR 7's rollout coordinator staged new params on every replica
+(phase 1), then committed (phase 2).  Review caught an early return on
+a wrong-step reply that left the already-prepared replicas holding full
+staged device trees: a param-tree memory leak AND a stale-commit hazard
+(a later rollout's trivial commit could swap in the stranded tree).
+The commit/abort calls all EXIST in the file — glomlint v1 provably
+cannot flag this, because only the early-return *path* misses them.
+"""
+
+
+class Coordinator:
+    def __init__(self, fleet):
+        self.fleet = fleet
+
+    def rollout(self, target):
+        prepared = []
+        for replica in self.fleet:
+            staged = replica.stage_reload(target)
+            if staged != target:
+                # BUG: returns with every replica in `prepared` still
+                # holding its staged tree — nothing aborts them
+                return {"status": "aborted", "replica": replica.name}
+            prepared.append(replica)
+        for replica in prepared:
+            replica.commit_staged()
+        return {"status": "committed", "step": target}

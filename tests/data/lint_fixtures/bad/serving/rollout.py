@@ -1,0 +1,28 @@
+"""res-leak-on-raise must-flag fixture — the PR 7 commit-gate reopen
+review finding, reduced.
+
+PR 7's coordinated rollout closed the dispatch gate for the commit
+window; review caught that a raising commit left the gate closed
+forever — every subsequent request then waits ``gate_timeout_s`` and
+fails: a whole-fleet outage from one bad replica.  The reopen EXISTS in
+the function, so glomlint v1 (flow-insensitive, per-file shape
+matching) provably cannot flag it: only the exception *path* misses the
+``.set()``, and v1 has no notion of paths.
+"""
+
+import threading
+
+
+class Router:
+    def __init__(self, replicas):
+        self._dispatch_open = threading.Event()
+        self._dispatch_open.set()
+        self.replicas = replicas
+
+    def rollout(self, target):
+        self._dispatch_open.clear()  # gate closes for the commit window
+        for replica in self.replicas:
+            # raises on a failed replica: the gate never reopens
+            replica.commit(target)
+        self._dispatch_open.set()
+        return target

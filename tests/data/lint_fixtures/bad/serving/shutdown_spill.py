@@ -1,0 +1,27 @@
+"""proto-paired-call (precede kind) must-flag fixture — the PR 10
+spill-vs-inflight-drain review finding, reduced.
+
+PR 10's session shutdown spills per-session column state so a drained
+replica reboots warm.  Review caught a spill issued while session
+frames were still in flight: a frame the client already got an ACK for
+had not yet ``put()`` its state, so the spill silently missed it —
+"nothing accepted is dropped" broken for exactly the requests racing
+shutdown.  The barrier call exists in the codebase and the spill call
+exists here; only the *path* relationship (spill must sit behind the
+drain wait on EVERY route) is wrong, which flow-insensitive glomlint v1
+provably cannot express.
+"""
+
+
+class Engine:
+    def __init__(self, sessions, spill_dir, threads):
+        self.sessions = sessions
+        self.spill_dir = spill_dir
+        self.threads = threads
+
+    def shutdown(self):
+        for t in self.threads:
+            t.join()
+        # BUG: no in-flight drain barrier before the spill — an
+        # acknowledged frame's put() can land after the snapshot
+        self.sessions.spill(self.spill_dir)

@@ -1,0 +1,20 @@
+"""shard-spec-arity must-flag fixture: the kernel takes two positional
+arguments but ``in_specs`` supplies three (and the two-tuple return is
+covered by a three-tuple ``out_specs``) — a trace-time TypeError that
+only fires on the sharded config path, never in the replicated CPU
+tests."""
+
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def kernel(params, x):
+    return params, x
+
+
+def build(mesh):
+    return shard_map(
+        kernel, mesh=mesh,
+        in_specs=(P(), P("data"), P("model")),  # BUG: kernel takes 2
+        out_specs=(P(), P("data"), P()),        # BUG: kernel returns 2
+    )

@@ -1,0 +1,18 @@
+"""Golden-output source: a tiny module with a deterministic finding set
+(one ``conc-broad-except`` warning, one ``res-leak-on-raise`` error) so
+the text/json/sarif CLI formats can be byte-compared against committed
+goldens.  Changing rule output formats means regenerating the goldens
+(tests/test_analysis.py::test_golden_outputs says how)."""
+
+
+def poll(fetch):
+    try:
+        return fetch()
+    except Exception:
+        return None
+
+
+def swap(gate, commit):
+    gate.clear()
+    commit()
+    gate.set()

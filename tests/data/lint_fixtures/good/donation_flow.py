@@ -1,0 +1,17 @@
+"""shard-donation-flow must-pass fixture: the retry path launders too,
+so no path into the donating jit carries numpy host-buffer taint."""
+
+import jax
+import numpy as np
+
+step = jax.jit(lambda state, batch: state, donate_argnums=(0,))
+
+
+def restore_with_retry(path, batch):
+    trees = jax.jit(lambda t: t)(np.load(path))
+    for _ in range(2):
+        try:
+            return step(trees, batch)
+        except RuntimeError:
+            trees = jax.jit(lambda t: t)(np.load(path))  # laundered
+    return None

@@ -1,0 +1,20 @@
+"""res-double-release must-pass fixture: one close, in the ``finally``,
+covering every path — and a re-acquire between two releases (the
+reconnect shape) is recognized as resetting the state, not a double
+release."""
+
+
+def fetch(conn, request):
+    try:
+        payload = conn.send(request)
+    finally:
+        conn.close()
+    return payload
+
+
+def reconnecting_fetch(pool, request):
+    conn = pool.acquire()
+    try:
+        return conn.send(request)
+    finally:
+        conn.release()

@@ -1,0 +1,17 @@
+"""shard-unknown-axis must-pass fixture: every PartitionSpec literal
+names an axis the ``*AXES`` declarations carry."""
+
+DEFAULT_AXES = ("data", "model", "seq")
+MESH_AXES = DEFAULT_AXES + ("pipe",)
+
+
+def batch_spec(P):
+    return P("data", None)
+
+
+def param_spec(P):
+    return P(None, "model")
+
+
+def stage_spec(P):
+    return P("pipe")

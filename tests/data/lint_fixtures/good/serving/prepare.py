@@ -1,0 +1,35 @@
+"""proto-paired-call must-pass fixture — the PR 7 fix shape: every exit
+from the prepare phase settles the staged trees through a DIRECT settle
+call (the ``abort_staged``/``commit_staged`` helpers — direct calls, so
+the zero-iteration path of an inline loop can't dodge them): abort on
+the mismatch return, abort-and-reraise on an unexpected exception,
+commit on success."""
+
+
+class Coordinator:
+    def __init__(self, fleet):
+        self.fleet = fleet
+
+    def abort_staged(self, prepared):
+        for done in prepared:
+            done.abort_staged()
+
+    def commit_staged(self, prepared):
+        for replica in prepared:
+            replica.commit_staged()
+
+    def rollout(self, target):
+        prepared = []
+        try:
+            for replica in self.fleet:
+                staged = replica.stage_reload(target)
+                if staged != target:
+                    self.abort_staged(prepared)
+                    return {"status": "aborted",
+                            "replica": replica.name}
+                prepared.append(replica)
+        except Exception:
+            self.abort_staged(prepared)
+            raise
+        self.commit_staged(prepared)
+        return {"status": "committed", "step": target}

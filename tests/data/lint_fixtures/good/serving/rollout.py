@@ -1,0 +1,22 @@
+"""res-leak-on-raise must-pass fixture — the PR 7 fix shape: the gate
+reopen lives in a ``finally``, so every path (commit success, commit
+raise, early return) runs it.  The dataflow engine sees the release on
+the exception edges too and stays quiet."""
+
+import threading
+
+
+class Router:
+    def __init__(self, replicas):
+        self._dispatch_open = threading.Event()
+        self._dispatch_open.set()
+        self.replicas = replicas
+
+    def rollout(self, target):
+        self._dispatch_open.clear()
+        try:
+            for replica in self.replicas:
+                replica.commit(target)
+        finally:
+            self._dispatch_open.set()
+        return target

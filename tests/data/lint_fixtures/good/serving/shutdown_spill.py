@@ -1,0 +1,21 @@
+"""proto-paired-call (precede kind) must-pass fixture — the PR 10 fix
+shape: the spill sits behind the in-flight drain barrier
+(``wait_for`` on the session condition variable), so every path into
+``spill`` has passed it."""
+
+
+class Engine:
+    def __init__(self, sessions, spill_dir, threads, cv):
+        self.sessions = sessions
+        self.spill_dir = spill_dir
+        self.threads = threads
+        self._session_cv = cv
+        self._session_inflight = 0
+
+    def shutdown(self, timeout=30.0):
+        for t in self.threads:
+            t.join()
+        with self._session_cv:
+            self._session_cv.wait_for(
+                lambda: self._session_inflight == 0, timeout=timeout)
+        self.sessions.spill(self.spill_dir)

@@ -1,0 +1,17 @@
+"""shard-spec-arity must-pass fixture: in_specs arity matches the
+kernel's positional arity and out_specs matches the returned tuple."""
+
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def kernel(params, x):
+    return params, x
+
+
+def build(mesh):
+    return shard_map(
+        kernel, mesh=mesh,
+        in_specs=(P(), P("data")),
+        out_specs=(P(), P("data")),
+    )

@@ -65,7 +65,31 @@ RULE_FIXTURES = [
      "serving/compile_cache.py"),
     ("obs-state-in-cache", "serving/compile_cache.py",
      "serving/compile_cache.py"),
+    # -- the v2 dataflow packs (cfg.py + rules_paths + rules_sharding) --
+    ("res-leak-on-raise", "serving/rollout.py", "serving/rollout.py"),
+    ("proto-paired-call", "serving/prepare.py", "serving/prepare.py"),
+    ("res-double-release", "doublerelease.py", "doublerelease.py"),
+    ("shard-unknown-axis", "parallel/mesh.py", "parallel/mesh.py"),
+    ("shard-spec-arity", "shardmap_arity.py", "shardmap_arity.py"),
+    ("shard-donation-flow", "donation_flow.py", "donation_flow.py"),
 ]
+
+#: (fixture, the PR whose review finding it reduces) — each must be
+#: flagged by the v2 packs AND completely clean under the v1 rule set:
+#: the classes only a path-sensitive engine can see.
+HISTORICAL_PATH_FIXTURES = [
+    ("serving/rollout.py", "PR 7 commit-gate reopen"),
+    ("serving/prepare.py", "PR 7 stranded staged tree"),
+    ("serving/shutdown_spill.py", "PR 10 spill-vs-inflight drain"),
+    ("donation_flow.py", "PR 6 donation aliasing (retry shape)"),
+]
+
+V2_RULE_PREFIXES = ("res-", "proto-", "shard-")
+
+
+def v1_rule_names():
+    return [r.name for r in default_rules()
+            if not r.name.startswith(V2_RULE_PREFIXES)]
 
 
 @pytest.mark.parametrize("rule,bad_rel,good_rel", RULE_FIXTURES,
@@ -155,6 +179,109 @@ def test_toctou_double_checked_variant_passes():
     """dispatch_fast re-checks under the lock — recognized as safe."""
     result = run_rules(os.path.join(GOOD, "toctou.py"), root=GOOD)
     assert not findings_for(result, "conc-check-then-act")
+
+
+# -- the v2 dataflow packs: historical path findings -----------------------
+
+@pytest.mark.parametrize("rel,what", HISTORICAL_PATH_FIXTURES,
+                         ids=[w for _, w in HISTORICAL_PATH_FIXTURES])
+def test_historical_path_finding_v1_provably_misses(rel, what):
+    """The acceptance bar for the dataflow engine: each fixture is a
+    faithful reduction of a named historical review finding (see its
+    docstring for the PR citation), the v2 packs flag it, and the ENTIRE
+    v1 rule set — run over the same file — reports nothing.  These are
+    the bug classes four PRs of human review caught that flow-insensitive
+    lint provably cannot."""
+    path = os.path.join(BAD, rel)
+    v1 = run_rules(path, root=BAD, names=v1_rule_names())
+    assert not v1.findings, (
+        f"v1 rules unexpectedly flag {rel} ({what}): {v1.findings} — "
+        f"the fixture no longer proves the v2 packs add coverage")
+    v2 = run_rules(path, root=BAD)
+    v2_hits = [f for f in v2.findings
+               if f.rule.startswith(V2_RULE_PREFIXES)]
+    assert v2_hits, f"v2 packs must flag {rel} ({what})"
+    assert "PR" in open(path).read(200), (
+        f"{rel} must cite its historical PR in the docstring")
+
+
+def test_paired_call_precede_spec_flags_unbarriered_spill():
+    """The PR 10 shape: a spill with no wait_for behind it on some path
+    (kind='precede' protocol), and the barriered good form passes."""
+    bad = run_rules(os.path.join(BAD, "serving", "shutdown_spill.py"),
+                    root=BAD)
+    hits = findings_for(bad, "proto-paired-call")
+    assert len(hits) == 1 and "spill-after-drain" in hits[0].message
+    good = run_rules(os.path.join(GOOD, "serving", "shutdown_spill.py"),
+                     root=GOOD)
+    assert not findings_for(good, "proto-paired-call")
+
+
+def test_leak_rule_inconsistency_filter(tmp_path):
+    """A close-only helper (the reopen lives elsewhere by design) is NOT
+    a leak — the rule only fires when the same function releases on some
+    paths but not others."""
+    result = _lint_source(tmp_path, """
+        class Batcher:
+            def close(self):
+                self.admission_gate.clear()
+    """, names=["res-leak-on-raise"])
+    assert not result.findings
+
+
+def test_leak_rule_conditional_acquire_is_ignored(tmp_path):
+    """acquire(blocking=False) is conditional — whether the lock is held
+    depends on the return value, which gen/kill facts can't track; the
+    rule must not flag the standard try-lock/continue loop."""
+    result = _lint_source(tmp_path, """
+        class Poller:
+            def tick(self, replica):
+                if not self._rollout_lock.acquire(blocking=False):
+                    return
+                try:
+                    self.probe(replica)
+                finally:
+                    self._rollout_lock.release()
+    """, names=["res-leak-on-raise"])
+    assert not result.findings
+
+
+def test_double_release_reacquire_resets(tmp_path):
+    """release; acquire; release is NOT a double release."""
+    result = _lint_source(tmp_path, """
+        def cycle(conn):
+            conn.release()
+            conn.acquire()
+            conn.release()
+    """, names=["res-double-release"])
+    assert not result.findings
+
+
+def test_shard_axis_rule_needs_a_declaration_file(tmp_path):
+    """Without a mesh.py in the analyzed set there is no vocabulary to
+    be consistent with: a targeted single-file run must not mass-flag
+    every spec literal."""
+    result = _lint_source(tmp_path, """
+        def spec(P):
+            return P("data", "anything_at_all")
+    """, names=["shard-unknown-axis"])
+    assert not result.findings
+
+
+def test_shard_axis_rule_checks_axis_param_defaults(tmp_path):
+    """A typo'd axis default on a *_axis parameter is exactly the drift
+    the rule exists for — checked against the mesh.py vocabulary."""
+    (tmp_path / "parallel").mkdir()
+    (tmp_path / "parallel" / "mesh.py").write_text(
+        'DEFAULT_AXES = ("data", "model", "seq")\n')
+    (tmp_path / "ops.py").write_text(textwrap.dedent("""
+        def run(x, data_axis="dataa"):
+            return x
+    """))
+    result = run_rules([str(tmp_path)], root=str(tmp_path),
+                       names=["shard-unknown-axis"])
+    assert len(result.findings) == 1
+    assert "dataa" in result.findings[0].message
 
 
 # -- suppressions ----------------------------------------------------------
@@ -431,6 +558,206 @@ def test_cli_list_rules():
         assert rule in proc.stdout
 
 
+# -- golden outputs: one committed golden per format -----------------------
+
+GOLDEN_SRC = os.path.join(FIXTURES, "golden_src")
+GOLDEN_OUT = os.path.join(FIXTURES, "golden_out")
+
+_REGEN = ("regenerate: python tools/lint.py [--format json|sarif] "
+          "--baseline none --root tests/data/lint_fixtures/golden_src "
+          "tests/data/lint_fixtures/golden_src > "
+          "tests/data/lint_fixtures/golden_out/golden.<ext> "
+          "(then re-normalize the sarif SRCROOT uri to file://<SRCROOT>/)")
+
+
+def _normalize_sarif(text):
+    import re
+    return re.sub(r'"file://[^"]*/golden_src/"', '"file://<SRCROOT>/"',
+                  text)
+
+
+@pytest.mark.parametrize("fmt,golden,normalize", [
+    ("text", "golden.txt", None),
+    ("json", "golden.json", None),
+    ("sarif", "golden.sarif", _normalize_sarif),
+], ids=["text", "json", "sarif"])
+def test_golden_outputs(fmt, golden, normalize):
+    """Each CLI output format is byte-stable against its committed
+    golden (the contract consumers — CI log scrapers, the SARIF
+    artifact, Prometheus textfiles — parse)."""
+    args = ["--baseline", "none", "--root", GOLDEN_SRC, GOLDEN_SRC]
+    if fmt != "text":
+        args = ["--format", fmt] + args
+    proc = _run_cli(args)
+    assert proc.returncode == 1  # the golden source has findings
+    got = proc.stdout
+    if normalize:
+        got = normalize(got)
+    want = open(os.path.join(GOLDEN_OUT, golden)).read()
+    assert got == want, f"{fmt} output drifted from {golden}; {_REGEN}"
+
+
+def test_sarif_validates_against_schema():
+    """The SARIF output validates against the (vendored subset of the)
+    SARIF 2.1.0 schema: required properties, level/baselineState enums,
+    1-based region coordinates."""
+    jsonschema = pytest.importorskip("jsonschema")
+    proc = _run_cli(["--format", "sarif", "--baseline", "none",
+                     "--root", FIXTURES, BAD])
+    payload = json.loads(proc.stdout)
+    schema = json.load(open(os.path.join(
+        REPO, "tests", "data", "sarif-2.1.0.schema.json")))
+    jsonschema.validate(payload, schema)
+    run = payload["runs"][0]
+    assert payload["version"] == "2.1.0"
+    assert run["tool"]["driver"]["name"] == "glomlint"
+    # every emitted ruleId is declared in the driver's rules array
+    declared = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {r["ruleId"] for r in run["results"]} <= declared
+    assert all(r["baselineState"] == "new" for r in run["results"])
+
+
+def test_sarif_baselined_findings_marked_unchanged(tmp_path):
+    """Baseline-absorbed findings ship in the SARIF too, as
+    baselineState=unchanged — the viewer shows the same split the exit
+    code enforces."""
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "mod.py").write_text(textwrap.dedent("""
+        def poll(fetch):
+            try:
+                return fetch()
+            except Exception:
+                return None
+    """))
+    bl = tmp_path / "bl.json"
+    res = run_rules([str(src)], root=str(tmp_path))
+    write_baseline(str(bl), res.findings)
+    proc = _run_cli(["--format", "sarif", "--baseline", str(bl),
+                     "--root", str(tmp_path), str(src)])
+    assert proc.returncode == 0  # fully baselined
+    results = json.loads(proc.stdout)["runs"][0]["results"]
+    assert results and all(r["baselineState"] == "unchanged"
+                           for r in results)
+
+
+# -- --diff fast mode ------------------------------------------------------
+
+def _git(cwd, *args):
+    return subprocess.run(["git", "-C", str(cwd)] + list(args),
+                          capture_output=True, text=True, check=True,
+                          timeout=60)
+
+
+def test_cli_diff_gates_only_changed_files(tmp_path):
+    """--diff <ref>: the whole tree is analyzed, but only findings in
+    files changed since <ref> (plus untracked files) gate; a one-file
+    change returns fast."""
+    import time
+
+    repo = tmp_path / "repo"
+    (repo / "src").mkdir(parents=True)
+    dirty = textwrap.dedent("""
+        def poll(fetch):
+            try:
+                return fetch()
+            except Exception:
+                return None
+    """)
+    (repo / "src" / "old.py").write_text(dirty)
+    (repo / "src" / "other.py").write_text("x = 1\n")
+    _git(repo, "init", "-q")
+    _git(repo, "-c", "user.email=t@t", "-c", "user.name=t", "add", ".")
+    _git(repo, "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-qm", "seed")
+
+    # pre-existing finding in an UNCHANGED file: --diff does not gate it
+    (repo / "src" / "other.py").write_text("y = 2\n")
+    t0 = time.time()
+    proc = _run_cli(["--diff", "HEAD", "--format", "json",
+                     "--baseline", "none", "--root", str(repo),
+                     str(repo / "src")], cwd=str(repo))
+    elapsed = time.time() - t0
+    payload = json.loads(proc.stdout)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert payload["summary"]["out_of_diff"] == 1
+    assert payload["out_of_diff"][0]["path"] == "src/old.py"
+    assert elapsed < 5.0, f"--diff took {elapsed:.1f}s on a one-file change"
+
+    # the same hazard in a CHANGED file gates
+    (repo / "src" / "other.py").write_text(dirty)
+    proc = _run_cli(["--diff", "HEAD", "--format", "json",
+                     "--baseline", "none", "--root", str(repo),
+                     str(repo / "src")], cwd=str(repo))
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert [f["path"] for f in payload["findings"]] == ["src/other.py"]
+
+    # an UNTRACKED new file gates too (pre-commit must see new files)
+    (repo / "src" / "other.py").write_text("y = 2\n")
+    (repo / "src" / "new.py").write_text(dirty)
+    proc = _run_cli(["--diff", "HEAD", "--format", "json",
+                     "--baseline", "none", "--root", str(repo),
+                     str(repo / "src")], cwd=str(repo))
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert [f["path"] for f in payload["findings"]] == ["src/new.py"]
+
+
+def test_cli_diff_root_below_git_toplevel(tmp_path):
+    """--diff must keep gating when --root is a SUBDIRECTORY of the git
+    toplevel (vendored/monorepo layout): git diff paths are relativized
+    to root, so they match the root-relative finding paths."""
+    top = tmp_path / "mono"
+    proj = top / "proj"
+    (proj / "src").mkdir(parents=True)
+    (proj / "src" / "m.py").write_text("x = 1\n")
+    _git(top, "init", "-q")
+    _git(top, "-c", "user.email=t@t", "-c", "user.name=t", "add", ".")
+    _git(top, "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-qm", "seed")
+    (proj / "src" / "m.py").write_text(textwrap.dedent("""
+        def poll(fetch):
+            try:
+                return fetch()
+            except Exception:
+                return None
+    """))
+    proc = _run_cli(["--diff", "HEAD", "--format", "json",
+                     "--baseline", "none", "--root", str(proj),
+                     str(proj / "src")], cwd=str(top))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert [f["path"] for f in payload["findings"]] == ["src/m.py"]
+
+
+def test_cli_sarif_file_side_output(tmp_path):
+    """--sarif-file writes the SARIF log alongside any --format, so CI
+    emits json + sarif from ONE analysis pass."""
+    out = tmp_path / "lint.sarif"
+    proc = _run_cli(["--format", "json", "--baseline", "none",
+                     "--root", GOLDEN_SRC, "--sarif-file", str(out),
+                     GOLDEN_SRC])
+    assert proc.returncode == 1
+    json.loads(proc.stdout)  # the json output is intact on stdout
+    payload = json.loads(out.read_text())
+    assert payload["version"] == "2.1.0"
+    assert payload["runs"][0]["results"]
+
+
+def test_cli_diff_bad_ref_is_usage_error(tmp_path):
+    repo = tmp_path / "repo"
+    (repo / "src").mkdir(parents=True)
+    (repo / "src" / "m.py").write_text("x = 1\n")
+    _git(repo, "init", "-q")
+    proc = _run_cli(["--diff", "no-such-ref", "--root", str(repo),
+                     str(repo / "src")], cwd=str(repo))
+    assert proc.returncode == 2
+    proc = _run_cli(["--write-baseline", "--diff", "HEAD"])
+    assert proc.returncode == 2
+    assert "full run" in proc.stderr
+
+
 # -- the gate itself: the repo is clean modulo the committed baseline ------
 
 def test_self_lint_repo_clean_modulo_baseline():
@@ -445,6 +772,17 @@ def test_self_lint_repo_clean_modulo_baseline():
     new, _old = split_baseline(result.findings, budget)
     assert not new, "new lint findings:\n" + "\n".join(
         f"  {f.location}: {f.rule} {f.message}" for f in new)
+
+
+def test_self_lint_baseline_is_empty():
+    """ISSUE 13 burned the baseline to zero: the repo self-lints clean
+    with NO absorbed debt — new findings must be fixed or carry a
+    reasoned suppression, never parked."""
+    budget = load_baseline(
+        os.path.join(REPO, "tools", "glomlint_baseline.json"))
+    assert budget == {}, (
+        "the baseline must stay empty — fix the finding or suppress it "
+        "in place with a reason")
 
 
 def test_self_lint_baseline_is_small_and_honest():

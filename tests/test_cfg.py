@@ -1,0 +1,279 @@
+"""CFG + dataflow engine unit tests (glom_tpu.analysis.cfg).
+
+The edge cases here are the ones that make path-sensitive rules honest:
+``finally`` with ``return`` (the finally's return overrides the pending
+continuation), ``break`` out of a ``with`` (no implicit finally in the
+way), bare ``raise`` re-raise (reaches the function's exceptional
+exit), ``while True`` (no false edge — code after is only reachable via
+break), and exception edges feeding handlers so loop-carried facts
+propagate around back edges.
+
+Pure AST — no accelerator, no model import, fast.
+"""
+
+import ast
+import os
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO) if REPO not in sys.path else None
+
+from glom_tpu.analysis.cfg import (  # noqa: E402
+    build_cfg, may_raise, solve_forward, witness_path,
+)
+
+
+def cfg_of(source):
+    # lstrip the leading newline so `def` sits on line 1 and the line
+    # numbers in the tests read off the snippet directly
+    tree = ast.parse(textwrap.dedent(source).lstrip("\n"))
+    fn = tree.body[0]
+    return build_cfg(fn)
+
+
+def nodes_at(cfg, lineno):
+    return [n for n in cfg.nodes if n.lineno == lineno]
+
+
+def succ_set(node):
+    return {(s.index, k) for s, k in node.succs}
+
+
+# -- structural edge cases -------------------------------------------------
+
+def test_finally_with_return_overrides_pending_return():
+    cfg = cfg_of("""
+        def f():
+            try:
+                return 1
+            finally:
+                return 2
+    """)
+    (ret1,) = nodes_at(cfg, 3)
+    ret2s = nodes_at(cfg, 5)
+    # the pending `return 1` routes into a finally landing pad, NOT
+    # straight to exit
+    assert all(s is not cfg.exit for s, _ in ret1.succs), ret1.succs
+    assert any(s.kind == "finally" for s, _ in ret1.succs)
+    # the finally's own `return 2` reaches exit; every exit pred is a
+    # line-5 node (return 1 never completes)
+    assert any(s is cfg.exit for r2 in ret2s for s, _ in r2.succs)
+    assert {p.lineno for p, _ in cfg.exit.preds} == {5}
+
+
+def test_finally_runs_on_normal_and_exception_paths():
+    cfg = cfg_of("""
+        def f(work, gate):
+            gate.clear()
+            try:
+                work()
+            finally:
+                gate.set()
+    """)
+    # both the raise continuation and the normal one get their own copy
+    # of the finally body: two distinct line-6 nodes
+    sets = nodes_at(cfg, 6)
+    assert len(sets) == 2
+    # the raise-path copy flows to raise_exit, the normal copy to exit
+    succs = {s for n in sets for s, _ in n.succs}
+    assert cfg.exit in succs and cfg.raise_exit in succs
+
+
+def test_break_out_of_with_reaches_loop_exit():
+    cfg = cfg_of("""
+        def f(xs, lock):
+            for x in xs:
+                with lock:
+                    if x:
+                        break
+            return 0
+    """)
+    (brk,) = nodes_at(cfg, 5)
+    (ret,) = nodes_at(cfg, 6)
+    assert any(s is ret for s, _ in brk.succs), brk.succs
+
+
+def test_bare_raise_reraise_reaches_raise_exit():
+    cfg = cfg_of("""
+        def f(g):
+            try:
+                g()
+            except ValueError:
+                raise
+    """)
+    (reraise,) = nodes_at(cfg, 5)
+    assert any(s is cfg.raise_exit for s, _ in reraise.succs)
+    # a ValueError-only handler does not catch everything: the dispatch
+    # also falls through to raise_exit
+    dispatch = [n for n in cfg.nodes if n.kind == "dispatch"]
+    assert dispatch and any(
+        s is cfg.raise_exit for s, _ in dispatch[0].succs)
+
+
+def test_broad_handler_has_no_dispatch_fallthrough():
+    cfg = cfg_of("""
+        def f(g):
+            try:
+                g()
+            except Exception as e:
+                log(e)
+    """)
+    (dispatch,) = [n for n in cfg.nodes if n.kind == "dispatch"]
+    assert all(s is not cfg.raise_exit for s, _ in dispatch.succs)
+
+
+def test_while_true_has_no_false_edge():
+    cfg = cfg_of("""
+        def f(q):
+            while True:
+                item = q.get()
+                if item is None:
+                    break
+            return 1
+    """)
+    (head,) = nodes_at(cfg, 2)
+    assert all(k != "false" for _, k in head.succs)
+    # `return 1` is reachable only through the break
+    (ret,) = nodes_at(cfg, 6)
+    assert {p.lineno for p, _ in ret.preds} == {5}
+
+
+def test_while_else_runs_only_on_normal_exit():
+    cfg = cfg_of("""
+        def f(n, g):
+            while n:
+                if g():
+                    break
+            else:
+                n = 0
+            return n
+    """)
+    (els,) = nodes_at(cfg, 6)
+    # the else body is entered from the loop head's false edge only
+    assert all(k == "false" for _, k in els.preds)
+
+
+def test_return_value_evaluation_gets_exception_edge():
+    cfg = cfg_of("""
+        def f(g):
+            try:
+                return g()
+            except RuntimeError:
+                return None
+    """)
+    (ret,) = nodes_at(cfg, 3)
+    dispatch = [n for n in cfg.nodes if n.kind == "dispatch"]
+    assert dispatch and any(s is dispatch[0] for s, _ in ret.succs)
+
+
+def test_module_body_cfg_builds():
+    tree = ast.parse("x = setup()\nteardown(x)\n")
+    cfg = build_cfg(tree.body)
+    assert len(cfg.stmt_nodes()) == 2
+    assert cfg.exit.preds  # falls off the end
+
+
+def test_may_raise_is_header_only():
+    stmt = ast.parse("if check():\n    pass\n").body[0]
+    assert may_raise(stmt)  # the test calls
+    stmt = ast.parse("if flag:\n    boom()\n").body[0]
+    assert not may_raise(stmt)  # the call is in the body, not the header
+    stmt = ast.parse("cb = lambda: boom()\n").body[0]
+    assert not may_raise(stmt)  # a lambda body does not execute here
+
+
+# -- the solver ------------------------------------------------------------
+
+def _event_transfer(cfg, gen_lines, kill_lines, fact="f"):
+    gen = set(gen_lines)
+    kill = set(kill_lines)
+
+    def transfer(node, state):
+        if node.lineno in kill:
+            state = state - {fact}
+        if node.lineno in gen:
+            state = state | {fact}
+        return state
+    return transfer
+
+
+def test_solver_may_carries_fact_around_loop_back_edge():
+    cfg = cfg_of("""
+        def f(p, b):
+            t = clean(p)
+            for _ in range(2):
+                try:
+                    use(t)
+                except RuntimeError:
+                    t = taint(p)
+    """)
+    # fact generated at line 7 (the handler) must reach line 5's input
+    # via the loop back edge
+    transfer = _event_transfer(cfg, gen_lines=[7], kill_lines=[])
+    results = solve_forward(cfg, transfer, may=True)
+    (use,) = nodes_at(cfg, 5)
+    assert "f" in results[use][0]
+
+
+def test_solver_must_intersects_paths():
+    cfg = cfg_of("""
+        def f(cond):
+            if cond:
+                barrier()
+            action()
+    """)
+    transfer = _event_transfer(cfg, gen_lines=[3], kill_lines=[])
+    results = solve_forward(cfg, transfer, may=False)
+    (action,) = nodes_at(cfg, 4)
+    assert "f" not in results[action][0]  # only SOME paths passed it
+    cfg2 = cfg_of("""
+        def f(cond):
+            barrier()
+            action()
+    """)
+    transfer2 = _event_transfer(cfg2, gen_lines=[2], kill_lines=[])
+    results2 = solve_forward(cfg2, transfer2, may=False)
+    (action2,) = nodes_at(cfg2, 3)
+    assert "f" in results2[action2][0]
+
+
+def test_solver_exc_transfer_splits_edge_states():
+    cfg = cfg_of("""
+        def f(gate, work):
+            gate.clear()
+            work()
+            gate.set()
+    """)
+    transfer = _event_transfer(cfg, gen_lines=[2], kill_lines=[4])
+
+    def exc_transfer(node, state):
+        # the acquiring line's own exception edge: nothing acquired
+        if node.lineno == 2:
+            return state - {"f"}
+        return transfer(node, state)
+
+    results = solve_forward(cfg, transfer, may=True,
+                            exc_transfer=exc_transfer)
+    # the fact escapes to raise_exit only via line 3's exception edge
+    # (line 2's own raise carries nothing, line 4 releases)
+    assert "f" in results[cfg.raise_exit][0]
+    (work,) = nodes_at(cfg, 3)
+    path = witness_path(cfg, results, "f", nodes_at(cfg, 2)[0],
+                        cfg.raise_exit)
+    assert work in path
+    # and the normal exit is clean
+    assert "f" not in results[cfg.exit][0]
+
+
+def test_unreachable_code_contributes_no_facts():
+    cfg = cfg_of("""
+        def f():
+            return 1
+            leak()
+    """)
+    transfer = _event_transfer(cfg, gen_lines=[3], kill_lines=[])
+    results = solve_forward(cfg, transfer, may=True)
+    (dead,) = nodes_at(cfg, 3)
+    assert dead not in results
+    assert "f" not in results[cfg.exit][0]

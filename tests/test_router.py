@@ -57,6 +57,7 @@ class FakeReplica:
         self.prev = None
         self.up = True            # connection-level: down => URLError
         self.fail_prepare = False
+        self.malformed_prepare = False  # staged OK, reply corrupted
         self.fail_commit = False
         self.requests = []        # (endpoint, headers) per proxied request
         self.attempts = 0         # every connection attempt, up or not
@@ -93,6 +94,11 @@ class FakeReplica:
                 self.staged = None
                 return 200, {"staged_step": None, "serving_step": self.step}
             self.staged = int(step)
+            if self.malformed_prepare:
+                # the engine staged for real, but the reply is garbage
+                # (torn proxy, corrupted JSON field)
+                return 200, {"staged_step": "garbage",
+                             "serving_step": self.step}
             return 200, {"staged_step": self.staged,
                          "serving_step": self.step}
         if path == "/admin/reload/commit":
@@ -438,6 +444,26 @@ class TestCoordinatedRollout:
         # success on the engine side)
         assert all(r.staged is None for r in fleet.replicas.values())
         assert "abort" in bad.admin_calls
+
+    def test_malformed_prepare_response_aborts_all_staged(self):
+        """A replica answering prepare with a non-numeric staged_step
+        raises during router-side validation (int()).  The prepare phase
+        must abort every staged tree — the already-prepared replicas AND
+        the mid-validation one, whose engine staged for real before the
+        reply went bad — then propagate (the rollout poll loop counts
+        it).  Found by glomlint's proto-paired-call rule in ISSUE 13."""
+        fleet = FakeFleet(3, step=1)
+        router, _ = _router(fleet)
+        for r in fleet.replicas.values():
+            r.available = 9
+        bad = list(fleet.replicas.values())[1]
+        bad.malformed_prepare = True
+        with pytest.raises(ValueError):
+            router.coordinated_reload(step=9)
+        assert all(r.staged is None for r in fleet.replicas.values())
+        assert "abort" in bad.admin_calls
+        # nothing committed, nothing served new
+        assert [r.step for r in fleet.replicas.values()] == [1, 1, 1]
 
     def test_pinned_step_rollout(self):
         fleet = FakeFleet(2, step=3)

@@ -2,6 +2,8 @@
 
   python tools/lint.py                         # lint glom_tpu/ + tools/
   python tools/lint.py --format json           # machine output (CI)
+  python tools/lint.py --format sarif          # SARIF 2.1.0 (CI artifact)
+  python tools/lint.py --diff HEAD             # pre-commit fast gate
   python tools/lint.py --rule conc-broad-except glom_tpu/serving
   python tools/lint.py --write-baseline        # absorb current findings
   python tools/lint.py --stats                 # Prometheus gauges
@@ -15,6 +17,13 @@ ignored AND reported.  ``--stats`` renders per-rule
 exposition format ``glom_tpu/obs/exporters.py`` emits, so lint debt is
 trackable like any other metric (point a textfile collector at
 ``--stats-file``).
+
+``--diff <base-ref>`` is the pre-commit split: the FULL tree is still
+analyzed (whole-program rules — lock graphs, the sharding axis
+vocabulary — need every file), but only findings in files changed since
+``base-ref`` (plus untracked files) gate the exit code; everything else
+is reported as out-of-diff.  CI runs the full gate; ``--diff HEAD`` is
+the fast local loop.
 
 The engine is stdlib-``ast`` only: no accelerator, no model import, safe
 for CI and the tier-1 suite.
@@ -109,12 +118,111 @@ def stats_lines(by_rule, baselined: int, suppressed: int) -> str:
     return "\n".join(lines) + "\n"
 
 
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA_URI = "https://json.schemastore.org/sarif-2.1.0.json"
+
+
+def sarif_payload(rules, new, baselined, root: str) -> dict:
+    """SARIF 2.1.0 log: one run, every rule as a reportingDescriptor,
+    gating findings as ``baselineState: "new"`` and baseline-absorbed
+    ones as ``"unchanged"`` (so a SARIF viewer shows the same split the
+    exit code enforces)."""
+    rule_list = sorted(rules, key=lambda r: r.name)
+    rule_index = {r.name: i for i, r in enumerate(rule_list)}
+
+    def result(f, state: str) -> dict:
+        res = {
+            "ruleId": f.rule,
+            "level": "error" if f.severity == "error" else "warning",
+            "message": {"text": f.message},
+            "baselineState": state,
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(1, f.line),
+                               "startColumn": f.col + 1},
+                },
+            }],
+            "partialFingerprints": {
+                # the baseline key: stable under pure line-number drift
+                "glomlintFingerprint/v1": f"{f.rule}:{f.path}:{f.code}",
+            },
+        }
+        if f.rule in rule_index:
+            res["ruleIndex"] = rule_index[f.rule]
+        if f.code:
+            loc = res["locations"][0]["physicalLocation"]
+            loc["region"]["snippet"] = {"text": f.code}
+        return res
+
+    root_uri = "file://" + os.path.abspath(root).replace(os.sep, "/")
+    return {
+        "$schema": SARIF_SCHEMA_URI,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "glomlint",
+                "informationUri":
+                    "https://github.com/glom-tpu/glom-tpu/blob/main/"
+                    "docs/ANALYSIS.md",
+                "rules": [{
+                    "id": r.name,
+                    "shortDescription": {"text": r.description
+                                         or r.name},
+                    "defaultConfiguration": {
+                        "level": "error" if r.severity == "error"
+                        else "warning"},
+                } for r in rule_list],
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": root_uri + "/"}},
+            "columnKind": "utf16CodeUnits",
+            "results": ([result(f, "new") for f in new]
+                        + [result(f, "unchanged") for f in baselined]),
+        }],
+    }
+
+
+def changed_files(base_ref: str, root: str):
+    """Root-relative POSIX paths of .py files changed since ``base_ref``
+    plus untracked ones — the set a ``--diff`` run gates on.  Returns
+    None (a usage error) when git can't answer."""
+    import subprocess
+
+    out = set()
+    # --relative makes git diff print paths relative to cwd (= root),
+    # matching the root-relative finding paths even when root is a
+    # subdirectory of the git toplevel (ls-files is cwd-relative already)
+    for args in (["git", "diff", "--name-only", "--diff-filter=d",
+                  "--relative", base_ref, "--", "*.py"],
+                 ["git", "ls-files", "--others", "--exclude-standard",
+                  "--", "*.py"]):
+        proc = subprocess.run(args, cwd=root, capture_output=True,
+                              text=True, timeout=60)
+        if proc.returncode != 0:
+            print(f"lint.py: {' '.join(args)} failed: "
+                  f"{proc.stderr.strip()}", file=sys.stderr)
+            return None
+        out.update(line.strip().replace(os.sep, "/")
+                   for line in proc.stdout.splitlines() if line.strip())
+    return out
+
+
 def run(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="lint.py", description="glomlint: project static analysis")
     ap.add_argument("paths", nargs="*", default=None,
                     help=f"files/dirs to lint (default: {DEFAULT_PATHS})")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
+    ap.add_argument("--diff", metavar="BASE_REF", default=None,
+                    help="gate only findings in files changed since this "
+                         "git ref (whole-program analysis still runs "
+                         "over everything)")
+    ap.add_argument("--sarif-file", default=None,
+                    help="also write SARIF 2.1.0 output to this file "
+                         "(atomic; lets CI emit json + sarif from ONE "
+                         "analysis pass)")
     ap.add_argument("--baseline", default=None,
                     help=f"baseline JSON (default {DEFAULT_BASELINE}; "
                          f"'none' disables)")
@@ -164,11 +272,11 @@ def run(argv=None) -> int:
         if not use_baseline:
             print("--write-baseline needs a baseline path", file=sys.stderr)
             return 2
-        if args.rule or args.paths:
+        if args.rule or args.paths or args.diff:
             # a filtered run sees only a slice of the findings; writing it
             # out would silently drop every other baseline entry
             print("--write-baseline requires a full run (no --rule, no "
-                  "explicit paths)", file=sys.stderr)
+                  "explicit paths, no --diff)", file=sys.stderr)
             return 2
         write_baseline(baseline_path, result.findings)
         print(f"baseline: wrote {len(result.findings)} finding(s) to "
@@ -177,6 +285,15 @@ def run(argv=None) -> int:
 
     budget = load_baseline(baseline_path) if use_baseline else {}
     new, baselined = split_baseline(result.findings, budget)
+
+    out_of_diff = []
+    if args.diff is not None:
+        changed = changed_files(args.diff, args.root)
+        if changed is None:
+            return 2
+        gated = [f for f in new if f.path in changed]
+        out_of_diff = [f for f in new if f.path not in changed]
+        new = gated
 
     by_rule_all = result.by_rule()
     summary = {
@@ -190,13 +307,22 @@ def run(argv=None) -> int:
         "new_by_rule": _count_by_rule(new),
         "status": "ok" if not new else "failing",
     }
+    if args.diff is not None:
+        summary["diff_base"] = args.diff
+        summary["out_of_diff"] = len(out_of_diff)
 
     if args.format == "json":
-        print(json.dumps({
+        payload = {
             "summary": summary,
             "findings": [f.to_dict() for f in new],
             "baselined": [f.to_dict() for f in baselined],
-        }, indent=2))
+        }
+        if args.diff is not None:
+            payload["out_of_diff"] = [f.to_dict() for f in out_of_diff]
+        print(json.dumps(payload, indent=2))
+    elif args.format == "sarif":
+        print(json.dumps(sarif_payload(rules, new, baselined, args.root),
+                         indent=2))
     else:
         for f in new:
             print(f"{f.location}: {f.rule} [{f.severity}] {f.message}")
@@ -205,8 +331,20 @@ def run(argv=None) -> int:
         print(f"glomlint: {result.files} files, {len(new)} new finding(s), "
               f"{len(baselined)} baselined, {len(result.suppressed)} "
               f"suppressed")
+        if args.diff is not None:
+            print(f"  (--diff {args.diff}: gating only changed files; "
+                  f"{len(out_of_diff)} out-of-diff finding(s) not gated "
+                  f"— the full CI run gates those)")
         for rule, count in summary["new_by_rule"].items():
             print(f"  {rule}: {count}")
+
+    if args.sarif_file:
+        tmp = args.sarif_file + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(sarif_payload(rules, new, baselined, args.root),
+                      fh, indent=2)
+            fh.write("\n")
+        os.replace(tmp, args.sarif_file)
 
     if args.stats or args.stats_file:
         text = stats_lines(by_rule_all, len(baselined),
