@@ -11,11 +11,12 @@ from glom_tpu.analysis.rules_concurrency import CONCURRENCY_RULES
 from glom_tpu.analysis.rules_jax import JAX_RULES
 from glom_tpu.analysis.rules_obs import OBS_RULES
 from glom_tpu.analysis.rules_paths import PATH_RULES
+from glom_tpu.analysis.rules_races import RACE_RULES
 from glom_tpu.analysis.rules_sharding import SHARDING_RULES
 
 ALL_RULE_CLASSES = (tuple(JAX_RULES) + tuple(CONCURRENCY_RULES)
                     + tuple(OBS_RULES) + tuple(PATH_RULES)
-                    + tuple(SHARDING_RULES))
+                    + tuple(SHARDING_RULES) + tuple(RACE_RULES))
 
 
 def default_rules(names=None):

@@ -1,0 +1,422 @@
+"""glomlint interprocedural layer — class-aware call graph + thread roots.
+
+The v1/v2 packs reason about one function (CFG dataflow) or one class
+(the lock-order graph).  The race findings PRs 7-10 kept catching by
+hand are *cross-thread* bugs: a read on the request path racing a write
+on the watcher thread, a helper splitting a caller's critical section.
+Seeing those requires knowing **which threads can execute which code** —
+this module supplies that:
+
+  * :class:`CallGraphBuilder` / :class:`CallGraph` — a whole-program,
+    class-aware call graph over every analyzed module.  Scopes are
+    methods, module functions, and the nested functions/lambdas defined
+    inside them (a closure handed to ``Thread(target=...)`` is its own
+    scope, with its calls resolved against the enclosing class).  Edges
+    resolve ``self.m()`` within the class (including same-module base
+    classes), bare names to nested functions then module functions —
+    the resolution the lock-order rule already trusts, factored out and
+    made program-wide.
+  * **Thread-root discovery** — the places a new thread of control
+    enters the code: ``Thread(target=...)`` / ``Timer(...)`` sites,
+    ``executor.submit(fn)``, callback registrations (``callback=`` /
+    ``on_*=`` keyword arguments taking a method reference), and the
+    ``do_*``/``handle`` methods of ``*RequestHandler`` subclasses
+    (every ``ThreadingHTTPServer`` request is its own thread).  Each
+    public method additionally carries an *external* root: the caller's
+    thread is a thread too — the race partner most analyses forget.
+  * **Root propagation** — roots flow along call edges to a fixpoint,
+    so every method is annotated with the set of thread roots that can
+    reach it (:meth:`CallGraph.roots`).  A method reachable from two
+    distinct roots can race with itself across threads; a root marked
+    ``concurrent_with_self`` (thread started in a loop, executor pools,
+    HTTP handlers) races with itself outright.
+
+``__init__``/``__new__``/``__del__`` are excluded from root annotation:
+constructor accesses happen before the object is published to any other
+thread, and flagging them would bury the real findings.  (The known
+blind spot — code *after* a ``start()`` inside ``__init__`` — is
+accepted for the precision.)
+
+Stdlib-``ast`` only, like the rest of the engine.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from glom_tpu.analysis.cfg import header_exprs as _stmt_exprs
+from glom_tpu.analysis.engine import (
+    ModuleContext, child_blocks as _child_blocks, dotted_name,
+    is_self_attr, terminal_name,
+)
+
+__all__ = ["ThreadRoot", "Scope", "ClassInfo", "CallGraph",
+           "CallGraphBuilder", "MODULE_SCOPE", "ROOT_EXCLUDED_METHODS"]
+
+#: pseudo-class owner key suffix for module-level functions
+MODULE_SCOPE = "<module>"
+
+#: methods that run before/after the object is shared across threads
+ROOT_EXCLUDED_METHODS = {"__init__", "__new__", "__del__"}
+
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+_TIMER_CTORS = {"threading.Timer", "Timer"}
+_HANDLER_BASE_MARKER = "RequestHandler"
+_HANDLER_METHODS_EXACT = {"handle", "handle_one_request"}
+_CALLBACK_KWARGS = {"callback", "target", "function"}
+
+
+@dataclasses.dataclass(frozen=True)
+class ThreadRoot:
+    """One source of a thread of control.  ``key`` is the identity the
+    race rules count distinct roots by; ``concurrent_with_self`` marks
+    roots that can run two instances at once (executor pools, HTTP
+    handler threads, a Thread started inside a loop)."""
+
+    kind: str                       # thread|timer|executor|callback|http-handler|external
+    key: str
+    path: str
+    line: int
+    concurrent_with_self: bool = False
+
+    def describe(self) -> str:
+        return f"{self.kind} @{self.path}:{self.line}"
+
+
+@dataclasses.dataclass
+class Scope:
+    """One unit of executable code: a method, a module function, or a
+    nested function/lambda inside one (``name`` is dotted for nested
+    scopes: ``"shutdown.drain"``)."""
+
+    owner: str                      # "relpath::Class" or "relpath::<module>"
+    name: str
+    node: ast.AST                   # FunctionDef / AsyncFunctionDef / Lambda
+    relpath: str
+    #: resolved same-class / same-module call targets, with the line
+    calls: List[Tuple[str, int]] = dataclasses.field(default_factory=list)
+    #: unresolved thread-root sites found lexically in this scope:
+    #: (kind, ref, line, in_loop) where ref is ("self", name) |
+    #: ("name", name) | ("lambda", Lambda node)
+    root_sites: List[Tuple[str, tuple, int, bool]] = dataclasses.field(
+        default_factory=list)
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.owner, self.name)
+
+    @property
+    def method_name(self) -> str:
+        """The directly-invocable method this scope belongs to (the head
+        of the dotted name)."""
+        return self.name.split(".", 1)[0]
+
+    @property
+    def is_public(self) -> bool:
+        head = self.method_name
+        if head in ROOT_EXCLUDED_METHODS:
+            return False
+        if "." in self.name:
+            return False            # a closure is not an entry point
+        return (not head.startswith("_")) or (
+            head.startswith("__") and head.endswith("__"))
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    key: str                        # "relpath::Name"
+    name: str
+    relpath: str
+    node: ast.ClassDef
+    bases: Tuple[str, ...]
+    scopes: Dict[str, Scope] = dataclasses.field(default_factory=dict)
+
+    @property
+    def is_request_handler(self) -> bool:
+        return any(_HANDLER_BASE_MARKER in b for b in self.bases)
+
+
+class _ScopeCollector:
+    """Walk ONE scope's statements (never descending into nested
+    function/class bodies — those are their own scopes) collecting call
+    edges and thread-root sites."""
+
+    def __init__(self, scope: Scope, in_class: bool):
+        self.scope = scope
+        self.in_class = in_class
+        self._loop_depth = 0
+
+    def run(self) -> List[Tuple[str, ast.AST]]:
+        """Returns nested (name, FunctionDef|Lambda) scopes found."""
+        self.nested: List[Tuple[str, ast.AST]] = []
+        node = self.scope.node
+        if isinstance(node, ast.Lambda):
+            self._expr(node.body)
+        else:
+            self._block(node.body)
+        return self.nested
+
+    def _block(self, body: Sequence[ast.stmt]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.nested.append((stmt.name, stmt))
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                continue            # collected separately by the builder
+            for expr in _stmt_exprs(stmt):
+                self._expr(expr)
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                # only the loop BODY repeats; the else block runs once
+                self._loop_depth += 1
+                self._block(stmt.body)
+                self._loop_depth -= 1
+                self._block(stmt.orelse)
+                continue
+            for inner in _child_blocks(stmt):
+                self._block(inner)
+
+    def _expr(self, node: ast.AST) -> None:
+        for sub in _walk_exprs(node):
+            if isinstance(sub, ast.Lambda):
+                self.nested.append((f"<lambda@{sub.lineno}>", sub))
+                continue
+            if not isinstance(sub, ast.Call):
+                continue
+            self._call(sub)
+
+    def _call(self, call: ast.Call) -> None:
+        in_loop = self._loop_depth > 0
+        callee_dotted = dotted_name(call.func)
+        # call edges: self.m() in a class, bare f() anywhere
+        if self.in_class:
+            attr = is_self_attr(call.func)
+            if attr:
+                self.scope.calls.append((attr, call.lineno))
+        if isinstance(call.func, ast.Name):
+            self.scope.calls.append((call.func.id, call.lineno))
+        # thread-root sites
+        if callee_dotted in _THREAD_CTORS:
+            ref = _callable_ref(_kwarg(call, "target"))
+            if ref:
+                self.scope.root_sites.append(("thread", ref, call.lineno,
+                                              in_loop))
+            return
+        if callee_dotted in _TIMER_CTORS:
+            fn = _kwarg(call, "function")
+            if fn is None and len(call.args) >= 2:
+                fn = call.args[1]
+            ref = _callable_ref(fn)
+            if ref:
+                self.scope.root_sites.append(("timer", ref, call.lineno,
+                                              in_loop))
+            return
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr == "submit" and call.args):
+            ref = _callable_ref(call.args[0])
+            if ref:
+                self.scope.root_sites.append(("executor", ref, call.lineno,
+                                              True))
+            return
+        for kw in call.keywords:
+            if kw.arg and (kw.arg in _CALLBACK_KWARGS
+                           or kw.arg.startswith("on_")):
+                ref = _callable_ref(kw.value)
+                if ref:
+                    self.scope.root_sites.append(
+                        ("callback", ref, call.lineno, in_loop))
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _callable_ref(node: Optional[ast.AST]) -> Optional[tuple]:
+    if node is None:
+        return None
+    attr = is_self_attr(node)
+    if attr:
+        return ("self", attr)
+    if isinstance(node, ast.Name):
+        return ("name", node.id)
+    if isinstance(node, ast.Lambda):
+        return ("lambda", node)
+    return None
+
+
+def _walk_exprs(node: ast.AST):
+    """ast.walk that stops at nested scope boundaries (their bodies are
+    separate scopes) but yields the boundary node itself."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+class CallGraph:
+    """The built graph: scopes, resolved edges, and per-scope thread-root
+    annotations (:meth:`roots`)."""
+
+    def __init__(self) -> None:
+        self.classes: Dict[str, ClassInfo] = {}
+        self.scopes: Dict[Tuple[str, str], Scope] = {}
+        self.edges: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        self.root_methods: Dict[Tuple[str, str], Set[ThreadRoot]] = {}
+        self._roots_of: Dict[Tuple[str, str], frozenset] = {}
+
+    def roots(self, key: Tuple[str, str]) -> frozenset:
+        """Thread roots that can reach this scope (fixpoint-propagated).
+        Empty frozenset for unknown/unreached scopes."""
+        return self._roots_of.get(key, frozenset())
+
+    def class_roots(self, cls_key: str) -> Set[ThreadRoot]:
+        """Union of roots over all of a class's scopes."""
+        out: Set[ThreadRoot] = set()
+        for name in self.classes.get(cls_key, ClassInfo(
+                cls_key, "", "", None, ())).scopes:
+            out |= self.roots((cls_key, name))
+        return out
+
+
+class CallGraphBuilder:
+    """Feed :meth:`add_module` every :class:`ModuleContext`, then
+    :meth:`build` once — the whole-program pass."""
+
+    def __init__(self) -> None:
+        self.graph = CallGraph()
+        #: relpath -> module owner key
+        self._module_owner: Dict[str, str] = {}
+
+    # -- collection --------------------------------------------------------
+
+    def add_module(self, ctx: ModuleContext) -> None:
+        if ctx.tree is None:
+            return
+        owner = f"{ctx.relpath}::{MODULE_SCOPE}"
+        self._module_owner[ctx.relpath] = owner
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_scope(owner, node.name, node, ctx.relpath,
+                                in_class=False)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                self._add_class(node, ctx.relpath)
+
+    def _add_class(self, cls: ast.ClassDef, relpath: str) -> None:
+        key = f"{relpath}::{cls.name}"
+        bases = tuple(b for b in (terminal_name(base)
+                                  for base in cls.bases) if b)
+        info = ClassInfo(key=key, name=cls.name, relpath=relpath,
+                         node=cls, bases=bases)
+        self.graph.classes[key] = info
+        for method in cls.body:
+            if isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_scope(key, method.name, method, relpath,
+                                in_class=True, cls_info=info)
+
+    def _add_scope(self, owner: str, name: str, node: ast.AST,
+                   relpath: str, *, in_class: bool,
+                   cls_info: Optional[ClassInfo] = None) -> None:
+        scope = Scope(owner=owner, name=name, node=node, relpath=relpath)
+        self.graph.scopes[scope.key] = scope
+        if cls_info is not None:
+            cls_info.scopes[name] = scope
+        for sub_name, sub_node in _ScopeCollector(scope, in_class).run():
+            self._add_scope(owner, f"{name}.{sub_name}", sub_node, relpath,
+                            in_class=in_class, cls_info=cls_info)
+
+    # -- resolution --------------------------------------------------------
+
+    def _resolve(self, scope: Scope, ref_name: str
+                 ) -> Optional[Tuple[str, str]]:
+        """A called/targeted name, resolved: nested scope of this method
+        first, then a sibling scope of the owner (method of the class /
+        function of the module), then same-module base-class methods."""
+        nested = (scope.owner, f"{scope.name}.{ref_name}")
+        if nested in self.graph.scopes:
+            return nested
+        sibling = (scope.owner, ref_name)
+        if sibling in self.graph.scopes:
+            return sibling
+        cls = self.graph.classes.get(scope.owner)
+        if cls is not None:
+            for base in cls.bases:
+                base_key = (f"{cls.relpath}::{base}", ref_name)
+                if base_key in self.graph.scopes:
+                    return base_key
+        mod_owner = self._module_owner.get(scope.relpath)
+        if mod_owner is not None and mod_owner != scope.owner:
+            mod_key = (mod_owner, ref_name)
+            if mod_key in self.graph.scopes:
+                return mod_key
+        return None
+
+    def build(self) -> CallGraph:
+        g = self.graph
+        # call edges + discovered roots
+        for scope in g.scopes.values():
+            targets = g.edges.setdefault(scope.key, set())
+            for callee, _line in scope.calls:
+                resolved = self._resolve(scope, callee)
+                if resolved is not None and resolved != scope.key:
+                    targets.add(resolved)
+            for kind, ref, line, in_loop in scope.root_sites:
+                if ref[0] == "lambda":
+                    # the lambda was registered as a nested scope
+                    resolved = self._resolve(scope,
+                                             f"<lambda@{ref[1].lineno}>")
+                else:
+                    resolved = self._resolve(scope, ref[1])
+                if resolved is None:
+                    continue
+                root = ThreadRoot(
+                    kind=kind,
+                    key=f"{kind}:{resolved[0]}.{resolved[1]}",
+                    path=scope.relpath, line=line,
+                    concurrent_with_self=in_loop or kind == "executor")
+                g.root_methods.setdefault(resolved, set()).add(root)
+        # HTTP request-handler methods: one (self-concurrent) root per
+        # handler class — every request runs on its own server thread
+        for cls in g.classes.values():
+            if not cls.is_request_handler:
+                continue
+            for name, scope in cls.scopes.items():
+                head = scope.method_name
+                if head.startswith("do_") or head in _HANDLER_METHODS_EXACT:
+                    root = ThreadRoot(
+                        kind="http-handler", key=f"http-handler:{cls.key}",
+                        path=cls.relpath, line=scope.node.lineno
+                        if hasattr(scope.node, "lineno") else 1,
+                        concurrent_with_self=True)
+                    g.root_methods.setdefault(scope.key, set()).add(root)
+        # the external root: public entry points run on the caller's
+        # thread — the race partner of every background loop
+        for scope in g.scopes.values():
+            if scope.is_public:
+                root = ThreadRoot(kind="external",
+                                  key=f"external:{scope.owner}",
+                                  path=scope.relpath,
+                                  line=getattr(scope.node, "lineno", 1))
+                g.root_methods.setdefault(scope.key, set()).add(root)
+        # fixpoint: roots flow along call edges
+        roots_of: Dict[Tuple[str, str], Set[ThreadRoot]] = {
+            k: set(v) for k, v in g.root_methods.items()}
+        work = list(roots_of)
+        while work:
+            key = work.pop()
+            src = roots_of.get(key, set())
+            for callee in g.edges.get(key, ()):
+                dst = roots_of.setdefault(callee, set())
+                if not src <= dst:
+                    dst |= src
+                    work.append(callee)
+        g._roots_of = {k: frozenset(v) for k, v in roots_of.items()}
+        return g

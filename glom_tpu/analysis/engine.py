@@ -23,8 +23,10 @@ module makes those reviews machine-checked:
 
 The engine is stdlib-only (``ast``): it runs identically on a laptop, in
 CI, and in the tier-1 suite with no accelerator and no jax import.  Rule
-packs live in :mod:`glom_tpu.analysis.rules_jax` and
-:mod:`glom_tpu.analysis.rules_concurrency`; ``tools/lint.py`` is the CLI.
+packs live in ``rules_jax`` / ``rules_concurrency`` / ``rules_obs`` /
+``rules_paths`` / ``rules_sharding`` / ``rules_races`` (the last on the
+:mod:`glom_tpu.analysis.callgraph` thread-root model);
+``tools/lint.py`` is the CLI.
 """
 
 from __future__ import annotations
@@ -155,6 +157,20 @@ class _BadSuppressionRule(Rule):
 _BAD_SUPPRESSION = _BadSuppressionRule()
 
 
+def _matching_suppression(ctx: ModuleContext, f: Finding):
+    """The suppression entry covering this finding's line, if any: a
+    same-line disable, or a standalone disable on the line above."""
+    ent_here = ctx.suppressions.get(f.line)
+    if ent_here is not None and (f.rule in ent_here[0]
+                                 or "all" in ent_here[0]):
+        return ent_here
+    ent_above = ctx.suppressions.get(f.line - 1)
+    if (ent_above is not None and ent_above[2]
+            and (f.rule in ent_above[0] or "all" in ent_above[0])):
+        return ent_above
+    return None
+
+
 def apply_suppressions(ctx: ModuleContext,
                        findings: List[Finding]) -> Tuple[List[Finding],
                                                          List[Finding]]:
@@ -163,15 +179,7 @@ def apply_suppressions(ctx: ModuleContext,
     kept: List[Finding] = []
     suppressed: List[Finding] = []
     for f in findings:
-        entry = None
-        ent_here = ctx.suppressions.get(f.line)
-        if ent_here is not None and (f.rule in ent_here[0] or "all" in ent_here[0]):
-            entry = ent_here
-        else:
-            ent_above = ctx.suppressions.get(f.line - 1)
-            if (ent_above is not None and ent_above[2]
-                    and (f.rule in ent_above[0] or "all" in ent_above[0])):
-                entry = ent_above
+        entry = _matching_suppression(ctx, f)
         if entry is not None and entry[1]:
             suppressed.append(f)
         else:
@@ -246,12 +254,16 @@ class AnalysisResult:
 def analyze(paths: Sequence[str], rules: Sequence[Rule],
             root: Optional[str] = None) -> AnalysisResult:
     """Dispatch every ``.py`` under ``paths`` through every rule, apply
-    suppressions, then collect whole-program ``finalize()`` findings
-    (which are suppression-exempt: a graph cycle has no single line to
-    carry the comment — baseline those instead)."""
+    suppressions, then collect whole-program ``finalize()`` findings.
+    Finalize findings that land on a concrete line of an analyzed file
+    honor that line's inline suppressions too (the race pack's findings
+    are per-access, so a reasoned disable must work there exactly like a
+    per-file finding); reasonless disables were already reported in the
+    per-file pass and are not re-reported here."""
     root = os.path.abspath(root or os.getcwd())
     findings: List[Finding] = []
     suppressed: List[Finding] = []
+    ctxs: Dict[str, ModuleContext] = {}
     files = 0
     for path in iter_py_files(paths):
         files += 1
@@ -274,6 +286,7 @@ def analyze(paths: Sequence[str], rules: Sequence[Rule],
                 message=f"syntax error: {ctx.parse_error.msg}",
                 code=ctx.source_line(ctx.parse_error.lineno or 1)))
             continue
+        ctxs[ctx.relpath] = ctx
         file_findings: List[Finding] = []
         for rule in rules:
             file_findings.extend(rule.check(ctx))
@@ -281,7 +294,14 @@ def analyze(paths: Sequence[str], rules: Sequence[Rule],
         findings.extend(kept)
         suppressed.extend(supp)
     for rule in rules:
-        findings.extend(rule.finalize())
+        for f in rule.finalize():
+            ctx = ctxs.get(f.path)
+            entry = (_matching_suppression(ctx, f)
+                     if ctx is not None else None)
+            if entry is not None and entry[1]:
+                suppressed.append(f)
+            else:
+                findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return AnalysisResult(findings=findings, suppressed=suppressed,
                           files=files)

@@ -1,0 +1,915 @@
+"""glomlint race rule pack — RacerD-style interprocedural race detection.
+
+The single largest class of review-hardening findings across PRs 7-10
+was cross-thread races neither the syntactic (v1) nor the
+intraprocedural-CFG (v2) rules can see: the commit-gate TOCTOU, the
+``SessionStore`` lock re-mint window, the healthz staged-step read, the
+scrape-vs-request exemplar iteration, the spill-vs-inflight shutdown
+race — all caught by humans, post hoc.  These rules sit on the
+:mod:`glom_tpu.analysis.callgraph` thread-root model and the v2 CFG
+solver:
+
+  * ``conc-unguarded-attr`` — per-class *guarded-attribute inference*:
+    for each ``self._attr``, infer its majority guard from the accesses
+    the CFG solver proves occur under a held lock (``with self._lock:``
+    blocks by containment, ``acquire()``/``release()`` pairs by
+    must-analysis, helpers credited with the locks held at EVERY call
+    site).  An access that escapes the inferred guard, in code reachable
+    from two distinct thread roots (or one self-concurrent root), is a
+    data race candidate — the PR 9 exemplar-iteration shape and the
+    interprocedural form of the PR 7 commit-gate TOCTOU.
+  * ``conc-lock-window`` — interprocedural lock-set summaries: a callee
+    that releases a lock it did not itself acquire (the
+    drop-and-reacquire helper) silently splits its caller's critical
+    section in two; the call site under the lock is flagged (the PR 10
+    ``SessionStore`` re-mint shape).  A ``release()`` inside the lock's
+    own ``with`` block is flagged directly.
+  * ``conc-escaping-state`` — escape analysis at the thread boundary: a
+    mutable local (dict/list/set) captured by a ``Thread(target=...)``
+    closure (or passed via ``args=``) and then used by the spawning
+    function on a path with no ``join()`` between start and use is
+    shared mutable state with no lock on either side — the PR 10
+    spill-vs-inflight shutdown race.
+
+Guard inference needs a *majority*: at least two proven-guarded accesses
+covering at least half of all accesses.  Attributes holding sync
+primitives (locks, conditions, events, queues, deques, thread handles)
+are exempt — reading a lock attribute is how you use it.  Constructor
+scopes (``__init__``/``__new__``/``__del__``) carry no thread roots:
+pre-publication writes are not races.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from glom_tpu.analysis.callgraph import (
+    CallGraphBuilder, ClassInfo, Scope, ThreadRoot,
+)
+from glom_tpu.analysis.cfg import (
+    _walk_no_scopes, build_cfg, header_exprs as _stmt_exprs, solve_forward,
+)
+from glom_tpu.analysis.engine import (
+    Finding, ModuleContext, Rule, child_blocks as _child_blocks,
+    dotted_name, is_self_attr, parent_map,
+)
+
+#: attribute names recognized as guards when entered via ``with self.X:``
+_GUARD_RE = re.compile(r"lock|mutex|cv|cond", re.IGNORECASE)
+
+#: constructors whose values are sync/thread primitives — accesses to
+#: these attributes are how threads coordinate, not what they guard
+_SYNC_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Event", "threading.Semaphore", "threading.BoundedSemaphore",
+    "threading.Barrier", "threading.Thread", "threading.Timer",
+    "threading.local", "Lock", "RLock", "Condition", "Event", "Semaphore",
+    "BoundedSemaphore", "Barrier", "Thread", "Timer",
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue", "Queue", "SimpleQueue",
+    "collections.deque", "deque",
+}
+
+#: method names that mutate their receiver (container mutation counts as
+#: a write to the attribute holding the container)
+_MUTATORS = {
+    "append", "appendleft", "add", "clear", "discard", "extend", "insert",
+    "pop", "popitem", "popleft", "remove", "setdefault", "update", "sort",
+    "reverse", "put", "put_nowait",
+}
+
+_MUTABLE_CTORS = {"dict", "list", "set", "bytearray", "defaultdict",
+                  "OrderedDict", "Counter", "collections.defaultdict",
+                  "collections.OrderedDict", "collections.Counter"}
+
+
+def _is_guard_attr(name: Optional[str]) -> bool:
+    return bool(name and _GUARD_RE.search(name))
+
+
+# -- per-scope facts: accesses, held locks, release/call events ------------
+
+@dataclasses.dataclass(frozen=True)
+class Access:
+    attr: str
+    kind: str                     # "read" | "write"
+    line: int
+    locks: FrozenSet[str]         # guards held where the access executes
+
+
+@dataclasses.dataclass(frozen=True)
+class CallSite:
+    callee: str                   # called name (self.m / bare f)
+    line: int
+    locks: FrozenSet[str]
+
+
+@dataclasses.dataclass(frozen=True)
+class ReleaseEvent:
+    lock: str
+    line: int
+    locks: FrozenSet[str]         # held (with/must) where it executes
+    with_held: bool               # True: releasing a with-held lock
+
+
+@dataclasses.dataclass
+class ScopeFacts:
+    accesses: List[Access]
+    calls: List[CallSite]
+    releases: List[ReleaseEvent]
+
+
+def _access_kind(node: ast.Attribute, parents: Dict) -> str:
+    """Whether this ``self.X`` node is a write: a direct Store/Del, the
+    receiver of a Store-context subscript/attribute (``self.x[k] = v``,
+    ``self.x.y = v``), an AugAssign target, or the receiver of a
+    mutating method call (``self.x.append(...)``)."""
+    if isinstance(node.ctx, (ast.Store, ast.Del)):
+        return "write"
+    p = parents.get(node)
+    if isinstance(p, ast.Subscript) and p.value is node and isinstance(
+            p.ctx, (ast.Store, ast.Del)):
+        return "write"
+    if isinstance(p, ast.Attribute) and p.value is node:
+        if isinstance(p.ctx, (ast.Store, ast.Del)):
+            return "write"
+        gp = parents.get(p)
+        if isinstance(gp, ast.Call) and gp.func is p and \
+                p.attr in _MUTATORS:
+            return "write"
+    return "read"
+
+
+def _cfg_must_held(fn) -> Dict[int, FrozenSet[str]]:
+    """id(stmt) -> guards PROVEN held (must-analysis over the CFG) via
+    explicit ``self.X.acquire()``/``release()`` pairs — the v2 solver's
+    acquire/release facts reused for guard inference.  A raising acquire
+    never acquired (exc_transfer)."""
+    def events(stmt):
+        out = []
+        for expr in _stmt_exprs(stmt):
+            for node in _walk_no_scopes(expr):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                attr = is_self_attr(node.func.value)
+                if not _is_guard_attr(attr):
+                    continue
+                if node.func.attr == "acquire" and not node.args \
+                        and not node.keywords:
+                    out.append(("acquire", attr))
+                elif node.func.attr == "release":
+                    out.append(("release", attr))
+        return out
+
+    # cheap pre-scan: most scopes lock via `with` only — don't pay for a
+    # CFG + solve unless an explicit acquire/release call exists
+    if not any(isinstance(n, ast.Attribute)
+               and n.attr in ("acquire", "release")
+               and _is_guard_attr(is_self_attr(n.value))
+               for n in ast.walk(fn)):
+        return {}
+    try:
+        cfg = build_cfg(fn)
+    except RecursionError:          # pathological nesting: no credit
+        return {}
+    ev_by_node = {}
+    any_events = False
+    for node in cfg.stmt_nodes():
+        if node.kind == "handler":
+            continue
+        ev = events(node.stmt)
+        if ev:
+            ev_by_node[node.index] = ev
+            any_events = True
+    if not any_events:
+        return {}
+
+    def transfer(node, state):
+        for action, lock in ev_by_node.get(node.index, ()):
+            state = state | {lock} if action == "acquire" else state - {lock}
+        return state
+
+    def exc_transfer(node, state):
+        for action, lock in ev_by_node.get(node.index, ()):
+            if action == "release":
+                state = state - {lock}
+        return state
+
+    results = solve_forward(cfg, transfer, may=False,
+                            exc_transfer=exc_transfer)
+    held: Dict[int, FrozenSet[str]] = {}
+    for node in cfg.stmt_nodes():
+        if node in results:
+            held[id(node.stmt)] = results[node][0]
+    return held
+
+
+def collect_scope_facts(scope: Scope) -> ScopeFacts:
+    """Accesses / call sites / release events of one scope, each with the
+    guards held where it executes: ``with self._lock:`` containment
+    (exact) unioned with the CFG must-held acquire/release facts."""
+    facts = ScopeFacts(accesses=[], calls=[], releases=[])
+    node = scope.node
+    parents = parent_map(node)
+    if isinstance(node, ast.Lambda):
+        _collect_exprs(node.body, frozenset(), facts, parents)
+        return facts
+    must_held = _cfg_must_held(node)
+
+    def walk(body: Sequence[ast.stmt], with_held: FrozenSet[str]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            held = with_held | must_held.get(id(stmt), frozenset())
+            for expr in _stmt_exprs(stmt):
+                _collect_exprs(expr, held, facts, parents,
+                               with_held=with_held)
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                guards = frozenset(
+                    a for a in (is_self_attr(item.context_expr)
+                                for item in stmt.items)
+                    if _is_guard_attr(a))
+                walk(stmt.body, with_held | guards)
+                continue
+            for block in _child_blocks(stmt):
+                walk(block, with_held)
+
+    walk(node.body, frozenset())
+    return facts
+
+
+def _collect_exprs(expr: ast.AST, held: FrozenSet[str], facts: ScopeFacts,
+                   parents: Dict, with_held: FrozenSet[str] = frozenset()
+                   ) -> None:
+    for node in _walk_no_scopes(expr):
+        if isinstance(node, ast.Attribute):
+            attr = is_self_attr(node)
+            if attr is None:
+                continue
+            p = parents.get(node)
+            if isinstance(p, ast.Call) and p.func is node:
+                # a self-METHOD call, not state: record the call site
+                facts.calls.append(CallSite(attr, node.lineno, held))
+                continue
+            if isinstance(p, ast.Attribute) and p.value is node and \
+                    parents.get(p) is not None and \
+                    isinstance(parents.get(p), ast.Call) and \
+                    parents[p].func is p:
+                # self.X.m(...): release/acquire bookkeeping + mutation
+                if p.attr == "release" and _is_guard_attr(attr):
+                    facts.releases.append(ReleaseEvent(
+                        lock=attr, line=node.lineno, locks=held,
+                        with_held=attr in with_held))
+                    continue
+                if p.attr == "acquire" and _is_guard_attr(attr):
+                    continue        # the guard machinery itself
+            if _is_guard_attr(attr):
+                continue            # guards are used, not guarded
+            facts.accesses.append(Access(
+                attr=attr, kind=_access_kind(node, parents),
+                line=node.lineno, locks=held))
+
+
+def _sync_typed_attrs(cls: ClassInfo) -> Set[str]:
+    """self-attributes assigned a sync/thread primitive anywhere in the
+    class (typically ``__init__``)."""
+    out: Set[str] = set()
+    for node in ast.walk(cls.node):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (isinstance(node.value, ast.Call)
+                and dotted_name(node.value.func) in _SYNC_CTORS):
+            continue
+        for tgt in node.targets:
+            attr = is_self_attr(tgt)
+            if attr:
+                out.add(attr)
+    return out
+
+
+def _resolve_in_class(cls: ClassInfo, caller: Scope, name: str
+                      ) -> Optional[str]:
+    nested = f"{caller.name}.{name}"
+    if nested in cls.scopes:
+        return nested
+    if name in cls.scopes:
+        return name
+    return None
+
+
+def _entry_credit(cls: ClassInfo, facts: Dict[str, ScopeFacts],
+                  direct_roots: Set[str]) -> Dict[str, FrozenSet[str]]:
+    """Locks a scope may be credited with at entry: the intersection,
+    over every intra-class call site, of the locks held there (plus the
+    caller's own credit).  Public methods and direct thread-root targets
+    enter with nothing — the threading machinery calls them bare."""
+    entry: Dict[str, FrozenSet[str]] = {}
+    sites: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+    for sname, f in facts.items():
+        caller = cls.scopes[sname]
+        for call in f.calls:
+            target = _resolve_in_class(cls, caller, call.callee)
+            if target is not None and target != sname:
+                sites.setdefault(target, []).append((sname, call.locks))
+
+    def bare_entry(sname: str) -> bool:
+        return (cls.scopes[sname].is_public or sname in direct_roots
+                or sname not in sites)
+
+    for sname in facts:
+        if bare_entry(sname):
+            entry[sname] = frozenset()
+    for _ in range(len(facts) + 1):
+        changed = False
+        for sname in facts:
+            if bare_entry(sname):
+                continue
+            acc: Optional[FrozenSet[str]] = None
+            for caller, locks in sites[sname]:
+                held = locks | entry.get(caller, frozenset())
+                acc = held if acc is None else (acc & held)
+            acc = acc or frozenset()
+            if entry.get(sname) != acc:
+                entry[sname] = acc
+                changed = True
+        if not changed:
+            break
+    return {s: entry.get(s, frozenset()) for s in facts}
+
+
+# -- conc-unguarded-attr ---------------------------------------------------
+
+class UnguardedAttrRule(Rule):
+    name = "conc-unguarded-attr"
+    severity = "error"
+    description = ("shared attribute escapes its inferred majority lock "
+                   "in code reachable from >=2 thread roots (PR 9 "
+                   "exemplar-iteration / PR 7 commit-gate class): guard "
+                   "the access or snapshot under the lock")
+
+    #: inference needs a majority: >= MIN_GUARDED guarded accesses
+    #: covering at least half of all accesses to the attribute
+    MIN_GUARDED = 2
+
+    def __init__(self) -> None:
+        self._builder = CallGraphBuilder()
+        self._ctx_lines: Dict[str, List[str]] = {}
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        self._builder.add_module(ctx)
+        self._ctx_lines[ctx.relpath] = ctx.lines
+        return []
+
+    def finalize(self) -> List[Finding]:
+        graph = self._builder.build()
+        findings: List[Finding] = []
+        for cls_key in sorted(graph.classes):
+            cls = graph.classes[cls_key]
+            findings.extend(self._check_class(cls, graph))
+        return findings
+
+    def _check_class(self, cls: ClassInfo, graph) -> List[Finding]:
+        roots_by_scope = {name: graph.roots((cls.key, name))
+                          for name in cls.scopes}
+        if not any(r.kind != "external"
+                   for roots in roots_by_scope.values() for r in roots):
+            return []               # no background thread ever runs here
+        sync_attrs = _sync_typed_attrs(cls)
+        facts = {name: collect_scope_facts(scope)
+                 for name, scope in cls.scopes.items()}
+        direct = {name for name in cls.scopes
+                  if any(r.kind != "external" for r in
+                         graph.root_methods.get((cls.key, name), ()))}
+        entry = _entry_credit(cls, facts, direct)
+
+        # group accesses per attribute, entry-credited, roots attached
+        per_attr: Dict[str, List[Tuple[Access, str, frozenset]]] = {}
+        for sname, f in facts.items():
+            roots = roots_by_scope[sname]
+            if not roots:
+                continue            # unreachable / constructor scope
+            for a in f.accesses:
+                if a.attr in sync_attrs:
+                    continue
+                credited = dataclasses.replace(
+                    a, locks=a.locks | entry[sname])
+                per_attr.setdefault(a.attr, []).append(
+                    (credited, sname, roots))
+
+        findings: List[Finding] = []
+        for attr in sorted(per_attr):
+            findings.extend(self._check_attr(cls, attr, per_attr[attr]))
+        return findings
+
+    def _check_attr(self, cls: ClassInfo, attr: str,
+                    accesses: List[Tuple[Access, str, frozenset]]
+                    ) -> List[Finding]:
+        if len(accesses) < 2:
+            return []
+        if not any(a.kind == "write" for a, _, _ in accesses):
+            return []
+        root_keys = {r.key for _, _, roots in accesses for r in roots}
+        self_conc = any(r.concurrent_with_self
+                        for _, _, roots in accesses for r in roots)
+        if len(root_keys) < 2 and not self_conc:
+            return []               # only one thread can ever touch it
+        # majority-guard inference
+        counts: Dict[str, int] = {}
+        for a, _, _ in accesses:
+            for lock in a.locks:
+                counts[lock] = counts.get(lock, 0) + 1
+        total = len(accesses)
+        guard = None
+        for lock in sorted(counts, key=lambda k: (-counts[k], k)):
+            if counts[lock] >= self.MIN_GUARDED and \
+                    2 * counts[lock] >= total:
+                guard = lock
+                break
+        if guard is None:
+            return []               # no inferable discipline to enforce
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int]] = set()
+        for a, sname, roots in accesses:
+            if guard in a.locks:
+                continue
+            partner = self._race_partner(a, roots, accesses)
+            if partner is None:
+                continue
+            if (attr, a.line) in seen:
+                continue
+            seen.add((attr, a.line))
+            p_access, p_roots = partner
+            p_root = sorted(p_roots, key=lambda r: r.key)[0]
+            line_text = ""
+            lines = self._ctx_lines.get(cls.relpath)
+            if lines and 1 <= a.line <= len(lines):
+                line_text = lines[a.line - 1].strip()
+            findings.append(Finding(
+                rule=self.name, severity=self.severity, path=cls.relpath,
+                line=a.line, col=0,
+                message=f"{cls.name}.{attr} is guarded by self.{guard} on "
+                        f"{counts[guard]}/{total} accesses but this "
+                        f"{a.kind} in {sname!r} escapes it while a "
+                        f"concurrent {p_access.kind} at line "
+                        f"{p_access.line} can run on another thread "
+                        f"({p_root.describe()}): hold self.{guard} here "
+                        f"or snapshot the state under it",
+                code=line_text))
+        return findings
+
+    @staticmethod
+    def _race_partner(access: Access, roots: frozenset,
+                      accesses: List[Tuple[Access, str, frozenset]]
+                      ) -> Optional[Tuple[Access, frozenset]]:
+        """An access that can run CONCURRENTLY with ``access`` such that
+        at least one of the pair is a write and the two hold NO lock in
+        common (a shared secondary lock — a poll lock serializing reader
+        and writer — makes the pair mutually exclusive even when neither
+        holds the majority guard).  Concurrency needs two distinct roots
+        across the PAIR (identical root sets qualify when they contain
+        two roots: the external caller and the watcher can each be
+        mid-method at once) or one self-concurrent root."""
+        my_keys = {r.key for r in roots}
+        for other, _, o_roots in accesses:
+            if other is access:
+                continue
+            if access.kind != "write" and other.kind != "write":
+                continue
+            if access.locks & other.locks:
+                continue            # serialized by a common lock
+            o_keys = {r.key for r in o_roots}
+            if len(my_keys | o_keys) >= 2:
+                return (other, o_roots)
+            if any(r.concurrent_with_self for r in o_roots | roots):
+                return (other, o_roots)
+        return None
+
+
+# -- conc-lock-window ------------------------------------------------------
+
+class LockWindowRule(Rule):
+    name = "conc-lock-window"
+    severity = "error"
+    description = ("a helper that releases a lock it did not acquire is "
+                   "called with that lock held: the caller's critical "
+                   "section silently splits in two (PR 10 SessionStore "
+                   "lock re-mint window)")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        if ".release(" not in ctx.source:
+            return []               # no drop can exist without a release
+        findings: List[Finding] = []
+        builder = CallGraphBuilder()
+        builder.add_module(ctx)
+        graph = builder.build()
+        for cls_key in sorted(graph.classes):
+            findings.extend(self._check_class(graph.classes[cls_key], ctx))
+        return findings
+
+    def _check_class(self, cls: ClassInfo, ctx: ModuleContext
+                     ) -> List[Finding]:
+        facts = {name: collect_scope_facts(scope)
+                 for name, scope in cls.scopes.items()}
+        findings: List[Finding] = []
+        # direct: releasing a with-held lock inside its own with block —
+        # the window starts here AND __exit__ will double-release
+        summary: Dict[str, Set[str]] = {}
+        for sname, f in facts.items():
+            uncredited: Set[str] = set()
+            for rel in f.releases:
+                if rel.with_held:
+                    findings.append(ctx.finding(
+                        self, _line_node(rel.line),
+                        f"{cls.name}.{sname} releases self.{rel.lock} "
+                        f"inside its own `with self.{rel.lock}:` block: "
+                        f"the critical section is split open mid-body "
+                        f"and the with-exit will release it again"))
+                elif rel.lock not in rel.locks:
+                    uncredited.add(rel.lock)
+            summary[sname] = uncredited
+        # transitive: a callee's uncredited releases propagate up until a
+        # frame actually holds the lock — that call site is the window
+        for _ in range(len(facts) + 1):
+            changed = False
+            for sname, f in facts.items():
+                for call in f.calls:
+                    target = _resolve_in_class(cls, cls.scopes[sname],
+                                               call.callee)
+                    if target is None or target == sname:
+                        continue
+                    inherit = summary.get(target, set()) - call.locks
+                    if not inherit <= summary[sname]:
+                        summary[sname] |= inherit
+                        changed = True
+            if not changed:
+                break
+        for sname, f in facts.items():
+            for call in f.calls:
+                target = _resolve_in_class(cls, cls.scopes[sname],
+                                           call.callee)
+                if target is None or target == sname:
+                    continue
+                windows = call.locks & summary.get(target, set())
+                for lock in sorted(windows):
+                    findings.append(ctx.finding(
+                        self, _line_node(call.line),
+                        f"{cls.name}.{sname} holds self.{lock} here but "
+                        f"{call.callee!r} (or a helper it calls) releases "
+                        f"and re-mints it: the critical section is TWO "
+                        f"sections with a window between — another thread "
+                        f"can run in the gap (PR 10 SessionStore re-mint "
+                        f"shape); restructure so the helper runs outside "
+                        f"the lock or never drops it"))
+        return findings
+
+
+def _line_node(line: int) -> ast.AST:
+    node = ast.Pass()
+    node.lineno = line
+    node.col_offset = 0
+    return node
+
+
+# -- conc-escaping-state ---------------------------------------------------
+
+class EscapingStateRule(Rule):
+    name = "conc-escaping-state"
+    severity = "error"
+    description = ("a mutable local captured by a Thread target is used "
+                   "by the spawner on a join-free path: shared mutable "
+                   "state with no lock on either side (PR 10 "
+                   "spill-vs-inflight shutdown race)")
+
+    _THREAD_CTORS = {"threading.Thread", "Thread", "threading.Timer",
+                     "Timer"}
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        if not any(name in ctx.source for name in ("Thread(", "Timer(")):
+            return []               # no thread boundary in this file
+        findings: List[Finding] = []
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_fn(fn, ctx))
+        return findings
+
+    def _check_fn(self, fn, ctx: ModuleContext) -> List[Finding]:
+        # cheap pre-scan: any Thread ctor at all?
+        if not any(isinstance(n, ast.Call)
+                   and dotted_name(n.func) in self._THREAD_CTORS
+                   for n in _walk_no_scopes(fn)):
+            return []
+        nested = {n.name: n for n in fn.body if isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        for stmt in ast.walk(fn):
+            body = getattr(stmt, "body", None)
+            if not isinstance(body, list):
+                continue
+            for n in body:
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    nested.setdefault(n.name, n)
+        mutable_locals = self._mutable_locals(fn)
+        if not mutable_locals:
+            return []
+        local_locks = self._local_locks(fn)
+        sites = self._thread_sites(fn, nested, mutable_locals, local_locks)
+        if not sites:
+            return []
+        stmt_guards = self._stmt_guards(fn, local_locks)
+        findings: List[Finding] = []
+        cfg = build_cfg(fn)
+        for site_stmt, tvar, captured, target_writes, target_guards in sites:
+            findings.extend(self._check_site(
+                fn, cfg, site_stmt, tvar, captured, target_writes,
+                target_guards, stmt_guards, ctx))
+        return findings
+
+    @staticmethod
+    def _local_locks(fn) -> Set[str]:
+        """Locals bound to sync primitives: a ``with <lock>:`` around
+        both sides of a captured name's accesses is real discipline."""
+        out: Set[str] = set()
+        for node in _walk_no_scopes(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call) and dotted_name(
+                    node.value.func) in _SYNC_CTORS:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+        return out
+
+    @staticmethod
+    def _stmt_guards(fn, local_locks: Set[str]
+                     ) -> Dict[int, FrozenSet[str]]:
+        """id(stmt) -> local locks lexically held (``with <lock>:``
+        containment) when the statement's header evaluates."""
+        guards: Dict[int, FrozenSet[str]] = {}
+
+        def walk(body, held: FrozenSet[str]) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                guards[id(stmt)] = held
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    locks = frozenset(
+                        item.context_expr.id for item in stmt.items
+                        if isinstance(item.context_expr, ast.Name)
+                        and item.context_expr.id in local_locks)
+                    walk(stmt.body, held | locks)
+                    continue
+                for block in _child_blocks(stmt):
+                    walk(block, held)
+
+        walk(fn.body, frozenset())
+        return guards
+
+    def _mutable_locals(self, fn) -> Set[str]:
+        out: Set[str] = set()
+        for node in _walk_no_scopes(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            mutable = isinstance(v, (ast.Dict, ast.List, ast.Set,
+                                     ast.DictComp, ast.ListComp,
+                                     ast.SetComp)) or (
+                isinstance(v, ast.Call)
+                and dotted_name(v.func) in _MUTABLE_CTORS)
+            if not mutable:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+        return out
+
+    def _thread_sites(self, fn, nested, mutable_locals, local_locks):
+        """(site stmt, thread var or None, captured mutable locals,
+        names the target body writes, per-name guard locks).  Only a
+        statement whose OWN header contains the Thread constructor is a
+        site — a compound statement enclosing one is not (its body
+        statements are)."""
+        sites = []
+        for stmt in _walk_no_scopes(fn):
+            if not isinstance(stmt, ast.stmt):
+                continue
+            call = None
+            for expr in _stmt_exprs(stmt):
+                for n in _walk_no_scopes(expr):
+                    if isinstance(n, ast.Call) and \
+                            dotted_name(n.func) in self._THREAD_CTORS:
+                        call = n
+                        break
+                if call is not None:
+                    break
+            if call is None:
+                continue
+            tvar = None
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                tvar = stmt.targets[0].id
+            target = None
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+            captured: Set[str] = set()
+            target_body: Optional[ast.AST] = None
+            if isinstance(target, ast.Name) and target.id in nested:
+                target_body = nested[target.id]
+                captured |= self._free_names(target_body) & mutable_locals
+            elif isinstance(target, ast.Lambda):
+                target_body = target
+                captured |= self._free_names(target) & mutable_locals
+            for kw in call.keywords:
+                if kw.arg in ("args", "kwargs") and isinstance(
+                        kw.value, (ast.Tuple, ast.List)):
+                    for el in kw.value.elts:
+                        if isinstance(el, ast.Name) and \
+                                el.id in mutable_locals:
+                            captured.add(el.id)
+            if not captured:
+                continue
+            writes = (self._written_names(target_body)
+                      if target_body is not None else set())
+            guards = (self._target_guards(target_body, captured,
+                                          local_locks)
+                      if target_body is not None else {})
+            sites.append((stmt, tvar, captured, writes, guards))
+        return sites
+
+    @staticmethod
+    def _target_guards(target, captured: Set[str], local_locks: Set[str]
+                       ) -> Dict[str, FrozenSet[str]]:
+        """Per captured name: the local locks held around EVERY access
+        of it inside the thread target (empty set = at least one bare
+        access, i.e. no discipline to credit)."""
+        guards: Dict[str, Optional[FrozenSet[str]]] = {}
+        if isinstance(target, ast.Lambda):
+            for n in ast.walk(target.body):
+                if isinstance(n, ast.Name) and n.id in captured:
+                    guards[n.id] = frozenset()
+            return {k: v for k, v in guards.items() if v}
+
+        def walk(body, held: FrozenSet[str]) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    walk(stmt.body, frozenset())  # runs who-knows-where
+                    continue
+                if isinstance(stmt, ast.ClassDef):
+                    continue
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    locks = frozenset(
+                        item.context_expr.id for item in stmt.items
+                        if isinstance(item.context_expr, ast.Name)
+                        and item.context_expr.id in local_locks)
+                    for item in stmt.items:
+                        note_exprs(item.context_expr, held)
+                    walk(stmt.body, held | locks)
+                    continue
+                for expr in _stmt_exprs(stmt):
+                    note_exprs(expr, held)
+                for block in _child_blocks(stmt):
+                    walk(block, held)
+
+        def note_exprs(expr, held: FrozenSet[str]) -> None:
+            for n in _walk_no_scopes(expr):
+                if isinstance(n, ast.Name) and n.id in captured:
+                    cur = guards.get(n.id)
+                    guards[n.id] = held if cur is None else (cur & held)
+
+        walk(target.body, frozenset())
+        return {k: v for k, v in guards.items() if v}
+
+    @staticmethod
+    def _free_names(target) -> Set[str]:
+        body = target.body if isinstance(target, ast.Lambda) else target
+        bound: Set[str] = set()
+        if not isinstance(target, ast.Lambda):
+            a = target.args
+            bound = {x.arg for x in (a.posonlyargs + a.args
+                                     + a.kwonlyargs)}
+            for node in ast.walk(target):
+                if isinstance(node, ast.Name) and isinstance(
+                        node.ctx, ast.Store):
+                    bound.add(node.id)
+        names: Set[str] = set()
+        for node in ast.walk(body if isinstance(body, ast.AST)
+                             else target):
+            if isinstance(node, ast.Name) and node.id not in bound:
+                names.add(node.id)
+        return names
+
+    @staticmethod
+    def _written_names(target) -> Set[str]:
+        out: Set[str] = set()
+        scan = target.body if isinstance(target, ast.Lambda) else target
+        nodes = ast.walk(scan) if isinstance(scan, ast.AST) else []
+        pm = parent_map(scan) if isinstance(scan, ast.AST) else {}
+        for node in nodes:
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    out.add(node.id)
+                    continue
+                p = pm.get(node)
+                if isinstance(p, ast.Subscript) and p.value is node and \
+                        isinstance(p.ctx, (ast.Store, ast.Del)):
+                    out.add(node.id)
+                elif isinstance(p, ast.Attribute) and p.value is node:
+                    gp = pm.get(p)
+                    if isinstance(gp, ast.Call) and gp.func is p and \
+                            p.attr in _MUTATORS:
+                        out.add(node.id)
+        return out
+
+    def _check_site(self, fn, cfg, site_stmt, tvar, captured,
+                    target_writes, target_guards, stmt_guards,
+                    ctx: ModuleContext) -> List[Finding]:
+        fact = f"unjoined@{site_stmt.lineno}"
+        site_ids = {id(site_stmt)}
+
+        def is_join(stmt) -> bool:
+            if tvar is None:
+                return False
+            for n in _walk_no_scopes(stmt):
+                if isinstance(n, ast.Call) and isinstance(
+                        n.func, ast.Attribute) and n.func.attr == "join" \
+                        and isinstance(n.func.value, ast.Name) \
+                        and n.func.value.id == tvar:
+                    return True
+            # `for w in workers: w.join()` joins the whole thread list
+            if isinstance(stmt, (ast.For, ast.AsyncFor)) and isinstance(
+                    stmt.iter, ast.Name) and stmt.iter.id == tvar and \
+                    isinstance(stmt.target, ast.Name):
+                w = stmt.target.id
+                for n in ast.walk(stmt):
+                    if isinstance(n, ast.Call) and isinstance(
+                            n.func, ast.Attribute) and \
+                            n.func.attr == "join" and isinstance(
+                            n.func.value, ast.Name) and \
+                            n.func.value.id == w:
+                        return True
+            return False
+
+        def transfer(node, state):
+            stmt = node.stmt
+            if stmt is None:
+                return state
+            if id(stmt) in site_ids:
+                return state | {fact}
+            if is_join(stmt):
+                return state - {fact}
+            return state
+
+        results = solve_forward(cfg, transfer, may=True)
+        findings: List[Finding] = []
+        reported: Set[str] = set()
+        for node in cfg.stmt_nodes():
+            stmt = node.stmt
+            if node not in results or id(stmt) in site_ids or \
+                    node.kind == "handler":
+                continue
+            if fact not in results[node][0]:
+                continue
+            if is_join(stmt):
+                continue
+            pm = parent_map(stmt)
+            held_here = stmt_guards.get(id(stmt), frozenset())
+            for expr in _stmt_exprs(stmt):
+                for n in _walk_no_scopes(expr):
+                    if not (isinstance(n, ast.Name) and n.id in captured):
+                        continue
+                    if n.id in reported:
+                        continue
+                    use_writes = isinstance(n.ctx, (ast.Store, ast.Del))
+                    p = pm.get(n)
+                    if isinstance(p, ast.Subscript) and p.value is n and \
+                            isinstance(p.ctx, (ast.Store, ast.Del)):
+                        use_writes = True
+                    if isinstance(p, ast.Attribute) and p.value is n:
+                        gp = pm.get(p)
+                        if isinstance(gp, ast.Call) and gp.func is p and \
+                                p.attr in _MUTATORS:
+                            use_writes = True  # pending.clear() and kin
+                    if not (use_writes or n.id in target_writes):
+                        continue    # read-on-both-sides: no conflict
+                    if held_here & target_guards.get(n.id, frozenset()):
+                        continue    # both sides share a real lock
+                    reported.add(n.id)
+                    findings.append(ctx.finding(
+                        self, n,
+                        f"mutable local {n.id!r} is captured by the "
+                        f"thread started at line {site_stmt.lineno} and "
+                        f"used here on a path with no join() in between: "
+                        f"the thread can still be "
+                        f"{'writing' if n.id in target_writes else 'reading'}"
+                        f" it (PR 10 spill-vs-inflight class) — join the "
+                        f"thread first, or hand it a snapshot instead of "
+                        f"the live object"))
+        return findings
+
+
+RACE_RULES = (UnguardedAttrRule, LockWindowRule, EscapingStateRule)

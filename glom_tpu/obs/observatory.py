@@ -700,9 +700,12 @@ class FleetObservatory:
                 max(int(e.get("seq", -1)) for e in events))
         return fresh
 
-    def check_incidents(self, fresh_events: Sequence[dict],
-                        forensics: Dict[str, dict]) -> List[str]:
-        """Correlate this poll's signals into incident bundles.  Triggers:
+    def _check_incidents(self, fresh_events: Sequence[dict],
+                         forensics: Dict[str, dict]) -> List[str]:
+        """Correlate this poll's signals into incident bundles.  Runs as
+        a step of the ``poll_once`` duty cycle, under BOTH the poll lock
+        and the state lock (it reads ``_poll_n`` and mutates incident
+        bookkeeping) — private so no caller can reach it bare.  Triggers:
         a NEW ``slo_burn`` bundle on any replica, or a NEW ejection event
         on the router timeline.  Bundles already present the first time a
         replica is SIGHTED — at attach, or when a replica joins/returns
@@ -889,7 +892,7 @@ class FleetObservatory:
                              for name, payload in fetched["forensics"].items()
                              if isinstance(payload, dict)}
                 self._forensics_by_replica = forensics
-                incidents = self.check_incidents(fresh_events, forensics)
+                incidents = self._check_incidents(fresh_events, forensics)
                 return {
                     "poll": self._poll_n,
                     "pulled_segments": pulled,

@@ -544,6 +544,7 @@ class ServingEngine:
             # images raises, it doesn't cast); the model itself casts to
             # its compute dtype in-graph (glom.cast_for_compute)
             cache.warmup(
+                # glomlint: disable=conc-unguarded-attr -- warmup runs at startup / under the reload lock of the staged path; the watcher that swaps _params is not polling yet
                 self._params,
                 lambda b: jax.ShapeDtypeStruct(
                     (b, c.channels, c.image_size, c.image_size), np.float32,
@@ -582,7 +583,8 @@ class ServingEngine:
     # -- lifecycle ---------------------------------------------------------
     @property
     def params(self):
-        return self._params  # reference read is atomic; swap happens whole
+        # glomlint: disable=conc-unguarded-attr -- reference read is atomic under the GIL; reloads rebind the whole tree (the documented in-flight-on-old-params contract)
+        return self._params
 
     def _place(self, quantized_tree):
         """Put a quantized host tree on device(s) — sharded per the mesh
@@ -666,6 +668,7 @@ class ServingEngine:
         ).inc()
         warnings.warn(
             f"{what} failed ({type(e).__name__}: {e}); continuing to serve "
+            # glomlint: disable=conc-unguarded-attr -- warning text only; a stale step number in a log line is harmless
             f"step {self.step}",
             stacklevel=3,
         )
@@ -688,6 +691,7 @@ class ServingEngine:
         # artifact's CRC just to learn nothing new landed
         return integrity.latest_valid_step(
             self.checkpoint_dir, observer=self._integrity_obs,
+            # glomlint: disable=conc-unguarded-attr -- poll heuristic only: a stale step means one extra CRC pass, and the swap re-validates under _reload_lock
             newer_than=self.step,
         )
 
@@ -715,6 +719,7 @@ class ServingEngine:
                     self._reload_failstreak += 1
                     return False
                 self._sleep(self._reload_retry_base_s * (2 ** attempt))
+        # glomlint: disable=conc-unguarded-attr -- double-checked: the unlocked fast path skips the lock on no-op polls and is re-checked under _reload_lock below
         if newest is None or newest <= self.step:
             return False
         # serialize with the staged-reload API: a router-driven commit and
@@ -1080,6 +1085,7 @@ class ServingEngine:
                     cold, frames = False, entry.frames + 1
                 elapsed = self._clock() - t0
                 self.sessions.put(session_id, new_levels, batch=b,
+                                  # glomlint: disable=conc-unguarded-attr -- provenance label on the stored state; a reload mid-frame legitimately tags the frame with the step it computed on
                                   bucket=bucket, step=self.step,
                                   frames=frames)
         finally:
@@ -1214,6 +1220,7 @@ class ServingEngine:
             return
         with self._slo_lock:
             self._slo.observe(endpoint, latency_ms, error,
+                              # glomlint: disable=conc-unguarded-attr -- debounce cursor only needs to be roughly current (documented above); _lock under _slo_lock would invert the batcher's order
                               trace_id=trace_id, step=self.request_count)
 
     # -- debug plane (pulled by glom_tpu.obs.observatory) ------------------
@@ -1252,6 +1259,7 @@ class ServingEngine:
             slo_fired = []
         return {
             "role": "engine",
+            # glomlint: disable=conc-unguarded-attr -- point-in-time debug snapshot; the pull plane must never park behind a multi-second locked restore
             "step": int(self.step),
             "bundles": bundles,
             "registry": self.registry.snapshot(),
@@ -1271,6 +1279,7 @@ class ServingEngine:
         staged = self._staged
         return {
             "status": "ok",
+            # glomlint: disable=conc-unguarded-attr -- /healthz must answer DURING reloads; taking _reload_lock here would park liveness behind a multi-second restore (the staged read above has the same contract)
             "step": int(self.step),
             "warm": all(cache.warmed for cache in self.caches.values()),
             "queue_depth": {ep: b.depth for ep, b in self.batchers.items()},

@@ -861,6 +861,7 @@ class FleetRouter:
             "role": "router",
             "healthy_replicas": n,
             "fleet_step": self.fleet_step,
+            # glomlint: disable=conc-unguarded-attr -- live phase indicator: /healthz must answer while a rollout holds _rollout_lock for its whole prepare/drain/commit cycle; a stale phase string is the display contract
             "rollout_phase": self.rollout_phase,
             "replicas": replicas,
         }

@@ -1,0 +1,40 @@
+"""conc-unguarded-attr must-flag fixture — the PR 9 exemplar-dict
+scrape-vs-request iteration race, reduced.
+
+PR 9's exemplar-linked histograms kept a per-bucket ``{bucket: trace
+id}`` dict, written by request threads on every ``observe()`` and read
+by the Prometheus scrape path.  Review caught the scrape iterating the
+LIVE dict while request threads mutated it — ``RuntimeError: dictionary
+changed size during iteration`` under exactly the load a scrape is
+meant to observe; the fix snapshots under the lock.  The write side is
+locked (the majority guard), the scrape-loop read escapes it, and the
+two run on different thread roots — invisible to every per-method rule
+because each method is individually well-formed.
+"""
+
+import threading
+
+
+class ExemplarStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._exemplars = {}
+        self._scrape = threading.Thread(target=self._serve_scrapes,
+                                        daemon=True)
+        self._scrape.start()
+
+    def observe(self, bucket, trace_id):
+        with self._lock:
+            self._exemplars[bucket] = trace_id
+
+    def reset(self):
+        with self._lock:
+            self._exemplars.clear()
+
+    def _serve_scrapes(self):
+        while not self._stop.is_set():
+            self._render(self._exemplars)   # BAD: live dict, no lock
+
+    def _render(self, exemplars):
+        return list(exemplars.items())

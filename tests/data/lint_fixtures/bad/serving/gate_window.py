@@ -1,0 +1,52 @@
+"""conc-unguarded-attr must-flag fixture — the PR 7 commit-gate TOCTOU,
+in the INTERPROCEDURAL form v1 provably cannot see.
+
+PR 7's router checked the commit gate outside the pick lock and closed
+it under the lock: between check and act a commit could close the gate
+and a request dispatched against a half-committed fleet.  v1's
+``conc-check-then-act`` catches the single-method shape (an ``if`` on
+guarded state followed by a ``with``) — here the unguarded read hides
+inside a helper (``_gate_is_open``), so no single method contains both
+the check and the act.  Only guarded-attribute inference over the call
+graph sees it: ``_gate_open`` is written under ``self._lock`` by both
+the probe thread and the public close path (the majority guard), while
+the helper's read — reachable from the external request threads —
+escapes the lock entirely.
+"""
+
+import threading
+
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._gate_open = True
+        self._inflight = 0
+
+    def start(self):
+        self._probe = threading.Thread(target=self._probe_loop,
+                                       daemon=True)
+        self._probe.start()
+
+    def _gate_is_open(self):
+        return self._gate_open        # BAD: the read escapes the lock
+
+    def dispatch(self, request):
+        if self._gate_is_open():      # the check the probe can invalidate
+            with self._lock:
+                self._inflight += 1
+            return request.send()
+        raise RuntimeError("gate closed")
+
+    def close_gate(self):
+        with self._lock:
+            self._gate_open = False
+
+    def _probe_loop(self):
+        while not self._stop.is_set():
+            with self._lock:
+                self._gate_open = self._healthy()
+
+    def _healthy(self):
+        return True

@@ -1,0 +1,49 @@
+"""conc-lock-window must-flag fixture — the PR 10 SessionStore lock
+re-mint window, reduced.
+
+PR 10's session store kept per-session frame-ordering locks in a dict
+guarded by the store lock.  Review caught a cleanup path that dropped
+the store lock mid-critical-section (so a slow spill could run
+lock-free) and re-minted it before returning: in the window another
+thread's fetch->acquire could observe the half-updated store and mint a
+SECOND lock for the same session — two threads, two locks, one session.
+No single method shows the bug: the release and the re-acquire live in
+helpers, and the caller's ``with self._lock:`` block LOOKS atomic.
+Only an interprocedural lock-set summary sees that ``_unlocked_spill``
+(through ``_drop_lock``) releases the very lock ``put`` still believes
+it holds.
+"""
+
+import threading
+
+
+class SessionStore:
+    def __init__(self, budget):
+        self._lock = threading.Lock()
+        self._sessions = {}
+        self.budget = budget
+
+    def _drop_lock(self):
+        """Caller holds self._lock; drop it so the spill runs lock-free."""
+        self._lock.release()
+
+    def _remint_lock(self):
+        self._lock.acquire()
+
+    def _unlocked_spill(self, sid):
+        self._drop_lock()
+        self._write_out(sid)
+        self._remint_lock()
+
+    def _write_out(self, sid):
+        return sid
+
+    def _over_budget(self):
+        return len(self._sessions) > self.budget
+
+    def put(self, sid, state):
+        with self._lock:
+            self._sessions[sid] = state
+            if self._over_budget():
+                self._unlocked_spill(sid)   # BAD: splits the section open
+            self._sessions[sid] = state

@@ -1,0 +1,36 @@
+"""conc-escaping-state must-flag fixture — the PR 10 spill-vs-inflight
+shutdown race as ESCAPING mutable state, reduced.
+
+PR 10's shutdown spilled per-session column state while in-flight
+frames were still being applied by a drain worker: a frame the client
+already had an ACK for landed in the live dict AFTER the spill
+snapshotted it — "nothing accepted is dropped" broken for exactly the
+requests racing shutdown.  The shape: a mutable local crosses the
+thread boundary via closure capture, and the spawner keeps using the
+live object on a path with no ``join()`` between start and use.
+Per-method and per-class rules see two individually-fine pieces; only
+escape analysis at the ``Thread(target=...)`` boundary connects them.
+"""
+
+import threading
+
+
+class Engine:
+    def __init__(self, queue, spill_dir):
+        self._queue = queue
+        self._spill_dir = spill_dir
+
+    def shutdown(self):
+        frames = {}
+
+        def drain():
+            for sid, frame in self._queue.drain():
+                frames[sid] = frame      # the worker is still writing...
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        # BAD: ...while the spill reads the live dict — no join between
+        self._snapshot(self._spill_dir, frames)
+
+    def _snapshot(self, path, frames):
+        return (path, dict(frames))

@@ -1,0 +1,32 @@
+"""conc-unguarded-attr must-pass fixture — the PR 9 fix shape: the
+scrape path snapshots the exemplar dict UNDER the lock and renders the
+snapshot; every access holds the inferred guard."""
+
+import threading
+
+
+class ExemplarStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._exemplars = {}
+        self._scrape = threading.Thread(target=self._serve_scrapes,
+                                        daemon=True)
+        self._scrape.start()
+
+    def observe(self, bucket, trace_id):
+        with self._lock:
+            self._exemplars[bucket] = trace_id
+
+    def reset(self):
+        with self._lock:
+            self._exemplars.clear()
+
+    def _serve_scrapes(self):
+        while not self._stop.is_set():
+            with self._lock:
+                snapshot = dict(self._exemplars)
+            self._render(snapshot)
+
+    def _render(self, exemplars):
+        return list(exemplars.items())

@@ -1,0 +1,37 @@
+"""conc-unguarded-attr must-pass fixture — the PR 7 fix shape: the gate
+check moved INSIDE the same lock acquisition that performs the act, so
+every ``_gate_open`` access holds the inferred guard."""
+
+import threading
+
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._gate_open = True
+        self._inflight = 0
+
+    def start(self):
+        self._probe = threading.Thread(target=self._probe_loop,
+                                       daemon=True)
+        self._probe.start()
+
+    def dispatch(self, request):
+        with self._lock:
+            if not self._gate_open:   # check and act share the lock
+                raise RuntimeError("gate closed")
+            self._inflight += 1
+        return request.send()
+
+    def close_gate(self):
+        with self._lock:
+            self._gate_open = False
+
+    def _probe_loop(self):
+        while not self._stop.is_set():
+            with self._lock:
+                self._gate_open = self._healthy()
+
+    def _healthy(self):
+        return True

@@ -1,0 +1,31 @@
+"""conc-lock-window must-pass fixture — the PR 10 fix shape: the
+critical section only PICKS the spill victim; the slow spill runs after
+the ``with`` block exits, and re-validates under a fresh acquisition.
+No helper ever releases a lock it did not acquire."""
+
+import threading
+
+
+class SessionStore:
+    def __init__(self, budget):
+        self._lock = threading.Lock()
+        self._sessions = {}
+        self.budget = budget
+
+    def _pick_victim(self):
+        """Caller holds self._lock."""
+        if len(self._sessions) > self.budget:
+            return next(iter(self._sessions))
+        return None
+
+    def _spill_out(self, sid):
+        with self._lock:
+            state = self._sessions.pop(sid, None)
+        return state
+
+    def put(self, sid, state):
+        with self._lock:
+            self._sessions[sid] = state
+            victim = self._pick_victim()
+        if victim is not None:
+            self._spill_out(victim)

@@ -1,0 +1,26 @@
+"""conc-escaping-state must-pass fixture — the PR 10 fix shape: the
+drain worker is JOINED before the spill touches the shared dict, so the
+uses are sequential, not concurrent."""
+
+import threading
+
+
+class Engine:
+    def __init__(self, queue, spill_dir):
+        self._queue = queue
+        self._spill_dir = spill_dir
+
+    def shutdown(self):
+        frames = {}
+
+        def drain():
+            for sid, frame in self._queue.drain():
+                frames[sid] = frame
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        t.join()                         # the drain barrier
+        self._snapshot(self._spill_dir, frames)
+
+    def _snapshot(self, path, frames):
+        return (path, dict(frames))

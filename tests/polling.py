@@ -1,0 +1,32 @@
+"""Shared poll-briefly helper for read-after-reply races in tests.
+
+The serving stack closes/exports spans AFTER writing the HTTP reply, so
+a client that got its response can race the handler thread's
+bookkeeping: the span sink, the JSONL trace feed, and the completed-
+trace ring all trail the reply by a scheduling window.  Three sites
+grew the same ad-hoc deadline loop (test_router's trace-propagation
+test, test_tracing's traceparent-join test, loadgen ``--smoke``) — this
+is that loop, once.
+
+``poll_until(probe)`` calls ``probe()`` until it returns a truthy value
+or the deadline passes, and returns the LAST probe value either way.
+Probes that return ``None`` while incomplete should pair with a
+fallback collection at the call site (``poll_until(...) or collect()``)
+so a timeout's assertion failure still names the final observed state.
+Not a synchronization primitive: use it only to wait out bounded
+bookkeeping lag, never to paper over a missing barrier in the code
+under test.
+"""
+
+import time
+
+
+def poll_until(probe, *, timeout=5.0, interval=0.01):
+    """Poll ``probe`` until truthy or ``timeout`` seconds; returns the
+    last value ``probe`` returned."""
+    deadline = time.monotonic() + timeout
+    while True:
+        value = probe()
+        if value or time.monotonic() >= deadline:
+            return value
+        time.sleep(interval)

@@ -72,6 +72,13 @@ RULE_FIXTURES = [
     ("shard-unknown-axis", "parallel/mesh.py", "parallel/mesh.py"),
     ("shard-spec-arity", "shardmap_arity.py", "shardmap_arity.py"),
     ("shard-donation-flow", "donation_flow.py", "donation_flow.py"),
+    # -- the v3 race pack (callgraph.py + rules_races) --
+    ("conc-unguarded-attr", "serving/gate_window.py",
+     "serving/gate_window.py"),
+    ("conc-lock-window", "serving/lock_remint.py",
+     "serving/lock_remint.py"),
+    ("conc-escaping-state", "serving/spill_escape.py",
+     "serving/spill_escape.py"),
 ]
 
 #: (fixture, the PR whose review finding it reduces) — each must be
@@ -86,10 +93,35 @@ HISTORICAL_PATH_FIXTURES = [
 
 V2_RULE_PREFIXES = ("res-", "proto-", "shard-")
 
+#: the v3 interprocedural race pack (rules_races.py on callgraph.py)
+V3_RULE_NAMES = ("conc-unguarded-attr", "conc-lock-window",
+                 "conc-escaping-state")
+
+#: (fixture, flagging v3 rule, the PR review finding it reduces) — each
+#: must be flagged by its race rule AND completely clean under the ENTIRE
+#: v1+v2 rule set: the cross-thread classes only the call-graph layer
+#: can see.
+HISTORICAL_RACE_FIXTURES = [
+    ("serving/gate_window.py", "conc-unguarded-attr",
+     "PR 7 commit-gate TOCTOU (interprocedural form)"),
+    ("obs/exemplar_scrape.py", "conc-unguarded-attr",
+     "PR 9 exemplar-dict scrape-vs-request iteration"),
+    ("serving/lock_remint.py", "conc-lock-window",
+     "PR 10 SessionStore lock re-mint window"),
+    ("serving/spill_escape.py", "conc-escaping-state",
+     "PR 10 spill-vs-inflight shutdown race"),
+]
+
 
 def v1_rule_names():
     return [r.name for r in default_rules()
-            if not r.name.startswith(V2_RULE_PREFIXES)]
+            if not r.name.startswith(V2_RULE_PREFIXES)
+            and r.name not in V3_RULE_NAMES]
+
+
+def v1_v2_rule_names():
+    return [r.name for r in default_rules()
+            if r.name not in V3_RULE_NAMES]
 
 
 @pytest.mark.parametrize("rule,bad_rel,good_rel", RULE_FIXTURES,
@@ -282,6 +314,286 @@ def test_shard_axis_rule_checks_axis_param_defaults(tmp_path):
                        names=["shard-unknown-axis"])
     assert len(result.findings) == 1
     assert "dataa" in result.findings[0].message
+
+
+# -- the v3 race pack: interprocedural races on the thread-root model ------
+
+@pytest.mark.parametrize("rel,rule,what", HISTORICAL_RACE_FIXTURES,
+                         ids=[w for _, _, w in HISTORICAL_RACE_FIXTURES])
+def test_historical_race_finding_v1_v2_provably_miss(rel, rule, what):
+    """The acceptance bar for the race pack: each fixture is a faithful
+    reduction of a named cross-thread review finding (docstring cites
+    the PR), its race rule flags it, and the ENTIRE v1+v2 rule set —
+    run over the same file — reports nothing: these are the bug classes
+    three rounds of human review hardening caught that per-method and
+    per-class analysis provably cannot."""
+    path = os.path.join(BAD, rel)
+    v12 = run_rules(path, root=BAD, names=v1_v2_rule_names())
+    assert not v12.findings, (
+        f"v1+v2 rules unexpectedly flag {rel} ({what}): {v12.findings} — "
+        f"the fixture no longer proves the race pack adds coverage")
+    v3 = run_rules(path, root=BAD)
+    hits = findings_for(v3, rule)
+    assert hits, f"{rule} must flag {rel} ({what})"
+    assert "PR" in open(path).read(400), (
+        f"{rel} must cite its historical PR in the docstring")
+
+
+RACY = """
+    import threading
+
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._stop = threading.Event()
+            self._items = {{}}
+            self._watch = threading.Thread(target=self._loop, daemon=True)
+            self._watch.start()
+
+        def put(self, k, v):
+            with self._lock:
+                self._items[k] = v
+
+        def drop(self, k):
+            with self._lock:
+                self._items.pop(k, None)
+
+        def _loop(self):
+            while not self._stop.is_set():
+{scrape}
+"""
+
+
+def test_unguarded_attr_requires_majority_guard(tmp_path):
+    """One guarded access out of two is no discipline to enforce: guard
+    inference needs >= 2 proven-guarded accesses covering at least half
+    of all accesses, so a lock-free class is never mass-flagged."""
+    result = _lint_source(tmp_path, """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def _loop(self):
+                print(self._n)
+    """, names=["conc-unguarded-attr"])
+    assert not result.findings
+
+
+def test_unguarded_attr_credits_helper_called_under_lock(tmp_path):
+    """Interprocedural lock-set credit: a private helper whose EVERY
+    call site holds the lock is treated as locked — the PR 8-era rules
+    would need the access lexically inside the with block."""
+    locked_helper = RACY.format(scrape=(
+        "                with self._lock:\n"
+        "                    self._sweep()\n\n"
+        "        def _sweep(self):\n"
+        "            self._items.clear()"))
+    result = _lint_source(tmp_path, locked_helper,
+                          names=["conc-unguarded-attr"])
+    assert not result.findings
+    bare_helper = RACY.format(scrape=(
+        "                self._sweep()\n\n"
+        "        def _sweep(self):\n"
+        "            self._items.clear()"))
+    result = _lint_source(tmp_path, bare_helper,
+                          names=["conc-unguarded-attr"],
+                          filename="bare.py")
+    assert len(result.findings) == 1
+    assert "_items" in result.findings[0].message
+
+
+def test_unguarded_attr_shared_secondary_lock_is_not_a_race(tmp_path):
+    """A reader and writer serialized by a COMMON second lock cannot
+    race even when neither holds the majority guard (the observatory's
+    poll-lock pattern)."""
+    result = _lint_source(tmp_path, """
+        import threading
+
+        class Collector:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._poll_lock = threading.Lock()
+                self._n = 0
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def poll(self):
+                with self._poll_lock:
+                    with self._lock:
+                        self._n += 1
+                    self._flush()
+
+            def flush_all(self):
+                with self._poll_lock:
+                    with self._lock:
+                        self._n += 1
+                    self._flush()
+
+            def _flush(self):
+                return self._n      # serialized by _poll_lock
+
+            def _loop(self):
+                while True:
+                    with self._poll_lock:
+                        with self._lock:
+                            self._n += 1
+    """, names=["conc-unguarded-attr"])
+    assert not result.findings, result.findings
+
+
+def test_unguarded_attr_finding_is_suppressible_with_reason(tmp_path):
+    """The race pack reports from finalize() (it needs the whole-program
+    call graph) — an inline reasoned suppression on the access line must
+    still be honored, and a reasonless one must not."""
+    bare = RACY.format(scrape=(
+        "                self._render(self._items)"
+        "  # glomlint: disable=conc-unguarded-attr -- scrape tolerates a torn view by design\n\n"
+        "        def _render(self, items):\n"
+        "            return list(items)"))
+    result = _lint_source(tmp_path, bare, names=["conc-unguarded-attr"])
+    assert not result.findings
+    assert len(result.suppressed) == 1
+    reasonless = RACY.format(scrape=(
+        "                self._render(self._items)"
+        "  # glomlint: disable=conc-unguarded-attr\n\n"
+        "        def _render(self, items):\n"
+        "            return list(items)"))
+    result = _lint_source(tmp_path, reasonless,
+                          names=["conc-unguarded-attr"],
+                          filename="reasonless.py")
+    rules = {f.rule for f in result.findings}
+    assert "conc-unguarded-attr" in rules
+    assert "lint-bad-suppression" in rules
+
+
+def test_lock_window_direct_release_inside_with(tmp_path):
+    """Releasing the lock a with-block holds splits the section AND
+    double-releases at __exit__ — flagged without any call graph."""
+    result = _lint_source(tmp_path, """
+        class Store:
+            def put(self, k, v):
+                with self._lock:
+                    self._items[k] = v
+                    self._lock.release()
+                    self._slow_spill(k)
+                    self._lock.acquire()
+    """, names=["conc-lock-window"])
+    assert len(result.findings) == 1
+    assert "with" in result.findings[0].message
+
+
+def test_lock_window_credits_own_acquire_release(tmp_path):
+    """The manual acquire/try/finally/release idiom is a NORMAL critical
+    section, not a window: the must-analysis credits the acquire."""
+    result = _lint_source(tmp_path, """
+        class Store:
+            def put(self, k, v):
+                self._lock.acquire()
+                try:
+                    self._items[k] = v
+                finally:
+                    self._lock.release()
+    """, names=["conc-lock-window"])
+    assert not result.findings
+
+
+def test_escaping_state_shared_local_lock_is_credited(tmp_path):
+    """Both sides of the captured-state access under ONE local lock is
+    real discipline (the chaos/loadgen worker-counter pattern) — and
+    joining a thread LIST via the for-loop idiom counts as the join."""
+    result = _lint_source(tmp_path, """
+        import threading
+
+        def run(n):
+            counts = {"ok": 0}
+            lock = threading.Lock()
+
+            def worker():
+                with lock:
+                    counts["ok"] += 1
+
+            workers = [threading.Thread(target=worker, daemon=True)
+                       for _ in range(n)]
+            for w in workers:
+                w.start()
+            with lock:
+                snapshot = counts["ok"]     # shared lock: fine
+            for w in workers:
+                w.join()
+            return counts["ok"], snapshot   # after the join: fine
+    """, names=["conc-escaping-state"])
+    assert not result.findings, result.findings
+
+
+def test_unguarded_attr_same_method_on_two_roots_races_itself(tmp_path):
+    """A method reachable from TWO roots (the external caller and the
+    thread that targets it) races with itself — identical root sets on
+    both accesses must not read as 'one thread'."""
+    result = _lint_source(tmp_path, """
+        import threading
+
+        class Ticker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                self._t = threading.Thread(target=self.tick, daemon=True)
+                self._t.start()
+
+            def tick(self):
+                with self._lock:
+                    self._n += 1
+                with self._lock:
+                    self._n += 1
+                self._n += 1       # BAD: escapes on both roots at once
+    """, names=["conc-unguarded-attr"])
+    assert len(result.findings) == 1
+
+
+def test_escaping_state_spawner_mutator_call_flags(tmp_path):
+    """The spawner mutating the captured container via a METHOD call
+    (.clear()/.update()) is a write like any subscript store."""
+    result = _lint_source(tmp_path, """
+        import threading
+
+        def run():
+            pending = {}
+
+            def drain():
+                return list(pending)
+
+            t = threading.Thread(target=drain, daemon=True)
+            t.start()
+            pending.clear()                 # BAD: no join, method write
+    """, names=["conc-escaping-state"])
+    assert len(result.findings) == 1
+    assert "pending" in result.findings[0].message
+
+
+def test_escaping_state_bare_use_before_join_flags(tmp_path):
+    result = _lint_source(tmp_path, """
+        import threading
+
+        def run(n):
+            counts = {"ok": 0}
+
+            def worker():
+                counts["ok"] += 1
+
+            t = threading.Thread(target=worker, daemon=True)
+            t.start()
+            return counts["ok"]             # BAD: no join, no lock
+    """, names=["conc-escaping-state"])
+    assert len(result.findings) == 1
+    assert "counts" in result.findings[0].message
 
 
 # -- suppressions ----------------------------------------------------------
@@ -729,6 +1041,87 @@ def test_cli_diff_root_below_git_toplevel(tmp_path):
     assert proc.returncode == 1, proc.stdout + proc.stderr
     payload = json.loads(proc.stdout)
     assert [f["path"] for f in payload["findings"]] == ["src/m.py"]
+
+
+DIRTY_SRC = """
+def poll(fetch):
+    try:
+        return fetch()
+    except Exception:
+        return None
+"""
+
+
+def test_cli_diff_renamed_file_gates_new_path(tmp_path):
+    """A rename since the base ref: the gate set must track the NEW
+    path (git reports the post-rename name) and never reference — let
+    alone crash on — the old one, which no longer exists on disk."""
+    repo = tmp_path / "repo"
+    (repo / "src").mkdir(parents=True)
+    (repo / "src" / "old_name.py").write_text(DIRTY_SRC)
+    _git(repo, "init", "-q")
+    _git(repo, "-c", "user.email=t@t", "-c", "user.name=t", "add", ".")
+    _git(repo, "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-qm", "seed")
+    _git(repo, "mv", "src/old_name.py", "src/new_name.py")
+    proc = _run_cli(["--diff", "HEAD", "--format", "json",
+                     "--baseline", "none", "--root", str(repo),
+                     str(repo / "src")], cwd=str(repo))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert [f["path"] for f in payload["findings"]] == ["src/new_name.py"]
+    assert "old_name" not in proc.stdout
+
+
+def test_cli_diff_deleted_file_does_not_crash(tmp_path):
+    """A file deleted since the base ref must simply drop out of the
+    gate set — the run must not crash trying to analyze it, and a
+    finding it used to carry must not resurface anywhere."""
+    repo = tmp_path / "repo"
+    (repo / "src").mkdir(parents=True)
+    (repo / "src" / "doomed.py").write_text(DIRTY_SRC)
+    (repo / "src" / "kept.py").write_text("x = 1\n")
+    _git(repo, "init", "-q")
+    _git(repo, "-c", "user.email=t@t", "-c", "user.name=t", "add", ".")
+    _git(repo, "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-qm", "seed")
+    (repo / "src" / "doomed.py").unlink()
+    proc = _run_cli(["--diff", "HEAD", "--format", "json",
+                     "--baseline", "none", "--root", str(repo),
+                     str(repo / "src")], cwd=str(repo))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["summary"]["new"] == 0
+    assert "doomed" not in proc.stdout
+
+
+def test_cli_diff_moved_declaration_file_keeps_whole_program_rules(tmp_path):
+    """A fixture-adjacent move: a whole-program declaration file
+    (``parallel/mesh.py`` — the sharding axis vocabulary) moved since
+    the base ref.  The full-tree analysis must pick the vocabulary up at
+    its NEW location (axis uses elsewhere stay consistent), and the gate
+    must track the moved file's new path without crashing on the old."""
+    repo = tmp_path / "repo"
+    (repo / "old_parallel").mkdir(parents=True)
+    (repo / "ops").mkdir()
+    (repo / "old_parallel" / "mesh.py").write_text(
+        'DEFAULT_AXES = ("data", "model")\n')
+    (repo / "ops" / "use.py").write_text(
+        "def run(x, data_axis='data'):\n    return x\n")
+    _git(repo, "init", "-q")
+    _git(repo, "-c", "user.email=t@t", "-c", "user.name=t", "add", ".")
+    _git(repo, "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-qm", "seed")
+    (repo / "parallel").mkdir()
+    _git(repo, "mv", "old_parallel/mesh.py", "parallel/mesh.py")
+    proc = _run_cli(["--diff", "HEAD", "--format", "json",
+                     "--baseline", "none", "--root", str(repo),
+                     str(repo)], cwd=str(repo))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    # the vocabulary was found at the new path: the valid axis default
+    # in ops/use.py raises no shard-unknown-axis finding
+    assert payload["summary"]["new"] == 0
 
 
 def test_cli_sarif_file_side_output(tmp_path):

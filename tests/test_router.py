@@ -29,6 +29,7 @@ from glom_tpu.serving.router import (
     NoHealthyReplica,
     make_router_server,
 )
+from tests.polling import poll_until
 
 
 class FakeClock:
@@ -612,18 +613,22 @@ class TestFleetIntegration:
 
         # the engine records respond AFTER writing the reply, so the
         # client can observe the response before the handler thread logs
-        # the span — poll briefly instead of racing it (the loadgen
-        # --smoke pattern)
-        engine_spans = []
-        deadline = time.monotonic() + 5.0
-        while time.monotonic() < deadline:
-            engine_spans = []
+        # the span — poll briefly instead of racing it (the shared
+        # read-after-reply helper, same as loadgen --smoke)
+        def spans_with_respond():
+            spans = []
             for eng, _ in members:
-                engine_spans += [s.to_dict()
-                                 for s in eng.tracer.sink.trace(rid)]
-            if {"respond"} <= {s["name"] for s in engine_spans}:
-                break
-            time.sleep(0.01)
+                spans += [s.to_dict()
+                          for s in eng.tracer.sink.trace(rid)]
+            if {"respond"} <= {s["name"] for s in spans}:
+                return spans
+            return None
+
+        # on timeout, fall back to whatever spans DID arrive so the
+        # assertion failure names them instead of an empty list
+        engine_spans = poll_until(spans_with_respond) or [
+            s.to_dict() for eng, _ in members
+            for s in eng.tracer.sink.trace(rid)]
         root = next(s for s in engine_spans if s["name"] == "request")
         assert root["trace_id"] == rid
         assert root["parent_id"] == proxy["span_id"]

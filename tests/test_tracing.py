@@ -30,6 +30,7 @@ from glom_tpu.obs.tracing import (
     span_coverage,
     to_perfetto,
 )
+from tests.polling import poll_until
 
 TOOLS = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
@@ -356,17 +357,17 @@ def _post(url, path, payload, headers=None):
 
 def _wait_trace(eng, trace_id, timeout=5.0):
     """The server closes the root span AFTER writing the reply; poll for
-    the closed root instead of racing the handler thread."""
-    import time
-
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
+    the closed root instead of racing the handler thread (the shared
+    read-after-reply helper)."""
+    def closed_root():
         spans = eng.tracer.sink.trace(trace_id)
         root = next((s for s in spans if s.root), None)
         if root is not None and root.end is not None:
             return spans
-        time.sleep(0.01)
-    return eng.tracer.sink.trace(trace_id)
+        return None
+
+    return poll_until(closed_root, timeout=timeout) \
+        or eng.tracer.sink.trace(trace_id)
 
 
 class TestHTTPTracePropagation:
@@ -418,18 +419,14 @@ class TestHTTPTracePropagation:
         # the joined trace still reaches the JSONL feed (root detection
         # must not conflate root-ness with parent_id None) with a
         # computable coverage.  The file write trails the sink's root-end
-        # by a scheduling window — poll it like _wait_trace polls the sink
-        import time as _time
-
-        deadline = _time.monotonic() + 5.0
-        mine = []
-        while _time.monotonic() < deadline:
+        # by a scheduling window — poll it like _wait_trace polls the
+        # sink (the shared read-after-reply helper)
+        def joined_records():
             with open(trace_log) as f:
                 recs = [json.loads(line) for line in f if line.strip()]
-            mine = [r for r in recs if r["trace_id"] == "ab" * 16]
-            if mine:
-                break
-            _time.sleep(0.01)
+            return [r for r in recs if r["trace_id"] == "ab" * 16]
+
+        mine = poll_until(joined_records) or []
         assert len(mine) == 1 and mine[0]["root"] == "request"
         assert span_coverage(mine[0]["spans"]) is not None
 

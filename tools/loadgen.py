@@ -596,25 +596,27 @@ def run_smoke(fleet: bool = False) -> int:
             # spans explaining the request span's wall time.  The server
             # closes the root span AFTER writing the reply, so the client
             # can get here before the handler thread records it — poll
-            # briefly instead of racing it.
-            spans = []
-            deadline = time.monotonic() + 5.0
-            while time.monotonic() < deadline:
-                spans = [s.to_dict()
-                         for s in engine.tracer.sink.trace(request_id)]
-                root = next((s for s in spans if s.get("root_span")), None)
+            # briefly instead of racing it (--smoke runs from a checkout,
+            # so the shared tests/ helper is importable).
+            from tests.polling import poll_until
+
+            def closed_root_spans():
+                out = [s.to_dict()
+                       for s in engine.tracer.sink.trace(request_id)]
+                root = next((s for s in out if s.get("root_span")), None)
                 if root is not None and root.get("end") is not None:
-                    break
-                time.sleep(0.01)
+                    return out
+                return None
+
+            spans = poll_until(closed_root_spans) or [
+                s.to_dict() for s in engine.tracer.sink.trace(request_id)]
             if fleet:
                 # the STITCHED trace is the honest denominator: the
                 # router_request root's wall time, explained by router-
                 # AND engine-side spans joined over the hop
                 from glom_tpu.obs.observatory import stitch
 
-                deadline = time.monotonic() + 5.0
-                stitched = None
-                while time.monotonic() < deadline:
+                def both_segments():
                     segments = []
                     for src, tracer in (("router", router.tracer),
                                         ("replica", engine.tracer)):
@@ -622,12 +624,11 @@ def run_smoke(fleet: bool = False) -> int:
                         segments.extend(
                             (src, r) for r in recs
                             if r.get("trace_id") == request_id)
-                    if len(segments) >= 2:
-                        stitched = stitch(segments)
-                        break
-                    time.sleep(0.01)
-                if stitched is not None:
-                    spans = stitched["spans"]
+                    return segments if len(segments) >= 2 else None
+
+                segments = poll_until(both_segments)
+                if segments:
+                    spans = stitch(segments)["spans"]
             coverage = span_coverage(spans)
             perfetto_path = os.path.join(
                 tempfile.gettempdir(), "glom_smoke_trace.json")
