@@ -379,6 +379,18 @@ PROTOCOL_SPECS: Tuple[ProtocolSpec, ...] = (
         scope=("serving",),
     ),
     ProtocolSpec(
+        name="deploy-lifecycle",
+        begin=("begin_shadow", "begin_canary"),
+        settle=("promote", "rollback", "abort"),
+        description="a started shadow/canary deploy must reach "
+                    "promote/rollback/abort on every path — an unsettled "
+                    "candidate is a resident device param tree leak AND "
+                    "leaves live traffic split against a version nobody "
+                    "is evaluating (the PR 7 stranded-staged-tree class, "
+                    "at deploy granularity)",
+        scope=("serving",),
+    ),
+    ProtocolSpec(
         name="spill-after-drain",
         kind="precede",
         begin=("spill",),

@@ -45,13 +45,16 @@ class SLO:
     objective encodes the percentile — objective 0.95 + threshold 250
     reads "p95 < 250 ms") or ``"error_rate"`` (bad = request errored;
     objective 0.99 reads "error rate < 1%").  ``endpoint`` None matches
-    every endpoint."""
+    every endpoint; ``tenant`` None matches every tenant (a per-tenant
+    SLO sees only that tenant's outcomes — the alerting half of the
+    bulkhead: tenant A's burn can never page for tenant B's traffic)."""
 
     name: str
     kind: str                       # "latency" | "error_rate"
     objective: float                # good fraction promised, in (0, 1)
     threshold_ms: Optional[float] = None   # latency kind only
     endpoint: Optional[str] = None          # None = all endpoints
+    tenant: Optional[str] = None            # None = all tenants
     short_window_s: float = 60.0
     long_window_s: float = 300.0
     burn_threshold: float = 2.0     # both windows must burn past this
@@ -87,10 +90,12 @@ class SLO:
 
 
 _LATENCY_RE = re.compile(
-    r"^(?:(?P<ep>[a-z_]+):)?p(?P<pct>\d{1,2}(?:\.\d+)?)<(?P<ms>\d+(?:\.\d+)?)ms$"
+    r"^(?:(?P<tenant>[A-Za-z0-9._-]+)/)?(?:(?P<ep>[a-z_]+):)?"
+    r"p(?P<pct>\d{1,2}(?:\.\d+)?)<(?P<ms>\d+(?:\.\d+)?)ms$"
 )
 _ERROR_RE = re.compile(
-    r"^(?:(?P<ep>[a-z_]+):)?errors<(?P<pct>\d+(?:\.\d+)?)%$"
+    r"^(?:(?P<tenant>[A-Za-z0-9._-]+)/)?(?:(?P<ep>[a-z_]+):)?"
+    r"errors<(?P<pct>\d+(?:\.\d+)?)%$"
 )
 
 
@@ -101,6 +106,9 @@ def parse_slo(spec: str, **overrides) -> SLO:
       * ``p99<1000ms``      — latency, all endpoints
       * ``errors<1%``       — error rate under 1% (objective 0.99)
       * ``embed:errors<0.5%``
+      * ``acme/embed:p95<250ms`` — per-tenant: only outcomes tagged
+        tenant ``acme`` feed this target (the bulkhead's alerting half)
+      * ``acme/errors<1%``
 
     ``overrides`` pass through to :class:`SLO` (windows, burn threshold).
     """
@@ -111,7 +119,7 @@ def parse_slo(spec: str, **overrides) -> SLO:
             name=spec, kind="latency",
             objective=float(m.group("pct")) / 100.0,
             threshold_ms=float(m.group("ms")),
-            endpoint=m.group("ep"), **overrides,
+            endpoint=m.group("ep"), tenant=m.group("tenant"), **overrides,
         )
     m = _ERROR_RE.match(spec)
     if m:
@@ -120,10 +128,11 @@ def parse_slo(spec: str, **overrides) -> SLO:
             raise ValueError(f"error-rate bound must be in (0, 100)%: {spec!r}")
         return SLO(
             name=spec, kind="error_rate", objective=1.0 - rate,
-            endpoint=m.group("ep"), **overrides,
+            endpoint=m.group("ep"), tenant=m.group("tenant"), **overrides,
         )
     raise ValueError(
-        f"unparseable SLO spec {spec!r} (want 'ep:p95<250ms' or 'errors<1%')"
+        f"unparseable SLO spec {spec!r} (want '[tenant/][ep:]p95<250ms' "
+        f"or '[tenant/]errors<1%')"
     )
 
 
@@ -238,11 +247,14 @@ class SloManager:
 
     def observe(self, endpoint: str, latency_ms: Optional[float],
                 error: bool, trace_id: Optional[str] = None,
-                step: int = 0) -> List[Dict[str, Any]]:
+                step: int = 0,
+                tenant: Optional[str] = None) -> List[Dict[str, Any]]:
         fired = []
         for ev in self.evaluators:
             slo = ev.slo
             if slo.endpoint is not None and slo.endpoint != endpoint:
+                continue
+            if slo.tenant is not None and slo.tenant != tenant:
                 continue
             if slo.kind == "latency":
                 if latency_ms is None:

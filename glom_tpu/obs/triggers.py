@@ -29,6 +29,10 @@ TRIGGER_STEP_TIME = "step_time_regression"
 TRIGGER_QUEUE_SATURATION = "queue_saturation"
 # serving-side: multi-window SLO burn-rate breach (glom_tpu.obs.slo)
 TRIGGER_SLO_BURN = "slo_burn"
+# serving-side: a shadow/canary deploy candidate burned its error budget
+# and was auto-retired (glom_tpu.serving.deploy) — the bundle names the
+# offending traces and the before/after version pins
+TRIGGER_DEPLOY_ROLLBACK = "deploy_rollback"
 # resilience-side (glom_tpu.resilience): a checkpoint failed integrity
 # verification and was quarantined; a supervised fit() crashed and restarted
 TRIGGER_CKPT_CORRUPT = "ckpt_corrupt"

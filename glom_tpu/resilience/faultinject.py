@@ -36,6 +36,9 @@ Sites (the names production code passes to :func:`fire`):
                                             stalled input, pipeline crash)
   reload      io_error, corrupt_manifest    ``serving/engine.py`` hot-reload
                                             watcher polls
+  candidate   delay, error                  ``serving/deploy.py`` candidate
+                                            executes (a regressing shadow/
+                                            canary deploy candidate)
   host_preempt       kill                   ``resilience/elastic.py`` per-step
                                             tick (one fault domain dies)
   coordinator_loss   lost                   elastic tick (coordinator stops
@@ -68,6 +71,11 @@ KINDS = {
     "ckpt_write": ("torn", "bitflip"),
     "data": ("nan_batch", "drop_batch", "delay", "crash"),
     "reload": ("io_error", "corrupt_manifest"),
+    # deploy-candidate regression (glom_tpu.serving.deploy): fired once
+    # per candidate execute (shadow mirror or live canary batch) —
+    # "delay" makes the candidate measurably slow (client-visible latency
+    # on canary traffic, never an error), "error" fails the execute
+    "candidate": ("delay", "error"),
     # elastic multi-host sites (glom_tpu.resilience.elastic): fired from
     # ElasticContext.tick (the per-global-step seam) and the supervisor's
     # re-plan, so every recovery path is deterministic on CPU

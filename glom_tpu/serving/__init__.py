@@ -29,7 +29,17 @@ telemetry/forensics stack (PRs 1-2) on the request path:
   * :mod:`glom_tpu.serving.router` — the fleet tier: one front door over
     N engine replicas (least-loaded + consistent-hash dispatch,
     health-aware ejection/re-admission, aggregated per-replica metrics,
-    trace propagation through the hop, coordinated two-phase hot-reload).
+    trace propagation through the hop, coordinated two-phase hot-reload);
+  * :mod:`glom_tpu.serving.registry` — the multi-tenant model registry:
+    named models/versions resident at once, per-version compile-cache
+    namespaces with AOT aliasing, checkpoint lineage anchored on
+    ``integrity.latest_valid_step``;
+  * :mod:`glom_tpu.serving.deploy` — the safe-deploy state machine:
+    shadow (mirrored, discarded, candidate-only accounting) -> canary
+    (deterministic affinity-hashed fraction) -> burn-rate auto-promote /
+    auto-rollback with a ``deploy_rollback`` forensics bundle; tenant
+    bulkheads (token-bucket admission, per-tenant SLOs/metrics) ride
+    :mod:`glom_tpu.serving.batcher`'s :class:`TenantAdmission`.
 
 ``tools/loadgen.py`` drives it (closed/open loop, p50/p95/p99 report,
 multi-target + per-replica breakdown); ``docs/SERVING.md`` documents
@@ -43,6 +53,13 @@ from glom_tpu.serving.batcher import (  # noqa: F401
     Closed,
     DynamicBatcher,
     Overloaded,
+    TenantAdmission,
+    TenantQuotaExceeded,
+    TokenBucket,
+)
+from glom_tpu.serving.registry import (  # noqa: F401
+    ModelRegistry,
+    ModelVersion,
 )
 from glom_tpu.serving.compile_cache import (  # noqa: F401
     BucketedCompileCache,

@@ -17,6 +17,15 @@ queue already holds ``max_queue`` images, ``submit`` raises
 behind an unbounded queue just times out and retries, making the overload
 worse (the PAPERS.md serving lesson: shed early, never queue unboundedly).
 
+**Tenant bulkheads**: :class:`TenantAdmission` holds one token bucket per
+configured tenant (``rate[:burst]`` in images/s), shared across every
+endpoint batcher of an engine.  A tenant past its quota sheds with
+:class:`TenantQuotaExceeded` — a 503 the CLIENT can attribute to its own
+budget — while other tenants' admission, queueing, and latency are
+untouched: the quota keeps any one tenant from filling the shared queue,
+which is the isolation the per-tenant SLOs (:mod:`glom_tpu.obs.slo`)
+promise.
+
 Time is injectable (``clock``) and the flush decision is a pure function
 of queue state + clock (:meth:`next_batch` with ``block=False`` never
 sleeps), so every semantics test runs deterministically with a fake clock
@@ -39,8 +48,140 @@ class Overloaded(RuntimeError):
     """Queue at capacity: the request was shed, not enqueued."""
 
 
+class TenantQuotaExceeded(Overloaded):
+    """One tenant's token bucket is empty: only THAT tenant's request was
+    shed — the bulkhead contract (a saturating tenant never consumes the
+    shared queue's headroom)."""
+
+    def __init__(self, message: str, tenant: str):
+        super().__init__(message)
+        self.tenant = tenant
+
+
 class Closed(RuntimeError):
     """Submitted after shutdown began: the request was not enqueued."""
+
+
+class TokenBucket:
+    """Classic token bucket over an injectable clock: ``rate`` tokens/s
+    refill up to ``burst`` capacity; :meth:`take` consumes atomically or
+    not at all.  NOT internally locked — the owner
+    (:class:`TenantAdmission`) serializes access."""
+
+    def __init__(self, rate: float, burst: float, *, clock=None):
+        if rate <= 0:
+            raise ValueError(f"rate must be > 0 tokens/s, got {rate}")
+        if burst < 1:
+            raise ValueError(f"burst must be >= 1 token, got {burst}")
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._clock = clock if clock is not None else time.monotonic
+        self._tokens = self.burst  # a fresh tenant starts with full burst
+        self._last = self._clock()
+
+    def _refill(self) -> None:
+        now = self._clock()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._last) * self.rate)
+        self._last = now
+
+    def take(self, n: float = 1.0) -> bool:
+        self._refill()
+        if self._tokens >= n:
+            self._tokens -= n
+            return True
+        return False
+
+    @property
+    def tokens(self) -> float:
+        self._refill()
+        return self._tokens
+
+
+def parse_quota(spec) -> "tuple":
+    """``"RATE"`` or ``"RATE:BURST"`` (images/s; burst defaults to
+    ``max(rate, 1)``) -> ``(rate, burst)``.  Tuples/lists pass through."""
+    if isinstance(spec, (tuple, list)):
+        rate, burst = float(spec[0]), float(spec[1])
+        return rate, burst
+    text = str(spec)
+    if ":" in text:
+        rate_s, burst_s = text.split(":", 1)
+        return float(rate_s), float(burst_s)
+    rate = float(text)
+    return rate, max(rate, 1.0)
+
+
+class TenantAdmission:
+    """Per-tenant token-bucket bulkheads, shared across every endpoint
+    batcher of one engine (a quota is a promise about the TENANT's load,
+    not about one endpoint's).
+
+    ``quotas`` maps tenant name -> quota spec (:func:`parse_quota`);
+    tenants without a configured quota are unlimited here and bounded
+    only by the global ``max_queue``.  :meth:`admit` consumes
+    ``images`` tokens or raises :class:`TenantQuotaExceeded` — the shed
+    is charged to the tenant (tokens are only consumed on admission, so
+    a storm of rejected requests cannot starve the tenant's own future
+    budget).  Injectable clock; internally locked (handler threads race
+    through admission)."""
+
+    def __init__(self, quotas: dict, *, clock=None):
+        clock = clock if clock is not None else time.monotonic
+        self._buckets = {}
+        self._limits = {}
+        for tenant, spec in (quotas or {}).items():
+            rate, burst = parse_quota(spec)
+            self._buckets[tenant] = TokenBucket(rate, burst, clock=clock)
+            self._limits[tenant] = (rate, burst)
+        self._lock = threading.Lock()
+        self.admitted: dict = {t: 0 for t in self._buckets}
+        self.shed: dict = {t: 0 for t in self._buckets}
+
+    def admit(self, tenant: Optional[str], images: int) -> None:
+        if tenant is None:
+            return
+        with self._lock:
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                return
+            if bucket.take(images):
+                self.admitted[tenant] += images
+                return
+            self.shed[tenant] += 1
+        raise TenantQuotaExceeded(
+            f"tenant {tenant!r} over its admission quota "
+            f"({self._limits[tenant][0]:g} imgs/s, "
+            f"burst {self._limits[tenant][1]:g}); request shed",
+            tenant,
+        )
+
+    def refund(self, tenant: Optional[str], images: int) -> None:
+        """Return tokens consumed for a request that was then rejected
+        DOWNSTREAM (global queue shed): the tenant's budget must reflect
+        work actually admitted, or a fleet-wide overload would burn
+        every tenant's quota for requests nobody served."""
+        if tenant is None:
+            return
+        with self._lock:
+            bucket = self._buckets.get(tenant)
+            if bucket is not None:
+                bucket._tokens = min(bucket.burst, bucket._tokens + images)
+                self.admitted[tenant] = max(0, self.admitted[tenant] - images)
+
+    def snapshot(self) -> dict:
+        """Per-tenant quota state for ``/healthz``."""
+        with self._lock:
+            return {
+                tenant: {
+                    "rate": self._limits[tenant][0],
+                    "burst": self._limits[tenant][1],
+                    "tokens": round(self._buckets[tenant].tokens, 3),
+                    "admitted_images": self.admitted[tenant],
+                    "shed_requests": self.shed[tenant],
+                }
+                for tenant in sorted(self._buckets)
+            }
 
 
 @dataclass
@@ -53,6 +194,12 @@ class _Item:
     ctx: Any = None          # the request's span context (root span)
     queue_span: Any = None   # open queue_wait span, closed at batch take
     batch_span: Any = None   # the batch-level span this item flushed into
+    # -- multi-tenant / multi-version routing (engine.process_once) --
+    tenant: Optional[str] = None
+    # (model, step) the item must execute against; None = the default
+    # model's primary params.  Items with different keys share a flush
+    # but execute as separate groups (one params tree per dispatch).
+    mkey: Any = None
 
 
 class BatcherStats:
@@ -104,12 +251,16 @@ class DynamicBatcher:
         with self._cond:
             return self._queued
 
-    def submit(self, payload: Any, size: int = 1, *, ctx=None) -> Future:
+    def submit(self, payload: Any, size: int = 1, *, ctx=None,
+               tenant: Optional[str] = None, mkey: Any = None) -> Future:
         """Enqueue ``payload`` (``size`` images); returns the Future the
         worker resolves.  Raises :class:`Overloaded` at capacity (shed) or
         :class:`Closed` after shutdown began.  ``ctx`` (a span context
         from :mod:`glom_tpu.obs.tracing`) opens a ``queue_wait`` span
-        under the request's trace, closed when the batch is taken."""
+        under the request's trace, closed when the batch is taken.
+        ``tenant`` labels the item (quota admission happens upstream in
+        the engine, against the shared :class:`TenantAdmission`);
+        ``mkey`` pins the item to a (model, step) params tree."""
         if size < 1:
             raise ValueError(f"size must be >= 1, got {size}")
         if size > self.max_batch:
@@ -127,7 +278,8 @@ class DynamicBatcher:
                     f"images); request shed"
                 )
             item = _Item(payload=payload, size=size,
-                         enqueued_at=self._clock(), ctx=ctx)
+                         enqueued_at=self._clock(), ctx=ctx,
+                         tenant=tenant, mkey=mkey)
             if self._tracer is not None and ctx is not None:
                 from glom_tpu.obs.tracing import SPAN_QUEUE_WAIT
 

@@ -87,7 +87,12 @@ from glom_tpu.obs.triggers import (
 from glom_tpu.resilience import faultinject, integrity
 from glom_tpu.serving import quant as serving_quant
 from glom_tpu.serving import sessions as serving_sessions
-from glom_tpu.serving.batcher import Closed, DynamicBatcher, Overloaded  # noqa: F401
+from glom_tpu.serving.batcher import (  # noqa: F401
+    Closed,
+    DynamicBatcher,
+    Overloaded,
+    TenantQuotaExceeded,
+)
 from glom_tpu.serving.compile_cache import BucketedCompileCache
 from glom_tpu.training import denoise
 
@@ -242,6 +247,13 @@ class ServingEngine:
         session_ttl_s: float = 600.0,
         session_max_bytes: int = 256 * 2 ** 20,
         session_spill_dir: Optional[str] = None,
+        tenant_quotas: Optional[Dict[str, object]] = None,
+        extra_models: Optional[Dict[str, str]] = None,
+        deploy_promote_after: int = 3,
+        deploy_window_s: Optional[float] = None,
+        deploy_min_events: Optional[int] = None,
+        deploy_canary_fraction: float = 0.1,
+        deploy_pin_url: Optional[str] = None,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.registry = registry if registry is not None else MetricRegistry()
@@ -503,6 +515,53 @@ class ServingEngine:
                 tracer=self.tracer,
             )
 
+        # -- model registry (glom_tpu.serving.registry) --------------------
+        # Every servable (model, step) is a registry record; the startup
+        # tree is the default model's primary, kept in sync by every
+        # param-swap path.  A deploy candidate or an extra model is just
+        # another resident record the partitioned execute can target.
+        from glom_tpu.serving import registry as model_registry
+
+        self.models = model_registry.ModelRegistry(
+            registry=self.registry, clock=self._clock)
+        self._signature = model_registry.cache_signature(
+            self.config, quant, buckets, iters=iters, mesh_axes=mesh_axes)
+        self.models.register(
+            model_registry.DEFAULT_MODEL, step, params=self._params,
+            caches=self.caches, config=serve_cfg, train_cfg=self.train_cfg,
+            signature=self._signature, source_dir=checkpoint_dir,
+            quant=quant, role="primary",
+        )
+        for name, model_dir in (extra_models or {}).items():
+            if name == model_registry.DEFAULT_MODEL:
+                raise ValueError(
+                    f"extra model name {name!r} collides with the "
+                    f"engine's own model")
+            model_registry.load_version(
+                name, model_dir, buckets=buckets, quant=quant, iters=iters,
+                donate=donate_inputs, warmup=warmup, models=self.models,
+                role="primary",
+            )
+
+        # -- tenant bulkheads (glom_tpu.serving.batcher) -------------------
+        # One TenantAdmission shared across endpoints: a tenant's quota
+        # is a promise about the tenant, not one queue.  Tenants without
+        # a configured quota ride the global max_queue bound only.
+        from glom_tpu.serving.batcher import TenantAdmission
+
+        self.tenants: Optional[TenantAdmission] = (
+            TenantAdmission(tenant_quotas, clock=self._clock)
+            if tenant_quotas else None)
+
+        # -- shadow/canary deploys (glom_tpu.serving.deploy) ---------------
+        from glom_tpu.serving.deploy import DeployController
+
+        self.deploy = DeployController(
+            self, promote_after=deploy_promote_after,
+            window_s=deploy_window_s, min_events=deploy_min_events,
+            canary_fraction=deploy_canary_fraction, pin_url=deploy_pin_url,
+        )
+
         # -- staged (two-phase) reload state -------------------------------
         # ``_staged`` holds (step, placed-params) loaded by stage_reload()
         # but not yet serving; ``_prev`` holds the (step, params) a commit
@@ -628,6 +687,7 @@ class ServingEngine:
         for batcher in self.batchers.values():
             batcher.close(drain=drain)
         self._stop.set()
+        self.deploy.close()
         deadline = time.monotonic() + timeout  # glomlint: disable=conc-raw-clock -- the drain deadline must track wall time: under a fake test clock the joins would otherwise never time out
         for t in self._threads:
             t.join(timeout=max(0.0, deadline - time.monotonic()))  # glomlint: disable=conc-raw-clock -- paired with the wall-clock deadline above
@@ -784,6 +844,10 @@ class ServingEngine:
         self.registry.gauge(
             "serving_checkpoint_step", help="step of the params being served",
         ).set(step)
+        # every swap path re-anchors the registry's primary record, so
+        # the residency view (and /healthz's models block) never drifts
+        # from what actually serves
+        self.models.sync_primary("default", step, self._params)
 
     # -- staged (two-phase) reload: the fleet coordination primitive -------
     def stage_reload(self, step: Optional[int] = None) -> Optional[int]:
@@ -901,7 +965,32 @@ class ServingEngine:
                 "serving_checkpoint_step",
                 help="step of the params being served",
             ).set(old_step)
+            self.models.sync_primary("default", old_step, old_params,
+                                     source="rollback")
             return int(old_step)
+
+    def promote_candidate(self, step: int) -> int:
+        """The deploy controller's local promote: the RESIDENT candidate
+        becomes primary through the same atomic reference swap as a
+        staged commit (no restore — the tree is already placed), keeping
+        the displaced params as the staged-API rollback point until
+        :meth:`finalize_reload`."""
+        version = self.models.get("default", int(step))
+        if version is None:
+            raise KeyError(f"no resident default@{step} to promote")
+        with self._reload_lock:
+            span = self.tracer.start_trace(
+                SPAN_RELOAD, attrs={"from_step": int(self.step),
+                                    "to_step": int(step),
+                                    "phase": "promote"},
+            )
+            with self._lock:
+                self._prev = (self.step, self._params)
+                self._params = version.params
+                self.step = int(step)
+            self.tracer.end(span)
+            self._note_swap(int(step))
+        return int(step)
 
     def _watch_loop(self) -> None:
         # consecutive FULLY-failed polls stretch the wait (doubling, capped
@@ -920,18 +1009,49 @@ class ServingEngine:
                 self.sessions.sweep()
 
     # -- request path ------------------------------------------------------
-    def submit(self, endpoint: str, imgs: np.ndarray, *, ctx=None):
+    def submit(self, endpoint: str, imgs: np.ndarray, *, ctx=None,
+               tenant: Optional[str] = None, model: Optional[str] = None,
+               version: Optional[int] = None):
         """Enqueue a ``(k, c, H, W)`` batch for ``endpoint``; returns the
         Future resolving to the endpoint's output for those ``k`` images.
         Raises :class:`Overloaded` (shed) or :class:`Closed` (shutting
         down) — the server maps both to structured 503s.  ``ctx`` (the
         request's root span) threads the trace through the batcher and
-        executor."""
+        executor.
+
+        ``tenant`` passes the request through its admission quota
+        (:class:`~glom_tpu.serving.batcher.TenantAdmission`; a tenant
+        past its token bucket sheds with
+        :class:`~glom_tpu.serving.batcher.TenantQuotaExceeded` — only
+        its own traffic).  ``model`` targets a non-default registry
+        model; ``version`` pins the default model's deploy-candidate
+        step (the server derives it from
+        :meth:`DeployController.assign`).  Items tagged differently
+        share a flush but execute as separate groups."""
+        if self.tenants is not None:
+            try:
+                self.tenants.admit(tenant, int(imgs.shape[0]))
+            except TenantQuotaExceeded:
+                self._note_tenant_shed(tenant)
+                raise
+        mkey = None
+        if model is not None:
+            if self.models.get(model) is None:
+                raise ValueError(f"unknown model {model!r}; resident: "
+                                 f"{self.models.models()}")
+            mkey = (model, None)
+        elif version is not None:
+            mkey = ("default", int(version))
         batcher = self.batchers[endpoint]
         try:
             future = batcher.submit(np.ascontiguousarray(imgs, dtype=np.float32),
-                                    size=imgs.shape[0], ctx=ctx)
+                                    size=imgs.shape[0], ctx=ctx,
+                                    tenant=tenant, mkey=mkey)
         except Overloaded:
+            if self.tenants is not None:
+                # the tokens bought nothing — a GLOBAL queue shed must
+                # not also burn the tenant's own future budget
+                self.tenants.refund(tenant, int(imgs.shape[0]))
             self.registry.counter(
                 "serving_shed_total", help="requests shed at queue capacity",
             ).inc()
@@ -940,56 +1060,144 @@ class ServingEngine:
         self._observe_saturation(endpoint)
         return future
 
+    def _note_tenant_shed(self, tenant: Optional[str]) -> None:
+        """Quota-shed accounting shared by the batched and session
+        admission paths."""
+        self.registry.counter(
+            "serving_shed_total", help="requests shed at queue capacity",
+        ).inc()
+        self.registry.counter(
+            self.registry.labeled("serving_tenant_shed_", tenant),
+            help="requests shed at a tenant's admission quota",
+        ).inc()
+
+    def _resolve_group(self, endpoint: str, mkey):
+        """``(params, cache, retired)`` for one execute group.  ``mkey``
+        None is the default primary (the overwhelmingly common group and
+        the only one most deployments ever see); ``("default", step)`` is
+        the deploy candidate — when it was retired between submit and
+        execute, the group falls back to the primary (safe: same config,
+        and exactly the documented post-rollback contract); ``(model,
+        None)`` is an extra registry model's primary."""
+        if mkey is None:
+            return self.params, self.caches[endpoint], False
+        model, step = mkey
+        if model == "default" and step is not None:
+            version = self.deploy.candidate(step)
+            if version is None:
+                return self.params, self.caches[endpoint], True
+            return version.params, version.caches[endpoint], False
+        version = self.models.get(model)
+        if version is None:
+            raise RuntimeError(f"model {model!r} was retired with items "
+                               f"in flight")
+        return version.params, version.caches[endpoint], False
+
     def process_once(self, endpoint: str, *, block: bool = False,
                      timeout: Optional[float] = None) -> int:
         """Pull one batch (if a flush rule fired) and run it; returns the
         number of images served.  The worker thread loops the blocking
-        form; tests call the non-blocking form directly."""
+        form; tests call the non-blocking form directly.
+
+        Items tagged with different ``mkey``s (deploy-candidate canary
+        traffic, extra registry models) share the flush but execute as
+        separate groups — one params tree per dispatch, each padded to
+        its own bucket against already-warm AOT executables, so the
+        partition costs no compiles.  A group's failure fails only its
+        own items' futures.  With an active shadow deploy, the primary
+        group's images are mirrored (non-blocking, lossy) onto the
+        shadow executor after the primary futures resolve."""
         batcher = self.batchers[endpoint]
         batch = batcher.next_batch(block=block, timeout=timeout)
         if not batch:
             return 0
-        cache = self.caches[endpoint]
-        params = self.params  # snapshot: in-flight work finishes on these
-        arrays = [item.payload for item in batch]
         # span contexts this batch reports under: the batch-level span
         # (created at take, carries the links) first — it feeds the
         # duration histograms — then each member request's root span (the
         # same physical pad/execute mirrored into every trace that paid
         # for it)
         batch_span = batch[0].batch_span
-        member_ctxs = [it.ctx for it in batch if it.ctx is not None]
-        contexts = ([batch_span] if batch_span is not None else []) + member_ctxs
+        n_total = sum(item.size for item in batch)
+        groups: Dict = {}
+        for item in batch:
+            groups.setdefault(item.mkey, []).append(item)
+        # assembly (the host-side concat into per-group device batches)
+        # is timed once for the whole flush and mirrored into every
+        # member trace, exactly as before the partition existed
         t_asm0 = self.tracer.clock()
-        imgs = arrays[0] if len(arrays) == 1 else np.concatenate(arrays)
-        n = imgs.shape[0]
-        if contexts:
+        group_imgs = {}
+        for mkey, items in groups.items():
+            arrays = [item.payload for item in items]
+            group_imgs[mkey] = (arrays[0] if len(arrays) == 1
+                                else np.concatenate(arrays))
+        if batch_span is not None or any(it.ctx is not None for it in batch):
             t_asm1 = self.tracer.clock()
-            for i, ctx in enumerate(contexts):
+            all_ctxs = ([batch_span] if batch_span is not None else []) + [
+                it.ctx for it in batch if it.ctx is not None]
+            for i, ctx in enumerate(all_ctxs):
                 self.tracer.record(
                     SPAN_BATCH_ASSEMBLY, ctx, t_asm0, t_asm1,
-                    attrs={"items": len(batch), "images": n}, observe=i == 0,
+                    attrs={"items": len(batch), "images": n_total},
+                    observe=i == 0,
                 )
-        t0 = self._clock()
-        try:
-            out = np.asarray(cache(params, imgs, tracer=self.tracer,
-                                   contexts=contexts))
-        except Exception as e:
-            for item in batch:
-                if not item.future.done():
-                    item.future.set_exception(e)
-            if batch_span is not None:
-                self.tracer.end(batch_span, attrs={"error": repr(e)})
-            return 0
-        batch_s = self._clock() - t0
-        offset = 0
-        for item in batch:
-            item.future.set_result(out[offset:offset + item.size])
-            offset += item.size
+        served = 0
+        primary_imgs = None
+        batch_error = None
+        for mkey, items in groups.items():
+            imgs = group_imgs[mkey]
+            n = imgs.shape[0]
+            member_ctxs = [it.ctx for it in items if it.ctx is not None]
+            contexts = ([batch_span] if batch_span is not None
+                        else []) + member_ctxs
+            try:
+                params, cache, retired = self._resolve_group(endpoint, mkey)
+                t0 = self._clock()
+                out = np.asarray(cache(params, imgs, tracer=self.tracer,
+                                       contexts=contexts))
+                if mkey is not None and mkey[1] is not None and not retired:
+                    # canary group: the injected-candidate fault seam
+                    # (chaos's "latency-injected checkpoint" — a delay is
+                    # client-visible latency, never an error)
+                    kind = self.deploy.injected_fault()
+                    if kind == "delay":
+                        self._sleep(self.deploy.fault_delay_s)
+                    elif kind == "error":
+                        raise faultinject.FaultError(
+                            "injected candidate error")
+            except Exception as e:
+                for item in items:
+                    if not item.future.done():
+                        item.future.set_exception(e)
+                batch_error = e
+                continue
+            batch_s = self._clock() - t0
+            offset = 0
+            for item in items:
+                item.future.set_result(out[offset:offset + item.size])
+                offset += item.size
+            if mkey is None:
+                primary_imgs = imgs
+            self._account_batch(endpoint, cache, n, batch_s)
+            if mkey is not None and mkey[0] != "default":
+                self.registry.counter(
+                    self.registry.labeled("serving_model_requests_",
+                                          mkey[0]),
+                    help="images served per non-default registry model",
+                ).inc(n)
+            elif mkey is not None and not retired:
+                self.registry.counter(
+                    "deploy_canary_requests",
+                    help="live images executed against the deploy "
+                         "candidate",
+                ).inc(n)
+            served += n
         if batch_span is not None:
-            self.tracer.end(batch_span)
-        self._account_batch(endpoint, cache, n, batch_s)
-        return n
+            self.tracer.end(batch_span,
+                            attrs=({} if batch_error is None
+                                   else {"error": repr(batch_error)}))
+        if primary_imgs is not None and self.deploy.phase == "shadow":
+            self.deploy.mirror(endpoint, primary_imgs)
+        return served
 
     def _worker_loop(self, endpoint: str) -> None:
         batcher = self.batchers[endpoint]
@@ -1023,7 +1231,8 @@ class ServingEngine:
             return jax.device_put(arr, self._state_sharding)
         return jax.device_put(arr)
 
-    def session_embed(self, session_id: str, imgs: np.ndarray, *, ctx=None):
+    def session_embed(self, session_id: str, imgs: np.ndarray, *, ctx=None,
+                      tenant: Optional[str] = None):
         """One frame of a stateful session: warm-start from the session's
         resident column state at ``warm_iters`` when it exists, full cold
         settle otherwise.  Returns ``(embeddings, info)`` where ``info``
@@ -1043,6 +1252,16 @@ class ServingEngine:
             raise ValueError(
                 f"invalid session id {session_id!r} (want "
                 f"{serving_sessions.SESSION_ID_RE.pattern})")
+        # the bulkhead covers sessions too: a tenant past its bucket
+        # sheds ITS frames before they consume inline device time (the
+        # quota is shared with the batched endpoints — one promise about
+        # the tenant, not one per endpoint)
+        if self.tenants is not None:
+            try:
+                self.tenants.admit(tenant, int(imgs.shape[0]))
+            except TenantQuotaExceeded:
+                self._note_tenant_shed(tenant)
+                raise
         imgs = np.ascontiguousarray(imgs, dtype=np.float32)
         b = imgs.shape[0]
         cold_cache = self.caches["session_cold"]
@@ -1072,7 +1291,34 @@ class ServingEngine:
                     # equilibrium
                     self.sessions.reset(session_id)
                     entry, restart = None, "batch_changed"
+                # glomlint: disable=conc-unguarded-attr -- heuristic step comparison: a reload racing this read at worst defers the cold restart to the next frame; the retired() check itself is locked
+                if (entry is not None and entry.step != self.step
+                        and self.deploy.retired(entry.step)):
+                    # the state was computed by a candidate a rollback/
+                    # abort retired: warm-iterating a retired version's
+                    # equilibrium on primary params would straddle
+                    # versions mid-stream — cold-restart instead
+                    self.sessions.reset(session_id)
+                    entry, restart = None, "version_retired"
                 params = self.params  # snapshot: this frame runs whole on it
+                # glomlint: disable=conc-unguarded-attr -- provenance/version labels; the candidate() lookup below re-validates against the live deploy record
+                serving_step, canary = self.step, False
+                cand_step = self.deploy.candidate_step
+                if cand_step is not None:
+                    # version pinning: a session with RESIDENT state stays
+                    # on the version that computed it (its equilibrium
+                    # must not straddle versions mid-stream); only a cold
+                    # frame follows the deterministic canary assignment
+                    assigned = (cand_step
+                                if entry is not None
+                                and entry.step == cand_step
+                                else (self.deploy.assign(session_id)
+                                      if entry is None else None))
+                    if assigned is not None:
+                        cv = self.deploy.candidate(assigned)
+                        if cv is not None:
+                            params = cv.params
+                            serving_step, canary = cv.step, True
                 t0 = self._clock()
                 if entry is None:
                     out, new_levels = cold_cache(
@@ -1085,8 +1331,7 @@ class ServingEngine:
                     cold, frames = False, entry.frames + 1
                 elapsed = self._clock() - t0
                 self.sessions.put(session_id, new_levels, batch=b,
-                                  # glomlint: disable=conc-unguarded-attr -- provenance label on the stored state; a reload mid-frame legitimately tags the frame with the step it computed on
-                                  bucket=bucket, step=self.step,
+                                  bucket=bucket, step=serving_step,
                                   frames=frames)
         finally:
             with self._session_cv:
@@ -1096,7 +1341,11 @@ class ServingEngine:
         self._account_session(cold, b, elapsed, restart)
         info = {"cold": cold, "frames": frames,
                 "iters": (self._session_cold_iters if cold
-                          else self._session_warm_iters)}
+                          else self._session_warm_iters),
+                "step": int(serving_step)}
+        if canary:
+            # the server routes this outcome to the candidate evaluators
+            info["canary_step"] = int(serving_step)
         if restart is not None:
             info["restart"] = restart
         return out, info
@@ -1206,7 +1455,9 @@ class ServingEngine:
                             ).set(batcher.depth)
 
     def observe_outcome(self, endpoint: str, latency_ms: Optional[float],
-                        error: bool, trace_id: Optional[str] = None) -> None:
+                        error: bool, trace_id: Optional[str] = None,
+                        tenant: Optional[str] = None,
+                        version: Optional[int] = None) -> None:
         """One request's terminal outcome, fed to the SLO burn-rate
         evaluators (the server calls this for successes AND errors —
         sheds burn the error budget too).  No-op without configured SLOs.
@@ -1215,13 +1466,49 @@ class ServingEngine:
         threads race through here), NOT the engine lock: a burn capture's
         bundle write must never stall the batch worker's accounting or
         the hot-reload param swap.  ``request_count`` is read unlocked —
-        the debounce step only needs to be roughly current."""
+        the debounce step only needs to be roughly current.
+
+        ``tenant`` mints the per-tenant outcome metrics (cardinality-
+        guarded) and scopes any per-tenant SLOs.  ``version`` routes a
+        live CANARY outcome to the deploy candidate's evaluators INSTEAD
+        of the primary SLOs — the candidate's sins (and virtues) are the
+        deploy layer's evidence, never the primary's burn, mirroring the
+        shadow contract."""
+        if tenant is not None:
+            self.registry.counter(
+                self.registry.labeled("serving_tenant_requests_", tenant),
+                help="requests answered per tenant (all outcomes)",
+            ).inc()
+            if error:
+                self.registry.counter(
+                    self.registry.labeled("serving_tenant_errors_", tenant),
+                    help="5xx-class outcomes per tenant",
+                ).inc()
+            if latency_ms is not None:
+                self.registry.histogram(
+                    self.registry.labeled("serving_tenant_latency_ms_",
+                                          tenant),
+                    help="request latency per tenant", unit="ms",
+                ).observe(latency_ms)
+        if version is not None:
+            if version == self.deploy.candidate_step:
+                self.deploy.observe_candidate(endpoint, latency_ms, error,
+                                              trace_id=trace_id,
+                                              tenant=tenant)
+            # else: the candidate was retired while this request was in
+            # flight — the sample belongs to NEITHER side (the candidate's
+            # evaluators are gone; the primary didn't necessarily serve
+            # it), and feeding the retired candidate's degraded latencies
+            # into the primary's burn evaluators would page on a healthy
+            # primary during exactly the rollback it just executed
+            return
         if self._slo is None:
             return
         with self._slo_lock:
             self._slo.observe(endpoint, latency_ms, error,
                               # glomlint: disable=conc-unguarded-attr -- debounce cursor only needs to be roughly current (documented above); _lock under _slo_lock would invert the batcher's order
-                              trace_id=trace_id, step=self.request_count)
+                              trace_id=trace_id, step=self.request_count,
+                              tenant=tenant)
 
     # -- debug plane (pulled by glom_tpu.obs.observatory) ------------------
     def debug_forensics(self) -> dict:
@@ -1295,6 +1582,13 @@ class ServingEngine:
                 **self.sessions.snapshot(),
             }),
             "staged_step": None if staged is None else int(staged[0]),
+            # -- safe-deploy + multi-tenant surfacing ----------------------
+            # the deploy phase rides /healthz so a router/operator can see
+            # "this replica is canarying step N" without a dedicated poll
+            "deploy": self.deploy.status(),
+            "models": self.models.snapshot(),
+            "tenants": (None if self.tenants is None
+                        else self.tenants.snapshot()),
             "image_size": c.image_size,
             "channels": c.channels,
             "levels": c.levels,

@@ -1,0 +1,394 @@
+"""Multi-tenant model registry: named models/versions resident at once.
+
+One :class:`~glom_tpu.serving.engine.ServingEngine` used to own exactly
+one param tree of one checkpoint lineage.  The registry generalizes that
+into the safe-deploy substrate (ROADMAP item 4):
+
+  * **residency** — multiple :class:`ModelVersion` records live side by
+    side, each naming a ``(model, step)`` pair with its own placed param
+    tree, quant mode, config, and compile-cache bucket namespace.  The
+    engine's serving tree is the ``default`` model's ``primary`` record;
+    a deploy candidate (:mod:`glom_tpu.serving.deploy`) is a second
+    resident version of the same model; extra models (other checkpoints,
+    other configs, other quant modes) load independently;
+
+  * **compile-cache bucket namespaces with AOT reuse** — every version
+    owns a ``{endpoint: BucketedCompileCache}`` namespace, but two
+    versions whose :meth:`cache_signature` matches (same config, quant,
+    buckets, kernel choice, mesh) ALIAS one set of compiled executables:
+    params are executable *arguments*, so a new checkpoint of the same
+    model serves through the already-warm AOT entries with zero new
+    compiles — what makes a resident candidate cheap enough to shadow
+    (the pjit/TPUv4 AOT-reuse argument, arXiv:2204.06514).  A version
+    whose signature differs gets its own freshly-warmed namespace;
+
+  * **lineage tracking anchored on ``integrity.latest_valid_step``** —
+    each model records its checkpoint directory, and
+    :meth:`ModelRegistry.lineage` reports the newest step that VERIFIES
+    alongside the resident steps and the promote/retire history: a
+    deploy can only target a step the integrity scan vouches for, and
+    the anchor is the same one the hot-reload watcher and the trainer's
+    auto-resume trust.
+
+Host-side bookkeeping only (the param trees it holds are opaque
+references); injectable clock; every mutation is lock-serialized, reads
+return snapshots.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from glom_tpu.obs import MetricRegistry
+
+#: the engine's own model name in its registry
+DEFAULT_MODEL = "default"
+
+ROLES = ("primary", "candidate", "resident")
+
+
+def cache_signature(config, quant: str, buckets, *, iters=None,
+                    mesh_axes: Optional[dict] = None) -> Tuple:
+    """The executable-identity key: two versions with equal signatures
+    produce identical jit avals/HLO, so their compile-cache namespaces
+    may alias (params are call arguments, not compile-time constants)."""
+    return (
+        tuple(sorted(config.to_json_dict().items(),
+                     key=lambda kv: kv[0])),
+        str(quant),
+        tuple(int(b) for b in buckets),
+        None if iters is None else int(iters),
+        tuple(sorted((mesh_axes or {}).items())),
+    )
+
+
+@dataclass
+class ModelVersion:
+    """One resident ``(model, step)``: placed params + cache namespace."""
+
+    model: str
+    step: int
+    quant: str
+    params: Any                      # placed (device) param tree
+    caches: Dict[str, Any]           # endpoint -> BucketedCompileCache
+    config: Any                      # GlomConfig the params serve under
+    train_cfg: Any = None            # recorded TrainConfig (decode path)
+    signature: Tuple = ()
+    source_dir: Optional[str] = None
+    role: str = "resident"
+    aliased: bool = False            # caches shared with another version
+    loaded_at: float = 0.0
+
+    def summary(self) -> dict:
+        return {
+            "model": self.model,
+            "step": int(self.step),
+            "quant": self.quant,
+            "role": self.role,
+            "cache_aliased": bool(self.aliased),
+            "endpoints": sorted(self.caches),
+            "loaded_at": round(self.loaded_at, 3),
+        }
+
+
+class ModelRegistry:
+    """Residency + lineage bookkeeping for every loaded (model, step).
+
+    The engine registers its startup tree as ``(DEFAULT_MODEL, step,
+    role="primary")`` and keeps the record in sync across hot reloads /
+    staged commits (:meth:`sync_primary`); the deploy controller adds and
+    retires ``role="candidate"`` records; extra models register under
+    their own names.  ``max_versions_per_model`` bounds residency — every
+    resident version is a full device param tree, so an unbounded
+    registry is an OOM, not a feature."""
+
+    def __init__(self, *, registry: Optional[MetricRegistry] = None,
+                 clock=None, max_versions_per_model: int = 3,
+                 history: int = 32):
+        self.metrics = registry if registry is not None else MetricRegistry()
+        self._clock = clock if clock is not None else time.monotonic
+        if max_versions_per_model < 2:
+            # primary + one candidate is the minimum a deploy needs
+            raise ValueError(
+                f"max_versions_per_model must be >= 2, got "
+                f"{max_versions_per_model}")
+        self.max_versions_per_model = max_versions_per_model
+        self._lock = threading.Lock()
+        self._versions: Dict[Tuple[str, int], ModelVersion] = {}
+        self._dirs: Dict[str, str] = {}      # model -> checkpoint dir
+        self._history: "deque" = deque(maxlen=history)
+
+    # -- residency ---------------------------------------------------------
+    def register(self, model: str, step: int, *, params, caches,
+                 config, quant: str, signature: Tuple = (),
+                 train_cfg=None, source_dir: Optional[str] = None,
+                 role: str = "resident", aliased: bool = False
+                 ) -> ModelVersion:
+        if role not in ROLES:
+            raise ValueError(f"unknown role {role!r}; one of {ROLES}")
+        step = int(step)
+        with self._lock:
+            key = (model, step)
+            if key in self._versions:
+                raise ValueError(f"{model}@{step} is already resident")
+            mine = [v for v in self._versions.values() if v.model == model]
+            if len(mine) >= self.max_versions_per_model:
+                raise ValueError(
+                    f"model {model!r} already holds "
+                    f"{len(mine)} resident versions (max "
+                    f"{self.max_versions_per_model}); retire one first — "
+                    f"each version is a full device param tree")
+            if role == "primary":
+                for v in mine:
+                    if v.role == "primary":
+                        raise ValueError(
+                            f"{model} already has primary @{v.step}; use "
+                            f"promote()/sync_primary()")
+            version = ModelVersion(
+                model=model, step=step, quant=quant, params=params,
+                caches=dict(caches), config=config, train_cfg=train_cfg,
+                signature=signature, source_dir=source_dir, role=role,
+                aliased=aliased, loaded_at=self._clock(),
+            )
+            self._versions[key] = version
+            if source_dir:
+                self._dirs.setdefault(model, source_dir)
+            self._note("register", model, step, role=role, aliased=aliased)
+        self._gauges()
+        if aliased:
+            self.metrics.counter(
+                "registry_cache_alias_total",
+                help="resident versions serving through another version's "
+                     "AOT compile-cache namespace (zero new compiles)",
+            ).inc()
+        return version
+
+    def find_alias(self, model: str, signature: Tuple
+                   ) -> Optional[ModelVersion]:
+        """A resident version of ``model`` whose executable signature
+        matches — its caches may be shared by a new version."""
+        with self._lock:
+            for v in self._versions.values():
+                if v.model == model and v.signature == signature:
+                    return v
+        return None
+
+    def get(self, model: str, step: Optional[int] = None
+            ) -> Optional[ModelVersion]:
+        """``step=None`` -> the model's primary."""
+        with self._lock:
+            if step is not None:
+                return self._versions.get((model, int(step)))
+            for v in self._versions.values():
+                if v.model == model and v.role == "primary":
+                    return v
+        return None
+
+    def versions(self, model: Optional[str] = None) -> List[ModelVersion]:
+        with self._lock:
+            out = [v for v in self._versions.values()
+                   if model is None or v.model == model]
+        return sorted(out, key=lambda v: (v.model, v.step))
+
+    def models(self) -> List[str]:
+        with self._lock:
+            return sorted({v.model for v in self._versions.values()})
+
+    def remove(self, model: str, step: int) -> bool:
+        """Retire one resident version (its params reference is dropped —
+        the device memory frees when the last in-flight batch that
+        snapshotted it completes)."""
+        with self._lock:
+            version = self._versions.pop((model, int(step)), None)
+            if version is not None:
+                self._note("retire", model, int(step), role=version.role)
+        self._gauges()
+        return version is not None
+
+    # -- primary transitions ----------------------------------------------
+    def promote(self, model: str, step: int) -> ModelVersion:
+        """The resident ``(model, step)`` becomes primary; the displaced
+        primary record is retired (the ENGINE keeps its own rollback
+        reference — registry residency is about what serves, not about
+        undo)."""
+        with self._lock:
+            version = self._versions.get((model, int(step)))
+            if version is None:
+                raise KeyError(f"{model}@{step} is not resident")
+            for v in list(self._versions.values()):
+                if v.model == model and v.role == "primary":
+                    del self._versions[(model, v.step)]
+                    self._note("retire", model, v.step, role="displaced")
+            version.role = "primary"
+            self._note("promote", model, int(step))
+        self._gauges()
+        return version
+
+    def sync_primary(self, model: str, step: int, params,
+                     *, source: str = "reload") -> None:
+        """The engine's param swap paths (hot reload, staged commit,
+        rollback) re-anchor the primary record here so the registry view
+        never drifts from what actually serves.  The old primary's caches
+        carry over (same signature by construction — a reload re-places
+        the same config/quant)."""
+        step = int(step)
+        with self._lock:
+            old = None
+            for v in list(self._versions.values()):
+                if v.model == model and v.role == "primary":
+                    old = v
+                    del self._versions[(model, v.step)]
+            # a swap targeting an already-resident step (rollback onto a
+            # still-resident candidate record) adopts that record
+            existing = self._versions.get((model, step))
+            if existing is not None:
+                existing.role = "primary"
+                existing.params = params
+            elif old is not None:
+                old.step = step
+                old.params = params
+                old.loaded_at = self._clock()
+                self._versions[(model, step)] = old
+            self._note("sync_primary", model, step, source=source)
+        self._gauges()
+
+    # -- lineage -----------------------------------------------------------
+    def lineage(self, model: str) -> dict:
+        """Checkpoint-lineage view anchored on the integrity scan: the
+        newest step that VERIFIES in the model's checkpoint dir, the
+        resident steps, and the recent transition history."""
+        from glom_tpu.resilience import integrity
+
+        with self._lock:
+            source_dir = self._dirs.get(model)
+            resident = sorted(v.step for v in self._versions.values()
+                              if v.model == model)
+            primary = next((v.step for v in self._versions.values()
+                            if v.model == model and v.role == "primary"),
+                           None)
+            history = [h for h in self._history if h["model"] == model]
+        latest_valid = None
+        if source_dir:
+            # quarantine_corrupt=False: a lineage READ must not mutate
+            # the checkpoint dir — quarantine stays the watcher's call
+            latest_valid = integrity.latest_valid_step(
+                source_dir, quarantine_corrupt=False)
+        return {
+            "model": model,
+            "checkpoint_dir": source_dir,
+            "latest_valid_step": latest_valid,
+            "primary_step": primary,
+            "resident_steps": resident,
+            "history": history,
+        }
+
+    # -- views -------------------------------------------------------------
+    def snapshot(self) -> dict:
+        """The ``/healthz`` ``models`` block."""
+        with self._lock:
+            versions = [v.summary() for v in self._versions.values()]
+        versions.sort(key=lambda s: (s["model"], s["step"]))
+        return {
+            "resident": versions,
+            "models": sorted({s["model"] for s in versions}),
+        }
+
+    def _note(self, event: str, model: str, step: int, **fields) -> None:
+        # caller holds the lock
+        self._history.append({
+            "event": event, "model": model, "step": int(step),
+            "t": round(self._clock(), 6), **fields,
+        })
+
+    def _gauges(self) -> None:
+        with self._lock:
+            by_model: Dict[str, int] = {}
+            for v in self._versions.values():
+                by_model[v.model] = by_model.get(v.model, 0) + 1
+            total = len(self._versions)
+        self.metrics.gauge(
+            "registry_resident_versions",
+            help="model versions resident (each a full device param tree)",
+        ).set(total)
+        for model, count in by_model.items():
+            self.metrics.gauge(
+                self.metrics.labeled("registry_resident_versions_", model),
+                help="resident versions of one model",
+            ).set(count)
+
+
+# ---------------------------------------------------------------------------
+# standalone loading: a full (params + warmed cache namespace) version
+# from a checkpoint dir, without a ServingEngine
+# ---------------------------------------------------------------------------
+def load_version(model: str, checkpoint_dir: str, *,
+                 buckets=(1, 2, 4, 8), quant: str = "f32",
+                 iters: Optional[int] = None,
+                 step: Optional[int] = None,
+                 donate: Optional[bool] = None,
+                 warmup: bool = True,
+                 models: Optional[ModelRegistry] = None,
+                 role: str = "resident") -> ModelVersion:
+    """Load ``(model, step)`` from a Trainer checkpoint dir into a fully
+    servable :class:`ModelVersion`: quantized + placed params and an
+    embed/reconstruct compile-cache namespace, AOT-warmed unless an
+    already-resident version with the same :func:`cache_signature` can
+    be aliased (``models`` passed).  ``step=None`` anchors on the newest
+    step that verifies — the same ``integrity.latest_valid_step`` rule
+    the engine's watcher trusts."""
+    import jax
+    import numpy as np
+
+    from glom_tpu.serving import quant as serving_quant
+    from glom_tpu.serving.compile_cache import BucketedCompileCache
+    from glom_tpu.training import denoise
+
+    loaded_step, config, train_cfg, host_params = (
+        denoise.load_checkpoint_state(checkpoint_dir, step=step))
+    serve_cfg = serving_quant.serving_config(config, quant)
+    placed = jax.device_put(
+        serving_quant.quantize_tree(host_params, quant))
+    signature = cache_signature(config, quant, buckets, iters=iters)
+
+    alias = models.find_alias(model, signature) if models is not None else None
+    if alias is not None:
+        caches, aliased = alias.caches, True
+    else:
+        from glom_tpu.serving.engine import (
+            _make_embed_fn,
+            _make_reconstruct_fn,
+        )
+
+        caches = {
+            "embed": BucketedCompileCache(
+                serving_quant.quantized_forward(
+                    _make_embed_fn(serve_cfg, iters), quant),
+                buckets, name="embed", quant=quant, donate=donate),
+            "reconstruct": BucketedCompileCache(
+                serving_quant.quantized_forward(
+                    _make_reconstruct_fn(serve_cfg, train_cfg, iters),
+                    quant),
+                buckets, name="reconstruct", quant=quant, donate=donate),
+        }
+        aliased = False
+        if warmup:
+            c = serve_cfg
+            for cache in caches.values():
+                cache.warmup(placed, lambda b: jax.ShapeDtypeStruct(
+                    (b, c.channels, c.image_size, c.image_size),
+                    np.float32))
+
+    version_kwargs = dict(
+        params=placed, caches=caches, config=serve_cfg,
+        train_cfg=train_cfg, signature=signature,
+        source_dir=checkpoint_dir, role=role, aliased=aliased,
+        quant=quant,
+    )
+    if models is not None:
+        return models.register(model, loaded_step, **version_kwargs)
+    return ModelVersion(model=model, step=int(loaded_step),
+                        loaded_at=0.0, **version_kwargs)

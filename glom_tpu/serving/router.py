@@ -1113,6 +1113,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
         affinity = self.headers.get("X-Affinity-Key")
         if affinity:
             fwd["X-Affinity-Key"] = affinity
+        # the tenant identity must survive the hop or the engine-side
+        # bulkheads (admission quota, per-tenant SLOs/metrics) are
+        # silently inert in the router-fronted topology
+        tenant = self.headers.get("X-Tenant")
+        if tenant:
+            fwd["X-Tenant"] = tenant
         try:
             status, _resp_headers, resp_body, replica = router.dispatch(
                 endpoint, body, fwd, root_span=root, affinity_key=affinity,

@@ -27,6 +27,14 @@ micro-batched worker.  Overload surfaces as a structured 503
 SIGTERM drains in-flight work before exit, mirroring the trainer's
 preemption path.
 
+Multi-tenant + safe-deploy plane: requests may carry ``X-Tenant``
+(admission rides that tenant's token bucket; outcomes mint per-tenant
+metrics and feed per-tenant SLOs) and a ``"model"`` body field (an extra
+registry model).  ``POST /admin/deploy/{shadow,canary,promote,rollback,
+abort,status}`` drives the shadow/canary lifecycle
+(:mod:`glom_tpu.serving.deploy`); ``/healthz`` surfaces the deploy
+phase, resident models, and tenant quota state.
+
 Every inference request gets an end-to-end trace
 (:mod:`glom_tpu.obs.tracing`): an inbound ``X-Request-Id`` or W3C
 ``traceparent`` joins the client's trace, a fresh id is minted otherwise,
@@ -63,11 +71,14 @@ from glom_tpu.obs.tracing import (
     parse_traceparent,
     request_trace_id,
 )
-from glom_tpu.serving.batcher import Closed, Overloaded
+from glom_tpu.serving.batcher import Closed, Overloaded, TenantQuotaExceeded
 from glom_tpu.serving.engine import ServingEngine
 
 _MAX_BODY = 256 * 1024 * 1024  # refuse absurd payloads before np.asarray
 _HEX_ID = re.compile(r"[0-9a-f]{1,32}")
+# X-Tenant header charset: label-safe (it is minted into metric names
+# through the cardinality-guarded MetricRegistry.labeled)
+_TENANT_RE = re.compile(r"[A-Za-z0-9._-]{1,64}")
 
 
 class ServingHTTPServer(ThreadingHTTPServer):
@@ -131,13 +142,35 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(400, {"error": f"bad Content-Length {length}"})
             return None
         try:
-            return json.loads(self.rfile.read(length))
+            payload = json.loads(self.rfile.read(length))
         except (json.JSONDecodeError, UnicodeDecodeError) as e:
             self._reply(400, {"error": f"invalid JSON: {e}"})
             return None
+        if not isinstance(payload, dict):
+            # every route reads fields off the body: a valid-JSON array/
+            # scalar must be a clean 400, not an AttributeError mid-handler
+            self._reply(400, {"error": "body must be a JSON object"})
+            return None
+        return payload
 
-    def _parse_images(self, payload: dict) -> Optional[np.ndarray]:
-        cfg = self.server.engine.config
+    def _tenant(self) -> Optional[str]:
+        """The request's tenant (``X-Tenant`` header), or None.  An
+        invalid tenant label is replied 400 and reported as the string
+        ``""`` sentinel so callers can distinguish "absent" from "bad"."""
+        tenant = self.headers.get("X-Tenant")
+        if tenant is None:
+            return None
+        if not _TENANT_RE.fullmatch(tenant):
+            self._reply(400, {"error": (
+                f"bad X-Tenant {tenant!r}: want 1-64 chars of "
+                f"[A-Za-z0-9._-]")})
+            return ""
+        return tenant
+
+    def _parse_images(self, payload: dict,
+                      cfg=None) -> Optional[np.ndarray]:
+        if cfg is None:
+            cfg = self.server.engine.config
         try:
             imgs = np.asarray(payload["images"], dtype=np.float32)
         except (KeyError, TypeError, ValueError) as e:
@@ -189,6 +222,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(status, payload)
         elif parsed.path == "/debug/forensics":
             self._reply(200, engine.debug_forensics())
+        elif parsed.path == "/admin/deploy/status":
+            self._reply(200, engine.deploy.status())
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 
@@ -225,6 +260,60 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(200, {"step": step})
         else:
             self._reply(404, {"error": f"no admin action {action!r}"})
+
+    # -- deploy admin: the shadow/canary lifecycle verbs -------------------
+    # POSTed by an operator or a fleet deploy driver (docs/SERVING.md
+    # deploy section).  Control-plane calls, untraced, mirroring the
+    # /admin/reload convention.
+    def _do_deploy_admin(self):
+        engine = self.server.engine
+        deploy = engine.deploy
+        action = self.path[len("/admin/deploy/"):]
+        payload = (self._read_json() if int(
+            self.headers.get("Content-Length") or 0) > 0 else {})
+        if payload is None:
+            return
+        try:
+            if action == "shadow":
+                step = payload.get("step")
+                # glomlint: disable=proto-paired-call -- transport shim: each lifecycle verb arrives as its own HTTP request; the deploy driver owns the pairing (and the controller's auto actions settle a regressing candidate regardless)
+                staged = deploy.begin_shadow(
+                    step=int(step) if step is not None else None)
+                # the /admin/reload/prepare convention: "nothing to
+                # deploy" is a clean 200 with a null step, not an error
+                self._reply(200, {"candidate_step": staged,
+                                  "phase": deploy.phase,
+                                  "serving_step": int(engine.step)})
+            elif action == "canary":
+                step = payload.get("step")
+                fraction = payload.get("fraction")
+                # glomlint: disable=proto-paired-call -- transport shim (see shadow above)
+                staged = deploy.begin_canary(
+                    fraction=float(fraction) if fraction is not None
+                    else None,
+                    step=int(step) if step is not None else None)
+                self._reply(200, {"candidate_step": staged,
+                                  "phase": deploy.phase,
+                                  "serving_step": int(engine.step)})
+            elif action == "promote":
+                report = deploy.promote()
+                self._reply(200 if report is not None else 409,
+                            report or {"error": "no active deploy"})
+            elif action == "rollback":
+                report = deploy.rollback(
+                    reason=str(payload.get("reason", "operator")))
+                self._reply(200 if report is not None else 409,
+                            report or {"error": "no active deploy"})
+            elif action == "abort":
+                self._reply(200, {"aborted": deploy.abort()})
+            elif action == "status":
+                self._reply(200, deploy.status())
+            else:
+                self._reply(404,
+                            {"error": f"no deploy action {action!r}"})
+        except (RuntimeError, ValueError) as e:
+            # a second concurrent deploy, a bad fraction: caller error
+            self._reply(409, {"error": str(e)})
 
     # -- stateful session endpoints ----------------------------------------
     # POST /session/embed: one frame of a stateful stream — warm-starts
@@ -269,11 +358,18 @@ class _Handler(BaseHTTPRequestHandler):
         )
         self._trace_root = root
         self._request_id = rid_header or root.trace_id
+        tenant = self._tenant()
+        if tenant == "":
+            _t = tracer.clock()
+            tracer.record(SPAN_PARSE, root, root.start, _t)
+            tracer.end(root, attrs={"status": 400}, at=_t)
+            return
 
-        def _finish(status: int, latency_ms=None, at=None):
+        def _finish(status: int, latency_ms=None, at=None, version=None):
             tracer.end(root, attrs={"status": status}, at=at)
             engine.observe_outcome("session", latency_ms, status >= 500,
-                                   trace_id=root.trace_id)
+                                   trace_id=root.trace_id,
+                                   tenant=tenant, version=version)
 
         payload = self._read_json()
         session_id = payload.get("session") if payload is not None else None
@@ -296,7 +392,15 @@ class _Handler(BaseHTTPRequestHandler):
 
         t0 = _time.monotonic()
         try:
-            out, info = engine.session_embed(session_id, imgs, ctx=root)
+            out, info = engine.session_embed(session_id, imgs, ctx=root,
+                                             tenant=tenant)
+        except TenantQuotaExceeded as e:
+            self._reply(503, {"error": "tenant_overloaded",
+                              "tenant": e.tenant,
+                              "detail": "tenant admission quota exhausted; "
+                                        "back off"})
+            _finish(503)
+            return
         except Closed:
             self._reply(503, {"error": "shutting_down",
                               "detail": "server is draining; retry elsewhere"})
@@ -334,21 +438,24 @@ class _Handler(BaseHTTPRequestHandler):
                 _finish(400)
                 return
         self._reply(200, {
-            "step": int(engine.step),
             "latency_ms": round(latency * 1e3, 3),
             "request_id": self._request_id,
             "session": session_id,
             "embeddings": out.tolist(),
-            **info,
+            **info,  # carries the honest "step" (the version that served)
         })
         t_end = tracer.clock()
         tracer.record(SPAN_RESPOND, root, t_done, t_end)
-        _finish(200, latency_ms=latency * 1e3, at=t_end)
+        _finish(200, latency_ms=latency * 1e3, at=t_end,
+                version=info.get("canary_step"))
 
     def do_POST(self):  # noqa: N802
         self._request_id = None  # reset before routing (keep-alive reuse)
         if self.path.startswith("/admin/reload/"):
             self._do_admin()
+            return
+        if self.path.startswith("/admin/deploy/"):
+            self._do_deploy_admin()
             return
         if self.path in ("/session/embed", "/session/reset"):
             self._do_session()
@@ -375,10 +482,24 @@ class _Handler(BaseHTTPRequestHandler):
         self._trace_root = root
         self._request_id = rid_header or root.trace_id
 
-        def _finish(status: int, latency_ms=None, at=None):
+        # multi-tenant + canary routing identities, resolved up front:
+        # the tenant gates admission and labels the outcome; the canary
+        # assignment is the DETERMINISTIC hash of the stickiest key the
+        # request offers (affinity key, else its request id), so the
+        # same caller lands on the same version for the whole deploy
+        tenant = self._tenant()
+        if tenant == "":
+            _t = tracer.clock()
+            tracer.record(SPAN_PARSE, root, root.start, _t)
+            tracer.end(root, attrs={"status": 400}, at=_t)
+            return
+        deploy_key = self.headers.get("X-Affinity-Key") or self._request_id
+
+        def _finish(status: int, latency_ms=None, at=None, version=None):
             tracer.end(root, attrs={"status": status}, at=at)
             engine.observe_outcome(endpoint, latency_ms, status >= 500,
-                                   trace_id=root.trace_id)
+                                   trace_id=root.trace_id,
+                                   tenant=tenant, version=version)
 
         # The handler's own phases — parse / dispatch_wait / respond — are
         # recorded with SHARED edges (explicit timestamps) so they TILE
@@ -389,28 +510,59 @@ class _Handler(BaseHTTPRequestHandler):
         # dedupes the overlap, and it holds the scheduling gaps (worker
         # wake, future wake) no pipeline stage can see.
         payload = self._read_json()
-        imgs = self._parse_images(payload) if payload is not None else None
+        model = payload.get("model") if payload is not None else None
+        model_cfg = None
+        if payload is not None and model is not None:
+            record = engine.models.get(model)
+            if record is None:
+                self._reply(400, {"error": (
+                    f"unknown model {model!r}; resident: "
+                    f"{engine.models.models()}")})
+                _finish(400)
+                return
+            model_cfg = record.config
+            root.attrs["model"] = model
+        imgs = (self._parse_images(payload, cfg=model_cfg)
+                if payload is not None else None)
         t_parsed = tracer.clock()
         tracer.record(SPAN_PARSE, root, root.start, t_parsed)
         if imgs is None:
             _finish(400)
             return
+        # extra models never canary (deploys guard the default model)
+        version = engine.deploy.assign(deploy_key) if model is None else None
         import time as _time
 
         t0 = _time.monotonic()
+        # outcome attribution: a request REJECTED before execution (quota
+        # shed, queue shed, drain, validation) never touched the
+        # candidate — charging it to the candidate's error budget would
+        # let an overload unrelated to the deploy trigger a spurious
+        # auto-rollback.  Only outcomes that (may have) executed on the
+        # candidate keep the version tag.
+        out_version = version
         try:
-            future = engine.submit(endpoint, imgs, ctx=root)
+            future = engine.submit(endpoint, imgs, ctx=root, tenant=tenant,
+                                   model=model, version=version)
             out = future.result(timeout=60.0)
+        except TenantQuotaExceeded as e:
+            error, code, body = e, 503, {
+                "error": "tenant_overloaded", "tenant": e.tenant,
+                "detail": "tenant admission quota exhausted; back off"}
+            out_version = None
         except Overloaded as e:
             error, code, body = e, 503, {
                 "error": "overloaded",
                 "detail": "queue at capacity; retry with backoff"}
+            out_version = None
         except Closed as e:
             error, code, body = e, 503, {
                 "error": "shutting_down",
                 "detail": "server is draining; retry elsewhere"}
+            out_version = None
         except ValueError as e:  # e.g. request larger than max_batch
             error, code, body = e, 400, {"error": str(e)}
+            out_version = None
         except Exception as e:
             error, code, body = e, 500, {"error": f"{type(e).__name__}: {e}"}
         else:
@@ -419,7 +571,7 @@ class _Handler(BaseHTTPRequestHandler):
         tracer.record(SPAN_DISPATCH_WAIT, root, t_parsed, t_done)
         if error is not None:
             self._reply(code, body)
-            _finish(code)
+            _finish(code, version=out_version)
             return
         latency = _time.monotonic() - t0
         engine.registry.histogram(
@@ -427,9 +579,23 @@ class _Handler(BaseHTTPRequestHandler):
             help="request latency, admission to response", unit="seconds",
         ).observe(latency)
 
-        resp = {"step": int(engine.step),
+        # the step field is honest about WHICH version served: canary
+        # responses carry the candidate step (chaos/loadgen count the
+        # canary fraction from exactly this).  If the candidate was
+        # retired while this request was in flight, the group fell back
+        # to the primary — report the primary step, not the assignment
+        # (the outcome still carries the version tag so the engine can
+        # classify it as an orphan rather than primary-SLO evidence).
+        served_version = version
+        if (version is not None
+                and engine.deploy.candidate_step != version):
+            served_version = None
+        resp = {"step": int(served_version) if served_version is not None
+                else int(engine.step),
                 "latency_ms": round(latency * 1e3, 3),
                 "request_id": self._request_id}
+        if model is not None:
+            resp["model"] = model
         if endpoint == "embed":
             level = payload.get("level")
             if level is not None:
@@ -442,7 +608,7 @@ class _Handler(BaseHTTPRequestHandler):
                     )})
                     t_end = tracer.clock()
                     tracer.record(SPAN_RESPOND, root, t_done, t_end)
-                    _finish(400, at=t_end)
+                    _finish(400, at=t_end, version=version)
                     return
             resp["embeddings"] = out.tolist()
         else:
@@ -452,7 +618,7 @@ class _Handler(BaseHTTPRequestHandler):
         # between two separate clock reads would leak uncovered wall time
         t_end = tracer.clock()
         tracer.record(SPAN_RESPOND, root, t_done, t_end)
-        _finish(200, latency_ms=latency * 1e3, at=t_end)
+        _finish(200, latency_ms=latency * 1e3, at=t_end, version=version)
 
 
 def make_server(engine: ServingEngine, host: str = "127.0.0.1",
@@ -542,6 +708,32 @@ def main(argv=None) -> int:
                    help="declarative SLO target, repeatable: 'embed:p95<250ms' "
                         "(latency) or 'errors<1%%' (error rate); burn fires "
                         "the slo_burn forensics trigger")
+    p.add_argument("--tenant-quota", action="append", default=None,
+                   metavar="NAME=RATE[:BURST]",
+                   help="repeatable: per-tenant admission quota in "
+                        "images/s (token bucket; burst defaults to the "
+                        "rate).  Requests carry X-Tenant; a tenant past "
+                        "its bucket sheds 503 WITHOUT touching other "
+                        "tenants' admission or latency")
+    p.add_argument("--model", action="append", default=None,
+                   metavar="NAME=DIR", dest="models",
+                   help="repeatable: load an extra named model from its "
+                        "own checkpoint dir, resident alongside the "
+                        "default (request it with a \"model\" field)")
+    p.add_argument("--deploy-pin-url", default=None, metavar="URL",
+                   help="fleet router base URL: deploy promote/rollback "
+                        "converge every replica through its two-phase "
+                        "POST /rollout instead of a local-only swap")
+    p.add_argument("--deploy-promote-after", type=int, default=3,
+                   help="clean candidate burn windows before auto-promote")
+    p.add_argument("--deploy-window-s", type=float, default=None,
+                   help="candidate burn-window length (default: the "
+                        "longest SLO short window)")
+    p.add_argument("--deploy-min-events", type=int, default=None,
+                   help="candidate outcomes a window needs to count as "
+                        "evidence (default: the smallest SLO min_events)")
+    p.add_argument("--deploy-canary-fraction", type=float, default=0.1,
+                   help="default live-traffic fraction for begin_canary")
     p.add_argument("--demo", action="store_true",
                    help="write a tiny demo checkpoint into --checkpoint-dir "
                         "if it has none (smoke runs)")
@@ -600,6 +792,19 @@ def main(argv=None) -> int:
         session_ttl_s=args.session_ttl_s,
         session_max_bytes=int(args.session_max_mb * 2 ** 20),
         session_spill_dir=args.session_spill_dir,
+        tenant_quotas=(
+            {name: spec for name, spec in
+             (entry.split("=", 1) for entry in args.tenant_quota)}
+            if args.tenant_quota else None),
+        extra_models=(
+            {name: path for name, path in
+             (entry.split("=", 1) for entry in args.models)}
+            if args.models else None),
+        deploy_promote_after=args.deploy_promote_after,
+        deploy_window_s=args.deploy_window_s,
+        deploy_min_events=args.deploy_min_events,
+        deploy_canary_fraction=args.deploy_canary_fraction,
+        deploy_pin_url=args.deploy_pin_url,
     )
     engine.start()
     server = make_server(engine, args.host, args.port, quiet=not args.verbose)

@@ -1,0 +1,32 @@
+"""proto-paired-call (deploy-lifecycle) must-flag fixture.
+
+A deploy driver begins a shadow, validates, canaries, promotes.  The
+early return on a failed validation leaves the candidate RESIDENT — a
+full device param tree nobody will ever promote, roll back, or abort,
+with the controller still mirroring traffic onto it: the PR 7
+stranded-staged-tree class at deploy granularity.  Every settle verb
+EXISTS in the file — only the failed-validation *path* misses them, so
+a path-insensitive scan provably cannot flag it.
+"""
+
+
+class DeployDriver:
+    def __init__(self, controller):
+        self.controller = controller
+
+    def roll(self, step):
+        self.controller.begin_shadow(step)
+        if not self.validate(step):
+            # BUG: returns with the candidate still resident and
+            # shadowing — no promote/rollback/abort on this path
+            return {"status": "failed", "step": step}
+        self.controller.begin_canary(0.1)
+        if not self.watch_burn():
+            return self.controller.rollback("burn_rate")
+        return self.controller.promote()
+
+    def validate(self, step):
+        return step >= 0
+
+    def watch_burn(self):
+        return True

@@ -1,0 +1,29 @@
+"""proto-paired-call (deploy-lifecycle) must-pass fixture: every path
+out of the driver settles the candidate — the failed validation aborts,
+an unexpected exception rolls back before re-raising, and the happy
+path promotes."""
+
+
+class DeployDriver:
+    def __init__(self, controller):
+        self.controller = controller
+
+    def roll(self, step):
+        self.controller.begin_shadow(step)
+        try:
+            if not self.validate(step):
+                self.controller.abort()
+                return {"status": "failed", "step": step}
+            self.controller.begin_canary(0.1)
+            if not self.watch_burn():
+                return self.controller.rollback("burn_rate")
+            return self.controller.promote()
+        except Exception:
+            self.controller.rollback("error")
+            raise
+
+    def validate(self, step):
+        return step >= 0
+
+    def watch_burn(self):
+        return True

@@ -68,6 +68,10 @@ RULE_FIXTURES = [
     # -- the v2 dataflow packs (cfg.py + rules_paths + rules_sharding) --
     ("res-leak-on-raise", "serving/rollout.py", "serving/rollout.py"),
     ("proto-paired-call", "serving/prepare.py", "serving/prepare.py"),
+    # the deploy-lifecycle spec (PR 14): begin_shadow/begin_canary must
+    # settle with promote/rollback/abort on every CFG path
+    ("proto-paired-call", "serving/deploy_lifecycle.py",
+     "serving/deploy_lifecycle.py"),
     ("res-double-release", "doublerelease.py", "doublerelease.py"),
     ("shard-unknown-axis", "parallel/mesh.py", "parallel/mesh.py"),
     ("shard-spec-arity", "shardmap_arity.py", "shardmap_arity.py"),

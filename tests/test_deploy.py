@@ -1,0 +1,1030 @@
+"""Multi-tenant model registry + shadow/canary deploy tests.
+
+Tier-1 (CPU) coverage of the safe-deploy primitive (ROADMAP item 4):
+
+  * tenant bulkheads — token-bucket admission against a fake clock,
+    per-tenant SLO parsing/routing, HTTP isolation (tenant A past its
+    quota sheds only itself; B's error rate stays zero);
+  * model registry — residency, cache-namespace aliasing (a same-
+    signature version serves through the primary's AOT executables with
+    zero new compiles), lineage anchored on ``latest_valid_step``,
+    primary sync across hot-reload/rollback;
+  * deploy controller — shadow mirroring (candidate outcomes only,
+    primary SLO untouched, responses discarded), deterministic canary
+    assignment, burn-rate auto-rollback with the ``deploy_rollback``
+    forensics bundle (offending traces + before/after pins), clean-
+    window auto-promote, corrupt-candidate quarantine-and-abort;
+  * shadow-path invariants — zero request-path compiles across
+    shadow+canary, no session straddles versions mid-stream;
+  * the CI deploy-smoke gate — ``tools/chaos.py --smoke --scenario
+    canary_regression`` in a fresh subprocess.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from glom_tpu import checkpoint as ckpt_lib
+from glom_tpu.obs.slo import SloManager, parse_slo
+from glom_tpu.serving.batcher import (
+    Overloaded,
+    TenantAdmission,
+    TenantQuotaExceeded,
+    TokenBucket,
+)
+from glom_tpu.serving.engine import ServingEngine, make_demo_checkpoint
+from glom_tpu.serving.registry import (
+    DEFAULT_MODEL,
+    ModelRegistry,
+    cache_signature,
+    load_version,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, seconds):
+        self.t += seconds
+
+
+def _imgs(k=1, size=16):
+    rng = np.random.RandomState(0)
+    return rng.randn(k, 3, size, size).astype(np.float32)
+
+
+def _save_step(ckpt_dir, engine, step, scale=1.0):
+    """Write a new checkpoint step derived from the engine's template
+    (``scale`` != 1 makes its outputs measurably different)."""
+    host = jax.device_get(engine._template)
+    if scale != 1.0:
+        host = jax.tree_util.tree_map(lambda a: a * scale, host)
+    ckpt_lib.save(ckpt_dir, step, {"params": host})
+    return host
+
+
+# ---------------------------------------------------------------------------
+# tenant bulkheads: token bucket + admission + SLO scoping
+# ---------------------------------------------------------------------------
+class TestTokenBucket:
+    def test_burst_then_refill(self):
+        clock = FakeClock()
+        b = TokenBucket(rate=2.0, burst=4.0, clock=clock)
+        assert all(b.take() for _ in range(4))  # full burst available
+        assert not b.take()
+        clock.advance(1.0)  # 2 tokens back
+        assert b.take() and b.take() and not b.take()
+
+    def test_refill_caps_at_burst(self):
+        clock = FakeClock()
+        b = TokenBucket(rate=100.0, burst=2.0, clock=clock)
+        clock.advance(60.0)
+        assert b.take(2) and not b.take()
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            TokenBucket(rate=0, burst=1)
+        with pytest.raises(ValueError):
+            TokenBucket(rate=1, burst=0)
+
+
+class TestTenantAdmission:
+    def test_quota_isolation(self):
+        clock = FakeClock()
+        adm = TenantAdmission({"a": "2:2", "b": "100:100"}, clock=clock)
+        adm.admit("a", 2)
+        with pytest.raises(TenantQuotaExceeded) as exc:
+            adm.admit("a", 1)
+        assert exc.value.tenant == "a"
+        assert isinstance(exc.value, Overloaded)  # maps to the 503 path
+        # b is untouched by a's exhaustion
+        adm.admit("b", 50)
+        snap = adm.snapshot()
+        assert snap["a"]["shed_requests"] == 1
+        assert snap["b"]["shed_requests"] == 0
+
+    def test_unconfigured_tenant_unlimited(self):
+        adm = TenantAdmission({"a": "1:1"}, clock=FakeClock())
+        for _ in range(100):
+            adm.admit("mystery", 5)
+        adm.admit(None, 5)  # tenantless requests never quota
+
+    def test_rejections_do_not_drain_budget(self):
+        clock = FakeClock()
+        adm = TenantAdmission({"a": "1:1"}, clock=clock)
+        adm.admit("a", 1)
+        for _ in range(50):
+            with pytest.raises(TenantQuotaExceeded):
+                adm.admit("a", 1)
+        clock.advance(1.0)  # one token back despite the storm
+        adm.admit("a", 1)
+
+    def test_refund_restores_tokens(self):
+        """A downstream (global queue) shed refunds the tenant's tokens:
+        its budget reflects work actually admitted."""
+        clock = FakeClock()
+        adm = TenantAdmission({"a": "1:2"}, clock=clock)
+        adm.admit("a", 2)
+        with pytest.raises(TenantQuotaExceeded):
+            adm.admit("a", 1)
+        adm.refund("a", 2)
+        adm.admit("a", 2)  # budget restored, no clock advance needed
+        assert adm.snapshot()["a"]["admitted_images"] == 2
+        adm.refund("unknown", 5)  # unconfigured tenants: no-op
+        adm.refund(None, 5)
+
+    def test_quota_spec_forms(self):
+        adm = TenantAdmission({"r": "8", "rb": "8:32", "t": (4, 16)},
+                              clock=FakeClock())
+        snap = adm.snapshot()
+        assert snap["r"] == dict(snap["r"], rate=8.0, burst=8.0)
+        assert snap["rb"] == dict(snap["rb"], rate=8.0, burst=32.0)
+        assert snap["t"] == dict(snap["t"], rate=4.0, burst=16.0)
+
+
+class TestTenantSlo:
+    def test_parse_tenant_forms(self):
+        s = parse_slo("acme/embed:p95<250ms")
+        assert (s.tenant, s.endpoint, s.kind) == ("acme", "embed", "latency")
+        s = parse_slo("acme/errors<1%")
+        assert (s.tenant, s.endpoint, s.kind) == ("acme", None, "error_rate")
+        s = parse_slo("p95<100ms")
+        assert s.tenant is None
+
+    def test_observe_routes_by_tenant(self):
+        clock = FakeClock()
+        slo = parse_slo("acme/errors<10%", short_window_s=10,
+                        long_window_s=10, min_events=5, burn_threshold=1.0)
+        mgr = SloManager([slo], clock=clock)
+        ev = mgr.evaluators[0]
+        for _ in range(10):
+            mgr.observe("embed", 1.0, True, tenant="other")
+        assert len(ev._short) == 0  # wrong tenant: never fed
+        for _ in range(10):
+            mgr.observe("embed", 1.0, True, tenant="acme")
+        assert len(ev._short) == 10
+
+
+# ---------------------------------------------------------------------------
+# engine-backed fixtures (one checkpoint, several engines)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def ckpt_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("deploy_ckpt"))
+    make_demo_checkpoint(d)
+    return d
+
+
+def _engine(ckpt, **kw):
+    kw.setdefault("buckets", (1, 2))
+    kw.setdefault("max_wait_ms", 0.0)
+    kw.setdefault("warmup", True)
+    kw.setdefault("reload_poll_s", 0)
+    eng = ServingEngine(ckpt, **kw)
+    eng.start(workers=False, watch=False)
+    return eng
+
+
+def _pump(eng, endpoint="embed"):
+    while eng.process_once(endpoint):
+        pass
+
+
+def _xla_compiles(eng):
+    return eng.registry.snapshot().get("serving_xla_compiles", 0)
+
+
+# ---------------------------------------------------------------------------
+# model registry
+# ---------------------------------------------------------------------------
+class TestModelRegistry:
+    def test_primary_registered_at_startup(self, ckpt_dir):
+        eng = _engine(ckpt_dir)
+        try:
+            primary = eng.models.get(DEFAULT_MODEL)
+            assert primary is not None and primary.role == "primary"
+            assert primary.step == eng.step
+            assert not primary.aliased
+            snap = eng.models.snapshot()
+            assert snap["models"] == ["default"]
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_lineage_anchors_on_latest_valid_step(self, tmp_path):
+        d = str(tmp_path / "ckpt")
+        make_demo_checkpoint(d)
+        eng = _engine(d)
+        try:
+            _save_step(d, eng, 3)
+            # a CORRUPT newer step must not become the lineage anchor
+            # (and the lineage READ must not quarantine it either)
+            _save_step(d, eng, 7)
+            path = ckpt_lib.npz_path(d, 7)
+            with open(path, "r+b") as f:
+                f.seek(os.path.getsize(path) // 2)
+                byte = f.read(1)
+                f.seek(-1, os.SEEK_CUR)
+                f.write(bytes([byte[0] ^ 0xFF]))
+            lineage = eng.models.lineage(DEFAULT_MODEL)
+            assert not [x for x in os.listdir(d) if x.endswith(".corrupt")]
+            assert lineage["latest_valid_step"] == 3
+            assert lineage["primary_step"] == 0
+            assert lineage["checkpoint_dir"] == d
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_sync_primary_follows_hot_reload(self, tmp_path):
+        d = str(tmp_path / "ckpt")
+        make_demo_checkpoint(d)
+        eng = _engine(d)
+        try:
+            _save_step(d, eng, 5)
+            assert eng.check_reload() is True
+            primary = eng.models.get(DEFAULT_MODEL)
+            assert primary.step == 5 and primary.role == "primary"
+            assert len(eng.models.versions(DEFAULT_MODEL)) == 1
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_residency_bound(self, ckpt_dir):
+        reg = ModelRegistry(max_versions_per_model=2, clock=FakeClock())
+        sig = ("sig",)
+        reg.register("m", 1, params={}, caches={}, config=None,
+                     quant="f32", signature=sig)
+        reg.register("m", 2, params={}, caches={}, config=None,
+                     quant="f32", signature=sig)
+        with pytest.raises(ValueError, match="resident versions"):
+            reg.register("m", 3, params={}, caches={}, config=None,
+                         quant="f32", signature=sig)
+        assert reg.remove("m", 1)
+        reg.register("m", 3, params={}, caches={}, config=None,
+                     quant="f32", signature=sig)
+
+    def test_duplicate_and_double_primary_rejected(self):
+        reg = ModelRegistry(clock=FakeClock())
+        reg.register("m", 1, params={}, caches={}, config=None,
+                     quant="f32", role="primary")
+        with pytest.raises(ValueError, match="already resident"):
+            reg.register("m", 1, params={}, caches={}, config=None,
+                         quant="f32")
+        with pytest.raises(ValueError, match="primary"):
+            reg.register("m", 2, params={}, caches={}, config=None,
+                         quant="f32", role="primary")
+
+    def test_load_version_aliases_matching_signature(self, tmp_path):
+        """The AOT-reuse claim: a second version with the same signature
+        serves through the FIRST version's warmed executables — zero new
+        compiles, `aliased` visible in the snapshot."""
+        d = str(tmp_path / "ckpt")
+        make_demo_checkpoint(d)
+        reg = ModelRegistry(clock=FakeClock())
+        v0 = load_version("m", d, buckets=(1, 2), models=reg,
+                          role="primary")
+        assert not v0.aliased and v0.caches["embed"].warmed
+        ckpt_lib.save(d, 4, {"params": jax.device_get(
+            jax.tree_util.tree_map(np.asarray, v0.params))})
+        v4 = load_version("m", d, buckets=(1, 2), models=reg, step=4)
+        assert v4.aliased
+        assert v4.caches["embed"] is v0.caches["embed"]
+        assert reg.metrics.snapshot()["registry_cache_alias_total"] == 1
+        out = v4.caches["embed"](v4.params, _imgs(1))
+        assert np.asarray(out).shape[0] == 1
+        assert v4.caches["embed"].poll_compiles() == 0
+
+    def test_extra_model_served_over_http(self, ckpt_dir, tmp_path):
+        """A second named model loads resident and serves via the
+        request's \"model\" field; unknown models 400."""
+        d2 = str(tmp_path / "other_ckpt")
+        make_demo_checkpoint(d2)
+        eng = _engine(ckpt_dir, extra_models={"alt": d2})
+        from glom_tpu.serving.server import make_server
+
+        server = make_server(eng)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        url = "http://{}:{}".format(*server.server_address[:2])
+        worker = threading.Thread(
+            target=lambda: [eng.process_once("embed", block=True,
+                                             timeout=0.1)
+                            for _ in range(100)], daemon=True)
+        worker.start()
+        try:
+            body = json.dumps({"images": _imgs(1).tolist(),
+                               "model": "alt"}).encode()
+            req = urllib.request.Request(
+                f"{url}/embed", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                resp = json.loads(r.read())
+            assert resp["model"] == "alt"
+            assert eng.registry.snapshot().get(
+                "serving_model_requests_alt") == 1
+            bad = urllib.request.Request(
+                f"{url}/embed",
+                data=json.dumps({"images": _imgs(1).tolist(),
+                                 "model": "nope"}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(bad, timeout=30)
+            assert exc.value.code == 400
+            assert "unknown model" in json.loads(exc.value.read())["error"]
+        finally:
+            server.shutdown()
+            server.server_close()
+            eng.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# deploy controller: shadow
+# ---------------------------------------------------------------------------
+class TestShadow:
+    def test_shadow_loads_candidate_resident(self, tmp_path):
+        d = str(tmp_path / "ckpt")
+        make_demo_checkpoint(d)
+        eng = _engine(d)
+        try:
+            _save_step(d, eng, 2)
+            assert eng.deploy.begin_shadow() == 2
+            assert eng.deploy.phase == "shadow"
+            cand = eng.models.get(DEFAULT_MODEL, 2)
+            assert cand is not None and cand.role == "candidate"
+            assert cand.aliased  # same signature -> shared executables
+            assert eng.step == 0  # primary pin untouched
+            assert eng.health()["deploy"]["phase"] == "shadow"
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_shadow_mirrors_and_discards(self, tmp_path):
+        """Mirrored batches execute against the candidate, outcomes land
+        ONLY under the candidate's evaluators, primary SLO accounting
+        never sees them, and the request path never compiles."""
+        d = str(tmp_path / "ckpt")
+        make_demo_checkpoint(d)
+        eng = _engine(d, slos=["p95<10000ms"])
+        try:
+            _save_step(d, eng, 2)
+            assert eng.deploy.begin_shadow() == 2
+            fut = eng.submit("embed", _imgs(1))
+            _pump(eng)
+            fut.result(timeout=10)
+            # pump the shadow queue deterministically (no thread race)
+            mirrored = 0
+            for _ in range(10):
+                with eng.deploy._shadow_cv:
+                    item = (eng.deploy._shadow_q.popleft()
+                            if eng.deploy._shadow_q else None)
+                if item is None:
+                    break
+                assert eng.deploy.process_shadow(*item)
+                mirrored += 1
+            assert mirrored >= 1
+            snap = eng.registry.snapshot()
+            assert snap.get("deploy_shadow_requests", 0) == mirrored
+            # candidate evaluators fed; primary SLO evaluators NOT
+            assert sum(len(ev._short)
+                       for ev in eng.deploy._evaluators) == mirrored
+            assert all(len(ev._short) == 0
+                       for ev in eng._slo.evaluators)
+            assert _xla_compiles(eng) == 0
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_corrupt_candidate_quarantined_and_aborted(self, tmp_path):
+        d = str(tmp_path / "ckpt")
+        make_demo_checkpoint(d)
+        eng = _engine(d)
+        try:
+            _save_step(d, eng, 2)
+            path = ckpt_lib.npz_path(d, 2)
+            with open(path, "r+b") as f:
+                f.seek(os.path.getsize(path) // 2)
+                byte = f.read(1)
+                f.seek(-1, os.SEEK_CUR)
+                f.write(bytes([byte[0] ^ 0xFF]))
+            assert eng.deploy.begin_shadow() is None
+            assert eng.deploy.phase == "idle"
+            assert eng.models.get(DEFAULT_MODEL, 2) is None
+            assert [f for f in os.listdir(d) if f.endswith(".corrupt")]
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_second_deploy_requires_settling_first(self, tmp_path):
+        d = str(tmp_path / "ckpt")
+        make_demo_checkpoint(d)
+        eng = _engine(d)
+        try:
+            _save_step(d, eng, 2)
+            _save_step(d, eng, 3)
+            assert eng.deploy.begin_shadow(step=2) == 2
+            with pytest.raises(RuntimeError, match="active"):
+                eng.deploy.begin_shadow(step=3)
+            assert eng.deploy.abort() is True
+            assert eng.deploy.begin_shadow(step=3) == 3
+            eng.deploy.abort()
+            assert eng.models.versions(DEFAULT_MODEL)[0].step == eng.step
+        finally:
+            eng.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# deploy controller: canary
+# ---------------------------------------------------------------------------
+class TestCanary:
+    def test_assignment_deterministic_and_weighted(self, tmp_path):
+        d = str(tmp_path / "ckpt")
+        make_demo_checkpoint(d)
+        eng = _engine(d)
+        try:
+            _save_step(d, eng, 2)
+            assert eng.deploy.begin_canary(fraction=0.3, step=2) == 2
+            keys = [f"key-{i}" for i in range(1000)]
+            first = [eng.deploy.assign(k) for k in keys]
+            second = [eng.deploy.assign(k) for k in keys]
+            assert first == second  # deterministic per key
+            frac = sum(v is not None for v in first) / len(first)
+            assert 0.2 < frac < 0.4  # weighted ~fraction
+            assert eng.deploy.assign(None) is None
+            eng.deploy.abort()
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_canary_group_executes_on_candidate_params(self, tmp_path):
+        """A canary item's output must come from the CANDIDATE's params
+        (scaled weights -> measurably different embeddings), through the
+        shared executables with zero new compiles."""
+        d = str(tmp_path / "ckpt")
+        make_demo_checkpoint(d)
+        eng = _engine(d)
+        try:
+            _save_step(d, eng, 2, scale=2.0)
+            assert eng.deploy.begin_canary(fraction=0.5, step=2) == 2
+            imgs = _imgs(1)
+            f_primary = eng.submit("embed", imgs)
+            f_canary = eng.submit("embed", imgs,
+                                  version=eng.deploy.candidate_step)
+            _pump(eng)
+            out_p = f_primary.result(timeout=10)
+            out_c = f_canary.result(timeout=10)
+            assert not np.allclose(out_p, out_c)
+            # reference: run the candidate's cache directly
+            cand = eng.models.get(DEFAULT_MODEL, 2)
+            ref = np.asarray(cand.caches["embed"](cand.params, imgs))
+            np.testing.assert_array_equal(np.asarray(out_c), ref)
+            assert _xla_compiles(eng) == 0
+            eng.deploy.abort()
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_inflight_canary_items_survive_rollback(self, tmp_path):
+        d = str(tmp_path / "ckpt")
+        make_demo_checkpoint(d)
+        eng = _engine(d)
+        try:
+            _save_step(d, eng, 2)
+            assert eng.deploy.begin_canary(fraction=0.5, step=2) == 2
+            fut = eng.submit("embed", _imgs(1), version=2)
+            assert eng.deploy.abort() is True  # retired before execute
+            _pump(eng)
+            assert fut.result(timeout=10).shape[0] == 1  # fell back
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_session_never_straddles_versions(self, tmp_path):
+        """A session with resident state stays on the version that
+        computed it, whatever assign() says for new sessions."""
+        d = str(tmp_path / "ckpt")
+        make_demo_checkpoint(d)
+        eng = _engine(d, warm_iters=2)
+        try:
+            _save_step(d, eng, 2, scale=2.0)
+            # establish a primary-side session BEFORE the canary
+            sid_keys = [f"sess-{i}" for i in range(64)]
+            _, info0 = eng.session_embed(sid_keys[0], _imgs(1))
+            assert info0["step"] == 0
+            assert eng.deploy.begin_canary(fraction=1.0, step=2) == 2
+            # fraction 1.0: every NEW session goes candidate, but the
+            # established stream must keep its version mid-stream
+            _, info1 = eng.session_embed(sid_keys[0], _imgs(1))
+            assert info1["step"] == 0 and "canary_step" not in info1
+            _, info2 = eng.session_embed(sid_keys[1], _imgs(1))
+            assert info2["step"] == 2 and info2["canary_step"] == 2
+            # and the candidate-side stream stays candidate
+            _, info3 = eng.session_embed(sid_keys[1], _imgs(1))
+            assert info3["step"] == 2
+            assert _xla_compiles(eng) == 0
+            # rollback retires step 2: the candidate-side stream must
+            # COLD-restart on primary, never warm-iterate the retired
+            # version's equilibrium (the straddle the invariant forbids)
+            eng.deploy.rollback(reason="operator")
+            _, info4 = eng.session_embed(sid_keys[1], _imgs(1))
+            assert info4["step"] == 0 and info4["cold"]
+            assert info4["restart"] == "version_retired"
+            # the primary-side stream was never touched: still warm
+            _, info5 = eng.session_embed(sid_keys[0], _imgs(1))
+            assert info5["step"] == 0 and not info5["cold"]
+        finally:
+            eng.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# auto actions: burn-rate rollback, clean-window promote
+# ---------------------------------------------------------------------------
+class TestAutoActions:
+    def _deploy_engine(self, d, clock, **kw):
+        make_demo_checkpoint(d)
+        return _engine(
+            d, clock=clock,
+            slos=[parse_slo("p95<100ms", short_window_s=5.0,
+                            long_window_s=10.0, min_events=4,
+                            burn_threshold=2.0)],
+            deploy_promote_after=2, deploy_window_s=5.0,
+            deploy_min_events=4, **kw)
+
+    def test_burn_rollback_with_forensics_bundle(self, tmp_path):
+        d = str(tmp_path / "ckpt")
+        clock = FakeClock()
+        fdir = str(tmp_path / "forensics")
+        eng = self._deploy_engine(d, clock, forensics_dir=fdir)
+        try:
+            _save_step(d, eng, 2)
+            assert eng.deploy.begin_canary(fraction=0.5, step=2) == 2
+            # 4 slow candidate outcomes inside the short window: burn =
+            # (4/4)/0.05 = 20 >= 2 the moment min_events is reached
+            for i in range(4):
+                clock.advance(0.1)
+                eng.deploy.observe_candidate("embed", 500.0, False,
+                                             trace_id=f"bad-{i}")
+            assert eng.deploy.phase == "idle"
+            assert eng.models.get(DEFAULT_MODEL, 2) is None
+            assert eng.step == 0
+            snap = eng.registry.snapshot()
+            assert snap.get("deploy_rollbacks_total") == 1
+            report = eng.deploy.last_report
+            assert report["action"] == "rolled_back"
+            assert report["reason"] == "burn_rate"
+            assert report["pins"] == {"before": 2, "after": 0}
+            bundles = [b for b in os.listdir(fdir)
+                       if b.startswith("deploy_rollback-")]
+            assert len(bundles) == 1
+            with open(os.path.join(fdir, bundles[0],
+                                   "manifest.json")) as f:
+                manifest = json.load(f)
+            detail = manifest["detail"]
+            assert detail["pins"] == {"before": 2, "after": 0}
+            assert "bad-3" in detail["trace_ids"]
+            assert detail["burn_rates"]  # rates at the moment of retreat
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_error_rate_breach_rolls_back(self, tmp_path):
+        """Without configured SLOs the default errors<2% guardrail still
+        retreats on an error storm."""
+        d = str(tmp_path / "ckpt")
+        clock = FakeClock()
+        make_demo_checkpoint(d)
+        eng = _engine(d, clock=clock)
+        try:
+            _save_step(d, eng, 2)
+            assert eng.deploy.begin_canary(fraction=0.5, step=2) == 2
+            for i in range(eng.deploy.min_events):
+                clock.advance(0.01)
+                eng.deploy.observe_candidate("embed", None, True,
+                                             trace_id=f"err-{i}")
+            assert eng.deploy.phase == "idle"
+            assert eng.deploy.last_report["reason"] == "burn_rate"
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_clean_windows_auto_promote(self, tmp_path):
+        d = str(tmp_path / "ckpt")
+        clock = FakeClock()
+        eng = self._deploy_engine(d, clock)
+        try:
+            _save_step(d, eng, 2)
+            assert eng.deploy.begin_canary(fraction=0.5, step=2) == 2
+            # 2 clean windows (window_s=5, min_events=4, promote_after=2)
+            for _ in range(2):
+                for _ in range(5):
+                    clock.advance(1.1)
+                    eng.deploy.observe_candidate("embed", 5.0, False)
+            assert eng.deploy.phase == "idle"
+            assert eng.deploy.last_report["action"] == "promoted"
+            assert eng.step == 2
+            primary = eng.models.get(DEFAULT_MODEL)
+            assert primary.step == 2 and primary.role == "primary"
+            assert len(eng.models.versions(DEFAULT_MODEL)) == 1
+            # the displaced tree is the staged-API rollback point
+            assert eng.rollback() == 0
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_terminal_transition_resets_gauges(self, tmp_path):
+        """A retired deploy must not leave phantom phase/candidate
+        gauges behind (a dashboard would read 'mid-canary forever')."""
+        d = str(tmp_path / "ckpt")
+        clock = FakeClock()
+        eng = self._deploy_engine(d, clock)
+        try:
+            _save_step(d, eng, 2)
+            eng.deploy.begin_canary(fraction=0.5, step=2)
+            assert eng.registry.snapshot()["deploy_phase"] == 2
+            eng.deploy.abort()
+            snap = eng.registry.snapshot()
+            assert snap["deploy_phase"] == 0
+            assert snap["deploy_candidate_step"] == -1
+            assert snap["deploy_clean_windows"] == 0
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_tenant_scoped_candidate_slo(self, tmp_path):
+        """A tenant-scoped SLO judges only that tenant's candidate
+        outcomes — other tenants' (and tenantless shadow) latencies
+        never burn it."""
+        d = str(tmp_path / "ckpt")
+        clock = FakeClock()
+        make_demo_checkpoint(d)
+        eng = _engine(d, clock=clock,
+                      slos=[parse_slo("acme/p95<100ms",
+                                      short_window_s=5.0,
+                                      long_window_s=10.0, min_events=4,
+                                      burn_threshold=2.0)])
+        try:
+            _save_step(d, eng, 2)
+            assert eng.deploy.begin_canary(fraction=0.5, step=2) == 2
+            for i in range(6):  # slow, but the WRONG tenant
+                clock.advance(0.1)
+                eng.deploy.observe_candidate("embed", 900.0, False,
+                                             tenant="beta")
+            assert eng.deploy.phase == "canary"
+            for i in range(4):  # the scoped tenant burns it
+                clock.advance(0.1)
+                eng.deploy.observe_candidate("embed", 900.0, False,
+                                             tenant="acme")
+            assert eng.deploy.phase == "idle"
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_orphan_canary_outcome_feeds_nobody(self, tmp_path):
+        """An outcome tagged with a RETIRED candidate step (rollback
+        raced the in-flight window) must not land in the primary's SLO
+        evaluators — the retired version's latencies would page on a
+        healthy primary."""
+        d = str(tmp_path / "ckpt")
+        clock = FakeClock()
+        eng = self._deploy_engine(d, clock)
+        try:
+            _save_step(d, eng, 2)
+            eng.deploy.begin_canary(fraction=0.5, step=2)
+            eng.deploy.abort()  # candidate retired; step 2 now orphan
+            for _ in range(10):
+                clock.advance(0.1)
+                eng.observe_outcome("embed", 900.0, False, version=2)
+            assert all(len(ev._short) == 0
+                       for ev in eng._slo.evaluators)
+            # untagged outcomes still feed the primary as ever
+            eng.observe_outcome("embed", 5.0, False)
+            assert sum(len(ev._short)
+                       for ev in eng._slo.evaluators) == 1
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_breach_resets_clean_windows(self, tmp_path):
+        d = str(tmp_path / "ckpt")
+        clock = FakeClock()
+        eng = self._deploy_engine(d, clock)
+        try:
+            _save_step(d, eng, 2)
+            assert eng.deploy.begin_shadow(step=2) == 2
+            # shadow breaches never promote, and a breach resets the
+            # clean streak (rollback fires instead in shadow too)
+            for _ in range(5):
+                clock.advance(1.1)
+                eng.deploy.observe_candidate("embed", 5.0, False)
+            assert eng.deploy.status()["clean_windows"] == 1
+            for i in range(4):
+                clock.advance(0.1)
+                eng.deploy.observe_candidate("embed", 999.0, False)
+            assert eng.deploy.phase == "idle"  # rolled back from shadow
+            assert eng.deploy.last_report["action"] == "rolled_back"
+        finally:
+            eng.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# HTTP integration: tenants + deploy admin over the wire
+# ---------------------------------------------------------------------------
+@pytest.fixture()
+def served(tmp_path):
+    d = str(tmp_path / "ckpt")
+    make_demo_checkpoint(d)
+    eng = ServingEngine(
+        d, buckets=(1, 2, 4), max_wait_ms=1.0, warmup=True,
+        reload_poll_s=0, tenant_quotas={"tenantA": "2:2"},
+    )
+    eng.start(watch=False)
+    from glom_tpu.serving.server import make_server
+
+    server = make_server(eng)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = "http://{}:{}".format(*server.server_address[:2])
+    yield url, eng
+    server.shutdown()
+    server.server_close()
+    eng.shutdown(drain=False)
+
+
+def _post(url, path, payload, headers=None):
+    req = urllib.request.Request(
+        f"{url}{path}", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+class TestHTTPTenants:
+    def test_quota_shed_is_structured_503(self, served):
+        url, eng = served
+        payload = {"images": _imgs(1).tolist()}
+        headers = {"X-Tenant": "tenantA"}
+        # drive until the bucket is dry: the 2:2 quota admits the burst
+        # plus whatever refills while the admitted requests serve (a
+        # loaded CI box can be slow enough to re-earn a token mid-test)
+        body = None
+        for _ in range(30):
+            try:
+                _post(url, "/embed", payload, headers)
+            except urllib.error.HTTPError as exc:
+                assert exc.code == 503
+                body = json.loads(exc.read())
+                break
+        assert body is not None, "quota never shed"
+        assert body["error"] == "tenant_overloaded"
+        assert body["tenant"] == "tenantA"
+        snap = eng.registry.snapshot()
+        assert snap.get("serving_tenant_shed_tenantA", 0) >= 1
+        assert snap.get("serving_tenant_requests_tenantA", 0) >= 3
+
+    def test_tenant_b_isolated_from_a_flood(self, served):
+        """The acceptance shape: A past its quota, B's error rate zero
+        and its requests all served."""
+        url, eng = served
+        payload = {"images": _imgs(1).tolist()}
+        outcomes = {"a_shed": 0, "a_ok": 0, "b_ok": 0, "b_fail": 0}
+        lock = threading.Lock()
+
+        def flood_a():
+            for _ in range(40):
+                try:
+                    _post(url, "/embed", payload, {"X-Tenant": "tenantA"})
+                    with lock:
+                        outcomes["a_ok"] += 1
+                except urllib.error.HTTPError as e:
+                    e.read()
+                    with lock:
+                        outcomes["a_shed"] += 1
+
+        def trickle_b():
+            for _ in range(10):
+                try:
+                    _post(url, "/embed", payload, {"X-Tenant": "tenantB"})
+                    with lock:
+                        outcomes["b_ok"] += 1
+                except urllib.error.HTTPError as e:
+                    e.read()
+                    with lock:
+                        outcomes["b_fail"] += 1
+
+        threads = [threading.Thread(target=flood_a, daemon=True),
+                   threading.Thread(target=trickle_b, daemon=True)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert outcomes["a_shed"] > 0
+        assert outcomes["b_fail"] == 0 and outcomes["b_ok"] == 10
+        snap = eng.registry.snapshot()
+        assert snap.get("serving_tenant_errors_tenantB", 0) == 0
+
+    def test_quota_shed_never_burns_the_candidate(self, served):
+        """A shed during a canary never executed on the candidate: it
+        must not feed the candidate's error budget (a spurious rollback
+        would churn the fleet over an unrelated overload)."""
+        url, eng = served
+        _save_step(eng.checkpoint_dir, eng, 2)
+        _post(url, "/admin/deploy/canary",
+              {"step": 2, "fraction": 1.0})
+        payload = {"images": _imgs(1).tolist()}
+        headers = {"X-Tenant": "tenantA", "X-Affinity-Key": "pinned"}
+        sheds = 0
+        for _ in range(12):  # burst 2 admits; the rest shed
+            try:
+                _post(url, "/embed", payload, headers)
+            except urllib.error.HTTPError as e:
+                e.read()
+                assert e.code == 503
+                sheds += 1
+        assert sheds >= 8
+        # the candidate saw ZERO error observations from the sheds
+        assert all(ev._short_bad == 0 for ev in eng.deploy._evaluators)
+        assert eng.deploy.phase == "canary"
+        _post(url, "/admin/deploy/abort", {})
+
+    def test_session_frames_ride_the_tenant_quota(self, tmp_path):
+        """The bulkhead covers /session/* too: a tenant past its bucket
+        sheds session frames before they consume inline device time."""
+        d = str(tmp_path / "ckpt")
+        make_demo_checkpoint(d)
+        eng = _engine(d, warm_iters=2, tenant_quotas={"acme": "2:2"})
+        try:
+            eng.session_embed("s1", _imgs(1), tenant="acme")
+            eng.session_embed("s1", _imgs(1), tenant="acme")
+            with pytest.raises(TenantQuotaExceeded):
+                eng.session_embed("s1", _imgs(1), tenant="acme")
+            # other tenants (and tenantless frames) untouched
+            eng.session_embed("s2", _imgs(1), tenant="beta")
+            eng.session_embed("s3", _imgs(1))
+            snap = eng.registry.snapshot()
+            assert snap.get("serving_tenant_shed_acme") == 1
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_bad_tenant_label_is_400(self, served):
+        url, _ = served
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(url, "/embed", {"images": _imgs(1).tolist()},
+                  {"X-Tenant": "bad tenant!"})
+        assert exc.value.code == 400
+
+    def test_non_dict_json_body_is_400(self, served):
+        """A valid-JSON array/scalar body is a clean 400 on every route,
+        never an AttributeError mid-handler."""
+        url, _ = served
+        for path in ("/embed", "/admin/deploy/shadow"):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(url, path, [1, 2, 3])
+            assert exc.value.code == 400, path
+            assert "JSON object" in json.loads(exc.value.read())["error"]
+
+    def test_router_forwards_tenant_header(self, served):
+        """The bulkhead survives the fleet hop: a quota shed bites
+        through the router exactly as it does engine-direct."""
+        from glom_tpu.serving.router import FleetRouter, make_router_server
+
+        url, eng = served
+        router = FleetRouter([url], health_interval_s=0.2)
+        router.start()
+        rsrv = make_router_server(router)
+        threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+        rurl = "http://{}:{}".format(*rsrv.server_address[:2])
+        try:
+            payload = {"images": _imgs(1).tolist()}
+            body = None
+            for _ in range(30):
+                try:
+                    _post(rurl, "/embed", payload, {"X-Tenant": "tenantA"})
+                except urllib.error.HTTPError as exc:
+                    body = json.loads(exc.read())
+                    break
+            assert body is not None, "quota never bit through the router"
+            assert body["error"] == "tenant_overloaded"
+            assert eng.registry.snapshot().get(
+                "serving_tenant_shed_tenantA", 0) >= 1
+        finally:
+            router.shutdown()
+            rsrv.shutdown()
+            rsrv.server_close()
+
+    def test_healthz_surfaces_tenants_and_deploy(self, served):
+        url, _ = served
+        with urllib.request.urlopen(f"{url}/healthz", timeout=10) as r:
+            h = json.loads(r.read())
+        assert h["deploy"]["phase"] == "idle"
+        assert "tenantA" in h["tenants"]
+        assert h["models"]["models"] == ["default"]
+
+
+class TestHTTPDeployAdmin:
+    def test_lifecycle_over_the_wire(self, served, tmp_path):
+        url, eng = served
+        _save_step(eng.checkpoint_dir, eng, 2)
+        resp = _post(url, "/admin/deploy/shadow", {"step": 2})
+        assert resp == {"candidate_step": 2, "phase": "shadow",
+                        "serving_step": 0}
+        with urllib.request.urlopen(
+                f"{url}/admin/deploy/status", timeout=10) as r:
+            status = json.loads(r.read())
+        assert status["phase"] == "shadow"
+        resp = _post(url, "/admin/deploy/canary", {"fraction": 0.25})
+        assert resp["phase"] == "canary"
+        # a canary response's step names the version that served it
+        hit = miss = 0
+        for i in range(40):
+            body = _post(url, "/embed", {"images": _imgs(1).tolist()},
+                         {"X-Affinity-Key": f"k-{i}"})
+            if body["step"] == 2:
+                hit += 1
+            else:
+                assert body["step"] == 0
+                miss += 1
+        assert hit >= 1 and miss >= 1
+        resp = _post(url, "/admin/deploy/rollback", {"reason": "operator"})
+        assert resp["action"] == "rolled_back"
+        assert resp["pins"] == {"before": 2, "after": 0}
+        assert eng.deploy.phase == "idle"
+        # idempotent settling: a second rollback is a clean 409
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(url, "/admin/deploy/rollback", {})
+        assert exc.value.code == 409
+        exc.value.read()
+        assert _xla_compiles(eng) == 0
+
+    def test_promote_over_the_wire(self, served):
+        url, eng = served
+        _save_step(eng.checkpoint_dir, eng, 3)
+        _post(url, "/admin/deploy/shadow", {"step": 3})
+        resp = _post(url, "/admin/deploy/promote", {})
+        assert resp["action"] == "promoted" and resp["step"] == 3
+        assert eng.step == 3
+
+
+# ---------------------------------------------------------------------------
+# shadow-path primary-latency invariant (loadgen-shaped, in-process)
+# ---------------------------------------------------------------------------
+class TestShadowLatencyInvariant:
+    def test_primary_p95_unmoved_by_shadow(self, tmp_path):
+        """Same closed-loop drive with and without an active shadow: the
+        mirror must not move the primary's p95 beyond CI noise (the
+        shadow queue is bounded+lossy and the executor is off-thread)."""
+        d = str(tmp_path / "ckpt")
+        make_demo_checkpoint(d)
+        eng = ServingEngine(d, buckets=(1, 2, 4), max_wait_ms=1.0,
+                            warmup=True, reload_poll_s=0)
+        eng.start(watch=False)
+        from glom_tpu.serving.server import make_server
+
+        server = make_server(eng)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        url = "http://{}:{}".format(*server.server_address[:2])
+        payload = {"images": _imgs(1).tolist()}
+
+        def drive(n):
+            lats = []
+            for _ in range(n):
+                import time as _time
+
+                t0 = _time.monotonic()
+                _post(url, "/embed", payload)
+                lats.append((_time.monotonic() - t0) * 1e3)
+            return sorted(lats)
+
+        try:
+            drive(5)  # warm the HTTP path
+            base = drive(30)
+            _save_step(d, eng, 2)
+            assert eng.deploy.begin_shadow(step=2) == 2
+            shadowed = drive(30)
+            p95 = lambda xs: xs[int(0.95 * (len(xs) - 1))]  # noqa: E731
+            assert p95(shadowed) <= max(3.0 * p95(base),
+                                        p95(base) + 250.0), (
+                p95(base), p95(shadowed))
+            assert eng.registry.snapshot().get(
+                "deploy_shadow_requests", 0) >= 1
+            assert _xla_compiles(eng) == 0
+            eng.deploy.abort()
+        finally:
+            server.shutdown()
+            server.server_close()
+            eng.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# the CI deploy-smoke gate, tier-1 wired
+# ---------------------------------------------------------------------------
+class TestDeploySmoke:
+    def test_canary_regression_scenario_subprocess(self):
+        """The deploy-smoke CI job's exact command: the chaos
+        canary_regression scenario recovers in a fresh CPU process."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "chaos.py"),
+             "--smoke", "--scenario", "canary_regression"],
+            capture_output=True, text=True, timeout=300, env=env, cwd=ROOT,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        rec = json.loads(proc.stdout.splitlines()[0])
+        assert rec["outcome"] == "recovered"
+        assert rec["requests_error"] == 0
+        assert rec["mttr_s"] >= 0.0

@@ -562,7 +562,7 @@ class TestHTTPServer:
     def test_overload_is_structured_503(self, served, monkeypatch):
         url, eng = served
 
-        def _shed(payload, size=1, ctx=None):
+        def _shed(payload, size=1, ctx=None, **kw):
             raise Overloaded("queue at capacity")
 
         monkeypatch.setattr(eng.batchers["embed"], "submit", _shed)
@@ -578,7 +578,7 @@ class TestHTTPServer:
     def test_draining_is_structured_503(self, served, monkeypatch):
         url, eng = served
 
-        def _closed(payload, size=1, ctx=None):
+        def _closed(payload, size=1, ctx=None, **kw):
             raise Closed("shut down")
 
         monkeypatch.setattr(eng.batchers["embed"], "submit", _closed)

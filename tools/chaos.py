@@ -440,6 +440,222 @@ def scenario_replica_kill(soak):
                 "error_rate": round(errors / max(total, 1), 4)}
 
 
+def scenario_canary_regression(soak):
+    """A deliberately bad deploy candidate, twice over: a CORRUPT
+    candidate checkpoint is quarantined at shadow-load and never becomes
+    resident (zero exposure); a LATENCY-injected candidate shadows
+    clean, regresses under live canary traffic, and the burn-rate
+    auto-rollback retreats — zero client-visible errors (failover/gate
+    semantics via a fronting router preserved), the candidate capped at
+    its canary fraction, a ``deploy_rollback`` forensics bundle naming
+    the offending traces and the before/after version pins, and the
+    fleet pinned back through the router's two-phase rollout."""
+    import json
+    import threading
+    import urllib.request
+
+    import jax
+    import numpy as np
+
+    from glom_tpu import checkpoint as ckpt_lib
+    from glom_tpu.obs.slo import parse_slo
+    from glom_tpu.resilience import faultinject
+    from glom_tpu.serving.engine import ServingEngine, make_demo_checkpoint
+    from glom_tpu.serving.router import FleetRouter, make_router_server
+    from glom_tpu.serving.server import make_server
+
+    n_keys, min_requests = (64, 60) if not soak else (256, 400)
+    fraction = 0.5
+    with tempfile.TemporaryDirectory() as root:
+        ckpt = os.path.join(root, "ckpt")
+        fdir = os.path.join(root, "forensics")
+        make_demo_checkpoint(ckpt)
+        engine = ServingEngine(
+            ckpt, buckets=(1, 2), max_wait_ms=1.0, warmup=True,
+            reload_poll_s=0, forensics_dir=fdir,
+            slos=[parse_slo("p95<100ms", short_window_s=2.0,
+                            long_window_s=4.0, min_events=4,
+                            burn_threshold=2.0)],
+        )
+        engine.start(watch=False)
+        srv = make_server(engine)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        eng_url = "http://{}:{}".format(*srv.server_address[:2])
+        router = FleetRouter([eng_url], health_interval_s=0.2)
+        router.start()
+        rsrv = make_router_server(router)
+        threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+        rurl = "http://{}:{}".format(*rsrv.server_address[:2])
+        # promotes/rollbacks converge the fleet through the router
+        engine.deploy.pin_url = rurl
+
+        def admin(action, payload=None):
+            req = urllib.request.Request(
+                f"{eng_url}/admin/deploy/{action}",
+                data=json.dumps(payload or {}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read())
+
+        # -- phase A: a corrupt candidate must abort at load, pre-traffic
+        ckpt_lib.save(ckpt, 1, {"params": jax.device_get(engine._template)})
+        path = ckpt_lib.npz_path(ckpt, 1)
+        with open(path, "r+b") as f:
+            f.seek(os.path.getsize(path) // 2)
+            b = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([b[0] ^ 0xFF]))
+        resp = admin("shadow")  # step=None: anchors on latest VALID step
+        assert resp["candidate_step"] is None, resp
+        assert engine.deploy.phase == "idle"
+        assert [f for f in os.listdir(ckpt) if f.endswith(".corrupt")], (
+            "corrupt candidate was not quarantined")
+
+        # -- phase B: a valid-but-regressing candidate -----------------
+        ckpt_lib.save(ckpt, 2, {"params": jax.device_get(engine._template)})
+        body = json.dumps({"images": np.zeros(
+            (1, 3, 16, 16), np.float32).tolist()}).encode()
+        stop = threading.Event()
+        lock = threading.Lock()
+        counts = {"ok": 0, "error": 0, "canary": 0, "total_canary_window": 0}
+        canary_on = threading.Event()
+
+        def load(worker):
+            i = 0
+            while not stop.is_set():
+                i += 1
+                req = urllib.request.Request(
+                    f"{rurl}/embed", data=body,
+                    headers={"Content-Type": "application/json",
+                             "X-Affinity-Key":
+                                 f"key-{(worker * 7919 + i) % n_keys}"})
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        step = json.loads(r.read()).get("step")
+                    with lock:
+                        counts["ok"] += 1
+                        if canary_on.is_set():
+                            counts["total_canary_window"] += 1
+                            if step == 2:
+                                counts["canary"] += 1
+                except Exception:  # glomlint: disable=conc-broad-except -- the client-visible error count IS the scenario's acceptance signal
+                    with lock:
+                        counts["error"] += 1
+
+        workers = [threading.Thread(target=load, args=(w,), daemon=True)
+                   for w in range(6)]
+        for w in workers:
+            w.start()
+        try:
+            resp = admin("shadow", {"step": 2})
+            assert resp["candidate_step"] == 2, resp
+            # shadow evidence accumulates (mirrored, discarded, measured
+            # under the candidate only)
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                snap = engine.registry.snapshot()
+                if snap.get("deploy_shadow_requests", 0) >= 5:
+                    break
+                time.sleep(0.02)
+            assert engine.registry.snapshot().get(
+                "deploy_shadow_requests", 0) >= 5, "shadow never mirrored"
+            assert engine.deploy.phase == "shadow"
+
+            # advance to canary and let HEALTHY candidate traffic flow
+            # first (arming the fault while shadow mirrors still drain
+            # would burn the shadow evaluators and roll back before the
+            # canary phase ever measured anything)
+            canary_on.set()
+            resp = admin("canary", {"fraction": fraction})
+            assert resp["candidate_step"] == 2, resp
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                with lock:
+                    if counts["canary"] >= 3:
+                        break
+                time.sleep(0.02)
+            with lock:
+                assert counts["canary"] >= 1, counts
+
+            # now the candidate regresses mid-canary: every further
+            # candidate execute pays injected latency, the short window
+            # burns, and the auto-rollback retreats
+            with faultinject.injected("candidate:delay*100000"):
+                t_regress = time.monotonic()
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    if engine.registry.snapshot().get(
+                            "deploy_rollbacks_total", 0) >= 1:
+                        break
+                    time.sleep(0.02)
+                mttr = time.monotonic() - t_regress
+            canary_on.clear()
+            snap = engine.registry.snapshot()
+            assert snap.get("deploy_rollbacks_total", 0) == 1, (
+                "auto-rollback never fired")
+            assert engine.deploy.phase == "idle"
+            assert engine.step == 0, "primary pin moved during a canary"
+            # keep load flowing a moment: post-rollback traffic is all-old
+            # (the target also covers the total-request floor asserted
+            # below, so a CPU-contended run drives until it has evidence)
+            with lock:
+                target = max(counts["ok"] + min_requests // 3,
+                             min_requests)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                with lock:
+                    if counts["ok"] >= target:
+                        break
+                time.sleep(0.02)
+        finally:
+            stop.set()
+            for w in workers:
+                w.join(timeout=10)
+
+        with lock:
+            done = dict(counts)
+        assert done["ok"] >= min_requests, done
+        # ZERO client-visible errors: the regression was latency, the
+        # retreat automatic, and no request ever failed for it
+        assert done["error"] == 0, done
+        # the candidate saw live traffic, but never more than its
+        # deterministic canary fraction (binomial slack over n_keys)
+        assert done["canary"] >= 1, done
+        window = max(done["total_canary_window"], 1)
+        assert done["canary"] / window <= fraction + 0.25, done
+        # the rollback bundle: offending traces + before/after pins
+        bundles = [d for d in os.listdir(fdir)
+                   if d.startswith("deploy_rollback-")]
+        assert len(bundles) == 1, bundles
+        with open(os.path.join(fdir, bundles[0], "manifest.json")) as f:
+            manifest = json.load(f)
+        detail = manifest["detail"]
+        assert detail["pins"] == {"before": 2, "after": 0}, detail
+        assert detail["reason"] == "burn_rate", detail
+        assert detail["trace_ids"], "bundle names no offending traces"
+        assert detail["fleet_pin"]["ok"], detail
+        assert os.path.exists(os.path.join(
+            fdir, bundles[0], "deploy_traces.json")), (
+            "offending trace spans missing from the bundle")
+        # the fleet never pinned to the candidate
+        assert router.fleet_step in (None, 0), router.fleet_step
+
+        router.shutdown()
+        rsrv.shutdown()
+        rsrv.server_close()
+        srv.shutdown()
+        srv.server_close()
+        engine.shutdown(drain=False)
+        return {"mttr_s": mttr,
+                "requests_ok": done["ok"],
+                "requests_error": done["error"],
+                "canary_fraction_observed": round(
+                    done["canary"] / window, 4),
+                "shadow_requests": int(snap.get(
+                    "deploy_shadow_requests", 0)),
+                "rollback_bundle": bundles[0]}
+
+
 # -- elastic multi-host scenarios (glom_tpu/resilience/elastic.py) ---------
 
 def _elastic_run(*, hosts, steps, batch, spec, ckpt_dir, slots=None, seed=0):
@@ -591,6 +807,7 @@ SCENARIOS = {
     "reload_io_error": scenario_reload_io_error,
     "train_crash": scenario_train_crash,
     "replica_kill": scenario_replica_kill,
+    "canary_regression": scenario_canary_regression,
     "host_preempt": scenario_host_preempt,
     "coordinator_loss": scenario_coordinator_loss,
     "shrink_restart": scenario_shrink_restart,

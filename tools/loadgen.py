@@ -26,6 +26,16 @@ shapes are exercised; the image contract (size/channels) is read from
 object: p50/p95/p99/mean/max latency (ms), throughput (requests and
 images per second), and error/shed counts.
 
+**Mixed-tenant mode** (``--tenant NAME:WEIGHT``, repeatable): requests
+carry ``X-Tenant`` cycling tenants by weight, and the report gains a
+``per_tenant`` section (p50/p95, ``shed_rate``, ``error_rate``) — the
+measurement side of the serving bulkheads.  Quota sheds (503
+``tenant_overloaded``) count as sheds, not errors, so driving one
+tenant past its quota on purpose still exits 0.  ``--smoke`` runs the
+bulkhead acceptance leg: tenant A floods a deliberately tiny quota
+while tenant B repeats a baseline pattern — B must see zero
+sheds/errors and a statistically unmoved p95.
+
 **Fleet mode**: pass ``--target`` multiple times (requests cycle across
 the URLs — client-side spraying over N engines), or point ``--url`` at a
 ``glom_tpu.serving.router`` front.  Either way the report gains a
@@ -97,6 +107,14 @@ def parse_args(argv=None):
                         "the run)")
     p.add_argument("--frames", type=int, default=16,
                    help="session mode: frames per session")
+    p.add_argument("--tenant", action="append", default=None,
+                   metavar="NAME:WEIGHT",
+                   help="repeatable: mixed-tenant load — requests carry "
+                        "X-Tenant, cycling tenants by integer WEIGHT "
+                        "(acme:3 beta:1 = 3/4 acme traffic).  The report "
+                        "gains a per_tenant section (p50/p95/shed_rate); "
+                        "quota sheds (503 tenant_overloaded) count as "
+                        "sheds, not errors")
     p.add_argument("--timeout", type=float, default=60.0,
                    help="per-request HTTP timeout (seconds)")
     p.add_argument("--slow-n", type=int, default=0,
@@ -167,6 +185,9 @@ class _Results:
         self.cold_ms = []
         self.warm_ms = []
         self.sessions = {}
+        # per-tenant breakdown (--tenant): the bulkhead evidence — one
+        # tenant's sheds must coexist with another's unmoved latencies
+        self.tenants = {}
 
     def _replica(self, key):
         rec = self.replicas.get(key)
@@ -177,20 +198,34 @@ class _Results:
             }
         return rec
 
+    def _tenant(self, key):
+        rec = self.tenants.get(key)
+        if rec is None:
+            rec = self.tenants[key] = {
+                "latencies_ms": [], "ok": 0, "shed": 0, "errors": 0,
+            }
+        return rec
+
     def record(self, latency_ms=None, images=0, shed=False, error=False,
-               request_id=None, id_mismatch=False, replica=None):
+               request_id=None, id_mismatch=False, replica=None,
+               tenant=None):
         with self.lock:
             rep = self._replica(replica) if replica is not None else None
+            ten = self._tenant(tenant) if tenant is not None else None
             if id_mismatch:
                 self.id_mismatches += 1
             if shed:
                 self.shed += 1
                 if rep is not None:
                     rep["shed"] += 1
+                if ten is not None:
+                    ten["shed"] += 1
             elif error:
                 self.errors += 1
                 if rep is not None:
                     rep["errors"] += 1
+                if ten is not None:
+                    ten["errors"] += 1
             else:
                 self.ok += 1
                 self.images_ok += images
@@ -201,6 +236,9 @@ class _Results:
                     rep["ok"] += 1
                     rep["images_ok"] += images
                     rep["latencies_ms"].append(latency_ms)
+                if ten is not None:
+                    ten["ok"] += 1
+                    ten["latencies_ms"].append(latency_ms)
 
     def note_session(self, sid, *, cold=None, latency_ms=None, replica=None):
         with self.lock:
@@ -221,8 +259,21 @@ class _Results:
             return sorted(self.samples, reverse=True)[:n]
 
 
+def parse_tenants(specs):
+    """``["acme:3", "beta:1"]`` -> the deterministic request->tenant
+    cycle ``[acme, acme, acme, beta]`` (weights are integers; bare
+    ``NAME`` means weight 1)."""
+    schedule = []
+    for spec in specs:
+        name, _, weight = spec.partition(":")
+        if not name:
+            raise ValueError(f"bad --tenant spec {spec!r}")
+        schedule.extend([name] * max(1, int(weight or 1)))
+    return schedule
+
+
 def run_closed(urls, endpoint, payloads, batch_sizes, n_requests, concurrency,
-               timeout, results):
+               timeout, results, tenants=None):
     idx_lock = threading.Lock()
     counter = [0]
 
@@ -241,7 +292,8 @@ def run_closed(urls, endpoint, payloads, batch_sizes, n_requests, concurrency,
             t0 = time.monotonic()
             _send(urls[i % len(urls)], endpoint, payloads[b], b, timeout,
                   results, t0, request_id=f"lg-{os.getpid()}-{i}",
-                  multi_target=len(urls) > 1)
+                  multi_target=len(urls) > 1,
+                  tenant=tenants[i % len(tenants)] if tenants else None)
 
     threads = [threading.Thread(target=worker, daemon=True)
                for _ in range(concurrency)]
@@ -254,7 +306,7 @@ def run_closed(urls, endpoint, payloads, batch_sizes, n_requests, concurrency,
 
 
 def run_open(urls, endpoint, payloads, batch_sizes, rate, duration, timeout,
-             results):
+             results, tenants=None):
     """Fixed arrival schedule: request i fires at ``i / rate`` seconds
     whether or not earlier ones finished (one thread per in-flight
     request; the OS scheduler is the arrival clock)."""
@@ -273,7 +325,9 @@ def run_open(urls, endpoint, payloads, batch_sizes, rate, duration, timeout,
             args=(urls[i % len(urls)], endpoint, payloads[b], b, timeout,
                   results, time.monotonic()),
             kwargs={"request_id": f"lg-{os.getpid()}-{i}",
-                    "multi_target": len(urls) > 1},
+                    "multi_target": len(urls) > 1,
+                    "tenant": (tenants[i % len(tenants)]
+                               if tenants else None)},
             daemon=True,
         )
         t.start()
@@ -284,13 +338,15 @@ def run_open(urls, endpoint, payloads, batch_sizes, rate, duration, timeout,
 
 
 def _send(url, endpoint, body, n_images, timeout, results, t0,
-          request_id=None, multi_target=False):
+          request_id=None, multi_target=False, tenant=None):
     headers = {"Content-Type": "application/json"}
     if request_id is not None:
         # the trace identity: the server adopts it as the trace_id and
         # must echo it back — a missing/different echo is a broken
         # propagation path, counted as id_mismatch
         headers["X-Request-Id"] = request_id
+    if tenant is not None:
+        headers["X-Tenant"] = tenant
     req = urllib.request.Request(f"{url}/{endpoint}", data=body,
                                  headers=headers)
 
@@ -313,17 +369,18 @@ def _send(url, endpoint, body, n_images, timeout, results, t0,
         results.record(shed=(e.code == 503), error=(e.code != 503),
                        id_mismatch=(request_id is not None
                                     and echoed != request_id),
-                       replica=replica_key(e.headers))
+                       replica=replica_key(e.headers), tenant=tenant)
         return
     except Exception:  # glomlint: disable=conc-broad-except -- recorded as an error sample; a load generator must keep offering load through any single-request failure
         results.record(error=True,
-                       replica=url if multi_target else None)
+                       replica=url if multi_target else None,
+                       tenant=tenant)
         return
     results.record(
         latency_ms=(time.monotonic() - t0) * 1e3, images=n_images,
         request_id=request_id,
         id_mismatch=(request_id is not None and echoed != request_id),
-        replica=replica,
+        replica=replica, tenant=tenant,
     )
 
 
@@ -517,6 +574,26 @@ def report(results, wall_s, mode, slow_n=0):
             {"request_id": rid, "latency_ms": round(ms, 3)}
             for ms, rid in results.slowest(slow_n)
         ]
+    if results.tenants:
+        per_tenant = {}
+        for key, rec in sorted(results.tenants.items()):
+            tlat = rec["latencies_ms"]
+            total = rec["ok"] + rec["shed"] + rec["errors"]
+            per_tenant[key] = {
+                "requests_ok": rec["ok"],
+                "requests_shed": rec["shed"],
+                "requests_error": rec["errors"],
+                # the bulkhead's own number: the fraction of THIS
+                # tenant's offered load its quota turned away
+                "shed_rate": round(rec["shed"] / total, 4) if total else None,
+                "error_rate": (round(rec["errors"] / total, 4)
+                               if total else None),
+                "latency_ms": {
+                    "p50": round(percentile(tlat, 50), 3) if tlat else None,
+                    "p95": round(percentile(tlat, 95), 3) if tlat else None,
+                },
+            }
+        out["per_tenant"] = per_tenant
     if results.replicas:
         per = {}
         for key, rec in sorted(results.replicas.items()):
@@ -536,6 +613,91 @@ def report(results, wall_s, mode, slow_n=0):
             }
         out["per_replica"] = per
     return out
+
+
+def _smoke_tenant_bulkhead(ckpt_dir) -> dict:
+    """The bulkhead acceptance leg of ``--smoke``: tenant A is driven
+    hard past a deliberately tiny admission quota while tenant B offers
+    its ordinary trickle; B must see ZERO sheds/errors and a p95
+    statistically unchanged from its own B-only baseline measured first
+    on the same engine.  Returns the report dict; raises AssertionError
+    on an isolation breach."""
+    from glom_tpu.serving.engine import ServingEngine
+    from glom_tpu.serving.server import make_server
+
+    engine = ServingEngine(
+        ckpt_dir, buckets=(1, 2, 4), max_wait_ms=1.0, warmup=True,
+        reload_poll_s=0,
+        # ~4 imgs/s for A: the flood below offers far more, so most of
+        # A's traffic sheds at ITS bucket, never reaching the queue
+        tenant_quotas={"tenantA": "4:4"},
+    )
+    engine.start(watch=False)
+    server = make_server(engine)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = "http://{}:{}".format(*server.server_address[:2])
+    try:
+        health = _fetch_health(url, timeout=10)
+        payloads = _make_payloads(health, [1])
+
+        def drive(tenant, n, concurrency, results, pace_s=0.0):
+            def worker(w):
+                for i in range(n // concurrency):
+                    t0 = time.monotonic()
+                    _send(url, "embed", payloads[1], 1, 30.0, results, t0,
+                          request_id=f"lg-bh-{tenant}-{w}-{i}",
+                          tenant=tenant)
+                    if pace_s:
+                        time.sleep(pace_s)
+            threads = [threading.Thread(target=worker, args=(w,),
+                                        daemon=True)
+                       for w in range(concurrency)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+        # phase 1: B-only baseline (paced trickle)
+        base = _Results()
+        drive("tenantB", 24, 2, base, pace_s=0.01)
+        b0 = base.tenants["tenantB"]
+        p95_b0 = percentile(b0["latencies_ms"], 95)
+
+        # phase 2: A floods (4 unpaced workers, way past 4 imgs/s) while
+        # B repeats its exact phase-1 pattern
+        storm = _Results()
+        flood = threading.Thread(
+            target=drive, args=("tenantA", 400, 4, storm), daemon=True)
+        flood.start()
+        drive("tenantB", 24, 2, storm, pace_s=0.01)
+        flood.join()
+        a1 = storm.tenants["tenantA"]
+        b1 = storm.tenants["tenantB"]
+        p95_b1 = percentile(b1["latencies_ms"], 95)
+
+        assert a1["shed"] > 0, (
+            f"tenant A was never shed — the quota is not biting: {a1}")
+        assert b1["errors"] == 0 and b1["shed"] == 0, (
+            f"tenant B lost requests during A's flood: {b1}")
+        # "statistically unchanged": generous CI-noise bound — an
+        # unbulkheaded queue would shed B outright or inflate its p95 by
+        # queue-depth x service-time, far beyond this envelope
+        assert p95_b1 <= max(3.0 * p95_b0, p95_b0 + 250.0), (
+            f"tenant B p95 moved under A's flood: "
+            f"{p95_b0:.1f}ms -> {p95_b1:.1f}ms")
+        total_a = a1["ok"] + a1["shed"] + a1["errors"]
+        return {
+            "tenantA": {"ok": a1["ok"], "shed": a1["shed"],
+                        "shed_rate": round(a1["shed"] / total_a, 4)},
+            "tenantB_baseline_p95_ms": round(p95_b0, 3),
+            "tenantB_under_flood_p95_ms": round(p95_b1, 3),
+            "tenantB_errors": b1["errors"],
+            "tenantB_shed": b1["shed"],
+        }
+    finally:
+        server.shutdown()
+        engine.shutdown(drain=False)
+        server.server_close()
 
 
 def run_smoke(fleet: bool = False) -> int:
@@ -651,6 +813,10 @@ def run_smoke(fleet: bool = False) -> int:
                 and perfetto_ok
                 and want_names <= span_names
             )
+            # tenant-bulkhead acceptance (tenant A past its quota, B
+            # unmoved) runs only once the core smoke passed, and lands
+            # INSIDE the one JSON object consumers parse from stdout
+            bulkhead = _smoke_tenant_bulkhead(d) if ok else None
             print(json.dumps({
                 "smoke": "ok" if ok else "FAILED",
                 "smoke_mode": "fleet-stitched" if fleet else "engine",
@@ -661,6 +827,7 @@ def run_smoke(fleet: bool = False) -> int:
                                    else round(coverage, 4)),
                 "perfetto_file": perfetto_path,
                 "perfetto_events": len(perfetto.get("traceEvents", [])),
+                "tenant_bulkhead": bulkhead,
                 **report(results, wall, "smoke"),
             }, indent=2))
             if not ok:
@@ -716,15 +883,19 @@ def main(argv=None) -> int:
                   file=sys.stderr)
         return 0 if ok else 1
     payloads = _make_payloads(health, batch_sizes)
+    tenants = parse_tenants(args.tenant) if args.tenant else None
     if args.rate > 0:
         wall = run_open(urls, args.endpoint, payloads, batch_sizes,
-                        args.rate, args.duration, args.timeout, results)
+                        args.rate, args.duration, args.timeout, results,
+                        tenants=tenants)
         mode = f"open({args.rate}/s)"
     else:
         wall = run_closed(urls, args.endpoint, payloads, batch_sizes,
                           args.requests, args.concurrency, args.timeout,
-                          results)
+                          results, tenants=tenants)
         mode = f"closed(c={args.concurrency})"
+    if tenants:
+        mode += f" tenants({','.join(sorted(set(tenants)))})"
     if len(urls) > 1:
         mode += f" x{len(urls)} targets"
     print(json.dumps(report(results, wall, mode, slow_n=args.slow_n),
