@@ -20,6 +20,15 @@
     spill I/O — onto the execute core's hot path, and make the one
     jit-owning module stateful (its executables could then differ by
     WHEN they ran, the property the AOT warmup contract forbids).
+
+  * ``obs-unbounded-series`` — the retention contract (PR 16): every
+    per-sample/per-request accumulator in ``glom_tpu/obs/`` must be
+    bounded — ``deque(maxlen=)``, an explicit ``len()`` cap check, or
+    an eviction call (``pop``/``popleft``/``popitem``/``clear``/
+    ``del``) somewhere in the owning class.  The TSDB and trace/
+    forensics rings exist to watch long-lived serving processes for
+    leaks; an unbounded list inside them IS the leak, discovered only
+    after days of uptime.
 """
 
 from __future__ import annotations
@@ -142,4 +151,136 @@ class SessionStateInCacheRule(Rule):
         return findings
 
 
-OBS_RULES = (DebugPlaneInCacheRule, SessionStateInCacheRule)
+#: growth calls that accumulate one element per invocation
+_GROWTH_METHODS = {"append", "extend", "appendleft", "add"}
+#: eviction calls that count as bounding evidence for an attribute
+_EVICT_METHODS = {"pop", "popleft", "popitem", "clear"}
+#: constructors whose result is unbounded by default
+_UNBOUNDED_CTORS = {"list", "dict", "set", "OrderedDict", "defaultdict"}
+
+
+def _self_attr(node) -> str:
+    """``self.X`` -> ``"X"``, else ``""``."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return ""
+
+
+class UnboundedSeriesRule(Rule):
+    name = "obs-unbounded-series"
+    severity = "error"
+    description = ("per-sample container in glom_tpu/obs/ grows without a "
+                   "deque(maxlen=), len() cap check, or eviction call — "
+                   "the telemetry plane must not become the memory leak "
+                   "it exists to detect")
+
+    SCOPE_DIR = "obs"
+
+    @staticmethod
+    def _unbounded_init(value) -> bool:
+        """Is this initializer an unbounded container?  Literal displays
+        and comprehensions, the stdlib container constructors, and
+        ``deque()`` WITHOUT ``maxlen=`` all qualify."""
+        if isinstance(value, (ast.List, ast.Dict, ast.Set,
+                              ast.ListComp, ast.DictComp, ast.SetComp)):
+            return True
+        if isinstance(value, ast.Call):
+            d = dotted_name(value.func) or ""
+            base = d.split(".")[-1]
+            if base == "deque":
+                return not any(kw.arg == "maxlen" for kw in value.keywords)
+            return base in _UNBOUNDED_CTORS
+        return False
+
+    def _class_findings(self, ctx: ModuleContext,
+                        cls: ast.ClassDef) -> List[Finding]:
+        unbounded: dict = {}     # attr -> init node
+        evidence: set = set()    # attrs with cap/eviction anywhere in class
+        growth: List = []        # (attr, node, kind)
+        for node in ast.walk(cls):
+            # self.X = <unbounded container> (chained targets included)
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    attr = _self_attr(target)
+                    if attr and self._unbounded_init(node.value):
+                        unbounded.setdefault(attr, node)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                attr = _self_attr(node.target)
+                if attr and self._unbounded_init(node.value):
+                    unbounded.setdefault(attr, node)
+            # del self.X[...] is eviction
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        attr = _self_attr(t.value)
+                        if attr:
+                            evidence.add(attr)
+            elif isinstance(node, ast.Call):
+                # len(self.X) anywhere reads as a cap check (the
+                # `if len(self._series) >= self.max_series: drop` shape)
+                if (isinstance(node.func, ast.Name)
+                        and node.func.id == "len" and node.args):
+                    attr = _self_attr(node.args[0])
+                    if attr:
+                        evidence.add(attr)
+                elif isinstance(node.func, ast.Attribute):
+                    attr = _self_attr(node.func.value)
+                    if attr and node.func.attr in _EVICT_METHODS:
+                        evidence.add(attr)
+        # growth sites: .append()-family calls in any method but
+        # __init__, and subscript stores inside a loop (the per-sample
+        # shapes); a one-off subscript store outside a loop is a keyed
+        # update, not accumulation
+        for method in cls.body:
+            if (not isinstance(method,
+                               (ast.FunctionDef, ast.AsyncFunctionDef))
+                    or method.name == "__init__"):
+                continue
+            for node in ast.walk(method):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _GROWTH_METHODS):
+                    attr = _self_attr(node.func.value)
+                    if attr:
+                        growth.append((attr, node, node.func.attr))
+                elif isinstance(node, (ast.For, ast.While)):
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Assign):
+                            for target in sub.targets:
+                                if isinstance(target, ast.Subscript):
+                                    attr = _self_attr(target.value)
+                                    if attr:
+                                        growth.append(
+                                            (attr, sub, "loop store"))
+        findings: List[Finding] = []
+        flagged: set = set()
+        for attr, node, kind in growth:
+            if attr not in unbounded or attr in evidence or attr in flagged:
+                continue
+            flagged.add(attr)
+            findings.append(ctx.finding(
+                self, node,
+                f"self.{attr} grows per sample ({kind}) but is initialized "
+                f"unbounded and class {cls.name} never caps or evicts it — "
+                f"use deque(maxlen=), a len() bound, or an eviction sweep "
+                f"(the TSDB retention contract)"))
+        return findings
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        parts = ctx.relpath.split("/")
+        # component match (the obs-debug-in-cache convention): only
+        # modules under an obs/ directory are in scope — the telemetry
+        # plane's own retention contract, not a repo-wide style rule
+        if self.SCOPE_DIR not in parts[:-1]:
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._class_findings(ctx, node))
+        return findings
+
+
+OBS_RULES = (DebugPlaneInCacheRule, SessionStateInCacheRule,
+             UnboundedSeriesRule)

@@ -40,6 +40,16 @@ report through.  Four pieces, each usable on its own:
     exemplars to stored traces, and correlates ``slo_burn``/ejection
     signals into ONE cross-replica incident bundle
     (``tools/observatory.py`` is the CLI: serve / watch / report).
+  * :mod:`glom_tpu.obs.timeseries` — TSDB-lite: ring-bounded fixed-
+    interval series with downsampling tiers, a registry sampler, and
+    window math (rate / delta / percentile / linear trend / trend flip /
+    ETA-to-threshold) — the history layer behind ``/debug/series``.
+  * :mod:`glom_tpu.obs.capacity` — capacity accounting (duty cycle,
+    effective imgs/s vs the measured BENCH ceiling, padding waste, shed
+    and queue trends, tenant headroom) and the dry-run autoscale advisor:
+    declarative policy over the series, RECOMMENDATIONS only, persisted
+    pressure fired as a debounced ``capacity_pressure`` forensics
+    incident (``tools/capacity.py`` is the CLI).
 
 ``training/metrics.py``'s ``MetricLogger`` is the facade the Trainer
 logs through; it fans records out to the configured exporters.
@@ -111,6 +121,26 @@ from glom_tpu.obs.observatory import (  # noqa: F401
     make_observatory_server,
     parse_exemplars,
     stitch,
+)
+from glom_tpu.obs.timeseries import (  # noqa: F401
+    RegistrySampler,
+    SeriesStore,
+    delta,
+    eta_to_threshold,
+    linear_trend,
+    percentile_over,
+    rate,
+    series_key,
+    trend_arrow,
+    trend_flip,
+)
+from glom_tpu.obs.capacity import (  # noqa: F401
+    CapacityAccountant,
+    CapacityAdvisor,
+    CapacityPlane,
+    FleetCapacityPlane,
+    parse_capacity_policy,
+    read_bench_ceiling,
 )
 from glom_tpu.obs.perfgate import (  # noqa: F401
     GATE_FAIL,

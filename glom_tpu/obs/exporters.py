@@ -97,9 +97,9 @@ class CsvExporter:
 
     def emit(self, record: Dict) -> None:
         new = [k for k in record if k not in self._fields]
-        self._rows.append(dict(record))
+        self._rows.append(dict(record))  # glomlint: disable=obs-unbounded-series -- rows ARE the file: a header widening must rewrite the full history (class docstring); one small dict per logging boundary, not per request
         if new:
-            self._fields.extend(new)
+            self._fields.extend(new)  # glomlint: disable=obs-unbounded-series -- bounded by the record key vocabulary, which the instrumentation sites fix
             self._rewrite()
         else:
             with open(self.path, "a", newline="") as f:
@@ -230,7 +230,8 @@ def _family_key(name: str, types: Dict[str, str]):
 def _prom_render(state: Dict[str, float], types: Dict[str, str],
                  help_: Dict[str, str],
                  exemplars: Optional[Dict[str, tuple]] = None,
-                 openmetrics: bool = False) -> str:
+                 openmetrics: bool = False,
+                 timestamp: Optional[float] = None) -> str:
     keys = {name: _family_key(name, types) for name in state}
     lines = []
     declared = set()
@@ -249,6 +250,15 @@ def _prom_render(state: Dict[str, float], types: Dict[str, str],
                 lines.append(f"# HELP {declared_as} {help_[family]}")
             lines.append(f"# TYPE {declared_as} {types.get(family, 'gauge')}")
         line = f"{name} {_prom_fmt(state[name])}"
+        if timestamp is not None:
+            # OpenMetrics sample timestamp: unix seconds AFTER the value,
+            # BEFORE any exemplar clause.  Never rendered into the classic
+            # 0.0.4 text format here — a plain-text scraper already treats
+            # a trailing number as a MILLISECOND timestamp, so emitting
+            # seconds blind would silently skew every series by 1000x;
+            # callers gate on the OpenMetrics negotiation (see
+            # :func:`prometheus_lines`).
+            line += f" {_prom_fmt(float(timestamp))}"
         if exemplars and name in exemplars:
             # OpenMetrics exemplar syntax: `<sample> # {labels} <value>` —
             # the per-bucket link from a latency histogram to the trace id
@@ -280,7 +290,9 @@ def wants_openmetrics(accept_header) -> bool:
 
 
 def prometheus_lines(registry, prefix: str = "glom_",
-                     exemplars: bool = False) -> str:
+                     exemplars: bool = False,
+                     timestamps: bool = False,
+                     now: Optional[float] = None) -> str:
     """Render the registry's CURRENT state in Prometheus exposition format
     (the live-scrape companion to :class:`PrometheusTextfileExporter` —
     same families, no file).  ``exemplars=True`` renders the OpenMetrics
@@ -289,10 +301,26 @@ def prometheus_lines(registry, prefix: str = "glom_",
     served as ``OPENMETRICS_CONTENT_TYPE`` with a trailing ``# EOF``
     (see :func:`wants_openmetrics`); the classic text format has no
     exemplar syntax and a 0.0.4 parser rejects the whole scrape on the
-    first annotated line."""
+    first annotated line.  ``timestamps=True`` stamps every sample with
+    unix seconds (``now`` overrides the wall clock for tests) so scraped
+    series align with the internal TSDB windows
+    (:mod:`glom_tpu.obs.timeseries`); it rides the same negotiation rule
+    as exemplars — the classic format reads a trailing number as
+    MILLISECONDS, so timestamps without ``exemplars=True`` (i.e. outside
+    an OpenMetrics-negotiated body) are a :class:`ValueError`, not a
+    silently-skewed scrape."""
+    if timestamps and not exemplars:
+        raise ValueError(
+            "timestamps=True requires exemplars=True (OpenMetrics bodies "
+            "only — the classic 0.0.4 format parses a trailing number as "
+            "milliseconds and would skew every series 1000x)")
     state, types, help_, ex = registry_families(registry, prefix)
+    ts = None
+    if timestamps:
+        import time
+        ts = time.time() if now is None else float(now)
     return _prom_render(state, types, help_, ex if exemplars else None,
-                        openmetrics=exemplars)
+                        openmetrics=exemplars, timestamp=ts)
 
 
 def regroup_families(text: str) -> str:
@@ -384,12 +412,12 @@ class PrometheusTextfileExporter:
     def emit(self, record: Dict, registry=None) -> None:
         for k, v in record.items():
             if k == "event" and isinstance(v, str):
-                self._event_counts[v] = self._event_counts.get(v, 0) + 1
+                self._event_counts[v] = self._event_counts.get(v, 0) + 1  # glomlint: disable=obs-unbounded-series -- keyed by the code-defined event vocabulary (EVENT_* constants), not by request input
                 continue
             if isinstance(v, str):
                 continue  # free-form strings have no textfile representation
             name = prom_name(k, self.prefix)
-            self._state[name] = float(v)
+            self._state[name] = float(v)  # glomlint: disable=obs-unbounded-series -- last-value store keyed by metric name; cardinality is the registry's bound, not per-sample growth
             self._types.setdefault(name, "gauge")
         if registry is not None:
             # exemplars deliberately dropped: the textfile collector is
@@ -402,8 +430,8 @@ class PrometheusTextfileExporter:
             self._help.update(help_)
         for ev, n in self._event_counts.items():
             name = prom_name(f"event_{ev}_total", self.prefix)
-            self._state[name] = float(n)
-            self._types[name] = "counter"
+            self._state[name] = float(n)  # glomlint: disable=obs-unbounded-series -- same last-value store: one slot per event name, overwritten per emit
+            self._types[name] = "counter"  # glomlint: disable=obs-unbounded-series -- parallel type table, same key set as _state
         self._write()
 
     def _write(self) -> None:

@@ -286,7 +286,7 @@ class ForensicsManager:
         manifest["files"] = sorted(files) + [MANIFEST]
         files[MANIFEST] = manifest
         path = write_bundle(self.root, f"{trigger}-{int(step)}", files)
-        self.bundles.append(path)
+        self.bundles.append(path)  # glomlint: disable=obs-unbounded-series -- bounded upstream: every capture passes the TriggerEngine's global max_captures budget before reaching here
         if self._registry is not None:
             self._registry.counter(
                 "forensics_bundles", help="forensics bundles written"

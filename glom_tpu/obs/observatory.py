@@ -65,6 +65,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from glom_tpu.obs.forensics import is_bundle_dir, write_bundle
 from glom_tpu.obs.registry import MetricRegistry
+from glom_tpu.obs.timeseries import (SeriesStore, linear_trend, series_key,
+                                     trend_arrow)
 from glom_tpu.obs.tracing import find_root, span_coverage
 
 #: trace roots the collector stitches/samples; batch-level and reload
@@ -467,6 +469,12 @@ class FleetObservatory:
         self._forensics_by_replica: Dict[str, dict] = {}
         self._seen_bundles: Dict[str, set] = {}
         self._padding: Dict[Any, Dict[str, Any]] = {}
+        # fleet TSDB-lite (glom_tpu.obs.timeseries): each poll folds every
+        # replica's capacity_* registry snapshot in — per-replica series
+        # labeled {replica="name"}, fleet aggregates bare-named — so the
+        # console's capacity pane reads trends, not point gauges.  Ring-
+        # bounded by construction (the obs-unbounded-series contract).
+        self.series = SeriesStore(clock=self._clock)
         self.incidents: List[str] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -679,6 +687,69 @@ class FleetObservatory:
             agg["images"] += attrs.get("images", 0)
             agg["waste_sum"] += attrs.get("padding_waste", 0.0)
 
+    # -- capacity series ---------------------------------------------------
+    #: capacity series whose fleet roll-up sums over replicas (throughput
+    #: and queue depth add; everything else averages, latency takes max)
+    _CAP_SUM = frozenset(("capacity_effective_imgs_per_sec",
+                          "capacity_queue_depth"))
+    _CAP_MAX = frozenset(("capacity_p95_ms",))
+
+    def _ingest_capacity(self, forensics: Dict[str, dict]) -> None:
+        """Fold every replica's ``capacity_*`` registry scalars into the
+        fleet series store (caller holds ``_lock``): one labeled point per
+        replica per poll, plus the bare-named fleet aggregate."""
+        now = self._clock()
+        fleet: Dict[str, List[float]] = {}
+        for name, payload in forensics.items():
+            reg = payload.get("registry") or {}
+            caps = {k: v for k, v in reg.items()
+                    if k.startswith("capacity_")
+                    and isinstance(v, (int, float))}
+            if not caps:
+                continue
+            self.series.record_snapshot(caps, t=now,
+                                        labels={"replica": name})
+            for k, v in caps.items():
+                fleet.setdefault(k, []).append(float(v))
+        agg = {}
+        for k, vs in fleet.items():
+            if k in self._CAP_SUM:
+                agg[k] = sum(vs)
+            elif k in self._CAP_MAX:
+                agg[k] = max(vs)
+            else:
+                agg[k] = sum(vs) / len(vs)
+        if agg:
+            self.series.record_snapshot(agg, t=now)
+
+    def _capacity_pane(self) -> Dict[str, Any]:
+        """Console capacity view (caller holds ``_lock``): per-replica
+        duty cycle + utilization with a trend arrow from the last two
+        minutes of the labeled duty series, and the most recent advisor
+        recommendation witnessed on the router timeline."""
+        now = self._clock()
+        replicas: Dict[str, Dict[str, Any]] = {}
+        for name, payload in sorted(self._forensics_by_replica.items()):
+            reg = payload.get("registry") or {}
+            duty = reg.get("capacity_duty_cycle")
+            if duty is None:
+                continue
+            pts = self.series.points(
+                series_key("capacity_duty_cycle", {"replica": name}),
+                since=now - 120.0)
+            fit = linear_trend(pts)
+            replicas[name] = {
+                "duty": round(float(duty), 4),
+                "util": reg.get("capacity_utilization"),
+                "p95_ms": reg.get("capacity_p95_ms"),
+                "shed": reg.get("capacity_shed_ratio"),
+                "trend": trend_arrow(fit["slope"] if fit else 0.0),
+            }
+        recommendation = next(
+            (e for e in reversed(self._timeline)
+             if e.get("event") == "capacity_recommendation"), None)
+        return {"replicas": replicas, "recommendation": recommendation}
+
     # -- fleet state + incidents -------------------------------------------
     def _apply_timeline(self, payload) -> List[dict]:
         """Fold a fetched ``/debug/timeline`` into the cursor (caller
@@ -726,9 +797,12 @@ class FleetObservatory:
                 if first_sighting:
                     continue
                 trigger = (bundle.get("manifest") or {}).get("trigger")
-                if trigger == "slo_burn":
+                # capacity_pressure rides the same path as slo_burn: the
+                # replica-side TriggerEngine already debounced it, so a
+                # new bundle IS a witnessed incident
+                if trigger in ("slo_burn", "capacity_pressure"):
                     path = self._write_incident(
-                        "slo_burn", origin=name, origin_bundle=bundle,
+                        trigger, origin=name, origin_bundle=bundle,
                         forensics=forensics)
                     if path:
                         written.append(path)
@@ -892,6 +966,7 @@ class FleetObservatory:
                              for name, payload in fetched["forensics"].items()
                              if isinstance(payload, dict)}
                 self._forensics_by_replica = forensics
+                self._ingest_capacity(forensics)
                 incidents = self._check_incidents(fresh_events, forensics)
                 return {
                     "poll": self._poll_n,
@@ -949,6 +1024,7 @@ class FleetObservatory:
             },
             "rollout_events": self._timeline[-10:],
             "slo_burn_rates": burn_rates,
+            "capacity": self._capacity_pane(),
             "padding_waste": {
                 str(bucket): {
                     "batches": agg["batches"],

@@ -228,7 +228,7 @@ class MetricRegistry:
                 seen.add(value)
                 return family + value
             warn = family not in self._label_warned
-            self._label_warned.add(family)
+            self._label_warned.add(family)  # glomlint: disable=obs-unbounded-series -- one entry per metric FAMILY (code-defined, not input-defined); the per-value cardinality is what the max_label_values cap above bounds
         # the counter takes the registry lock itself — inc it outside
         self.counter(
             "registry_cardinality_overflows_total",

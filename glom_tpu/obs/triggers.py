@@ -29,6 +29,10 @@ TRIGGER_STEP_TIME = "step_time_regression"
 TRIGGER_QUEUE_SATURATION = "queue_saturation"
 # serving-side: multi-window SLO burn-rate breach (glom_tpu.obs.slo)
 TRIGGER_SLO_BURN = "slo_burn"
+# serving-side: a scale-up recommendation from the dry-run capacity
+# advisor (glom_tpu.obs.capacity) persisted past its window threshold —
+# the bundle carries the recommendation history and per-rule forecasts
+TRIGGER_CAPACITY_PRESSURE = "capacity_pressure"
 # serving-side: a shadow/canary deploy candidate burned its error budget
 # and was auto-retired (glom_tpu.serving.deploy) — the bundle names the
 # offending traces and the before/after version pins

@@ -254,6 +254,11 @@ class ServingEngine:
         deploy_min_events: Optional[int] = None,
         deploy_canary_fraction: float = 0.1,
         deploy_pin_url: Optional[str] = None,
+        capacity_policy: Optional[str] = None,
+        capacity_interval_s: float = 1.0,
+        capacity_window_s: float = 30.0,
+        capacity_persist_windows: int = 5,
+        capacity_ceiling: Optional[float] = None,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.registry = registry if registry is not None else MetricRegistry()
@@ -562,6 +567,29 @@ class ServingEngine:
             canary_fraction=deploy_canary_fraction, pin_url=deploy_pin_url,
         )
 
+        # -- capacity plane (glom_tpu.obs.capacity) ------------------------
+        # Always constructed (the TSDB + advisor are host-side dict work);
+        # nothing samples until tick() is driven — the server main() and
+        # the capacity smoke start the timer thread, tests tick under a
+        # fake clock.  Recommendations are DRY-RUN by contract: the plane
+        # can fire the debounced capacity_pressure trigger into forensics
+        # but never touches admission, batching, or the fleet.
+        from glom_tpu.obs.capacity import DEFAULT_POLICY, CapacityPlane
+
+        self.capacity = CapacityPlane(
+            self.registry,
+            policy=capacity_policy or DEFAULT_POLICY,
+            ceiling_imgs_per_sec=capacity_ceiling,
+            interval_s=capacity_interval_s,
+            window_s=capacity_window_s,
+            persist_windows=capacity_persist_windows,
+            clock=self._clock,
+            triggers=self._triggers,
+            forensics=self._forensics,
+            tenants_fn=(lambda: self.tenants.snapshot()
+                        if self.tenants is not None else None),
+        )
+
         # -- staged (two-phase) reload state -------------------------------
         # ``_staged`` holds (step, placed-params) loaded by stage_reload()
         # but not yet serving; ``_prev`` holds the (step, params) a commit
@@ -688,6 +716,7 @@ class ServingEngine:
             batcher.close(drain=drain)
         self._stop.set()
         self.deploy.close()
+        self.capacity.stop()  # no-op unless the timer thread was started
         deadline = time.monotonic() + timeout  # glomlint: disable=conc-raw-clock -- the drain deadline must track wall time: under a fake test clock the joins would otherwise never time out
         for t in self._threads:
             t.join(timeout=max(0.0, deadline - time.monotonic()))  # glomlint: disable=conc-raw-clock -- paired with the wall-clock deadline above
@@ -1412,6 +1441,12 @@ class ServingEngine:
         reg.histogram("serving_batch_occupancy",
                       help="real images / bucket size per executed batch"
                       ).observe(n / bucket)
+        # per-bucket occupancy: the capacity plane's padding-waste-per-
+        # bucket series (cardinality-bounded through labeled(), like the
+        # per-bucket execute-span histograms)
+        reg.histogram(reg.labeled("serving_batch_occupancy_b", bucket),
+                      help="real images / bucket size for one bucket"
+                      ).observe(n / bucket)
         reg.gauge("serving_queue_depth", help="queued images"
                   ).set(self.batchers[endpoint].depth)
         new_compiles = cache.poll_compiles()
@@ -1589,6 +1624,9 @@ class ServingEngine:
             "models": self.models.snapshot(),
             "tenants": (None if self.tenants is None
                         else self.tenants.snapshot()),
+            # the capacity summary rides /healthz so the router's health
+            # loop feeds its fleet series without a dedicated poll
+            "capacity": self.capacity.summary(),
             "image_size": c.image_size,
             "channels": c.channels,
             "levels": c.levels,

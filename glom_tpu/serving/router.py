@@ -185,6 +185,8 @@ class FleetRouter:
         http=None,
         trace_log: Optional[str] = None,
         trace_max_traces: int = 256,
+        capacity_policy: Optional[str] = None,
+        capacity_persist_windows: int = 5,
     ):
         if not replica_urls:
             raise ValueError("need at least one replica URL")
@@ -249,6 +251,23 @@ class FleetRouter:
         self.tracer = Tracer(
             clock=self._clock, sink=TraceSink(max_traces=trace_max_traces),
             registry=self.registry, exporter=trace_exporter,
+        )
+
+        # fleet capacity plane: per-replica signal series ingested from
+        # the /healthz capacity summaries the health loop ALREADY fetches
+        # (zero extra HTTP), rolled up into fleet aggregates the dry-run
+        # advisor judges.  Recommendation changes land on the timeline.
+        from glom_tpu.obs.capacity import DEFAULT_POLICY, FleetCapacityPlane
+
+        self.capacity = FleetCapacityPlane(
+            policy=capacity_policy or DEFAULT_POLICY,
+            persist_windows=capacity_persist_windows,
+            clock=self._clock,
+            registry=self.registry,
+            on_recommend=lambda rec: self.note_event(
+                "capacity_recommendation", action=rec["action"],
+                reasons=rec.get("reasons", []),
+                persisted=rec.get("persisted", 0)),
         )
 
         # consistent-hash ring over ALL replicas (ejection skips forward at
@@ -369,6 +388,12 @@ class FleetRouter:
                 with self._lock:
                     self._note_failure(replica)
                 continue
+            # fold the replica's capacity summary into the fleet series
+            # BEFORE any dispatch-lock work: ingest takes only the
+            # capacity plane's own lock, and a held-out replica's signal
+            # is still a live probe worth recording
+            self.capacity.ingest(replica.name, health.get("capacity"),
+                                 t=now)
             with self._lock:
                 was_down = not replica.healthy
                 if not was_down:
@@ -410,6 +435,9 @@ class FleetRouter:
                         self._note_failure(replica)
             finally:
                 self._rollout_lock.release()
+        # one advisor window per health pass: aggregate the freshest
+        # per-replica signals and (maybe) emit a recommendation
+        self.capacity.evaluate(now)
 
     def _admit(self, replica: Replica, was_down: bool) -> None:
         """Caller holds the lock."""
@@ -1051,6 +1079,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 "rollout_phase": router.rollout_phase,
                 "events": router.timeline(),
             })
+        elif parsed.path == "/debug/series":
+            # fleet TSDB-lite pull plane: per-replica (labeled) and
+            # fleet-aggregate capacity series (glom_tpu.obs.timeseries)
+            self._reply(200, router.capacity.series_payload(parsed.query))
+        elif parsed.path == "/capacity":
+            self._reply(200, router.capacity.payload())
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 
@@ -1173,6 +1207,9 @@ def _spawn_fleet(n: int, args) -> Tuple[List[str], list]:
             warm_iters=args.warm_iters,
         )
         engine.start(watch=False)
+        # per-replica capacity sampler: its /healthz summary feeds the
+        # router's fleet capacity plane
+        engine.capacity.start()
         server = make_server(engine, args.host, 0)
         threading.Thread(target=server.serve_forever, daemon=True,
                          name=f"glom-replica-{i}").start()
@@ -1224,6 +1261,15 @@ def main(argv=None) -> int:
     p.add_argument("--trace-log", default=None,
                    help="JSONL file receiving one record per completed "
                         "router trace")
+    p.add_argument("--capacity-policy", default=None, metavar="SPEC",
+                   help="fleet dry-run autoscale policy, e.g. "
+                        "'p95_ms<250,duty<0.8,shed<0.01' — evaluated over "
+                        "fleet-aggregate series each health pass; emits "
+                        "scale-up/down/rebalance RECOMMENDATIONS to the "
+                        "timeline and GET /capacity, never acts")
+    p.add_argument("--capacity-persist-windows", type=int, default=5,
+                   help="consecutive scale-up windows before a replica-"
+                        "side capacity_pressure incident is expected")
     p.add_argument("--platform", default="auto",
                    help="force a JAX platform for --spawn (e.g. 'cpu')")
     p.add_argument("--verbose", action="store_true")
@@ -1250,6 +1296,8 @@ def main(argv=None) -> int:
         eject_after=args.eject_after,
         rollout_poll_s=args.rollout_poll_s,
         trace_log=args.trace_log,
+        capacity_policy=args.capacity_policy,
+        capacity_persist_windows=args.capacity_persist_windows,
     )
     router.start()
     server = make_router_server(router, args.host, args.port,

@@ -71,6 +71,7 @@ from glom_tpu.obs.tracing import (
     parse_traceparent,
     request_trace_id,
 )
+from glom_tpu.obs.capacity import read_bench_ceiling
 from glom_tpu.serving.batcher import Closed, Overloaded, TenantQuotaExceeded
 from glom_tpu.serving.engine import ServingEngine
 
@@ -90,10 +91,14 @@ class ServingHTTPServer(ThreadingHTTPServer):
     # retransmit stalls
     request_queue_size = 128
 
-    def __init__(self, addr, handler, engine: ServingEngine, *, quiet: bool = True):
+    def __init__(self, addr, handler, engine: ServingEngine, *, quiet: bool = True,
+                 metrics_timestamps: bool = False):
         super().__init__(addr, handler)
         self.engine = engine
         self.quiet = quiet
+        # stamp /metrics samples with unix seconds — OpenMetrics bodies
+        # only (the negotiation rule is enforced in prometheus_lines)
+        self.metrics_timestamps = metrics_timestamps
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -205,7 +210,11 @@ class _Handler(BaseHTTPRequestHandler):
             # body must end with the spec's `# EOF` terminator or a
             # strict parser rejects it as truncated.
             om = wants_openmetrics(self.headers.get("Accept"))
-            body = prometheus_lines(engine.registry, exemplars=om)
+            # sample timestamps ride the same negotiation as exemplars:
+            # OpenMetrics bodies only — 0.0.4 parsers reject them
+            body = prometheus_lines(
+                engine.registry, exemplars=om,
+                timestamps=om and self.server.metrics_timestamps)
             if om:
                 body += "# EOF\n"
             self._reply(200, body,
@@ -222,6 +231,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(status, payload)
         elif parsed.path == "/debug/forensics":
             self._reply(200, engine.debug_forensics())
+        elif parsed.path == "/debug/series":
+            # the TSDB-lite pull plane (glom_tpu.obs.timeseries): ring-
+            # bounded history of every registry metric, for trend queries
+            self._reply(200, engine.capacity.series_payload(parsed.query))
+        elif parsed.path == "/capacity":
+            self._reply(200, engine.capacity.payload())
         elif parsed.path == "/admin/deploy/status":
             self._reply(200, engine.deploy.status())
         else:
@@ -622,10 +637,12 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def make_server(engine: ServingEngine, host: str = "127.0.0.1",
-                port: int = 0, *, quiet: bool = True) -> ServingHTTPServer:
+                port: int = 0, *, quiet: bool = True,
+                metrics_timestamps: bool = False) -> ServingHTTPServer:
     """Bind (port 0 = ephemeral — tests read ``server.server_address``);
     the caller starts ``serve_forever`` on its own thread."""
-    return ServingHTTPServer((host, port), _Handler, engine, quiet=quiet)
+    return ServingHTTPServer((host, port), _Handler, engine, quiet=quiet,
+                             metrics_timestamps=metrics_timestamps)
 
 
 def main(argv=None) -> int:
@@ -734,6 +751,26 @@ def main(argv=None) -> int:
                         "evidence (default: the smallest SLO min_events)")
     p.add_argument("--deploy-canary-fraction", type=float, default=0.1,
                    help="default live-traffic fraction for begin_canary")
+    p.add_argument("--capacity-policy", default=None, metavar="SPEC",
+                   help="dry-run autoscale advisor policy, e.g. "
+                        "'p95_ms<250,duty<0.8,shed<0.01' — evaluated over "
+                        "the capacity series every window; violations emit "
+                        "RECOMMENDATIONS only (GET /capacity), never act")
+    p.add_argument("--capacity-ceiling", type=float, default=None,
+                   help="measured imgs/s/chip ceiling for utilization "
+                        "accounting (default: newest BENCH_*.json "
+                        "last_measured in the repo root)")
+    p.add_argument("--capacity-window-s", type=float, default=30.0,
+                   help="capacity signal window (duty/shed/rate deltas "
+                        "are computed over this span)")
+    p.add_argument("--capacity-persist-windows", type=int, default=5,
+                   help="consecutive scale-up windows before the advisor "
+                        "fires the debounced capacity_pressure forensics "
+                        "incident")
+    p.add_argument("--metrics-timestamps", action="store_true",
+                   help="stamp /metrics samples with unix seconds on "
+                        "OpenMetrics-negotiated scrapes (aligns scraped "
+                        "series with the internal /debug/series windows)")
     p.add_argument("--demo", action="store_true",
                    help="write a tiny demo checkpoint into --checkpoint-dir "
                         "if it has none (smoke runs)")
@@ -805,9 +842,17 @@ def main(argv=None) -> int:
         deploy_min_events=args.deploy_min_events,
         deploy_canary_fraction=args.deploy_canary_fraction,
         deploy_pin_url=args.deploy_pin_url,
+        capacity_policy=args.capacity_policy,
+        capacity_window_s=args.capacity_window_s,
+        capacity_persist_windows=args.capacity_persist_windows,
+        capacity_ceiling=(args.capacity_ceiling
+                          if args.capacity_ceiling is not None
+                          else read_bench_ceiling()),
     )
     engine.start()
-    server = make_server(engine, args.host, args.port, quiet=not args.verbose)
+    engine.capacity.start()  # sampler thread: tests tick() with a fake clock
+    server = make_server(engine, args.host, args.port, quiet=not args.verbose,
+                         metrics_timestamps=args.metrics_timestamps)
 
     # SIGTERM/SIGINT -> graceful drain, mirroring the trainer's preemption
     # path: stop admission, flush queued batches, then stop accepting

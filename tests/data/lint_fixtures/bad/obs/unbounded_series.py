@@ -1,0 +1,33 @@
+"""obs-unbounded-series must-flag fixture — the unbounded-sample-buffer
+leak shape, reduced.
+
+A telemetry store keeps one list per metric and appends every sample a
+long-lived serving process ever records.  Nothing caps it: no
+``deque(maxlen=)``, no ``len()`` bound, no eviction sweep.  At one
+sample per second the process leaks ~250 MB/month of floats — found
+only after days of uptime, by the very dashboards this store feeds.
+The TSDB (glom_tpu.obs.timeseries) exists to watch serving processes
+for leaks; an unbounded accumulator inside the obs plane IS the leak.
+"""
+
+import threading
+
+
+class SampleStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._samples = []        # BAD: unbounded, appended per sample
+        self._by_name = {}        # BAD: per-name lists, also unbounded
+
+    def record(self, name, value):
+        with self._lock:
+            self._samples.append((name, value))
+
+    def record_many(self, pairs):
+        with self._lock:
+            for name, value in pairs:
+                self._by_name[name] = self._by_name.get(name, []) + [value]
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._samples)

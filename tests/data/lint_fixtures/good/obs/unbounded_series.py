@@ -1,0 +1,33 @@
+"""obs-unbounded-series must-pass fixture — the bounded forms: a ring
+buffer (``deque(maxlen=)``) for the flat sample feed, and an explicit
+``len()`` cap with oldest-first eviction for the per-name table.  Both
+shapes appear in glom_tpu.obs.timeseries; retention is a construction
+property, not a hope."""
+
+import threading
+from collections import deque
+
+
+class SampleStore:
+    MAX_NAMES = 256
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._samples = deque(maxlen=600)   # ring: old samples fall out
+        self._by_name = {}
+
+    def record(self, name, value):
+        with self._lock:
+            self._samples.append((name, value))
+
+    def record_many(self, pairs):
+        with self._lock:
+            for name, value in pairs:
+                if (name not in self._by_name
+                        and len(self._by_name) >= self.MAX_NAMES):
+                    self._by_name.pop(next(iter(self._by_name)))
+                self._by_name[name] = value
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._samples)

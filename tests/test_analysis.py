@@ -65,6 +65,8 @@ RULE_FIXTURES = [
      "serving/compile_cache.py"),
     ("obs-state-in-cache", "serving/compile_cache.py",
      "serving/compile_cache.py"),
+    ("obs-unbounded-series", "obs/unbounded_series.py",
+     "obs/unbounded_series.py"),
     # -- the v2 dataflow packs (cfg.py + rules_paths + rules_sharding) --
     ("res-leak-on-raise", "serving/rollout.py", "serving/rollout.py"),
     ("proto-paired-call", "serving/prepare.py", "serving/prepare.py"),

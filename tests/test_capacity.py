@@ -1,0 +1,621 @@
+"""Capacity signals plane tests (glom_tpu/obs/timeseries.py,
+glom_tpu/obs/capacity.py, tools/capacity.py).
+
+Tier-1 (CPU): the TSDB-lite store (tier bucketing, downsampling
+selection, cardinality cap, /debug/series payload), the window math
+(rate/delta/percentile/trend/flip/ETA), policy parsing, the accountant's
+signal derivations, the advisor's action machine, the engine-side plane
+firing exactly ONE debounced capacity_pressure bundle, the fleet plane's
+ingest/aggregate/rebalance path, the observatory capacity pane, the
+OpenMetrics timestamp negotiation, loadgen's --timeline windows, and the
+acceptance criterion: a loadgen timeline with a latency step, replayed
+through the TSDB, yields the trend flip and the ETA-to-threshold within
+one downsampling window of ground truth — all under fake clocks.  The
+tools/capacity.py --smoke subprocess gate (real engine + router, the
+chaos.py pattern) rides at the end.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from glom_tpu.obs.capacity import (
+    ACTION_HOLD,
+    ACTION_REBALANCE,
+    ACTION_SCALE_DOWN,
+    ACTION_SCALE_UP,
+    CapacityAccountant,
+    CapacityAdvisor,
+    CapacityPlane,
+    FleetCapacityPlane,
+    parse_capacity_policy,
+    read_bench_ceiling,
+)
+from glom_tpu.obs.forensics import ForensicsManager
+from glom_tpu.obs.registry import MetricRegistry
+from glom_tpu.obs.timeseries import (
+    DEFAULT_TIERS,
+    RegistrySampler,
+    SeriesStore,
+    delta,
+    eta_to_threshold,
+    linear_trend,
+    percentile_over,
+    rate,
+    series_key,
+    trend_flip,
+)
+from glom_tpu.obs.triggers import TRIGGER_CAPACITY_PRESSURE, TriggerEngine
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# SeriesStore
+# ---------------------------------------------------------------------------
+class TestSeriesStore:
+    def test_sample_and_hold_last_wins(self):
+        clk = FakeClock()
+        store = SeriesStore(tiers=((1.0, 10),), clock=clk)
+        store.record("g", 1.0, t=1000.2)
+        store.record("g", 2.0, t=1000.8)  # same 1 s bucket: overwrites
+        store.record("g", 3.0, t=1001.1)
+        assert store.points("g") == [(1000.0, 2.0), (1001.0, 3.0)]
+        assert store.latest("g") == 3.0
+
+    def test_ring_bound_is_the_tier_capacity(self):
+        store = SeriesStore(tiers=((1.0, 5),), clock=FakeClock())
+        for i in range(100):
+            store.record("c", float(i), t=1000.0 + i)
+        pts = store.points("c")
+        assert len(pts) == 5
+        assert pts[-1] == (1099.0, 99.0)
+
+    def test_tier_selection_by_step_and_since(self):
+        clk = FakeClock()
+        store = SeriesStore(tiers=((1.0, 10), (10.0, 50)), clock=clk)
+        for i in range(200):
+            store.record("x", float(i), t=1000.0 + i)
+        fine = store.points("x", step=1.0)
+        assert len(fine) == 10  # fine tier retains its last 10 buckets
+        coarse = store.points("x", step=10.0)
+        assert len(coarse) == 20
+        assert coarse[0][0] % 10.0 == 0.0
+        # since older than the fine tier's reach coarsens automatically
+        old = store.points("x", since=1000.0)
+        assert old[0][0] == 1000.0
+
+    def test_max_series_drops_newest_and_counts(self):
+        store = SeriesStore(tiers=((1.0, 4),), clock=FakeClock(),
+                            max_series=2)
+        store.record("a", 1.0, t=1000.0)
+        store.record("b", 1.0, t=1000.0)
+        store.record("c", 1.0, t=1000.0)  # over the cap: dropped
+        assert store.names() == ["a", "b"]
+        assert store.dropped_series == 1
+        store.record("a", 2.0, t=1001.0)  # existing names still record
+        assert store.latest("a") == 2.0
+
+    def test_non_numeric_and_non_finite_skipped(self):
+        store = SeriesStore(tiers=((1.0, 4),), clock=FakeClock())
+        store.record("s", "model-v3", t=1000.0)
+        store.record("s", float("nan"), t=1000.0)
+        store.record("s", float("inf"), t=1000.0)
+        assert store.names() == []
+
+    def test_labels_and_query_match_bare_plus_labeled(self):
+        store = SeriesStore(tiers=((1.0, 8),), clock=FakeClock())
+        store.record("capacity_duty_cycle", 0.5, t=1000.0)
+        store.record("capacity_duty_cycle", 0.9, t=1000.0,
+                     labels={"replica": "r0"})
+        assert series_key("capacity_duty_cycle", {"replica": "r0"}) \
+            == 'capacity_duty_cycle{replica="r0"}'
+        out = store.query("capacity_duty_cycle")
+        assert set(out) == {"capacity_duty_cycle",
+                            'capacity_duty_cycle{replica="r0"}'}
+        assert store.latest("capacity_duty_cycle",
+                            {"replica": "r0"}) == 0.9
+
+    def test_payload_discovery_and_relative_since(self):
+        clk = FakeClock(2000.0)
+        store = SeriesStore(tiers=((1.0, 100),), clock=clk)
+        for i in range(50):
+            store.record("m", float(i), t=1960.0 + i)
+        listing = store.payload("")
+        assert listing["names"] == ["m"]
+        assert listing["tiers"] == [[1.0, 100]]
+        body = store.payload("name=m&since=-10&step=1")
+        ts = [t for t, _ in body["series"]["m"]]
+        assert min(ts) >= 1990.0
+        assert store.payload("name=m&since=abc")["error"]
+
+    def test_record_snapshot_lands_in_one_bucket(self):
+        store = SeriesStore(tiers=((1.0, 4),), clock=FakeClock())
+        store.record_snapshot({"a": 1.0, "b": 2.0, "note": "x"}, t=1000.0)
+        assert store.points("a")[0][0] == store.points("b")[0][0]
+        assert store.names() == ["a", "b"]
+
+
+class TestRegistrySampler:
+    def test_tick_respects_interval(self):
+        reg = MetricRegistry()
+        reg.counter("n").inc(5)
+        store = SeriesStore(tiers=((1.0, 10),), clock=FakeClock())
+        s = RegistrySampler(reg, store, interval_s=1.0)
+        assert s.tick(1000.0) is True
+        assert s.tick(1000.5) is False  # not due
+        reg.counter("n").inc(5)
+        assert s.tick(1001.0) is True
+        assert store.points("n") == [(1000.0, 5.0), (1001.0, 10.0)]
+
+
+# ---------------------------------------------------------------------------
+# window math
+# ---------------------------------------------------------------------------
+class TestWindowMath:
+    def test_delta_and_rate(self):
+        pts = [(0.0, 10.0), (5.0, 60.0)]
+        assert delta(pts) == 50.0
+        assert rate(pts) == 10.0
+        assert rate([(0.0, 10.0)]) is None
+        # counter reset must not read as a negative rate
+        assert rate([(0.0, 100.0), (5.0, 2.0)]) is None
+
+    def test_percentile_over(self):
+        pts = [(float(i), float(i)) for i in range(100)]
+        assert percentile_over(pts, 50) == 49.0
+        assert percentile_over(pts, 95) == 94.0
+        assert percentile_over([], 50) is None
+
+    def test_linear_trend_recovers_slope(self):
+        pts = [(1000.0 + i, 5.0 + 0.25 * i) for i in range(20)]
+        fit = linear_trend(pts)
+        assert abs(fit["slope"] - 0.25) < 1e-9
+        assert abs(fit["value_at_end"] - pts[-1][1]) < 1e-9
+        assert linear_trend([(0.0, 1.0)]) is None
+        assert linear_trend([(0.0, 1.0), (0.0, 2.0)]) is None
+
+    def test_trend_flip_finds_the_knee(self):
+        flat = [(float(i), 10.0) for i in range(30)]
+        ramp = [(float(30 + i), 10.0 + 2.0 * i) for i in range(30)]
+        flip = trend_flip(flat + ramp, min_slope=0.01)
+        assert flip is not None
+        assert abs(flip["t"] - 30.0) <= 2.0
+        assert abs(flip["slope_before"]) < abs(flip["slope_after"])
+        assert trend_flip(flat, min_slope=0.01) is None
+
+    def test_eta_to_threshold(self):
+        pts = [(float(i), 1.0 * i) for i in range(10)]  # slope 1/s
+        eta = eta_to_threshold(pts, 20.0)
+        assert abs(eta - 11.0) < 1e-6  # from t=9, value 9 -> 20
+        assert eta_to_threshold(pts, 5.0) == 0.0  # already past
+        falling = [(float(i), 10.0 - i) for i in range(5)]
+        assert abs(eta_to_threshold(falling, 0.0) - 6.0) < 1e-6
+        # already below an upper threshold while travelling down: past it
+        assert eta_to_threshold(falling, 20.0) == 0.0
+        flat = [(float(i), 5.0) for i in range(5)]
+        assert eta_to_threshold(flat, 20.0) is None
+
+
+# ---------------------------------------------------------------------------
+# policy + accountant + advisor
+# ---------------------------------------------------------------------------
+class TestPolicy:
+    def test_parse_roundtrip(self):
+        rules = parse_capacity_policy("p95_ms<250,duty<0.8,shed<0.01")
+        assert [(r.signal, r.op, r.bound) for r in rules] == [
+            ("p95_ms", "<", 250.0), ("duty", "<", 0.8), ("shed", "<", 0.01)]
+        assert rules[1].ok(0.5) and not rules[1].ok(0.9)
+        assert rules[1].load_fraction(0.4) == 0.5
+
+    def test_parse_rejects_garbage(self):
+        with pytest.raises(ValueError, match="unknown capacity signal"):
+            parse_capacity_policy("dutty<0.8")
+        with pytest.raises(ValueError, match="unparseable"):
+            parse_capacity_policy("duty<=0.8")
+        with pytest.raises(ValueError, match="empty"):
+            parse_capacity_policy(" , ")
+
+    def test_read_bench_ceiling(self, tmp_path):
+        assert read_bench_ceiling(str(tmp_path)) is None
+        p = tmp_path / "BENCH_r01.json"
+        p.write_text(json.dumps(
+            {"parsed": {"last_measured": {"value": 123.5}}}))
+        assert read_bench_ceiling(str(tmp_path)) == 123.5
+        assert read_bench_ceiling(str(p)) == 123.5
+        # the repo root has BENCH_*.json checked in
+        assert read_bench_ceiling() is not None
+
+
+class TestAccountant:
+    def _store(self):
+        return SeriesStore(tiers=((1.0, 600),), clock=FakeClock())
+
+    def test_signal_derivations(self):
+        reg = MetricRegistry()
+        store = self._store()
+        acct = CapacityAccountant(reg, store, ceiling_imgs_per_sec=20.0,
+                                  window_s=30.0)
+        store.record_snapshot({
+            "serving_execute_ms_sum": 0.0, "serving_requests_total": 0.0,
+            "serving_shed_total": 0.0, "serving_queue_depth": 2.0,
+            "serving_batch_occupancy_sum": 0.0,
+            "serving_batch_occupancy_count": 0.0,
+        }, t=1000.0)
+        store.record_snapshot({
+            "serving_execute_ms_sum": 4000.0,
+            "serving_requests_total": 100.0, "serving_shed_total": 25.0,
+            "serving_queue_depth": 4.0, "serving_request_ms_p95": 180.0,
+            "serving_batch_occupancy_sum": 75.0,
+            "serving_batch_occupancy_count": 100.0,
+        }, t=1010.0)
+        sig = acct.signals(1010.0)
+        assert abs(sig["duty"] - 0.4) < 1e-9       # 4000 ms / 10 s wall
+        assert abs(sig["imgs_per_sec"] - 10.0) < 1e-9
+        assert abs(sig["util"] - 0.5) < 1e-9       # 10 / ceiling 20
+        assert abs(sig["shed"] - 0.2) < 1e-9       # 25 / (100 + 25)
+        assert sig["queue"] == 3.0                 # mean of 2, 4
+        assert sig["p95_ms"] == 180.0
+        assert abs(sig["padding_waste"] - 0.25) < 1e-9
+
+    def test_update_exports_gauges_and_series(self):
+        reg = MetricRegistry()
+        store = self._store()
+        acct = CapacityAccountant(reg, store, window_s=30.0)
+        store.record_snapshot({"serving_requests_total": 0.0}, t=1000.0)
+        store.record_snapshot({"serving_requests_total": 30.0}, t=1010.0)
+        acct.update(1010.0)
+        snap = reg.snapshot()
+        assert abs(snap["capacity_effective_imgs_per_sec"] - 3.0) < 1e-9
+        # recorded into the store in the SAME pass, not the next sample
+        assert store.latest("capacity_effective_imgs_per_sec") == \
+            snap["capacity_effective_imgs_per_sec"]
+
+    def test_no_window_means_none_not_zero(self):
+        reg = MetricRegistry()
+        acct = CapacityAccountant(reg, self._store(), window_s=30.0)
+        sig = acct.signals(1000.0)
+        assert sig["duty"] is None and sig["util"] is None
+        assert "capacity_duty_cycle" not in reg.snapshot()
+
+
+class TestAdvisor:
+    def _advisor(self, policy="duty<0.8,shed<0.01"):
+        return CapacityAdvisor(parse_capacity_policy(policy))
+
+    def test_violation_scales_up_with_reasons(self):
+        adv = self._advisor()
+        rec = adv.evaluate({"duty": 0.9, "shed": 0.0})
+        assert rec["action"] == ACTION_SCALE_UP
+        assert rec["reasons"] == ["duty<0.8 (now 0.9)"]
+        assert rec["persisted"] == 1
+        assert adv.evaluate({"duty": 0.9, "shed": 0.0})["persisted"] == 2
+
+    def test_low_water_scales_down_and_streak_resets(self):
+        adv = self._advisor()
+        assert adv.evaluate({"duty": 0.9})["action"] == ACTION_SCALE_UP
+        rec = adv.evaluate({"duty": 0.1, "shed": 0.0})
+        assert rec["action"] == ACTION_SCALE_DOWN
+        assert rec["persisted"] == 1  # streak restarted on the flip
+
+    def test_hold_between_low_water_and_bound(self):
+        rec = self._advisor().evaluate({"duty": 0.6, "shed": 0.0})
+        assert rec["action"] == ACTION_HOLD
+
+    def test_rebalance_on_duty_spread(self):
+        rec = self._advisor().evaluate(
+            {"duty": 0.45, "shed": 0.0},
+            per_replica_duty={"r0": 0.75, "r1": 0.1})
+        assert rec["action"] == ACTION_REBALANCE
+        assert "spread" in rec["reasons"][0]
+
+    def test_none_signals_are_skipped(self):
+        rec = self._advisor().evaluate({"duty": None, "shed": None})
+        assert rec["action"] == ACTION_HOLD  # nothing measurable yet
+
+
+# ---------------------------------------------------------------------------
+# the engine-side plane: exactly one debounced capacity_pressure bundle
+# ---------------------------------------------------------------------------
+class TestCapacityPlane:
+    def _plane(self, tmp_path, clk, **kw):
+        reg = MetricRegistry()
+        trig = TriggerEngine(debounce_steps=200, max_captures=3,
+                             registry=reg)
+        fm = ForensicsManager(str(tmp_path), config={},
+                              snapshot_fn=lambda: None)
+        plane = CapacityPlane(
+            reg, policy="duty<0.5", window_s=5.0, persist_windows=3,
+            interval_s=1.0, clock=clk, triggers=trig, forensics=fm, **kw)
+        return reg, trig, plane
+
+    def test_one_pressure_bundle_then_scale_down(self, tmp_path):
+        clk = FakeClock(0.0)
+        reg, trig, plane = self._plane(tmp_path, clk)
+        h = reg.histogram("serving_execute_ms")
+        h.observe(0.0)
+        assert plane.tick(0.0) is not None  # baseline window
+        recs = []
+        for t in range(1, 9):  # 800 busy-ms per 1 s wall: duty ~0.8
+            h.observe(800.0)
+            recs.append(plane.tick(float(t)))
+        assert all(r["action"] == ACTION_SCALE_UP for r in recs)
+        # fired once at persisted == 3, then debounced — never again
+        assert plane.pressure_fired == 1
+        bundles = [n for n in os.listdir(str(tmp_path))
+                   if n.startswith(TRIGGER_CAPACITY_PRESSURE)]
+        assert len(bundles) == 1
+        assert trig.suppressed > 0
+        # quiescence past the 5 s window: duty 0 -> scale_down
+        down = None
+        for t in range(20, 24):
+            down = plane.tick(float(t))
+        assert down["action"] == ACTION_SCALE_DOWN
+        assert plane.pressure_fired == 1  # scale-down never captures
+
+    def test_tick_below_interval_is_a_noop(self, tmp_path):
+        clk = FakeClock(0.0)
+        _, _, plane = self._plane(tmp_path, clk)
+        assert plane.tick(0.0) is not None
+        assert plane.tick(0.5) is None
+
+    def test_on_recommend_fires_on_action_change_only(self, tmp_path):
+        clk = FakeClock(0.0)
+        seen = []
+        reg, _, plane = self._plane(tmp_path, clk,
+                                    on_recommend=seen.append)
+        h = reg.histogram("serving_execute_ms")
+        h.observe(0.0)
+        plane.tick(0.0)
+        for t in range(1, 5):
+            h.observe(800.0)
+            plane.tick(float(t))
+        actions = [r["action"] for r in seen]
+        assert actions.count(ACTION_SCALE_UP) == 1  # not once per window
+
+    def test_payload_shape(self, tmp_path):
+        _, _, plane = self._plane(tmp_path, FakeClock(0.0))
+        plane.tick(0.0)
+        body = plane.payload()
+        assert body["role"] == "replica"
+        assert body["policy"] == "duty<0.5"
+        assert {f["rule"] for f in body["forecasts"]} == {"duty<0.5"}
+        assert plane.series_payload("")["tiers"]
+
+
+# ---------------------------------------------------------------------------
+# the fleet plane
+# ---------------------------------------------------------------------------
+class TestFleetCapacityPlane:
+    def test_ingest_aggregate_and_labeled_series(self):
+        clk = FakeClock(1000.0)
+        reg = MetricRegistry()
+        fleet = FleetCapacityPlane(policy="duty<0.8,queue<64",
+                                   clock=clk, registry=reg)
+        fleet.ingest("r0", {"signals": {"duty": 0.2, "queue": 3.0}})
+        fleet.ingest("r1", {"signals": {"duty": 0.6, "queue": 5.0}})
+        rec = fleet.evaluate()
+        assert rec["per_replica_duty"] == {"r0": 0.2, "r1": 0.6}
+        # mean duty, summed queue
+        assert abs(fleet.store.latest("capacity_duty_cycle") - 0.4) < 1e-9
+        assert fleet.store.latest("capacity_queue_depth") == 8.0
+        assert fleet.store.latest("capacity_duty_cycle",
+                                  {"replica": "r1"}) == 0.6
+        assert abs(reg.snapshot()["capacity_duty_cycle"] - 0.4) < 1e-9
+
+    def test_rebalance_and_recommend_callback_dedup(self):
+        clk = FakeClock(1000.0)
+        seen = []
+        fleet = FleetCapacityPlane(policy="duty<0.9", clock=clk,
+                                   on_recommend=seen.append)
+        for _ in range(3):
+            fleet.ingest("r0", {"signals": {"duty": 0.8}})
+            fleet.ingest("r1", {"signals": {"duty": 0.1}})
+            rec = fleet.evaluate()
+            clk.t += 1.0
+        assert rec["action"] == ACTION_REBALANCE
+        assert [r["action"] for r in seen] == [ACTION_REBALANCE]
+
+    def test_malformed_summaries_ignored(self):
+        fleet = FleetCapacityPlane(clock=FakeClock())
+        fleet.ingest("r0", None)
+        fleet.ingest("r0", {"no_signals": 1})
+        fleet.ingest("r0", {"signals": "not-a-dict"})
+        assert fleet.payload()["replicas"] == {}
+
+    def test_payload_shape(self):
+        fleet = FleetCapacityPlane(clock=FakeClock())
+        fleet.ingest("r0", {"signals": {"duty": 0.3}})
+        fleet.evaluate()
+        body = fleet.payload()
+        assert body["role"] == "router"
+        assert "r0" in body["replicas"]
+        assert 'capacity_duty_cycle{replica="r0"}' in body["series_names"]
+
+
+# ---------------------------------------------------------------------------
+# observatory capacity pane
+# ---------------------------------------------------------------------------
+class TestObservatoryCapacityPane:
+    def test_pane_aggregates_and_trends(self):
+        from glom_tpu.obs.observatory import FleetObservatory
+
+        clk = FakeClock(1000.0)
+        obs = FleetObservatory(replicas={"r0": "u0", "r1": "u1"},
+                               clock=clk,
+                               http=lambda *a, **k: (200, {}, b"{}"))
+        def forensics(duty0):
+            return {
+                "r0": {"registry": {"capacity_duty_cycle": duty0,
+                                    "capacity_p95_ms": 120.0,
+                                    "capacity_effective_imgs_per_sec": 4.0}},
+                "r1": {"registry": {"capacity_duty_cycle": 0.2,
+                                    "capacity_p95_ms": 40.0,
+                                    "capacity_effective_imgs_per_sec": 6.0}},
+            }
+        with obs._lock:
+            obs._ingest_capacity(forensics(0.3))
+        clk.t += 60.0
+        with obs._lock:
+            obs._ingest_capacity(forensics(0.9))
+            obs._forensics_by_replica = forensics(0.9)
+        pane = obs.console()["capacity"]
+        assert pane["replicas"]["r0"]["duty"] == 0.9
+        assert pane["replicas"]["r0"]["trend"] == "↑"
+        assert pane["replicas"]["r1"]["trend"] == "→"
+        # fleet aggregates: p95 is a max, imgs/s a sum, duty a mean
+        assert obs.series.latest("capacity_p95_ms") == 120.0
+        assert obs.series.latest("capacity_effective_imgs_per_sec") == 10.0
+        assert abs(obs.series.latest("capacity_duty_cycle") - 0.55) < 1e-9
+        assert pane["recommendation"] is None  # no timeline event yet
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics timestamps (exporter satellite)
+# ---------------------------------------------------------------------------
+class TestPrometheusTimestamps:
+    def test_timestamps_render_after_value_before_exemplar(self):
+        from glom_tpu.obs.exporters import prometheus_lines
+
+        reg = MetricRegistry()
+        reg.counter("x_total").inc(3)
+        reg.histogram("lat_ms").observe(5.0, exemplar="t-1")
+        body = prometheus_lines(reg, exemplars=True, timestamps=True,
+                                now=1234.5)
+        assert "glom_x_total 3 1234.5" in body
+        bucket = next(l for l in body.splitlines()
+                      if "lat_ms_bucket" in l and "# {" in l)
+        value_part, exemplar_part = bucket.split(" # ", 1)
+        assert value_part.endswith("1234.5")  # ts BEFORE the # clause
+        assert exemplar_part.startswith('{trace_id="t-1"}')
+        # counter families declared without the reserved _total suffix
+        assert "# TYPE glom_x counter" in body
+
+    def test_timestamps_require_openmetrics(self):
+        from glom_tpu.obs.exporters import prometheus_lines
+
+        reg = MetricRegistry()
+        reg.counter("x_total").inc()
+        with pytest.raises(ValueError, match="exemplars"):
+            # classic 0.0.4 parses a trailing number as MILLISECONDS —
+            # timestamps only ship on the negotiated OpenMetrics body
+            prometheus_lines(reg, exemplars=False, timestamps=True)
+        assert " 1234.5" not in prometheus_lines(reg, exemplars=True,
+                                                 timestamps=False,
+                                                 now=1234.5)
+
+
+# ---------------------------------------------------------------------------
+# loadgen --timeline windows
+# ---------------------------------------------------------------------------
+class TestLoadgenTimeline:
+    def test_windows_bucket_by_step(self):
+        lg = _load_tool("loadgen")
+        r = lg._Results(timeline=True)
+        r.timeline_samples = [
+            (100.1, 10.0, "ok"), (100.6, 30.0, "ok"),
+            (101.2, 50.0, "shed"), (102.4, 70.0, "error"),
+            (102.9, 90.0, "ok"),
+        ]
+        rep = lg.timeline_report(r, step_s=1.0)
+        assert rep["step_s"] == 1.0
+        w0, w1, w2 = rep["windows"]
+        assert (w0["t_s"], w0["requests_ok"], w0["p95_ms"]) == (0, 2, 30.0)
+        assert w1["requests_shed"] == 1 and w1["requests_ok"] == 0
+        assert w2["requests_error"] == 1 and w2["p50_ms"] == 90.0
+        assert w0["throughput_req_per_s"] == 2.0
+
+    def test_disabled_by_default(self):
+        lg = _load_tool("loadgen")
+        assert lg._Results().timeline_samples is None
+
+
+# ---------------------------------------------------------------------------
+# ACCEPTANCE: loadgen timeline with a latency step, replayed through the
+# TSDB, yields the trend flip and ETA within ONE downsampling window of
+# ground truth (fake clock end to end)
+# ---------------------------------------------------------------------------
+class TestTimelineReplayAcceptance:
+    FLIP_T = 300.0       # ground truth: latency starts ramping here
+    SLOPE = 0.5          # ms per second after the knee
+    BOUND = 250.0        # policy threshold the ETA must forecast
+    TIER_S = 10.0        # the downsampling window the answer reads from
+
+    def test_trend_flip_and_eta_within_one_window(self):
+        lg = _load_tool("loadgen")
+        t0 = 5000.0
+        samples = []
+        for s in range(600):
+            lat = 50.0 if s < self.FLIP_T else \
+                50.0 + self.SLOPE * (s - self.FLIP_T)
+            samples.append((t0 + s + 0.5, lat, "ok"))
+        r = lg._Results(timeline=True)
+        r.timeline_samples = samples
+        windows = lg.timeline_report(r, step_s=1.0)["windows"]
+        assert len(windows) == 600
+
+        store = SeriesStore(tiers=((1.0, 120), (self.TIER_S, 360)),
+                            clock=FakeClock(t0 + 600.0))
+        for w in windows:
+            store.record("capacity_p95_ms", w["p95_ms"],
+                         t=t0 + w["t_s"])
+        # the fine tier only reaches back 120 s: a 10-minute question
+        # must come from the 10 s tier — exactly the downsampling the
+        # acceptance bound is phrased in
+        pts = store.points("capacity_p95_ms", since=t0, step=self.TIER_S)
+        assert pts[1][0] - pts[0][0] == self.TIER_S
+
+        flip = trend_flip(pts, min_slope=0.01)
+        assert flip is not None
+        assert abs(flip["t"] - (t0 + self.FLIP_T)) <= self.TIER_S
+        assert abs(flip["slope_before"]) < 0.01
+        assert abs(flip["slope_after"] - self.SLOPE) < 0.05
+
+        ramp = [p for p in pts if p[0] >= flip["t"]]
+        eta = eta_to_threshold(ramp, self.BOUND)
+        truth_cross = t0 + self.FLIP_T + (self.BOUND - 50.0) / self.SLOPE
+        assert abs((ramp[-1][0] + eta) - truth_cross) <= self.TIER_S
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 subprocess gate (the chaos.py pattern)
+# ---------------------------------------------------------------------------
+class TestCapacitySmoke:
+    def test_smoke_suite(self):
+        """tools/capacity.py --smoke: engine + router in-process, a
+        loadgen burst => scale-up within the persist threshold and ONE
+        capacity_pressure bundle, quiescence => scale-down, zero
+        request-path compiles."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "capacity.py"),
+             "--smoke"],
+            capture_output=True, text=True, timeout=280, env=env, cwd=ROOT,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        summary = json.loads(proc.stdout)
+        assert summary["smoke"] == "ok"
+        assert summary["scale_up_window"] <= summary["persist_windows"]
+        assert summary["quiescence_actions"][-1] == "scale_down"
+        assert len(summary["pressure_bundles"]) == 1
+        assert summary["xla_compiles"] == 0

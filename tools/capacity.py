@@ -1,0 +1,323 @@
+#!/usr/bin/env python
+"""Capacity-plane CLI: read a live engine's (or router's) dry-run
+autoscale state, query the capacity TSDB, and run the CI smoke.
+
+  python tools/capacity.py report --url http://127.0.0.1:8000
+  python tools/capacity.py query --url http://127.0.0.1:8000 \\
+      --name capacity_duty_cycle --since -120 --step 1
+  python tools/capacity.py --smoke
+
+``report`` renders ``GET /capacity`` — the policy, the current
+recommendation (scale-up / scale-down / rebalance / hold, with the
+violated bounds as reasons), the per-rule trend/ETA forecasts, and (on a
+router) the per-replica signal table.  ``query`` is a thin front over
+``GET /debug/series``: name/since/step pass through, points print as
+``t value`` rows (``--format json`` for the raw body).  Both speak plain
+stdlib HTTP, so they run anywhere the server is reachable — no jax.
+
+``--smoke`` is the acceptance loop the CI job runs: demo checkpoint ->
+engine (+ router) in-process, a loadgen burst drives the duty cycle up,
+the advisor must recommend **scale-up** within the persist threshold and
+fire exactly ONE debounced ``capacity_pressure`` forensics bundle; after
+quiescence ages the burst out of the signal window the recommendation
+must flip to **scale-down**; and the request path must never have
+compiled (``serving_xla_compiles == 0``).  Capacity windows are driven
+by explicit ``tick(t)`` times (the plane's deterministic entry), so the
+pass/fail signal does not depend on wall-clock scheduling; only the
+signal VALUES come from real served requests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.parse
+import urllib.request
+
+# The smoke trips on ANY execute work inside the window (and scale-down
+# on none): the smoke proves the plumbing — signals -> advisor ->
+# trigger -> bundle — not a tuned threshold, and a bound that real CPU
+# timings could straddle would make it flaky.
+SMOKE_POLICY = "duty<0.000001"
+SMOKE_WINDOW_S = 8.0
+SMOKE_PERSIST = 3
+SMOKE_BURST = 16
+
+
+def _get_json(url: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+# ---------------------------------------------------------------------------
+# report / query
+# ---------------------------------------------------------------------------
+def _fmt(v, spec=".4g"):
+    return "—" if v is None else format(v, spec)
+
+
+def cmd_report(args) -> int:
+    doc = _get_json(f"{args.url.rstrip('/')}/capacity", args.timeout)
+    if args.format == "json":
+        print(json.dumps(doc, indent=2))
+        return 0
+    rec = doc.get("recommendation") or {}
+    print(f"capacity @ {args.url}   role={doc.get('role')}   "
+          f"policy={doc.get('policy')}")
+    if rec:
+        reasons = "; ".join(rec.get("reasons", [])) or "—"
+        print(f"recommendation: {rec.get('action')} "
+              f"(persisted {rec.get('persisted')}/"
+              f"{doc.get('persist_windows')})   {reasons}")
+    else:
+        print("recommendation: — (no evaluation window yet)")
+    forecasts = doc.get("forecasts", [])
+    if forecasts:
+        print("\n| rule | value | trend | slope/s | eta to bound (s) |")
+        print("|---|---|---|---|---|")
+        for f in forecasts:
+            print(f"| {f.get('rule')} | {_fmt(f.get('value'))} | "
+                  f"{f.get('arrow', '—')} | {_fmt(f.get('slope_per_s'))} | "
+                  f"{_fmt(f.get('eta_s'), '.1f')} |")
+    replicas = doc.get("replicas") or {}
+    if replicas:
+        print("\n| replica | duty | util | p95 ms | shed | queue |")
+        print("|---|---|---|---|---|---|")
+        for name in sorted(replicas):
+            s = replicas[name]
+            print(f"| {name} | {_fmt(s.get('duty'))} | {_fmt(s.get('util'))}"
+                  f" | {_fmt(s.get('p95_ms'))} | {_fmt(s.get('shed'))}"
+                  f" | {_fmt(s.get('queue'))} |")
+    if doc.get("pressure_fired"):
+        print(f"\ncapacity_pressure bundles fired: {doc['pressure_fired']}")
+    return 0
+
+
+def cmd_query(args) -> int:
+    params = {}
+    if args.name:
+        params["name"] = args.name
+    if args.prefix:
+        params["prefix"] = args.prefix
+    if args.since is not None:
+        params["since"] = args.since
+    if args.step is not None:
+        params["step"] = args.step
+    qs = urllib.parse.urlencode(params)
+    doc = _get_json(f"{args.url.rstrip('/')}/debug/series?{qs}", args.timeout)
+    if args.format == "json":
+        print(json.dumps(doc, indent=2))
+        return 0
+    if "error" in doc:
+        print(f"error: {doc['error']}", file=sys.stderr)
+        return 1
+    if "names" in doc:  # no selector -> discovery listing
+        for name in doc["names"]:
+            print(name)
+        return 0
+    for key, pts in doc.get("series", {}).items():
+        print(f"# {key} ({len(pts)} points)")
+        for t, v in pts:
+            print(f"{t} {v}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# the CI smoke
+# ---------------------------------------------------------------------------
+def _poll_until(fn, timeout_s: float = 15.0, interval_s: float = 0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(interval_s)
+    return None
+
+
+def run_smoke() -> int:
+    import tempfile
+    import threading
+
+    import loadgen  # sibling tool: health fetch, payload builder, sender
+
+    from glom_tpu.obs.capacity import (ACTION_SCALE_DOWN, ACTION_SCALE_UP,
+                                       read_bench_ceiling)
+    from glom_tpu.serving.engine import ServingEngine, make_demo_checkpoint
+    from glom_tpu.serving.server import make_server
+
+    with tempfile.TemporaryDirectory() as d:
+        ckpt = os.path.join(d, "ckpt")
+        forensics_dir = os.path.join(d, "forensics")
+        make_demo_checkpoint(ckpt)
+        engine = ServingEngine(
+            ckpt, buckets=(1, 2), max_wait_ms=1.0, warmup=True,
+            reload_poll_s=0, forensics_dir=forensics_dir,
+            capacity_policy=SMOKE_POLICY,
+            capacity_window_s=SMOKE_WINDOW_S,
+            capacity_persist_windows=SMOKE_PERSIST,
+            capacity_ceiling=read_bench_ceiling(),
+        )
+        engine.start()
+        # deliberately NOT engine.capacity.start(): windows are driven
+        # below with explicit tick(t) times so the advisor's schedule is
+        # deterministic no matter how slowly CI executes the requests
+        server = make_server(engine)
+        host, port = server.server_address[:2]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        target = f"http://{host}:{port}"
+        router = router_server = None
+        try:
+            health = loadgen._fetch_health(target, timeout=10)
+            payloads = loadgen._make_payloads(health, [1])
+            results = loadgen._Results()
+            t0 = time.monotonic()
+
+            def burst(n, tag):
+                for i in range(n):
+                    loadgen._send(target, "embed", payloads[1], 1, 30.0,
+                                  results, t0, request_id=f"cap-{tag}-{i}")
+
+            # one priming request BEFORE the baseline sample: the first
+            # window needs a pre-burst serving_execute_ms_sum point to
+            # take a delta against
+            burst(1, "prime")
+            t = 1000.0
+            engine.capacity.tick(t)
+            burst(SMOKE_BURST, "burst")
+            actions = []
+            for _ in range(6):  # burst stays inside the signal window
+                t += 1.0
+                rec = engine.capacity.tick(t)
+                actions.append(rec["action"] if rec else None)
+            scale_up_window = next(
+                (i + 1 for i, a in enumerate(actions)
+                 if a == ACTION_SCALE_UP), None)
+            # quiescence: jump past the window so the burst ages out
+            t += SMOKE_WINDOW_S
+            quiesce = []
+            for _ in range(3):
+                t += 1.0
+                rec = engine.capacity.tick(t)
+                quiesce.append(rec["action"] if rec else None)
+            bundles = sorted(
+                name for name in (os.listdir(forensics_dir)
+                                  if os.path.isdir(forensics_dir) else [])
+                if name.startswith("capacity_pressure-"))
+            snap = engine.registry.snapshot()
+            compiles = snap.get("serving_xla_compiles", 0.0)
+
+            # the HTTP faces of the same plane
+            cap = _get_json(f"{target}/capacity")
+            series = _get_json(
+                f"{target}/debug/series?name=capacity_duty_cycle")
+
+            # fleet leg: a router fronting the replica ingests the
+            # capacity summary from /healthz and evaluates its own
+            # (default-policy) fleet advisor each health pass
+            from glom_tpu.serving.router import (FleetRouter,
+                                                 make_router_server)
+
+            router = FleetRouter([target], health_interval_s=0.2)
+            router.start()
+            router_server = make_router_server(router)
+            threading.Thread(target=router_server.serve_forever,
+                             daemon=True).start()
+            rhost, rport = router_server.server_address[:2]
+            rtarget = f"http://{rhost}:{rport}"
+            fleet_cap = _poll_until(
+                lambda: (lambda p: p if p.get("replicas") else None)(
+                    _get_json(f"{rtarget}/capacity")))
+            timeline = _get_json(f"{rtarget}/debug/timeline")
+            rec_events = [e for e in timeline.get("events", [])
+                          if e.get("event") == "capacity_recommendation"]
+
+            checks = {
+                "requests_ok": results.ok == 1 + SMOKE_BURST
+                               and results.errors == 0,
+                "scale_up_recommended": (
+                    scale_up_window is not None
+                    and scale_up_window <= SMOKE_PERSIST),
+                "scale_down_after_quiescence":
+                    quiesce[-1] == ACTION_SCALE_DOWN,
+                "one_pressure_bundle": len(bundles) == 1
+                                       and engine.capacity.pressure_fired == 1,
+                "zero_request_path_compiles": compiles == 0,
+                # the advisor canonicalizes bounds (%g: 0.000001 ->
+                # 1e-06), so match the parsed policy, not the spec string
+                "capacity_endpoint": cap.get("role") == "replica"
+                                     and cap.get("policy", "").startswith("duty<"),
+                "series_endpoint": bool(
+                    series.get("series", {}).get("capacity_duty_cycle")),
+                "fleet_ingested": bool(fleet_cap)
+                                  and fleet_cap.get("role") == "router",
+                "fleet_replica_series": bool(fleet_cap) and any(
+                    n.startswith("capacity_duty_cycle{")
+                    for n in fleet_cap.get("series_names", [])),
+                "fleet_recommendation_event": len(rec_events) >= 1,
+            }
+            ok = all(checks.values())
+            print(json.dumps({
+                "smoke": "ok" if ok else "FAILED",
+                "policy": SMOKE_POLICY,
+                "window_s": SMOKE_WINDOW_S,
+                "persist_windows": SMOKE_PERSIST,
+                "burst_actions": actions,
+                "quiescence_actions": quiesce,
+                "scale_up_window": scale_up_window,
+                "pressure_bundles": bundles,
+                "xla_compiles": compiles,
+                "fleet_recommendation": (
+                    rec_events[-1] if rec_events else None),
+                "checks": checks,
+            }, indent=2))
+            return 0 if ok else 1
+        finally:
+            if router_server is not None:
+                router.shutdown()
+                router_server.shutdown()
+                router_server.server_close()
+            server.shutdown()
+            engine.shutdown()
+            server.server_close()
+
+
+# ---------------------------------------------------------------------------
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--smoke", action="store_true",
+                   help="in-process engine+router acceptance loop (CI)")
+    sub = p.add_subparsers(dest="cmd")
+    rep = sub.add_parser("report", help="render GET /capacity")
+    rep.add_argument("--url", default="http://127.0.0.1:8000")
+    rep.add_argument("--timeout", type=float, default=10.0)
+    rep.add_argument("--format", choices=["text", "json"], default="text")
+    q = sub.add_parser("query", help="query GET /debug/series")
+    q.add_argument("--url", default="http://127.0.0.1:8000")
+    q.add_argument("--timeout", type=float, default=10.0)
+    q.add_argument("--name", default=None,
+                   help="series name (matches labeled variants too)")
+    q.add_argument("--prefix", default=None, help="series key prefix")
+    q.add_argument("--since", type=float, default=None,
+                   help="window start; negative = relative to now")
+    q.add_argument("--step", type=float, default=None,
+                   help="desired resolution in seconds (selects the tier)")
+    q.add_argument("--format", choices=["text", "json"], default="text")
+    args = p.parse_args(argv)
+    if args.smoke:
+        return run_smoke()
+    if args.cmd == "report":
+        return cmd_report(args)
+    if args.cmd == "query":
+        return cmd_query(args)
+    p.error("need --smoke, report, or query")
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

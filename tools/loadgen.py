@@ -115,6 +115,14 @@ def parse_args(argv=None):
                         "gains a per_tenant section (p50/p95/shed_rate); "
                         "quota sheds (503 tenant_overloaded) count as "
                         "sheds, not errors")
+    p.add_argument("--timeline", action="store_true",
+                   help="window the run into per-second "
+                        "throughput/p95/error buckets in the report "
+                        "(a deterministic series tools/capacity.py and "
+                        "tests replay into the TSDB — a mid-run latency "
+                        "step shows up as a trend flip)")
+    p.add_argument("--timeline-step-s", type=float, default=1.0,
+                   help="with --timeline: window width in seconds")
     p.add_argument("--timeout", type=float, default=60.0,
                    help="per-request HTTP timeout (seconds)")
     p.add_argument("--slow-n", type=int, default=0,
@@ -166,8 +174,11 @@ def _make_payloads(health, batch_sizes):
 
 
 class _Results:
-    def __init__(self):
+    def __init__(self, timeline=False):
         self.lock = threading.Lock()
+        # --timeline: one (completion monotonic, latency_ms|None, kind)
+        # sample per request, windowed by timeline_report
+        self.timeline_samples = [] if timeline else None
         self.latencies_ms = []
         self.samples = []        # (latency_ms, request_id) for --slow-n
         self.images_ok = 0
@@ -212,6 +223,10 @@ class _Results:
         with self.lock:
             rep = self._replica(replica) if replica is not None else None
             ten = self._tenant(tenant) if tenant is not None else None
+            if self.timeline_samples is not None:
+                kind = "shed" if shed else ("error" if error else "ok")
+                self.timeline_samples.append(
+                    (time.monotonic(), latency_ms, kind))
             if id_mismatch:
                 self.id_mismatches += 1
             if shed:
@@ -547,6 +562,47 @@ def session_report(results, urls, timeout, after_seq=-1):
     }
 
 
+def timeline_report(results, step_s=1.0):
+    """Window the run's completion samples into fixed ``step_s`` buckets:
+    per-window throughput, p95, shed and error counts, with window start
+    times relative to the first completion.  This is the deterministic
+    series shape the capacity TSDB replays (see
+    ``glom_tpu.obs.timeseries``): a mid-run latency step appears as a
+    trend flip in the windowed p95."""
+    with results.lock:
+        samples = list(results.timeline_samples or ())
+    if not samples:
+        return None
+    t0 = min(t for t, _, _ in samples)
+    windows = {}
+    for t, lat, kind in samples:
+        w = int((t - t0) / step_s)
+        rec = windows.setdefault(
+            w, {"ok": 0, "shed": 0, "errors": 0, "latencies": []})
+        if kind == "ok":
+            rec["ok"] += 1
+            if lat is not None:
+                rec["latencies"].append(lat)
+        elif kind == "shed":
+            rec["shed"] += 1
+        else:
+            rec["errors"] += 1
+    out = []
+    for w in sorted(windows):
+        rec = windows[w]
+        lats = rec["latencies"]
+        out.append({
+            "t_s": round(w * step_s, 3),
+            "requests_ok": rec["ok"],
+            "requests_shed": rec["shed"],
+            "requests_error": rec["errors"],
+            "throughput_req_per_s": round(rec["ok"] / step_s, 2),
+            "p50_ms": round(percentile(lats, 50), 3) if lats else None,
+            "p95_ms": round(percentile(lats, 95), 3) if lats else None,
+        })
+    return {"step_s": step_s, "windows": out}
+
+
 def report(results, wall_s, mode, slow_n=0):
     lat = results.latencies_ms
     out = {
@@ -859,7 +915,7 @@ def main(argv=None) -> int:
     batch_sizes = [int(b) for b in args.batch_sizes.split(",")]
     urls = [u.rstrip("/") for u in (args.target or [args.url])]
     health = _fetch_health(urls[0], args.timeout)
-    results = _Results()
+    results = _Results(timeline=args.timeline)
     if args.sessions > 0:
         image_lists = _make_image_lists(health, batch_sizes)
         # timeline cursor BEFORE the run: only ejections that happen
@@ -873,6 +929,8 @@ def main(argv=None) -> int:
                      f"sessions(n={args.sessions},frames={args.frames})",
                      slow_n=args.slow_n)
         out["session"] = sess
+        if args.timeline:
+            out["timeline"] = timeline_report(results, args.timeline_step_s)
         print(json.dumps(out, indent=2))
         ok = (results.errors == 0 and results.id_mismatches == 0
               and not sess["affinity"]["violations"])
@@ -898,8 +956,10 @@ def main(argv=None) -> int:
         mode += f" tenants({','.join(sorted(set(tenants)))})"
     if len(urls) > 1:
         mode += f" x{len(urls)} targets"
-    print(json.dumps(report(results, wall, mode, slow_n=args.slow_n),
-                     indent=2))
+    out = report(results, wall, mode, slow_n=args.slow_n)
+    if args.timeline:
+        out["timeline"] = timeline_report(results, args.timeline_step_s)
+    print(json.dumps(out, indent=2))
     return 0 if results.errors == 0 and results.id_mismatches == 0 else 1
 
 

@@ -9,6 +9,8 @@ markers, window-aggregated numerics, GLOM diagnostics) and prints:
   * per-phase p50 / p95 / share-of-wall step time (ms/step, normalized by
     each window's ``window_steps``);
   * throughput (imgs/sec p50 / best);
+  * a capacity summary — utilization and headroom against the measured
+    ``BENCH_*.json`` ceiling (``--bench``) plus the throughput trend;
   * recompile count, NaN windows, grad-norm spike windows, resume /
     preemption events;
   * final island agreement / attention entropy when diagnostics ran.
@@ -59,7 +61,50 @@ def read_records(path):
 LEGACY_EVENT_FLOATS = {1.0: "resume", 2.0: "preempt_stop"}
 
 
-def summarize(recs):
+def _read_bench_ceiling(path=None):
+    """Measured imgs/s ceiling from a ``BENCH_*.json`` (``parsed.
+    last_measured.value``); mirrors glom_tpu.obs.capacity.read_bench_ceiling
+    (inlined so this reader runs without importing the jax-backed package).
+    ``path`` is a file, a directory of BENCH files (newest wins), or None
+    for the repo root.  Returns None when nothing parseable exists."""
+    import glob
+    import os
+
+    if path is None:
+        path = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    candidates = ([path] if os.path.isfile(path)
+                  else sorted(glob.glob(os.path.join(path, "BENCH_*.json")),
+                              key=os.path.getmtime, reverse=True))
+    for cand in candidates:
+        try:
+            with open(cand) as f:
+                doc = json.load(f)
+            value = ((doc.get("parsed") or {})
+                     .get("last_measured") or {}).get("value")
+            if value is not None and float(value) > 0:
+                return float(value)
+        except (OSError, ValueError):
+            continue
+    return None
+
+
+def _trend_arrow(xs, rel=0.02):
+    """↑ / ↓ / → from a least-squares slope over window index; flat when
+    the end-to-end drift is under ``rel`` of the mean."""
+    if len(xs) < 2:
+        return "→"
+    n = len(xs)
+    mean_i = (n - 1) / 2.0
+    mean_x = sum(xs) / n
+    denom = sum((i - mean_i) ** 2 for i in range(n))
+    slope = sum((i - mean_i) * (x - mean_x) for i, x in enumerate(xs)) / denom
+    drift = slope * (n - 1)
+    if mean_x and abs(drift) < rel * abs(mean_x):
+        return "→"
+    return "↑" if drift > 0 else "↓"
+
+
+def summarize(recs, bench_ceiling=None):
     phases = {}          # name -> [ms/step per window]
     window_ms = []
     rates = []
@@ -122,9 +167,23 @@ def summarize(recs):
             phases.items(), key=lambda kv: -sum(kv[1])
         )
     ]
+    rate_p50 = _percentile(rates, 50)
+    rate_best = max(rates) if rates else None
+    capacity = {
+        "ceiling_imgs_per_sec": bench_ceiling,
+        "utilization_p50": (rate_p50 / bench_ceiling
+                            if rate_p50 is not None and bench_ceiling else None),
+        "utilization_best": (rate_best / bench_ceiling
+                             if rate_best is not None and bench_ceiling else None),
+        "headroom_imgs_per_sec": (bench_ceiling - rate_p50
+                                  if rate_p50 is not None and bench_ceiling
+                                  else None),
+        "throughput_trend": _trend_arrow(rates),
+    }
     return {
         "records": len(recs),
         "last_step": last_step,
+        "capacity": capacity,
         "step_time_ms_p50": _percentile(window_ms, 50),
         "step_time_ms_p95": _percentile(window_ms, 95),
         "phases": phase_rows,
@@ -160,6 +219,14 @@ def print_report(s):
     if s["imgs_per_sec_p50"] is not None:
         print(f"\nthroughput: p50 {_fmt(s['imgs_per_sec_p50'])} imgs/sec   "
               f"best {_fmt(s['imgs_per_sec_best'])}")
+    cap = s.get("capacity", {})
+    if cap.get("ceiling_imgs_per_sec") is not None:
+        util = cap.get("utilization_p50")
+        print(f"capacity: ceiling {_fmt(cap['ceiling_imgs_per_sec'])} imgs/sec"
+              f"   utilization p50 "
+              f"{'—' if util is None else f'{100 * util:.1f}%'}"
+              f"   headroom {_fmt(cap.get('headroom_imgs_per_sec'))} imgs/sec"
+              f"   trend {cap.get('throughput_trend', '—')}")
     print(f"\nhealth: recompiles={s['recompiles']}"
           + (f" (compile_count={s['compile_count']})" if s["compile_count"] else "")
           + f"   nan_windows={s['nan_windows']}"
@@ -180,6 +247,9 @@ def main(argv=None) -> int:
                         "JSON object (CI gates)")
     p.add_argument("--json", action="store_true",
                    help="deprecated alias for --format json")
+    p.add_argument("--bench", default=None,
+                   help="BENCH_*.json file or directory for the capacity "
+                        "utilization ceiling (default: repo root)")
     args = p.parse_args(argv)
     try:
         recs = read_records(args.jsonl)
@@ -189,7 +259,7 @@ def main(argv=None) -> int:
     if not recs:
         print(f"error: no JSON records in {args.jsonl}", file=sys.stderr)
         return 1
-    s = summarize(recs)
+    s = summarize(recs, bench_ceiling=_read_bench_ceiling(args.bench))
     if args.json or args.format == "json":
         print(json.dumps(s))
     else:

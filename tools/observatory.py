@@ -98,6 +98,23 @@ def render_console(con: dict) -> str:
     for name, rates in burn.items():
         for slo, rate in rates.items():
             lines.append(f"burn {name}: {slo} = {rate}")
+    capacity = con.get("capacity", {})
+    cap_replicas = capacity.get("replicas", {})
+    if cap_replicas:
+        lines.append("\n| replica | duty | util | p95 ms | shed | trend |")
+        lines.append("|---|---|---|---|---|---|")
+        for name in sorted(cap_replicas):
+            row = cap_replicas[name]
+            lines.append(
+                f"| {name} | {_fmt(row.get('duty'))} | {_fmt(row.get('util'))}"
+                f" | {_fmt(row.get('p95_ms'), '.1f')} | {_fmt(row.get('shed'))}"
+                f" | {row.get('trend', '—')} |")
+    rec = capacity.get("recommendation")
+    if rec:
+        reasons = ", ".join(rec.get("reasons", [])) or "—"
+        lines.append(
+            f"capacity: {rec.get('action', '?')} "
+            f"(persisted {rec.get('persisted', 0)})  {reasons}")
     slowest = con.get("slowest_traces", [])
     if slowest:
         lines.append("\nslowest stitched traces:")
