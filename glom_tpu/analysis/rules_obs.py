@@ -8,7 +8,11 @@
     reference or an HTTP client import appearing there means the data
     plane grew a dependency on the observability plane — the exact
     coupling the pull topology exists to forbid (a slow observer must
-    never be able to slow a request).
+    never be able to slow a request).  The quality plane (PR 17) rides
+    the same boundary: ``glom_tpu.obs.quality``/``glom_tpu.obs.sketch``
+    imports are equally forbidden here — the quality post-pass is a
+    SEPARATE bucketed cache owned by the engine, attached outside the
+    execute core, so sketch bookkeeping can never ride a request.
 
   * ``obs-state-in-cache`` — the session-state boundary (PR 10): per-
     session column state is OWNED by :mod:`glom_tpu.serving.sessions`
@@ -39,6 +43,10 @@ from typing import List
 from glom_tpu.analysis.engine import Finding, ModuleContext, Rule, dotted_name
 
 _HTTP_CLIENT_ROOTS = {"urllib", "http", "requests", "socket"}
+
+#: obs quality-plane modules forbidden in the execute core: the sampled
+#: post-pass lives in the ENGINE's separate quality cache, never here
+_QUALITY_PLANE_LEAVES = {"quality", "sketch"}
 
 
 class DebugPlaneInCacheRule(Rule):
@@ -72,15 +80,24 @@ class DebugPlaneInCacheRule(Rule):
             elif isinstance(node, (ast.Import, ast.ImportFrom)):
                 mod = (node.module or "" if isinstance(node, ast.ImportFrom)
                        else "")
-                roots = ([mod.split(".")[0]] if mod
-                         else [a.name.split(".")[0] for a in node.names])
-                for root in roots:
+                mods = [mod] if mod else [a.name for a in node.names]
+                for dotted in mods:
+                    root = dotted.split(".")[0]
                     if root in _HTTP_CLIENT_ROOTS:
                         findings.append(ctx.finding(
                             self, node,
                             f"HTTP/network import {root!r} in the execute "
                             f"core: network I/O (a /debug pull, a metrics "
                             f"push) has no place on the request path"))
+                    parts_mod = dotted.split(".")
+                    if ("obs" in parts_mod
+                            and parts_mod[-1] in _QUALITY_PLANE_LEAVES):
+                        findings.append(ctx.finding(
+                            self, node,
+                            f"quality-plane import {dotted!r} in the "
+                            f"execute core: sketch/quality bookkeeping "
+                            f"belongs to the engine's separate sampled "
+                            f"post-pass cache, never the request path"))
             elif isinstance(node, ast.Call):
                 d = dotted_name(node.func)
                 if d and d.split(".")[0] in {"urllib", "requests"}:

@@ -50,6 +50,15 @@ report through.  Four pieces, each usable on its own:
     declarative policy over the series, RECOMMENDATIONS only, persisted
     pressure fired as a debounced ``capacity_pressure`` forensics
     incident (``tools/capacity.py`` is the CLI).
+  * :mod:`glom_tpu.obs.sketch` — bounded, exactly-mergeable streaming
+    sketches (fixed-bin histogram + fixed-grid quantile sketch) with PSI
+    and KS drift scores; the distribution substrate of the quality plane.
+  * :mod:`glom_tpu.obs.quality` — the model-quality telemetry plane:
+    per-request island-agreement / entropy / norm / residual signals from
+    a sampled jitted post-pass, live-vs-reference drift
+    (``quality_ref.json``), quality SLOs through the burn machinery
+    (``quality_drift`` forensics), and the fleet-side exact sketch merge
+    (``tools/quality_report.py`` is the CLI).
 
 ``training/metrics.py``'s ``MetricLogger`` is the facade the Trainer
 logs through; it fans records out to the configured exporters.
@@ -141,6 +150,20 @@ from glom_tpu.obs.capacity import (  # noqa: F401
     FleetCapacityPlane,
     parse_capacity_policy,
     read_bench_ceiling,
+)
+from glom_tpu.obs.sketch import (  # noqa: F401
+    HistogramSketch,
+    QuantileSketch,
+    ks_distance,
+    psi,
+    sketch_from_dict,
+)
+from glom_tpu.obs.quality import (  # noqa: F401
+    CreditSampler,
+    FleetQualityPlane,
+    QualityPlane,
+    make_quality_fn,
+    unpack_signals,
 )
 from glom_tpu.obs.perfgate import (  # noqa: F401
     GATE_FAIL,

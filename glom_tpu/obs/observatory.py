@@ -722,6 +722,73 @@ class FleetObservatory:
         if agg:
             self.series.record_snapshot(agg, t=now)
 
+    # -- quality series ----------------------------------------------------
+    #: quality series whose fleet roll-up takes the max over replicas
+    #: (drift anywhere is drift; counts sum; signal levels average)
+    _QUAL_MAX = frozenset(("quality_drift",))
+    _QUAL_SUM = frozenset(("quality_observed_total",))
+
+    def _ingest_quality(self, forensics: Dict[str, dict]) -> None:
+        """Fold every replica's ``quality_*`` registry scalars into the
+        fleet series store (caller holds ``_lock``) — same shape as
+        :meth:`_ingest_capacity`: one labeled point per replica per poll
+        plus the bare-named fleet aggregate."""
+        now = self._clock()
+        fleet: Dict[str, List[float]] = {}
+        for name, payload in forensics.items():
+            reg = payload.get("registry") or {}
+            quals = {k: v for k, v in reg.items()
+                     if k.startswith("quality_")
+                     and isinstance(v, (int, float))}
+            if not quals:
+                continue
+            self.series.record_snapshot(quals, t=now,
+                                        labels={"replica": name})
+            for k, v in quals.items():
+                fleet.setdefault(k, []).append(float(v))
+        agg = {}
+        for k, vs in fleet.items():
+            if k in self._QUAL_MAX:
+                agg[k] = max(vs)
+            elif k in self._QUAL_SUM:
+                agg[k] = sum(vs)
+            else:
+                agg[k] = sum(vs) / len(vs)
+        if agg:
+            self.series.record_snapshot(agg, t=now)
+
+    def _quality_pane(self) -> Dict[str, Any]:
+        """Console quality view (caller holds ``_lock``): per-replica
+        agreement / drift with a trend arrow from the last two minutes of
+        the labeled drift series, plus the fleet-worst drift."""
+        now = self._clock()
+        replicas: Dict[str, Dict[str, Any]] = {}
+        worst_drift = 0.0
+        for name, payload in sorted(self._forensics_by_replica.items()):
+            reg = payload.get("registry") or {}
+            agreement = reg.get("quality_agreement")
+            drift = reg.get("quality_drift")
+            if agreement is None and drift is None:
+                continue
+            pts = self.series.points(
+                series_key("quality_drift", {"replica": name}),
+                since=now - 120.0)
+            fit = linear_trend(pts)
+            replicas[name] = {
+                "agreement": (round(float(agreement), 4)
+                              if agreement is not None else None),
+                "entropy": reg.get("quality_entropy"),
+                "residual": reg.get("quality_residual"),
+                "drift": (round(float(drift), 4)
+                          if drift is not None else None),
+                "observed": reg.get("quality_observed_total"),
+                "trend": trend_arrow(fit["slope"] if fit else 0.0),
+            }
+            if drift is not None:
+                worst_drift = max(worst_drift, float(drift))
+        return {"replicas": replicas,
+                "worst_drift": round(worst_drift, 4)}
+
     def _capacity_pane(self) -> Dict[str, Any]:
         """Console capacity view (caller holds ``_lock``): per-replica
         duty cycle + utilization with a trend arrow from the last two
@@ -797,10 +864,11 @@ class FleetObservatory:
                 if first_sighting:
                     continue
                 trigger = (bundle.get("manifest") or {}).get("trigger")
-                # capacity_pressure rides the same path as slo_burn: the
-                # replica-side TriggerEngine already debounced it, so a
-                # new bundle IS a witnessed incident
-                if trigger in ("slo_burn", "capacity_pressure"):
+                # capacity_pressure and quality_drift ride the same path
+                # as slo_burn: the replica-side TriggerEngine already
+                # debounced them, so a new bundle IS a witnessed incident
+                if trigger in ("slo_burn", "capacity_pressure",
+                               "quality_drift"):
                     path = self._write_incident(
                         trigger, origin=name, origin_bundle=bundle,
                         forensics=forensics)
@@ -967,6 +1035,7 @@ class FleetObservatory:
                              if isinstance(payload, dict)}
                 self._forensics_by_replica = forensics
                 self._ingest_capacity(forensics)
+                self._ingest_quality(forensics)
                 incidents = self._check_incidents(fresh_events, forensics)
                 return {
                     "poll": self._poll_n,
@@ -1025,6 +1094,7 @@ class FleetObservatory:
             "rollout_events": self._timeline[-10:],
             "slo_burn_rates": burn_rates,
             "capacity": self._capacity_pane(),
+            "quality": self._quality_pane(),
             "padding_waste": {
                 str(bucket): {
                     "batches": agg["batches"],

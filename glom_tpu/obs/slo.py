@@ -34,7 +34,8 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
-from glom_tpu.obs.triggers import TRIGGER_SLO_BURN
+from glom_tpu.obs.quality import QUALITY_SLO_METRICS
+from glom_tpu.obs.triggers import TRIGGER_QUALITY_DRIFT, TRIGGER_SLO_BURN
 
 
 @dataclass(frozen=True)
@@ -44,13 +45,16 @@ class SLO:
     ``kind`` is ``"latency"`` (bad = latency_ms > threshold_ms; the
     objective encodes the percentile — objective 0.95 + threshold 250
     reads "p95 < 250 ms") or ``"error_rate"`` (bad = request errored;
-    objective 0.99 reads "error rate < 1%").  ``endpoint`` None matches
+    objective 0.99 reads "error rate < 1%") or ``"quality"`` (bad = a
+    model-quality signal — island ``agreement``, sketch ``drift``,
+    shadow-compare ``divergence``, … — crossed ``threshold`` in the
+    direction ``bad_below`` encodes).  ``endpoint`` None matches
     every endpoint; ``tenant`` None matches every tenant (a per-tenant
     SLO sees only that tenant's outcomes — the alerting half of the
     bulkhead: tenant A's burn can never page for tenant B's traffic)."""
 
     name: str
-    kind: str                       # "latency" | "error_rate"
+    kind: str                       # "latency" | "error_rate" | "quality"
     objective: float                # good fraction promised, in (0, 1)
     threshold_ms: Optional[float] = None   # latency kind only
     endpoint: Optional[str] = None          # None = all endpoints
@@ -59,10 +63,22 @@ class SLO:
     long_window_s: float = 300.0
     burn_threshold: float = 2.0     # both windows must burn past this
     min_events: int = 10            # per window, before it can fire
+    # quality kind only: which signal, the bound, and its direction
+    # (``agreement>0.55`` promises the value stays ABOVE => bad_below)
+    metric: Optional[str] = None
+    threshold: Optional[float] = None
+    bad_below: bool = False
 
     def __post_init__(self):
-        if self.kind not in ("latency", "error_rate"):
+        if self.kind not in ("latency", "error_rate", "quality"):
             raise ValueError(f"unknown SLO kind {self.kind!r}")
+        if self.kind == "quality":
+            if self.metric not in QUALITY_SLO_METRICS:
+                raise ValueError(
+                    f"quality SLO metric must be one of "
+                    f"{QUALITY_SLO_METRICS}, got {self.metric!r}")
+            if self.threshold is None:
+                raise ValueError("quality SLO needs a threshold")
         if not 0.0 < self.objective < 1.0:
             raise ValueError(
                 f"objective must be in (0, 1), got {self.objective}"
@@ -97,6 +113,16 @@ _ERROR_RE = re.compile(
     r"^(?:(?P<tenant>[A-Za-z0-9._-]+)/)?(?:(?P<ep>[a-z_]+):)?"
     r"errors<(?P<pct>\d+(?:\.\d+)?)%$"
 )
+_QUALITY_RE = re.compile(
+    r"^(?:(?P<tenant>[A-Za-z0-9._-]+)/)?(?:(?P<ep>[a-z_]+):)?"
+    r"(?P<metric>" + "|".join(QUALITY_SLO_METRICS) + r")"
+    r"(?P<op>[<>])(?P<val>\d+(?:\.\d+)?)$"
+)
+
+#: good fraction promised by a quality objective when the spec doesn't
+#: say (quality specs carry a value bound, not a percentile — the burn
+#: budget is the 10% of sampled requests allowed to cross it)
+QUALITY_DEFAULT_OBJECTIVE = 0.9
 
 
 def parse_slo(spec: str, **overrides) -> SLO:
@@ -109,10 +135,24 @@ def parse_slo(spec: str, **overrides) -> SLO:
       * ``acme/embed:p95<250ms`` — per-tenant: only outcomes tagged
         tenant ``acme`` feed this target (the bulkhead's alerting half)
       * ``acme/errors<1%``
+      * ``embed:agreement>0.55`` — quality: sampled /embed requests'
+        island agreement must stay above 0.55 (``>`` = bad when below)
+      * ``drift<0.25`` — quality: live-vs-reference sketch drift (max
+        KS) must stay under 0.25; ``divergence<0.2`` guards the shadow
+        lane's primary-vs-candidate comparison the same way
 
     ``overrides`` pass through to :class:`SLO` (windows, burn threshold).
     """
     spec = spec.strip()
+    m = _QUALITY_RE.match(spec)
+    if m:
+        overrides.setdefault("objective", QUALITY_DEFAULT_OBJECTIVE)
+        return SLO(
+            name=spec, kind="quality",
+            metric=m.group("metric"), threshold=float(m.group("val")),
+            bad_below=m.group("op") == ">",
+            endpoint=m.group("ep"), tenant=m.group("tenant"), **overrides,
+        )
     m = _LATENCY_RE.match(spec)
     if m:
         return SLO(
@@ -131,8 +171,9 @@ def parse_slo(spec: str, **overrides) -> SLO:
             endpoint=m.group("ep"), tenant=m.group("tenant"), **overrides,
         )
     raise ValueError(
-        f"unparseable SLO spec {spec!r} (want '[tenant/][ep:]p95<250ms' "
-        f"or '[tenant/]errors<1%')"
+        f"unparseable SLO spec {spec!r} (want '[tenant/][ep:]p95<250ms', "
+        f"'[tenant/]errors<1%', or a quality objective like "
+        f"'[tenant/][ep:]agreement>0.55' / 'drift<0.25')"
     )
 
 
@@ -244,6 +285,10 @@ class SloManager:
         # detail (only bundle writes are debounced) — an unbounded list
         # would grow for the whole incident
         self.fired: "deque" = deque(maxlen=64)
+        # recent (trace_id, input_fingerprint) pairs from the quality
+        # path, so a quality_drift bundle can name the INPUTS behind the
+        # offending traces; bounded like the offender list itself
+        self._quality_fingerprints: "deque" = deque(maxlen=128)
 
     def observe(self, endpoint: str, latency_ms: Optional[float],
                 error: bool, trace_id: Optional[str] = None,
@@ -252,6 +297,8 @@ class SloManager:
         fired = []
         for ev in self.evaluators:
             slo = ev.slo
+            if slo.kind == "quality":
+                continue  # fed by observe_quality (sampled post-pass)
             if slo.endpoint is not None and slo.endpoint != endpoint:
                 continue
             if slo.tenant is not None and slo.tenant != tenant:
@@ -295,7 +342,74 @@ class SloManager:
             self._capture(detail, step)
         return fired
 
-    def _capture(self, detail: Dict[str, Any], step: int) -> None:
+    def observe_quality(self, values: Dict[str, float], *,
+                        endpoint: Optional[str] = None,
+                        trace_id: Optional[str] = None, step: int = 0,
+                        tenant: Optional[str] = None,
+                        fingerprint: Optional[str] = None,
+                        ) -> List[Dict[str, Any]]:
+        """Feed one sampled request's quality signals (``{metric:
+        value}``; missing metrics skip their evaluators) through every
+        matching QUALITY objective.  Same multi-window burn machinery as
+        request outcomes, but a breach fires the ``quality_drift``
+        trigger and the bundle carries input FINGERPRINTS alongside the
+        offending trace ids — "which inputs parsed badly", not just
+        "which requests were slow".  Same locking contract as
+        :meth:`observe` (the caller serializes)."""
+        if trace_id and fingerprint:
+            self._quality_fingerprints.append((trace_id, fingerprint))
+        fired = []
+        for ev in self.evaluators:
+            slo = ev.slo
+            if slo.kind != "quality":
+                continue
+            if slo.endpoint is not None and endpoint is not None \
+                    and slo.endpoint != endpoint:
+                continue
+            if slo.tenant is not None and slo.tenant != tenant:
+                continue
+            value = values.get(slo.metric)
+            if value is None:
+                continue
+            value = float(value)
+            bad = (value < slo.threshold if slo.bad_below
+                   else value > slo.threshold)
+            ev.observe(bad, trace_id)
+            rates = ev.burn_rates()
+            if self.registry is not None and rates["short"] is not None:
+                self.registry.gauge(
+                    f"slo_burn_rate_{_slug(slo.name)}",
+                    help=f"short-window burn rate of SLO {slo.name}",
+                ).set(round(rates["short"], 3))
+            if not ev.is_breach(rates):
+                continue
+            if self.triggers is not None and not self.triggers.fire(
+                TRIGGER_QUALITY_DRIFT, step
+            ):
+                continue
+            detail = ev.breach_detail(rates)
+            detail["metric"] = slo.metric
+            detail["value"] = round(value, 6)
+            detail["threshold"] = slo.threshold
+            # which INPUTS parsed badly: fingerprints for the offenders
+            # (bounded by the fingerprint ring and the trace-id cap)
+            known = dict(self._quality_fingerprints)
+            detail["fingerprints"] = {
+                tid: known[tid] for tid in detail.get("trace_ids", ())
+                if tid in known
+            }
+            fired.append(detail)
+            self.fired.append(detail)
+            if self.registry is not None:
+                self.registry.counter(
+                    "quality_drift_events",
+                    help="quality-objective burn detections (debounced)",
+                ).inc()
+            self._capture(detail, step, trigger=TRIGGER_QUALITY_DRIFT)
+        return fired
+
+    def _capture(self, detail: Dict[str, Any], step: int,
+                 trigger: str = TRIGGER_SLO_BURN) -> None:
         if self.forensics is None:
             return
         extra = None
@@ -308,10 +422,10 @@ class SloManager:
                 k: v for k, v in traces.items() if v  # evicted traces: omit
             }}
         path = self.forensics.capture(
-            TRIGGER_SLO_BURN, step, detail, trace=False, extra_files=extra,
+            trigger, step, detail, trace=False, extra_files=extra,
         )
         if path is None and self.triggers is not None:
-            self.triggers.refund(TRIGGER_SLO_BURN, step)
+            self.triggers.refund(trigger, step)
 
 
 def _slug(name: str) -> str:

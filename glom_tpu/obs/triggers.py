@@ -37,6 +37,11 @@ TRIGGER_CAPACITY_PRESSURE = "capacity_pressure"
 # and was auto-retired (glom_tpu.serving.deploy) — the bundle names the
 # offending traces and the before/after version pins
 TRIGGER_DEPLOY_ROLLBACK = "deploy_rollback"
+# serving-side: a model-QUALITY objective burned its budget (island
+# agreement collapsed, live distribution drifted off the reference
+# profile — glom_tpu.obs.quality via the SLO burn machinery); the bundle
+# names offending trace ids AND their input fingerprints
+TRIGGER_QUALITY_DRIFT = "quality_drift"
 # resilience-side (glom_tpu.resilience): a checkpoint failed integrity
 # verification and was quarantined; a supervised fit() crashed and restarted
 TRIGGER_CKPT_CORRUPT = "ckpt_corrupt"

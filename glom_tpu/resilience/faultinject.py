@@ -76,6 +76,11 @@ KINDS = {
     # "delay" makes the candidate measurably slow (client-visible latency
     # on canary traffic, never an error), "error" fails the execute
     "candidate": ("delay", "error"),
+    # deploy-candidate WEIGHT corruption (glom_tpu.serving.deploy):
+    # fired once at candidate load, AFTER integrity verification — the
+    # candidate loads clean and serves without errors but computes
+    # garbage; only the shadow lane's quality comparison can catch it
+    "candidate_load": ("bitflip",),
     # elastic multi-host sites (glom_tpu.resilience.elastic): fired from
     # ElasticContext.tick (the per-global-step seam) and the supervisor's
     # re-plan, so every recovery path is deterministic on CPU

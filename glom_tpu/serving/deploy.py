@@ -64,10 +64,42 @@ from glom_tpu.resilience import faultinject
 
 PHASES = ("idle", "shadow", "canary")
 
+
+def _cosine_divergence(a, b, eps: float = 1e-8):
+    """``(1 - mean cosine, per-level list)`` between two output arrays
+    of identical shape.  Embedding outputs ``(b, L, d)`` compare per
+    (image, level) vector — the per-level view shows WHICH level of the
+    part-whole hierarchy a candidate disagrees at; any other shape
+    (reconstructions ``(b, c, H, W)``) flattens per image.  Host-side
+    NumPy on already-fetched outputs: no device work, no compiles."""
+    import numpy as np
+
+    a = np.asarray(a, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    if a.shape != b.shape:
+        raise ValueError(f"shape mismatch {a.shape} vs {b.shape}")
+    if a.ndim == 3:                       # (b, L, d): per-level vectors
+        a2, b2 = a, b
+    else:                                 # flatten per image
+        a2 = a.reshape(a.shape[0], 1, -1)
+        b2 = b.reshape(b.shape[0], 1, -1)
+    dot = (a2 * b2).sum(axis=-1)
+    denom = (np.linalg.norm(a2, axis=-1) * np.linalg.norm(b2, axis=-1))
+    cos = dot / np.maximum(denom, eps)    # (b, L)
+    per_level = [float(1.0 - c) for c in cos.mean(axis=0)]
+    return float(1.0 - cos.mean()), per_level
+
 #: candidate guardrails when the engine has no SLOs configured: a deploy
 #: with no declared objectives still rolls back on a plainly-broken
 #: candidate (error storm) — guarded exposure must not be opt-in
 DEFAULT_CANDIDATE_SLOS = ("errors<2%",)
+
+#: the quality guardrail every deploy gets (unless the operator declared
+#: their own ``divergence`` objective): shadow-mirrored batches run on
+#: BOTH versions, and a candidate whose outputs diverge from the
+#: primary's on the same inputs burns this budget and rolls back —
+#: a fast-but-wrong candidate is a regression even with perfect latency
+DEFAULT_QUALITY_SLOS = ("divergence<0.2",)
 
 
 class _Candidate:
@@ -118,6 +150,20 @@ class DeployController:
             s.short_window_s for s in self._slos)
         self.min_events = int(min_events) if min_events is not None else min(
             s.min_events for s in self._slos)
+        # the quality guardrail rides the candidate's own cadence
+        # (windows/min_events resolved above), so shadow traffic can
+        # burn it as fast as it can burn a latency objective
+        if not any(s.kind == "quality" and s.metric == "divergence"
+                   for s in self._slos):
+            self._slos.extend(
+                parse_slo(spec, short_window_s=self.window_s,
+                          long_window_s=max(
+                              [self.window_s]
+                              + [s.long_window_s for s in self._slos]),
+                          min_events=self.min_events,
+                          burn_threshold=min(
+                              s.burn_threshold for s in self._slos))
+                for spec in DEFAULT_QUALITY_SLOS)
 
         self._lock = threading.Lock()
         # serializes whole begin_* calls INCLUDING the candidate load (a
@@ -295,6 +341,14 @@ class DeployController:
         except Exception as e:
             self._load_failure(step, e)
             return None
+        # chaos seam: a candidate whose weights are corrupted AFTER the
+        # integrity check — it loads clean, serves fast, and is WRONG.
+        # Only the shadow lane's quality comparison can catch this class
+        # of regression (CRC passed, latency/error SLOs stay green).
+        if faultinject.fire("candidate_load") == "bitflip":
+            import jax
+
+            params = jax.tree_util.tree_map(lambda leaf: -leaf, params)
         primary = engine.models.get(DEFAULT_MODEL)
         return engine.models.register(
             DEFAULT_MODEL, step, params=params,
@@ -487,8 +541,10 @@ class DeployController:
         return cand.step if (h / 0xFFFFFFFF) < cand.fraction else None
 
     # -- shadow mirroring --------------------------------------------------
-    def mirror(self, endpoint: str, imgs) -> None:
-        """Offer one primary batch to the shadow executor.  Non-blocking
+    def mirror(self, endpoint: str, imgs, primary_out=None) -> None:
+        """Offer one primary batch to the shadow executor, together with
+        the PRIMARY's outputs for the same batch (the quality-comparison
+        baseline — both sides then ran identical inputs).  Non-blocking
         and lossy by design: the mirror must never add latency to the
         primary path, so a backed-up shadow queue DROPS (counted) — the
         shadow is a measurement sample, not a delivery guarantee."""
@@ -503,7 +559,7 @@ class DeployController:
                          "bound (primary path stays unblocked)",
                 ).inc()
                 return
-            self._shadow_q.append((endpoint, imgs, cand.step))
+            self._shadow_q.append((endpoint, imgs, cand.step, primary_out))
             self._shadow_cv.notify()
 
     def _ensure_shadow_thread(self) -> None:
@@ -521,15 +577,20 @@ class DeployController:
                     self._shadow_cv.wait(timeout=0.25)
                 if self._stop.is_set():
                     return
-                endpoint, imgs, step = self._shadow_q.popleft()
-            self.process_shadow(endpoint, imgs, step)
+                endpoint, imgs, step, primary_out = self._shadow_q.popleft()
+            self.process_shadow(endpoint, imgs, step, primary_out)
 
-    def process_shadow(self, endpoint: str, imgs, step: int) -> bool:
-        """Execute one mirrored batch against the candidate and discard
-        the result; the outcome (latency incl. any injected candidate
-        fault, or error) feeds ONLY the candidate evaluators.  Public so
-        tests can pump the shadow path deterministically without the
-        thread."""
+    def process_shadow(self, endpoint: str, imgs, step: int,
+                       primary_out=None) -> bool:
+        """Execute one mirrored batch against the candidate and JUDGE
+        the result against the primary's outputs for the same inputs:
+        per-level cosine divergence plus the candidate's island-parse
+        agreement (through the engine's AOT-warmed quality post-pass —
+        zero compiles).  The outcome (latency incl. any injected
+        candidate fault, error, quality signals) feeds ONLY the
+        candidate evaluators — shadow responses never reach a client.
+        Public so tests can pump the shadow path deterministically
+        without the thread."""
         version = self.candidate(step)
         if version is None:
             return False
@@ -538,12 +599,15 @@ class DeployController:
             "endpoint": endpoint, "candidate_step": int(step)})
         t0 = self._clock()
         error = False
+        quality = None
         try:
             kind = faultinject.fire("candidate")
             if kind == "error":
                 raise faultinject.FaultError("injected candidate error")
             out = version.caches[endpoint](version.params, imgs)
-            del out  # discarded: shadow responses never reach a client
+            quality = self._shadow_quality(endpoint, version, imgs, out,
+                                           primary_out)
+            del out  # compared, never delivered: shadow stays invisible
             if kind == "delay":
                 time.sleep(self.fault_delay_s)  # glomlint: disable=conc-raw-clock -- deliberate injected wall-clock stall: the fault simulates a genuinely slow candidate kernel
         except Exception as e:
@@ -556,8 +620,58 @@ class DeployController:
             help="mirrored batches executed against the candidate",
         ).inc()
         self.observe_candidate(endpoint, None if error else latency_ms,
-                               error, trace_id=span.trace_id)
+                               error, trace_id=span.trace_id,
+                               quality=quality)
         return True
+
+    def _shadow_quality(self, endpoint: str, version, imgs, out,
+                        primary_out) -> Optional[Dict[str, float]]:
+        """Quality signals for one shadow comparison: ``divergence`` =
+        1 - mean per-level cosine between primary and candidate outputs
+        on the SAME batch (the direct is-it-the-same-model measure), and
+        the candidate's own ``agreement``/``residual`` from the quality
+        post-pass (does the candidate still PARSE — a candidate can
+        diverge because it is better, but a collapsed parse is not).
+        Best-effort: a missing primary baseline or quality cache just
+        omits those keys."""
+        import numpy as np
+
+        signals: Dict[str, float] = {}
+        if primary_out is not None:
+            div, per_level = _cosine_divergence(
+                np.asarray(primary_out), np.asarray(out))
+            signals["divergence"] = div
+            self.metrics.gauge(
+                "deploy_shadow_divergence",
+                help="1 - mean cosine(primary, candidate) on mirrored "
+                     "batches",
+            ).set(round(div, 6))
+            for i, d in enumerate(per_level):
+                self.metrics.gauge(
+                    f"deploy_shadow_divergence_l{i}",
+                    help="per-level primary-vs-candidate cosine "
+                         "divergence",
+                ).set(round(d, 6))
+            self.metrics.counter(
+                "deploy_shadow_compared",
+                help="mirrored batches judged primary-vs-candidate",
+            ).inc()
+        engine = self.engine
+        qc = getattr(engine, "quality_cache", None)
+        if qc is not None and getattr(imgs, "ndim", 0) == 4:
+            try:
+                mat = np.asarray(qc(version.params, imgs))
+                levels = engine.config.levels
+                signals["agreement"] = float(mat[:, :levels].mean())
+                signals["residual"] = float(mat[:, 3 * levels].mean())
+                engine.poll_quality_compiles()
+                self.metrics.gauge(
+                    "deploy_shadow_agreement",
+                    help="candidate island agreement on mirrored batches",
+                ).set(round(signals["agreement"], 6))
+            except Exception:  # glomlint: disable=conc-broad-except -- the comparison is evidence, not a dependency: a failed post-pass must not fail the mirror
+                pass
+        return signals or None
 
     #: wall-seconds one injected ``candidate:delay`` fault adds (the
     #: chaos scenario's "latency-injected checkpoint")
@@ -575,14 +689,20 @@ class DeployController:
     def observe_candidate(self, endpoint: str,
                           latency_ms: Optional[float], error: bool,
                           trace_id: Optional[str] = None,
-                          tenant: Optional[str] = None) -> None:
+                          tenant: Optional[str] = None,
+                          quality: Optional[Dict[str, float]] = None,
+                          ) -> None:
         """One candidate outcome (shadow execute or live canary request).
         Feeds the candidate evaluators and runs the auto-action logic:
         short-window burn -> rollback; ``promote_after`` clean windows
-        in canary -> promote.  A tenant-scoped SLO judges only that
-        tenant's outcomes, exactly like the primary-side
-        ``SloManager.observe`` (tenantless shadow mirrors are skipped by
-        tenant-scoped targets — they cannot be attributed)."""
+        in canary -> promote.  ``quality`` carries the shadow
+        comparison's signals (``divergence``/``agreement``/…), judged by
+        the quality-kind evaluators with the same burn math — a
+        fast-but-wrong candidate rolls back exactly like a slow one.  A
+        tenant-scoped SLO judges only that tenant's outcomes, exactly
+        like the primary-side ``SloManager.observe`` (tenantless shadow
+        mirrors are skipped by tenant-scoped targets — they cannot be
+        attributed)."""
         action = None
         with self._lock:
             cand = self._cand
@@ -600,6 +720,13 @@ class DeployController:
                     if latency_ms is None:
                         continue
                     bad = latency_ms > slo.threshold_ms
+                elif slo.kind == "quality":
+                    value = None if quality is None else \
+                        quality.get(slo.metric)
+                    if value is None:
+                        continue  # no quality evidence this outcome
+                    bad = (value < slo.threshold if slo.bad_below
+                           else value > slo.threshold)
                 else:
                     bad = error
                 if bad and trace_id is not None:

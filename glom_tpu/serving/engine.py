@@ -72,6 +72,7 @@ from glom_tpu.models import glom as glom_model
 from glom_tpu.models.heads import decoder_apply
 from glom_tpu.obs import MetricRegistry
 from glom_tpu.obs.forensics import ForensicsManager
+from glom_tpu.obs.quality import QualityPlane, make_quality_fn, unpack_signals
 from glom_tpu.obs.slo import SLO, SloManager, parse_slo
 from glom_tpu.obs.tracing import (
     SPAN_BATCH_ASSEMBLY,
@@ -259,6 +260,8 @@ class ServingEngine:
         capacity_window_s: float = 30.0,
         capacity_persist_windows: int = 5,
         capacity_ceiling: Optional[float] = None,
+        quality_sample: float = 1.0,
+        quality_seed: int = 0,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.registry = registry if registry is not None else MetricRegistry()
@@ -520,6 +523,30 @@ class ServingEngine:
                 tracer=self.tracer,
             )
 
+        # -- model-quality telemetry (glom_tpu.obs.quality) ----------------
+        # A jitted post-pass (island agreement / entropy / norms /
+        # reconstruction residual) attached HERE, outside the compile
+        # cache module, as one more AOT-warmed bucketed executable — the
+        # request path never compiles for quality.  Sampling is the PR 9
+        # credit accumulator; signals feed bounded mergeable sketches and
+        # the quality-kind SLOs.  quality_sample <= 0 skips the extra
+        # executable entirely (the plane still exists; it just never
+        # samples).
+        self.quality_cache: Optional[BucketedCompileCache] = None
+        if quality_sample > 0:
+            self.quality_cache = BucketedCompileCache(
+                serving_quant.quantized_forward(
+                    make_quality_fn(serve_cfg, self.train_cfg, iters,
+                                    ff_fn=ff_fn, fused_fn=fused_fn), quant),
+                buckets, name="quality", quant=quant,
+                shardings=shardings, mesh_axes=mesh_axes)
+        self.quality = QualityPlane(
+            self.registry, levels=serve_cfg.levels,
+            sample=quality_sample, seed=quality_seed, clock=self._clock)
+        # the reference profile rides checkpoint conventions: adopt
+        # quality_ref.json beside the checkpoints when one was captured
+        self.quality.load_reference(checkpoint_dir)
+
         # -- model registry (glom_tpu.serving.registry) --------------------
         # Every servable (model, step) is a registry record; the startup
         # tree is the default model's primary, kept in sync by every
@@ -646,6 +673,20 @@ class ServingEngine:
             )
             if self._warmup_dir:
                 self._write_warmup_snapshots(ep, cache)
+        if self.quality_cache is not None and not self.quality_cache.warmed:
+            # the quality post-pass warms per bucket alongside the
+            # endpoint matrix: sampled batches hit already-compiled
+            # executables, so quality telemetry costs zero request-path
+            # compiles (poll_quality_compiles() keeps the counter honest)
+            self.quality_cache.warmup(
+                # glomlint: disable=conc-unguarded-attr -- warmup runs at startup / under the reload lock of the staged path; the watcher that swaps _params is not polling yet
+                self._params,
+                lambda b: jax.ShapeDtypeStruct(
+                    (b, c.channels, c.image_size, c.image_size), np.float32,
+                ),
+            )
+            if self._warmup_dir:
+                self._write_warmup_snapshots("quality", self.quality_cache)
         self.registry.gauge(
             "serving_warmup_seconds",
             help="wall time of the startup AOT compile pass", unit="seconds",
@@ -1171,6 +1212,9 @@ class ServingEngine:
                 )
         served = 0
         primary_imgs = None
+        primary_out = None
+        primary_items = ()
+        primary_params = None
         batch_error = None
         for mkey, items in groups.items():
             imgs = group_imgs[mkey]
@@ -1206,6 +1250,9 @@ class ServingEngine:
                 offset += item.size
             if mkey is None:
                 primary_imgs = imgs
+                primary_out = out
+                primary_items = items
+                primary_params = params
             self._account_batch(endpoint, cache, n, batch_s)
             if mkey is not None and mkey[0] != "default":
                 self.registry.counter(
@@ -1225,7 +1272,13 @@ class ServingEngine:
                             attrs=({} if batch_error is None
                                    else {"error": repr(batch_error)}))
         if primary_imgs is not None and self.deploy.phase == "shadow":
-            self.deploy.mirror(endpoint, primary_imgs)
+            # the primary's outputs ride along: the shadow lane compares
+            # candidate-vs-primary on the SAME mirrored batch
+            self.deploy.mirror(endpoint, primary_imgs, primary_out)
+        if (primary_imgs is not None and self.quality_cache is not None
+                and self.quality.should_sample()):
+            self._observe_quality(endpoint, primary_imgs, primary_items,
+                                  primary_params)
         return served
 
     def _worker_loop(self, endpoint: str) -> None:
@@ -1456,6 +1509,65 @@ class ServingEngine:
                 help="request-path XLA compiles after warmup (must stay 0)",
             ).inc(new_compiles)
 
+    # -- model-quality telemetry (glom_tpu.obs.quality) --------------------
+    def poll_quality_compiles(self) -> None:
+        """Fold the quality post-pass's compile count into the shared
+        ``serving_xla_compiles`` budget — the post-pass is AOT-warmed
+        like every endpoint, so the zero-after-warmup invariant covers
+        it (and a regression here fails the same acceptance)."""
+        qc = self.quality_cache
+        if qc is None:
+            return
+        new_compiles = qc.poll_compiles()
+        if new_compiles:
+            self.registry.counter(
+                "serving_xla_compiles",
+                help="request-path XLA compiles after warmup (must stay 0)",
+            ).inc(new_compiles)
+
+    def _observe_quality(self, endpoint: str, imgs, items, params) -> None:
+        """One SAMPLED primary batch through the quality post-pass: the
+        jitted fn returns PER-IMAGE signal rows (bucket padding was
+        already sliced off by the cache), each request's rows are
+        averaged back to per-request signals, and both the quality plane
+        (sketches/drift/gauges) and the quality-kind SLOs observe them.
+        Telemetry must never fail a served batch: post-pass errors count
+        and return."""
+        import hashlib
+
+        try:
+            mat = np.asarray(self.quality_cache(params, imgs))
+        except Exception:  # glomlint: disable=conc-broad-except -- counted below; telemetry must never fail a served batch
+            self.registry.counter(
+                "quality_post_pass_failures",
+                help="quality post-pass executions that raised "
+                     "(telemetry-only; the served batch was unaffected)",
+            ).inc()
+            return
+        self.poll_quality_compiles()
+        levels = self.config.levels
+        offset = 0
+        for item in items:
+            rows = mat[offset:offset + item.size]
+            offset += item.size
+            if rows.size == 0:
+                continue
+            signals = unpack_signals(rows.mean(axis=0), levels)
+            trace_id = getattr(item.ctx, "trace_id", None)
+            # the INPUT fingerprint: what a quality_drift bundle names so
+            # an offending input is findable after the request is gone
+            fingerprint = hashlib.sha1(
+                np.ascontiguousarray(item.payload).tobytes()).hexdigest()[:16]
+            flat = self.quality.observe(
+                signals, trace_id=trace_id, tenant=item.tenant,
+                version=self.step, fingerprint=fingerprint)  # glomlint: disable=conc-unguarded-attr -- version label only needs to be roughly current; a reload mid-pass mislabels one sample
+            if self._slo is not None:
+                with self._slo_lock:
+                    self._slo.observe_quality(
+                        flat, endpoint=endpoint, trace_id=trace_id,
+                        step=self.request_count,  # glomlint: disable=conc-unguarded-attr -- debounce cursor only needs to be roughly current, same contract as observe_outcome
+                        tenant=item.tenant, fingerprint=fingerprint)
+
     def _observe_saturation(self, endpoint: str) -> None:
         batcher = self.batchers[endpoint]
         # the whole observe-decide-capture path runs under the lock:
@@ -1627,6 +1739,10 @@ class ServingEngine:
             # the capacity summary rides /healthz so the router's health
             # loop feeds its fleet series without a dedicated poll
             "capacity": self.capacity.summary(),
+            # the quality summary rides along the same way — it carries
+            # the serialized live sketches, so the router's health poll
+            # IS the exact fleet-merge feed (merge is associative)
+            "quality": self.quality.summary(),
             "image_size": c.image_size,
             "channels": c.channels,
             "levels": c.levels,

@@ -270,6 +270,17 @@ class FleetRouter:
                 persisted=rec.get("persisted", 0)),
         )
 
+        # fleet quality plane: replica sketch summaries ride the SAME
+        # /healthz fetch; merge is exact (fixed-discretization sketches,
+        # associative bin-wise adds), so fleet p95s are true percentiles
+        # over every replica's observations, not averages of averages
+        from glom_tpu.obs.quality import FleetQualityPlane
+
+        self.quality = FleetQualityPlane(
+            store=self.capacity.store, registry=self.registry,
+            clock=self._clock,
+        )
+
         # consistent-hash ring over ALL replicas (ejection skips forward at
         # lookup time, so only the dead replica's keys move)
         self._ring: List[Tuple[int, Replica]] = sorted(
@@ -394,6 +405,8 @@ class FleetRouter:
             # is still a live probe worth recording
             self.capacity.ingest(replica.name, health.get("capacity"),
                                  t=now)
+            self.quality.ingest(replica.name, health.get("quality"),
+                                t=now)
             with self._lock:
                 was_down = not replica.healthy
                 if not was_down:
@@ -438,6 +451,9 @@ class FleetRouter:
         # one advisor window per health pass: aggregate the freshest
         # per-replica signals and (maybe) emit a recommendation
         self.capacity.evaluate(now)
+        # fleet quality rollup rides the same cadence: exact sketch merge
+        # across replicas, fleet-aggregate series into the shared store
+        self.quality.rollup(now)
 
     def _admit(self, replica: Replica, was_down: bool) -> None:
         """Caller holds the lock."""
@@ -1085,6 +1101,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._reply(200, router.capacity.series_payload(parsed.query))
         elif parsed.path == "/capacity":
             self._reply(200, router.capacity.payload())
+        elif parsed.path == "/quality":
+            # fleet quality rollup: exactly-merged replica sketches plus
+            # the per-replica summaries they were merged from
+            self._reply(200, router.quality.payload())
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 

@@ -237,6 +237,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, engine.capacity.series_payload(parsed.query))
         elif parsed.path == "/capacity":
             self._reply(200, engine.capacity.payload())
+        elif parsed.path == "/quality":
+            # the model-quality telemetry plane (glom_tpu.obs.quality):
+            # sketch stats, drift vs the reference profile, worst offenders
+            self._reply(200, engine.quality.payload())
         elif parsed.path == "/admin/deploy/status":
             self._reply(200, engine.deploy.status())
         else:
@@ -471,6 +475,19 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.path.startswith("/admin/deploy/"):
             self._do_deploy_admin()
+            return
+        if self.path == "/admin/quality/ref":
+            # freeze the CURRENT live quality distributions as the drift
+            # reference profile (written next to the checkpoints, adopted
+            # immediately — see glom_tpu.obs.quality)
+            engine = self.server.engine
+            try:
+                path = engine.quality.save_reference(
+                    engine.checkpoint_dir, step=int(engine.step))
+            except OSError as e:
+                self._reply(500, {"error": f"reference write failed: {e}"})
+                return
+            self._reply(200, {"written": path, "step": int(engine.step)})
             return
         if self.path in ("/session/embed", "/session/reset"):
             self._do_session()
@@ -767,6 +784,12 @@ def main(argv=None) -> int:
                    help="consecutive scale-up windows before the advisor "
                         "fires the debounced capacity_pressure forensics "
                         "incident")
+    p.add_argument("--quality-sample", type=float, default=1.0,
+                   help="fraction of served batches fed through the "
+                        "model-quality post-pass (island agreement, "
+                        "residual, drift sketches — GET /quality).  "
+                        "Deterministic credit sampling; 0 disables the "
+                        "plane entirely")
     p.add_argument("--metrics-timestamps", action="store_true",
                    help="stamp /metrics samples with unix seconds on "
                         "OpenMetrics-negotiated scrapes (aligns scraped "
@@ -848,6 +871,7 @@ def main(argv=None) -> int:
         capacity_ceiling=(args.capacity_ceiling
                           if args.capacity_ceiling is not None
                           else read_bench_ceiling()),
+        quality_sample=args.quality_sample,
     )
     engine.start()
     engine.capacity.start()  # sampler thread: tests tick() with a fake clock

@@ -31,3 +31,27 @@ class SampleStore:
     def snapshot(self):
         with self._lock:
             return list(self._samples)
+
+
+class DriftSketch:
+    """The quality-plane leak shape (PR 17): a 'sketch' that is really a
+    raw sample log.  A streaming sketch earns its name by bounding its
+    bin count; keying a dict on every distinct observed value (or
+    appending every raw sample for an exact quantile later) grows with
+    traffic, not with resolution — one counter per distinct float is
+    the whole stream."""
+
+    def __init__(self):
+        self._counts = {}         # BAD: one key per distinct value
+        self._raw = []            # BAD: raw sample log "for exactness"
+
+    def record(self, value):
+        self._raw.append(value)
+
+    def merge(self, other_counts):
+        for value, n in other_counts.items():
+            self._counts[value] = self._counts.get(value, 0) + n
+
+    def quantile(self, q):
+        ordered = sorted(self._raw)
+        return ordered[int(q * (len(ordered) - 1))] if ordered else None

@@ -10,10 +10,16 @@ The PR 10 boundary: per-session column state is OWNED by
 glom_tpu.serving.sessions; the cache threads it through as an opaque
 array.  A store import or mutation here puts TTL/LRU/spill bookkeeping
 on the hot path.
+
+The PR 17 boundary: the model-quality post-pass runs from the ENGINE's
+separate sampled quality cache; a glom_tpu.obs.quality / .sketch import
+here would put sketch bookkeeping on the request path.
 """
 
 import urllib.request  # BAD: HTTP client import in the execute core
 
+from glom_tpu.obs.quality import QualityPlane  # BAD: quality-plane import in the execute core
+from glom_tpu.obs.sketch import QuantileSketch  # BAD: sketch import in the execute core
 from glom_tpu.serving import sessions  # BAD: state-plane import in the execute core
 
 DEBUG_TRACES = "/debug/traces"  # BAD: debug-plane endpoint reference

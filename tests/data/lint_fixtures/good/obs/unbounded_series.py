@@ -31,3 +31,27 @@ class SampleStore:
     def snapshot(self):
         with self._lock:
             return list(self._samples)
+
+
+class DriftSketch:
+    """The quality-plane bounded form (PR 17, glom_tpu.obs.sketch): a
+    fixed-grid quantile sketch.  Values round onto a finite grid, so the
+    key space is the RESOLUTION, not the stream; the explicit ``len()``
+    cap makes the bound a checked invariant (out-of-budget mass lands in
+    an overflow counter instead of a new bin), and merge inherits it."""
+
+    def __init__(self, resolution=128):
+        self.max_bins = resolution + 1
+        self._counts = {}
+        self.overflow = 0
+
+    def record(self, index, weight=1):
+        if (index not in self._counts
+                and len(self._counts) >= self.max_bins):
+            self.overflow += weight
+            return
+        self._counts[index] = self._counts.get(index, 0) + weight
+
+    def merge(self, other_counts):
+        for index, n in other_counts.items():
+            self.record(index, n)

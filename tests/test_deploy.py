@@ -377,6 +377,16 @@ class TestShadow:
         try:
             _save_step(d, eng, 2)
             assert eng.deploy.begin_shadow() == 2
+            # retire the shadow thread so the manual pump below is
+            # genuinely deterministic (the thread would race for the
+            # queue and usually win now that process_once does quality
+            # work after the mirror)
+            eng.deploy._stop.set()
+            with eng.deploy._shadow_cv:
+                eng.deploy._shadow_cv.notify_all()
+            eng.deploy._shadow_thread.join(timeout=5)
+            assert not eng.deploy._shadow_thread.is_alive()
+            eng.deploy._stop.clear()
             fut = eng.submit("embed", _imgs(1))
             _pump(eng)
             fut.result(timeout=10)
@@ -393,9 +403,14 @@ class TestShadow:
             assert mirrored >= 1
             snap = eng.registry.snapshot()
             assert snap.get("deploy_shadow_requests", 0) == mirrored
-            # candidate evaluators fed; primary SLO evaluators NOT
-            assert sum(len(ev._short)
-                       for ev in eng.deploy._evaluators) == mirrored
+            # candidate evaluators fed — the latency objective AND the
+            # auto-appended divergence guardrail (each mirrored batch is
+            # also a paired primary-vs-candidate quality comparison);
+            # primary SLO evaluators NOT
+            fed = {ev.slo.name: len(ev._short)
+                   for ev in eng.deploy._evaluators}
+            assert fed["p95<10000ms"] == mirrored
+            assert fed["divergence<0.2"] == mirrored
             assert all(len(ev._short) == 0
                        for ev in eng._slo.evaluators)
             assert _xla_compiles(eng) == 0

@@ -1,0 +1,577 @@
+"""Model-quality telemetry plane tests (glom_tpu/obs/sketch.py,
+glom_tpu/obs/quality.py, the quality SLO grammar in glom_tpu/obs/slo.py,
+tools/quality_report.py).
+
+Tier-1 (CPU): the bounded sketches (hard key/bin caps, overflow
+degradation instead of growth, exact ASSOCIATIVE merge — the property
+that makes the fleet rollup a true union rather than an approximation),
+the PSI/KS drift distances, the deterministic credit sampler, the
+quality SLO grammar + multi-window burn firing ONE debounced
+quality_drift bundle that names trace ids AND input fingerprints, the
+engine-side plane (sampled post-pass, zero request-path compiles under
+mixed sampled/unsampled traffic), the fleet plane's exact ingest/merge,
+and two subprocess gates: ``tools/quality_report.py --smoke`` (the
+clean-burst → freeze → corrupt-burst → drift acceptance) and the
+``quality_regression`` chaos scenario (a fast-but-wrong candidate
+caught in SHADOW on quality evidence alone — rolled back before canary
+with zero client-visible errors).
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from glom_tpu.obs.forensics import MANIFEST, ForensicsManager
+from glom_tpu.obs.quality import (
+    CreditSampler,
+    FleetQualityPlane,
+    QUALITY_METRICS,
+    QualityPlane,
+    REFERENCE_FILE,
+    unpack_signals,
+)
+from glom_tpu.obs.registry import MetricRegistry
+from glom_tpu.obs.sketch import (
+    HistogramSketch,
+    QuantileSketch,
+    ks_distance,
+    psi,
+    sketch_from_dict,
+)
+from glom_tpu.obs.slo import SloManager, parse_slo
+from glom_tpu.obs.triggers import TRIGGER_QUALITY_DRIFT, TriggerEngine
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, seconds):
+        self.t += seconds
+
+
+# ---------------------------------------------------------------------------
+# sketches: hard bounds, overflow degradation, exact associative merge
+# ---------------------------------------------------------------------------
+class TestQuantileSketch:
+    def test_memory_hard_bounded(self):
+        s = QuantileSketch(0.0, 1.0, resolution=32, clock=FakeClock())
+        rng = np.random.RandomState(0)
+        for v in rng.uniform(-0.5, 1.5, size=5000):
+            s.record(float(v))
+        assert len(s._counts) <= s.max_bins == 33
+        assert s.count == 5000
+        # out-of-range observations clamped into edge bins AND counted
+        assert s.overflow > 0
+        assert s.min < 0.0 and s.max > 1.0
+
+    def test_nan_goes_to_overflow_only(self):
+        s = QuantileSketch(0.0, 1.0, clock=FakeClock())
+        s.record(float("nan"))
+        s.record(float("inf"))
+        assert s.count == 0 and s.overflow == 2 and not s._counts
+
+    def test_overflow_backstop_never_grows(self):
+        # the guard is unreachable for in-grid indices by construction;
+        # prove the backstop holds even if _index misbehaves
+        s = QuantileSketch(0.0, 1.0, resolution=4, clock=FakeClock())
+        s._counts = {i: 1 for i in range(s.max_bins)}
+        s._index = lambda value: s.resolution + 7  # out-of-cap key
+        before = dict(s._counts)
+        s.record(0.5)
+        assert s._counts == before and s.overflow == 1
+
+    def test_quantile_within_grid_pitch(self):
+        s = QuantileSketch(0.0, 100.0, resolution=100, clock=FakeClock())
+        for v in range(1, 101):
+            s.record(float(v))
+        pitch = (s.hi - s.lo) / s.resolution
+        assert abs(s.quantile(0.5) - 50.0) <= pitch
+        assert abs(s.quantile(0.95) - 95.0) <= pitch
+        assert abs(s.cdf_at(50.0) - 0.5) <= 0.02
+
+    def test_merge_exact_and_associative(self):
+        # integer-aligned values => quantization is exact and the merge
+        # comparison can demand bit-for-bit equality on the counts
+        def make(values):
+            s = QuantileSketch(0.0, 64.0, resolution=64, clock=FakeClock())
+            for v in values:
+                s.record(float(v))
+            return s
+
+        def clone(s):
+            return QuantileSketch.from_dict(s.to_dict(), clock=FakeClock())
+
+        rng = np.random.RandomState(7)
+        parts = [rng.randint(0, 65, size=n).tolist() for n in (40, 25, 60)]
+        a, b, c = (make(p) for p in parts)
+        left = clone(a).merge(clone(b)).merge(clone(c))      # (a ⊕ b) ⊕ c
+        right = clone(a).merge(clone(b).merge(clone(c)))     # a ⊕ (b ⊕ c)
+        union = make([v for p in parts for v in p])          # ground truth
+        assert left._counts == right._counts == union._counts
+        assert left.count == right.count == union.count == 125
+        assert left.sum == right.sum == union.sum
+
+    def test_merge_grid_mismatch_raises(self):
+        a = QuantileSketch(0.0, 1.0, resolution=16, clock=FakeClock())
+        b = QuantileSketch(0.0, 1.0, resolution=32, clock=FakeClock())
+        with pytest.raises(ValueError, match="grid mismatch"):
+            a.merge(b)
+
+    def test_wire_roundtrip(self):
+        s = QuantileSketch(0.0, 2.0, resolution=16, clock=FakeClock())
+        for v in (0.1, 0.5, 0.5, 1.9, 3.0):
+            s.record(v)
+        r = sketch_from_dict(s.to_dict(), clock=FakeClock())
+        assert isinstance(r, QuantileSketch)
+        assert r.to_dict() == s.to_dict()
+
+
+class TestHistogramSketch:
+    def test_fixed_length_and_clamp(self):
+        h = HistogramSketch([0.0, 1.0, 2.0, 3.0], clock=FakeClock())
+        for v in (-5.0, 0.5, 1.5, 2.5, 99.0):
+            h.record(v)
+        assert len(h._counts) == 3          # never changes length
+        assert h.counts() == [2, 1, 2]      # out-of-range clamp to edges
+        assert h.overflow == 2
+        assert h.count == 5
+
+    def test_merge_exact_and_associative(self):
+        edges = [0.0, 1.0, 2.0, 3.0, 4.0]
+
+        def make(values):
+            h = HistogramSketch(edges, clock=FakeClock())
+            for v in values:
+                h.record(float(v))
+            return h
+
+        def clone(h):
+            return HistogramSketch.from_dict(h.to_dict(), clock=FakeClock())
+
+        rng = np.random.RandomState(3)
+        parts = [rng.uniform(0, 4, size=n).tolist() for n in (30, 50, 20)]
+        a, b, c = (make(p) for p in parts)
+        left = clone(a).merge(clone(b)).merge(clone(c))
+        right = clone(a).merge(clone(b).merge(clone(c)))
+        union = make([v for p in parts for v in p])
+        assert left.counts() == right.counts() == union.counts()
+        assert left.count == union.count == 100
+
+    def test_merge_edge_mismatch_raises(self):
+        a = HistogramSketch([0.0, 1.0, 2.0], clock=FakeClock())
+        b = HistogramSketch([0.0, 0.5, 2.0], clock=FakeClock())
+        with pytest.raises(ValueError, match="edge mismatch"):
+            a.merge(b)
+
+
+class TestDriftDistances:
+    def _hist(self, values, edges=(0.0, 0.25, 0.5, 0.75, 1.0)):
+        h = HistogramSketch(edges, clock=FakeClock())
+        for v in values:
+            h.record(float(v))
+        return h
+
+    def _quant(self, values):
+        q = QuantileSketch(0.0, 1.0, resolution=64, clock=FakeClock())
+        for v in values:
+            q.record(float(v))
+        return q
+
+    def test_psi_zero_for_identical_and_large_for_shifted(self):
+        rng = np.random.RandomState(0)
+        base = rng.uniform(0, 1, size=500).tolist()
+        assert psi(self._hist(base), self._hist(list(base))) == pytest.approx(
+            0.0, abs=1e-9)
+        shifted = [min(v * 0.2, 1.0) for v in base]   # mass collapses left
+        assert psi(self._hist(shifted), self._hist(base)) > 0.25
+
+    def test_ks_bounds_and_empty(self):
+        rng = np.random.RandomState(1)
+        lo = rng.uniform(0.0, 0.3, size=200).tolist()
+        hi = rng.uniform(0.7, 1.0, size=200).tolist()
+        d = ks_distance(self._quant(lo), self._quant(hi))
+        assert d == pytest.approx(1.0)                # disjoint supports
+        assert ks_distance(self._quant(lo), self._quant(list(lo))) \
+            == pytest.approx(0.0)
+        assert ks_distance(self._quant([]), self._quant(lo)) == 0.0
+
+
+class TestCreditSampler:
+    def test_long_run_rate_is_exact(self):
+        s = CreditSampler(0.25, seed=0)
+        kept = sum(s.decide() for _ in range(1000))
+        # credit accumulation keeps EXACTLY fraction*n (±1 for the
+        # in-flight credit) — no binomial variance, no unlucky clumps
+        assert abs(kept - 250) <= 1
+        assert s.decided == 1000 and s.kept == kept
+
+    def test_edges_and_determinism(self):
+        assert not any(CreditSampler(0.0).decide() for _ in range(100))
+        assert all(CreditSampler(1.0).decide() for _ in range(100))
+        a = [CreditSampler(0.3, seed=5).decide() for _ in range(50)]
+        b = [CreditSampler(0.3, seed=5).decide() for _ in range(50)]
+        assert a == b
+
+    def test_keeps_spread_not_clumped(self):
+        s = CreditSampler(0.1, seed=2)
+        keeps = [i for i in range(300) if s.decide()]
+        assert abs(len(keeps) - 30) <= 1
+        gaps = [b - a for a, b in zip(keeps, keeps[1:])]
+        # a keep can spend up to a full credit past the pick, so the gap
+        # bound is 2/fraction, not 1/fraction — but never worse
+        assert max(gaps) <= 20
+
+
+# ---------------------------------------------------------------------------
+# quality SLO grammar + burn → ONE debounced quality_drift bundle
+# ---------------------------------------------------------------------------
+class TestQualitySloGrammar:
+    def test_parse_forms(self):
+        s = parse_slo("embed:agreement>0.55")
+        assert (s.kind, s.metric, s.endpoint) == ("quality", "agreement",
+                                                  "embed")
+        assert s.threshold == 0.55 and s.bad_below  # '>' = bad when below
+        assert s.objective == 0.9                   # quality default
+        s = parse_slo("drift<0.25")
+        assert (s.kind, s.metric, s.bad_below) == ("quality", "drift", False)
+        s = parse_slo("acme/embed:residual<2.0")
+        assert (s.tenant, s.endpoint, s.metric) == ("acme", "embed",
+                                                    "residual")
+        s = parse_slo("divergence<0.2")
+        assert s.metric == "divergence"
+
+    def test_kinds_coexist(self):
+        kinds = {parse_slo(x).kind for x in
+                 ("p95<250ms", "errors<1%", "agreement>0.5")}
+        assert kinds == {"latency", "error_rate", "quality"}
+
+    def test_unknown_metric_rejected(self):
+        with pytest.raises(ValueError, match="unparseable"):
+            parse_slo("sharpness>0.5")
+
+    def test_outcome_path_skips_quality_evaluators(self):
+        clock = FakeClock()
+        mgr = SloManager([parse_slo("agreement>0.5", min_events=2)],
+                         clock=clock)
+        for _ in range(10):
+            mgr.observe("embed", 1.0, True)   # errors, not quality signals
+        assert len(mgr.evaluators[0]._short) == 0
+
+    def test_burn_fires_one_debounced_bundle_with_fingerprints(self, tmp_path):
+        clock = FakeClock()
+        reg = MetricRegistry()
+        trig = TriggerEngine(debounce_steps=200, max_captures=3)
+        fm = ForensicsManager(str(tmp_path), config={},
+                              snapshot_fn=lambda: None, clock=clock)
+        slo = parse_slo("embed:agreement>0.55", short_window_s=10,
+                        long_window_s=20, min_events=4, burn_threshold=1.0)
+        mgr = SloManager([slo], clock=clock, registry=reg, triggers=trig,
+                         forensics=fm)
+        fired = []
+        for i in range(12):
+            fired += mgr.observe_quality(
+                {"agreement": 0.1}, endpoint="embed", trace_id=f"t{i}",
+                fingerprint=f"fp{i}", step=i)
+            clock.advance(0.5)
+        assert len(fired) == 1  # every breach observed, ONE survives debounce
+        detail = fired[0]
+        assert detail["metric"] == "agreement"
+        assert detail["value"] == pytest.approx(0.1)
+        assert detail["threshold"] == 0.55
+        assert detail["trace_ids"]
+        # the bundle names the INPUTS, not just the requests
+        assert detail["fingerprints"]
+        assert all(detail["fingerprints"][t] == "fp" + t[1:]
+                   for t in detail["fingerprints"])
+        bundles = [d for d in os.listdir(tmp_path)
+                   if d.startswith(TRIGGER_QUALITY_DRIFT + "-")]
+        assert len(bundles) == 1
+        with open(os.path.join(tmp_path, bundles[0], MANIFEST)) as f:
+            manifest = json.load(f)
+        assert manifest["detail"]["fingerprints"] == detail["fingerprints"]
+        assert reg.snapshot()["quality_drift_events"] == 1
+
+    def test_good_signals_never_fire(self):
+        clock = FakeClock()
+        mgr = SloManager([parse_slo("agreement>0.55", min_events=4,
+                                    burn_threshold=1.0)], clock=clock)
+        fired = []
+        for i in range(20):
+            fired += mgr.observe_quality({"agreement": 0.9}, step=i)
+            clock.advance(0.5)
+        assert fired == []
+
+
+# ---------------------------------------------------------------------------
+# engine-side plane (host half, no jax)
+# ---------------------------------------------------------------------------
+def _signals(agree=0.8, entropy=0.5, norm=1.0, residual=0.3, levels=3):
+    return {
+        "agreement_levels": [agree] * levels,
+        "entropy_levels": [entropy] * levels,
+        "norm_levels": [norm] * levels,
+        "residual": residual,
+    }
+
+
+class TestQualityPlane:
+    def test_observe_exports_gauges_and_sketches(self):
+        reg = MetricRegistry()
+        plane = QualityPlane(reg, levels=3, clock=FakeClock())
+        flat = plane.observe(_signals(agree=0.7), trace_id="t0",
+                             tenant="acme", version=5, fingerprint="fp0")
+        assert flat["agreement"] == pytest.approx(0.7)
+        assert flat["drift"] == 0.0  # no reference => no evidence
+        snap = reg.snapshot()
+        assert snap["quality_agreement"] == pytest.approx(0.7)
+        assert snap["quality_agreement_l0"] == pytest.approx(0.7)
+        assert snap["quality_observed_total"] == 1
+        assert plane.live["agreement"]["quantile"].count == 1
+        pay = plane.payload()
+        assert pay["observed"] == 1
+        assert set(pay["metrics"]) == set(QUALITY_METRICS)
+
+    def test_reference_roundtrip_and_drift(self, tmp_path):
+        plane = QualityPlane(None, levels=2, clock=FakeClock())
+        rng = np.random.RandomState(0)
+        for _ in range(50):
+            plane.observe(_signals(agree=float(rng.uniform(0.6, 0.8)),
+                                   levels=2))
+        path = plane.save_reference(str(tmp_path), step=7)
+        assert os.path.basename(path) == REFERENCE_FILE
+        # identical live/reference => zero drift
+        assert plane.observe(_signals(agree=0.7, levels=2))["drift"] \
+            < 0.1
+        # a fresh plane loads the same file (the engine-restart path)
+        other = QualityPlane(None, levels=2, clock=FakeClock())
+        assert other.load_reference(str(tmp_path))
+        assert other.reference_meta["step"] == 7
+        # shift the live distribution => drift rises and is reported
+        for _ in range(50):
+            other.observe(_signals(agree=float(rng.uniform(-0.3, -0.1)),
+                                   levels=2))
+        assert other.drift()["max_ks"] > 0.5
+        assert other.drift()["agreement"]["ks"] > 0.5
+
+    def test_fingerprints_and_worst_bounded(self):
+        plane = QualityPlane(None, levels=1, worst_n=4, clock=FakeClock())
+        for i in range(plane.MAX_FINGERPRINTS + 50):
+            plane.observe(_signals(agree=0.5 + (i % 7) * 0.01, levels=1),
+                          trace_id=f"t{i}", fingerprint=f"fp{i}")
+        assert len(plane._fingerprints) == plane.MAX_FINGERPRINTS
+        assert len(plane.payload()["worst"]) == 4
+        assert plane.fingerprints(["t5"]) == {}          # evicted
+        last = f"t{plane.MAX_FINGERPRINTS + 49}"
+        assert plane.fingerprints([last]) == {last: "fp" + last[1:]}
+
+    def test_unpack_signals_shape_checked(self):
+        out = unpack_signals([0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.9], levels=2)
+        assert out["agreement_levels"] == [0.1, 0.2]
+        assert out["residual"] == 0.9
+        with pytest.raises(ValueError, match="columns"):
+            unpack_signals([0.0] * 5, levels=2)
+
+
+class TestFleetQualityPlane:
+    def _replica_plane(self, seed, n=40):
+        plane = QualityPlane(None, levels=2, clock=FakeClock())
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            plane.observe(_signals(agree=float(rng.uniform(0.2, 0.9)),
+                                   residual=float(rng.uniform(0.0, 2.0)),
+                                   levels=2))
+        return plane
+
+    def test_fleet_merge_is_exact_union(self):
+        a, b, c = (self._replica_plane(s) for s in (0, 1, 2))
+        fleet = FleetQualityPlane(clock=FakeClock())
+        for name, p in (("r0", a), ("r1", b), ("r2", c)):
+            fleet.ingest(name, p.summary())
+        merged = fleet.merged_sketches()
+        # the fleet distribution is the true union of every replica's
+        # observations — counts add exactly, nothing is resampled
+        for m in QUALITY_METRICS:
+            assert merged[m]["quantile"].count == 120
+            by_key = {}
+            for p in (a, b, c):
+                for k, v in p.live[m]["quantile"]._counts.items():
+                    by_key[k] = by_key.get(k, 0) + v
+            assert merged[m]["quantile"]._counts == by_key
+
+    def test_merge_order_irrelevant(self):
+        planes = [self._replica_plane(s) for s in (3, 4, 5)]
+        views = []
+        for order in ((0, 1, 2), (2, 0, 1)):
+            fleet = FleetQualityPlane(clock=FakeClock())
+            for i in order:
+                fleet.ingest(f"r{i}", planes[i].summary())
+            # counts/count are integer-exact; sums are floats whose ADD
+            # order varies with ingest order, so the exactness claim is
+            # about the distributions, not last-ulp float identity
+            views.append({m: (p["quantile"]._counts, p["quantile"].count,
+                              p["hist"].counts())
+                          for m, p in fleet.merged_sketches().items()})
+        assert views[0] == views[1]
+
+    def test_ingest_none_safe_and_rollup(self):
+        fleet = FleetQualityPlane(registry=MetricRegistry(),
+                                  clock=FakeClock())
+        fleet.ingest("old-replica", None)   # pre-plane replica: no crash
+        fleet.ingest("r0", self._replica_plane(6).summary())
+        roll = fleet.rollup()
+        assert roll["replicas"] == 1
+        assert "agreement" in roll["signals"]
+        pay = fleet.payload()
+        assert pay["role"] == "router"
+        assert set(pay["replicas"]) == {"r0"}
+
+
+# ---------------------------------------------------------------------------
+# engine-backed: sampled post-pass, zero request-path compiles
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def ckpt_dir(tmp_path_factory):
+    from glom_tpu.serving.engine import make_demo_checkpoint
+
+    d = str(tmp_path_factory.mktemp("quality_ckpt"))
+    make_demo_checkpoint(d)
+    return d
+
+
+def _imgs(k=1, size=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randn(k, 3, size, size).astype(np.float32)
+
+
+def _engine(ckpt, **kw):
+    from glom_tpu.serving.engine import ServingEngine
+
+    kw.setdefault("buckets", (1, 2))
+    kw.setdefault("max_wait_ms", 0.0)
+    kw.setdefault("warmup", True)
+    kw.setdefault("reload_poll_s", 0)
+    eng = ServingEngine(ckpt, **kw)
+    eng.start(workers=False, watch=False)
+    return eng
+
+
+class TestEngineQuality:
+    def test_sampled_traffic_zero_request_path_compiles(self, ckpt_dir):
+        # 0.5 sampling: some batches take the post-pass, some skip it —
+        # BOTH paths must be compile-free (the post-pass is AOT-warmed
+        # per bucket alongside the endpoint matrix)
+        eng = _engine(ckpt_dir, quality_sample=0.5)
+        try:
+            for i in range(8):
+                eng.submit("embed", _imgs(1, seed=i))
+                while eng.process_once("embed"):
+                    pass
+            snap = eng.registry.snapshot()
+            assert snap.get("serving_xla_compiles", 0) == 0
+            q = eng.quality
+            assert q.sampler.decided == 8
+            assert 0 < q.observed < 8          # genuinely mixed traffic
+            assert q.observed == q.sampler.kept
+            pay = eng.quality.payload()
+            assert pay["signals"]["agreement_levels"]
+            assert snap["quality_observed_total"] == q.observed
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_drift_slo_fires_one_bundle_with_fingerprints(
+            self, ckpt_dir, tmp_path):
+        fdir = str(tmp_path / "forensics")
+        slo = parse_slo("drift<0.2", short_window_s=60, long_window_s=120,
+                        min_events=4, burn_threshold=1.0)
+        eng = _engine(ckpt_dir, quality_sample=1.0, slos=[slo],
+                      forensics_dir=fdir)
+        try:
+            # clean traffic, then freeze it as the reference profile
+            for i in range(6):
+                eng.submit("embed", _imgs(1, seed=i))
+                while eng.process_once("embed"):
+                    pass
+            # frozen into tmp_path, NOT the module-shared checkpoint dir
+            # (a quality_ref.json there would leak into other engines)
+            eng.quality.save_reference(str(tmp_path), step=int(eng.step))
+            # corrupt traffic: heavy noise + occlusion (the loadgen
+            # --corrupt recipe) must push live KS drift over the SLO
+            rng = np.random.RandomState(99)
+            for i in range(12):
+                bad = _imgs(1, seed=i) + 2.5 * rng.randn(
+                    1, 3, 16, 16).astype(np.float32)
+                bad[..., :8, :] = 0.0
+                # a traced request, so the bundle can NAME the offender
+                root = eng.tracer.start_trace("embed")
+                eng.submit("embed", bad, ctx=root)
+                while eng.process_once("embed"):
+                    pass
+                eng.tracer.end(root)
+            snap = eng.registry.snapshot()
+            assert snap["quality_drift"] > 0.2
+            assert snap.get("serving_xla_compiles", 0) == 0
+            bundles = [d for d in os.listdir(fdir)
+                       if d.startswith(TRIGGER_QUALITY_DRIFT + "-")]
+            assert len(bundles) == 1           # debounced: one per burst
+            with open(os.path.join(fdir, bundles[0], MANIFEST)) as f:
+                manifest = json.load(f)
+            detail = manifest["detail"]
+            assert detail["metric"] == "drift"
+            assert detail["value"] > 0.2
+            assert detail["trace_ids"]
+            assert detail["fingerprints"]      # which INPUTS drifted
+        finally:
+            eng.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 subprocess gates (the chaos.py pattern)
+# ---------------------------------------------------------------------------
+class TestQualitySmoke:
+    def test_smoke_suite(self):
+        """tools/quality_report.py --smoke: engine + router in-process, a
+        clean burst freezes the reference, a corrupt burst crosses the
+        drift SLO and fires ONE quality_drift bundle with fingerprints,
+        the router merges the replica's sketches, zero request-path
+        compiles."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(ROOT, "tools", "quality_report.py"), "--smoke"],
+            capture_output=True, text=True, timeout=280, env=env, cwd=ROOT,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        summary = json.loads(proc.stdout)
+        assert summary["smoke"] == "ok"
+        assert all(summary["checks"].values()), summary["checks"]
+        assert summary["drift_after"] > 0.2 > summary["drift_before"]
+        assert summary["xla_compiles"] == 0
+
+    def test_quality_regression_scenario_subprocess(self):
+        """tools/chaos.py --smoke --scenario quality_regression: a
+        bit-flipped candidate loads clean and serves fast — only the
+        shadow lane's paired quality comparison catches it.  Rollback on
+        quality burn alone, BEFORE canary, zero client-visible errors."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "chaos.py"),
+             "--smoke", "--scenario", "quality_regression"],
+            capture_output=True, text=True, timeout=280, env=env, cwd=ROOT,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        rec = json.loads(proc.stdout.splitlines()[0])
+        assert rec["outcome"] == "recovered"
+        assert rec["requests_error"] == 0
+        assert rec["shadow_divergence"] > 0.2
+        assert rec["mttr_s"] >= 0.0

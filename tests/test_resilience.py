@@ -728,7 +728,7 @@ class TestChaosCli:
         assert set(mod.SCENARIOS) == {
             "torn_ckpt_write", "corrupt_restore", "nan_batch",
             "reload_io_error", "train_crash", "replica_kill",
-            "canary_regression",
+            "canary_regression", "quality_regression",
             "host_preempt", "coordinator_loss", "shrink_restart",
         }
 
@@ -745,7 +745,7 @@ class TestChaosCli:
         assert proc.returncode == 0, proc.stdout + proc.stderr
         with open(out_json) as f:
             summary = json.load(f)
-        assert summary["recovered"] == summary["total"] == 10
+        assert summary["recovered"] == summary["total"] == 11
         for rec in summary["results"]:
             assert rec["outcome"] == "recovered", rec
             assert rec["mttr_s"] >= 0.0
@@ -764,4 +764,4 @@ class TestChaosSoak:
         assert proc.returncode == 0, proc.stdout + proc.stderr
         with open(out_json) as f:
             summary = json.load(f)
-        assert summary["recovered"] == summary["total"] == 10
+        assert summary["recovered"] == summary["total"] == 11

@@ -656,6 +656,178 @@ def scenario_canary_regression(soak):
                 "rollback_bundle": bundles[0]}
 
 
+def scenario_quality_regression(soak):
+    """A FAST-BUT-WRONG deploy candidate: its weights are corrupted at
+    load (``candidate_load:bitflip``, fired AFTER integrity verification,
+    so the checkpoint verifies clean and the candidate serves quickly and
+    without errors — every latency/error SLO stays green).  Only the
+    shadow lane's paired quality comparison (per-level cosine divergence
+    against the primary's output on the SAME mirrored batches) can see
+    the regression: the ``divergence`` quality guardrail burns and the
+    auto-rollback retreats while the candidate is still SHADOW —
+    before any canary exposure, with zero client-visible errors — and
+    the ``deploy_rollback`` bundle names the quality SLO that fired."""
+    import json
+    import threading
+    import urllib.request
+
+    import jax
+    import numpy as np
+
+    from glom_tpu import checkpoint as ckpt_lib
+    from glom_tpu.obs.slo import parse_slo
+    from glom_tpu.resilience import faultinject
+    from glom_tpu.serving.engine import ServingEngine, make_demo_checkpoint
+    from glom_tpu.serving.router import FleetRouter, make_router_server
+    from glom_tpu.serving.server import make_server
+
+    min_requests = 30 if not soak else 150
+    with tempfile.TemporaryDirectory() as root:
+        ckpt = os.path.join(root, "ckpt")
+        fdir = os.path.join(root, "forensics")
+        make_demo_checkpoint(ckpt)
+        # latency/error SLOs are deliberately LOOSE: they must stay
+        # green for the whole scenario — quality alone drives the retreat
+        engine = ServingEngine(
+            ckpt, buckets=(1, 2), max_wait_ms=1.0, warmup=True,
+            reload_poll_s=0, forensics_dir=fdir,
+            slos=[parse_slo("p95<60000ms", short_window_s=2.0,
+                            long_window_s=4.0, min_events=4,
+                            burn_threshold=2.0)],
+            quality_sample=1.0,
+        )
+        engine.start(watch=False)
+        srv = make_server(engine)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        eng_url = "http://{}:{}".format(*srv.server_address[:2])
+        router = FleetRouter([eng_url], health_interval_s=0.2)
+        router.start()
+        rsrv = make_router_server(router)
+        threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+        rurl = "http://{}:{}".format(*rsrv.server_address[:2])
+        engine.deploy.pin_url = rurl
+
+        def admin(action, payload=None):
+            req = urllib.request.Request(
+                f"{eng_url}/admin/deploy/{action}",
+                data=json.dumps(payload or {}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read())
+
+        ckpt_lib.save(ckpt, 2, {"params": jax.device_get(engine._template)})
+        rng = np.random.RandomState(0)
+        body = json.dumps({"images": rng.randn(
+            1, 3, 16, 16).astype(np.float32).tolist()}).encode()
+        stop = threading.Event()
+        lock = threading.Lock()
+        counts = {"ok": 0, "error": 0}
+        reached_canary = threading.Event()
+
+        def load(worker):
+            i = 0
+            while not stop.is_set():
+                i += 1
+                req = urllib.request.Request(
+                    f"{rurl}/embed", data=body,
+                    headers={"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        r.read()
+                    with lock:
+                        counts["ok"] += 1
+                except Exception:  # glomlint: disable=conc-broad-except -- the client-visible error count IS the scenario's acceptance signal
+                    with lock:
+                        counts["error"] += 1
+
+        workers = [threading.Thread(target=load, args=(w,), daemon=True)
+                   for w in range(4)]
+        for w in workers:
+            w.start()
+        try:
+            # the candidate's weights are corrupted AT LOAD — the
+            # checkpoint on disk verifies clean, so quarantine cannot
+            # save us; this is the failure class only quality catches
+            with faultinject.injected("candidate_load:bitflip"):
+                t_fault = time.monotonic()
+                resp = admin("shadow", {"step": 2})
+                assert resp["candidate_step"] == 2, resp
+                deadline = time.monotonic() + 45
+                while time.monotonic() < deadline:
+                    if engine.deploy.phase == "canary":
+                        reached_canary.set()
+                    if engine.registry.snapshot().get(
+                            "deploy_rollbacks_total", 0) >= 1:
+                        break
+                    time.sleep(0.02)
+                mttr = time.monotonic() - t_fault
+            snap = engine.registry.snapshot()
+            assert snap.get("deploy_rollbacks_total", 0) == 1, (
+                "quality auto-rollback never fired")
+            # the whole point: caught in SHADOW, zero canary exposure
+            assert not reached_canary.is_set(), (
+                "corrupt candidate reached canary before quality caught it")
+            assert engine.deploy.phase == "idle"
+            assert engine.step == 0, "primary pin moved during a shadow"
+            # the shadow lane measured real divergence past the guardrail
+            assert snap.get("deploy_shadow_compared", 0) >= 4, snap
+            assert snap.get("deploy_shadow_divergence", 0.0) > 0.2, snap
+            # keep load flowing: post-rollback traffic is all-primary
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                with lock:
+                    if counts["ok"] >= min_requests:
+                        break
+                time.sleep(0.02)
+        finally:
+            stop.set()
+            for w in workers:
+                w.join(timeout=10)
+
+        with lock:
+            done = dict(counts)
+        assert done["ok"] >= min_requests, done
+        # ZERO client-visible errors: the candidate never served a
+        # client, and the retreat was invisible to callers
+        assert done["error"] == 0, done
+        # the rollback bundle blames the QUALITY guardrail, not latency.
+        # The rollbacks counter ticks BEFORE the bundle write lands, so
+        # give the capture a moment instead of racing it.
+        deadline = time.monotonic() + 10
+        bundles = []
+        while time.monotonic() < deadline:
+            if os.path.isdir(fdir):
+                bundles = [d for d in os.listdir(fdir)
+                           if d.startswith("deploy_rollback-")]
+                if bundles:
+                    break
+            time.sleep(0.05)
+        assert len(bundles) == 1, bundles
+        with open(os.path.join(fdir, bundles[0], "manifest.json")) as f:
+            detail = json.load(f)["detail"]
+        assert detail["reason"] == "burn_rate", detail
+        assert "divergence" in detail.get("slo", ""), detail
+        assert detail["phase_at_rollback"] == "shadow", detail
+        assert detail["pins"] == {"before": 2, "after": 0}, detail
+        # the fleet never pinned to the candidate
+        assert router.fleet_step in (None, 0), router.fleet_step
+
+        router.shutdown()
+        rsrv.shutdown()
+        rsrv.server_close()
+        srv.shutdown()
+        srv.server_close()
+        engine.shutdown(drain=False)
+        return {"mttr_s": mttr,
+                "requests_ok": done["ok"],
+                "requests_error": done["error"],
+                "shadow_compared": int(snap.get(
+                    "deploy_shadow_compared", 0)),
+                "shadow_divergence": round(float(snap.get(
+                    "deploy_shadow_divergence", 0.0)), 4),
+                "rollback_bundle": bundles[0]}
+
+
 # -- elastic multi-host scenarios (glom_tpu/resilience/elastic.py) ---------
 
 def _elastic_run(*, hosts, steps, batch, spec, ckpt_dir, slots=None, seed=0):
@@ -808,6 +980,7 @@ SCENARIOS = {
     "train_crash": scenario_train_crash,
     "replica_kill": scenario_replica_kill,
     "canary_regression": scenario_canary_regression,
+    "quality_regression": scenario_quality_regression,
     "host_preempt": scenario_host_preempt,
     "coordinator_loss": scenario_coordinator_loss,
     "shrink_restart": scenario_shrink_restart,

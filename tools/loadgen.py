@@ -115,6 +115,15 @@ def parse_args(argv=None):
                         "gains a per_tenant section (p50/p95/shed_rate); "
                         "quota sheds (503 tenant_overloaded) count as "
                         "sheds, not errors")
+    p.add_argument("--corrupt", type=float, default=0.0, metavar="FRAC",
+                   help="deterministically perturb this fraction of "
+                        "requests (seeded heavy noise + half-image "
+                        "occlusion) — drives the serving quality plane's "
+                        "drift score off its reference profile without "
+                        "touching latency or error rates.  Selection is a "
+                        "stratified index walk, so the same FRAC always "
+                        "corrupts the same requests; the report gains a "
+                        "requests_corrupted count")
     p.add_argument("--timeline", action="store_true",
                    help="window the run into per-second "
                         "throughput/p95/error buckets in the report "
@@ -173,6 +182,33 @@ def _make_payloads(health, batch_sizes):
     }
 
 
+def _make_corrupt_payloads(health, batch_sizes, seed=1):
+    """``--corrupt`` bodies: the SAME base images (seed 0) plus seeded
+    heavy noise and a half-image occlusion — a distribution shift the
+    quality plane's drift sketches must catch, while the request stays
+    perfectly well-formed (no latency/error signal)."""
+    import numpy as np
+
+    c, s = health["channels"], health["image_size"]
+    base = np.random.RandomState(0)
+    noise = np.random.RandomState(seed)
+    out = {}
+    for b in batch_sizes:
+        imgs = base.randn(b, c, s, s).astype("float32")
+        imgs = imgs + 2.5 * noise.randn(b, c, s, s).astype("float32")
+        imgs[..., : s // 2, :] = 0.0  # occlude the top half
+        out[b] = json.dumps({"images": imgs.tolist()}).encode()
+    return out
+
+
+def _corrupt_this(i, frac):
+    """Stratified deterministic pick: request ``i`` is corrupted iff the
+    integer part of the running credit ``(i + 1) * frac`` advanced —
+    exactly ``floor(n * frac)`` picks over any prefix of n requests,
+    evenly spread, same picks for the same frac every run."""
+    return frac > 0 and int((i + 1) * frac) > int(i * frac)
+
+
 class _Results:
     def __init__(self, timeline=False):
         self.lock = threading.Lock()
@@ -185,6 +221,7 @@ class _Results:
         self.ok = 0
         self.shed = 0
         self.errors = 0
+        self.corrupted = 0       # --corrupt: requests sent perturbed
         self.id_mismatches = 0   # X-Request-Id failed to round-trip
         # per-replica breakdown (fleet mode): key = the router's
         # X-Served-By echo when present, else the target URL the request
@@ -288,7 +325,8 @@ def parse_tenants(specs):
 
 
 def run_closed(urls, endpoint, payloads, batch_sizes, n_requests, concurrency,
-               timeout, results, tenants=None):
+               timeout, results, tenants=None, corrupt_payloads=None,
+               corrupt_frac=0.0):
     idx_lock = threading.Lock()
     counter = [0]
 
@@ -304,8 +342,13 @@ def run_closed(urls, endpoint, payloads, batch_sizes, n_requests, concurrency,
             # the two list lengths would pin each target to a fixed
             # batch-size subset and skew the per-replica comparison
             b = batch_sizes[(i // len(urls)) % len(batch_sizes)]
+            body = payloads[b]
+            if corrupt_payloads is not None and _corrupt_this(i, corrupt_frac):
+                body = corrupt_payloads[b]
+                with results.lock:
+                    results.corrupted += 1
             t0 = time.monotonic()
-            _send(urls[i % len(urls)], endpoint, payloads[b], b, timeout,
+            _send(urls[i % len(urls)], endpoint, body, b, timeout,
                   results, t0, request_id=f"lg-{os.getpid()}-{i}",
                   multi_target=len(urls) > 1,
                   tenant=tenants[i % len(tenants)] if tenants else None)
@@ -321,7 +364,7 @@ def run_closed(urls, endpoint, payloads, batch_sizes, n_requests, concurrency,
 
 
 def run_open(urls, endpoint, payloads, batch_sizes, rate, duration, timeout,
-             results, tenants=None):
+             results, tenants=None, corrupt_payloads=None, corrupt_frac=0.0):
     """Fixed arrival schedule: request i fires at ``i / rate`` seconds
     whether or not earlier ones finished (one thread per in-flight
     request; the OS scheduler is the arrival clock)."""
@@ -335,9 +378,14 @@ def run_open(urls, endpoint, payloads, batch_sizes, rate, duration, timeout,
             time.sleep(delay)
         # per-target-round batch cycling — see run_closed for why
         b = batch_sizes[(i // len(urls)) % len(batch_sizes)]
+        body = payloads[b]
+        if corrupt_payloads is not None and _corrupt_this(i, corrupt_frac):
+            body = corrupt_payloads[b]
+            with results.lock:
+                results.corrupted += 1
         t = threading.Thread(
             target=_send,
-            args=(urls[i % len(urls)], endpoint, payloads[b], b, timeout,
+            args=(urls[i % len(urls)], endpoint, body, b, timeout,
                   results, time.monotonic()),
             kwargs={"request_id": f"lg-{os.getpid()}-{i}",
                     "multi_target": len(urls) > 1,
@@ -610,6 +658,7 @@ def report(results, wall_s, mode, slow_n=0):
         "requests_ok": results.ok,
         "requests_shed": results.shed,
         "requests_error": results.errors,
+        "requests_corrupted": results.corrupted,
         "request_id_mismatches": results.id_mismatches,
         "images_ok": results.images_ok,
         "wall_seconds": round(wall_s, 3),
@@ -941,17 +990,24 @@ def main(argv=None) -> int:
                   file=sys.stderr)
         return 0 if ok else 1
     payloads = _make_payloads(health, batch_sizes)
+    corrupt_payloads = (_make_corrupt_payloads(health, batch_sizes)
+                        if args.corrupt > 0 else None)
     tenants = parse_tenants(args.tenant) if args.tenant else None
     if args.rate > 0:
         wall = run_open(urls, args.endpoint, payloads, batch_sizes,
                         args.rate, args.duration, args.timeout, results,
-                        tenants=tenants)
+                        tenants=tenants, corrupt_payloads=corrupt_payloads,
+                        corrupt_frac=args.corrupt)
         mode = f"open({args.rate}/s)"
     else:
         wall = run_closed(urls, args.endpoint, payloads, batch_sizes,
                           args.requests, args.concurrency, args.timeout,
-                          results, tenants=tenants)
+                          results, tenants=tenants,
+                          corrupt_payloads=corrupt_payloads,
+                          corrupt_frac=args.corrupt)
         mode = f"closed(c={args.concurrency})"
+    if args.corrupt > 0:
+        mode += f" corrupt({args.corrupt})"
     if tenants:
         mode += f" tenants({','.join(sorted(set(tenants)))})"
     if len(urls) > 1:

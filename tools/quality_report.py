@@ -1,0 +1,289 @@
+#!/usr/bin/env python
+"""Quality-plane CLI: read a live engine's (or router's) model-quality
+telemetry, freeze a drift reference profile, and run the CI smoke.
+
+  python tools/quality_report.py report --url http://127.0.0.1:8000
+  python tools/quality_report.py report --url ... --format json
+  python tools/quality_report.py freeze --url http://127.0.0.1:8000
+  python tools/quality_report.py --smoke
+
+``report`` renders ``GET /quality`` — per-metric live-vs-reference
+sketch stats (count / mean / p50 / p95), the PSI + KS drift scores, the
+latest sampled signals, and the worst-N offending requests with their
+trace ids and input fingerprints.  Against a router URL it shows the
+EXACTLY-merged fleet view instead.  ``freeze`` POSTs
+``/admin/quality/ref``: the current live distributions become the
+reference profile (``quality_ref.json`` next to the checkpoints).  Both
+speak plain stdlib HTTP — no jax.
+
+``--smoke`` is the acceptance loop the CI job runs: demo checkpoint ->
+engine (+ router) in-process with a tight drift SLO, a clean burst
+establishes the reference profile, then a ``--corrupt``-style burst of
+perturbed inputs (same bodies ``tools/loadgen.py --corrupt`` sends) must
+push the live KS drift over the SLO and fire exactly ONE debounced
+``quality_drift`` forensics bundle carrying trace ids + input
+fingerprints — while the request path never compiles
+(``serving_xla_compiles == 0``) and no request errors.  The router leg
+asserts the replica's sketches were ingested from ``/healthz`` and
+merged into the fleet ``/quality`` view.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+# The smoke's drift SLO: the corrupt burst shifts norm/residual mass far
+# outside the clean range, so the live-vs-reference KS gap approaches
+# corrupt/(clean+corrupt) ~ 0.67 — a 0.2 bound is decisive for the
+# plumbing without being a tuned model threshold real noise could graze.
+SMOKE_DRIFT_SLO = "drift<0.2"
+SMOKE_CLEAN = 8
+SMOKE_CORRUPT = 16
+
+
+def _get_json(url: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post_json(url: str, timeout: float = 10.0) -> dict:
+    req = urllib.request.Request(url, data=b"{}", method="POST",
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+# ---------------------------------------------------------------------------
+# report / freeze
+# ---------------------------------------------------------------------------
+def _fmt(v, spec=".4g"):
+    return "—" if v is None else format(v, spec)
+
+
+def _stats_row(stats):
+    if not stats:
+        return "—", "—", "—", "—"
+    return (_fmt(stats.get("count"), "d"), _fmt(stats.get("mean")),
+            _fmt(stats.get("p50")), _fmt(stats.get("p95")))
+
+
+def cmd_report(args) -> int:
+    doc = _get_json(f"{args.url.rstrip('/')}/quality", args.timeout)
+    if args.format == "json":
+        print(json.dumps(doc, indent=2))
+        return 0
+    if doc.get("role") == "router":
+        fleet = doc.get("fleet", {})
+        print(f"quality @ {args.url}   role=router   "
+              f"replicas={fleet.get('replicas')}")
+        metrics = fleet.get("metrics", {})
+        drift = fleet.get("drift", {})
+        print("\n| metric | n | mean | p50 | p95 | drift(ks) |")
+        print("|---|---|---|---|---|---|")
+        for m, stats in sorted(metrics.items()):
+            n, mean, p50, p95 = _stats_row(stats)
+            d = drift.get(m) if isinstance(drift.get(m), dict) else None
+            print(f"| {m} | {n} | {mean} | {p50} | {p95} | "
+                  f"{_fmt(d.get('ks') if d else None)} |")
+        for name, rep in sorted((doc.get("replicas") or {}).items()):
+            print(f"\nreplica {name}: observed={rep.get('observed')} "
+                  f"sampled={rep.get('sampled')} "
+                  f"drift={json.dumps(rep.get('drift'))}")
+        return 0
+    drift = doc.get("drift", {})
+    print(f"quality @ {args.url}   observed={doc.get('observed')}   "
+          f"sampled={doc.get('sampled')}/{doc.get('decided')}   "
+          f"reference={'yes' if doc.get('reference') else 'NO (freeze one)'}"
+          f"   drift(max_ks)={_fmt(drift.get('max_ks'))}")
+    print("\n| metric | live n/mean/p50/p95 | ref n/mean/p50/p95 "
+          "| ks | psi |")
+    print("|---|---|---|---|---|")
+    for m, row in sorted((doc.get("metrics") or {}).items()):
+        ln, lmean, lp50, lp95 = _stats_row(row.get("live"))
+        rn, rmean, rp50, rp95 = _stats_row(row.get("reference"))
+        d = row.get("drift") or {}
+        print(f"| {m} | {ln}/{lmean}/{lp50}/{lp95} "
+              f"| {rn}/{rmean}/{rp50}/{rp95} "
+              f"| {_fmt(d.get('ks'))} | {_fmt(d.get('psi'))} |")
+    worst = doc.get("worst") or []
+    if worst:
+        print("\nworst offenders (lowest agreement):")
+        for w in worst:
+            print(f"  trace={w.get('trace_id')} "
+                  f"agreement={_fmt(w.get('agreement'))} "
+                  f"fingerprint={w.get('fingerprint')}")
+    return 0
+
+
+def cmd_freeze(args) -> int:
+    out = _post_json(f"{args.url.rstrip('/')}/admin/quality/ref",
+                     args.timeout)
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# smoke
+# ---------------------------------------------------------------------------
+def _poll_until(fn, timeout_s: float = 15.0, interval_s: float = 0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(interval_s)
+    return None
+
+
+def run_smoke() -> int:
+    import tempfile
+    import threading
+
+    import loadgen  # sibling tool: health fetch, payload builders, sender
+
+    from glom_tpu.serving.engine import ServingEngine, make_demo_checkpoint
+    from glom_tpu.serving.server import make_server
+
+    with tempfile.TemporaryDirectory() as d:
+        ckpt = os.path.join(d, "ckpt")
+        forensics_dir = os.path.join(d, "forensics")
+        make_demo_checkpoint(ckpt)
+        engine = ServingEngine(
+            ckpt, buckets=(1, 2), max_wait_ms=1.0, warmup=True,
+            reload_poll_s=0, forensics_dir=forensics_dir,
+            slos=[SMOKE_DRIFT_SLO, "p95<60000ms"],
+            quality_sample=1.0,
+        )
+        engine.start()
+        server = make_server(engine)
+        host, port = server.server_address[:2]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        target = f"http://{host}:{port}"
+        router = router_server = None
+        try:
+            health = loadgen._fetch_health(target, timeout=10)
+            payloads = loadgen._make_payloads(health, [1])
+            corrupt = loadgen._make_corrupt_payloads(health, [1])
+            results = loadgen._Results()
+            t0 = time.monotonic()
+
+            def burst(n, bodies, tag):
+                for i in range(n):
+                    loadgen._send(target, "embed", bodies[1], 1, 30.0,
+                                  results, t0, request_id=f"q-{tag}-{i}")
+
+            # clean traffic first, then freeze it as the reference
+            burst(SMOKE_CLEAN, payloads, "clean")
+            frozen = _post_json(f"{target}/admin/quality/ref")
+            drift_before = _get_json(
+                f"{target}/quality")["drift"].get("max_ks", 0.0)
+
+            # the corrupt burst: same bodies `loadgen --corrupt 1.0`
+            # sends — well-formed requests, shifted distribution
+            burst(SMOKE_CORRUPT, corrupt, "corrupt")
+            quality = _get_json(f"{target}/quality")
+            drift_after = quality["drift"].get("max_ks", 0.0)
+
+            bundles = sorted(
+                name for name in (os.listdir(forensics_dir)
+                                  if os.path.isdir(forensics_dir) else [])
+                if name.startswith("quality_drift-"))
+            snap = engine.registry.snapshot()
+            compiles = snap.get("serving_xla_compiles", 0.0)
+            # the bundle must carry the offending trace ids AND their
+            # input fingerprints (the drift forensics contract)
+            bundle_detail = {}
+            if bundles:
+                with open(os.path.join(forensics_dir, bundles[0],
+                                       "manifest.json")) as f:
+                    bundle_detail = json.load(f).get("detail", {})
+
+            # fleet leg: a router fronting the replica merges its
+            # sketches from the same /healthz the health loop fetches
+            from glom_tpu.serving.router import (FleetRouter,
+                                                 make_router_server)
+
+            router = FleetRouter([target], health_interval_s=0.2)
+            router.start()
+            router_server = make_router_server(router)
+            threading.Thread(target=router_server.serve_forever,
+                             daemon=True).start()
+            rhost, rport = router_server.server_address[:2]
+            fleet = _poll_until(
+                lambda: (lambda p: p if (p.get("fleet") or {}).get(
+                    "replicas") else None)(
+                        _get_json(f"http://{rhost}:{rport}/quality")))
+
+            checks = {
+                "requests_ok": (
+                    results.ok == SMOKE_CLEAN + SMOKE_CORRUPT
+                    and results.errors == 0),
+                "reference_frozen": bool(frozen.get("written")),
+                "drift_clean_low": drift_before < 0.2,
+                "drift_crossed_slo": drift_after > 0.2,
+                "one_quality_drift_bundle": len(bundles) == 1,
+                "bundle_has_fingerprints": bool(
+                    bundle_detail.get("fingerprints")),
+                "zero_request_path_compiles": compiles == 0,
+                "quality_endpoint": quality.get("observed", 0) > 0,
+                "fleet_merged": bool(fleet) and bool(
+                    (fleet.get("fleet") or {}).get("metrics")),
+            }
+            ok = all(checks.values())
+            print(json.dumps({
+                "smoke": "ok" if ok else "FAILED",
+                "slo": SMOKE_DRIFT_SLO,
+                "drift_before": drift_before,
+                "drift_after": drift_after,
+                "quality_drift_bundles": bundles,
+                "xla_compiles": compiles,
+                "fleet_drift": (fleet.get("fleet") or {}).get("drift")
+                if fleet else None,
+                "checks": checks,
+            }, indent=2))
+            return 0 if ok else 1
+        finally:
+            if router_server is not None:
+                router.shutdown()
+                router_server.shutdown()
+                router_server.server_close()
+            server.shutdown()
+            engine.shutdown()
+            server.server_close()
+
+
+# ---------------------------------------------------------------------------
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--smoke", action="store_true",
+                   help="in-process engine+router acceptance loop (CI)")
+    sub = p.add_subparsers(dest="cmd")
+    rep = sub.add_parser("report", help="render GET /quality")
+    rep.add_argument("--url", default="http://127.0.0.1:8000")
+    rep.add_argument("--timeout", type=float, default=10.0)
+    rep.add_argument("--format", choices=["text", "json"], default="text")
+    fr = sub.add_parser("freeze",
+                        help="POST /admin/quality/ref: adopt the live "
+                             "distributions as the drift reference")
+    fr.add_argument("--url", default="http://127.0.0.1:8000")
+    fr.add_argument("--timeout", type=float, default=10.0)
+    args = p.parse_args(argv)
+    if args.smoke:
+        return run_smoke()
+    if args.cmd == "report":
+        return cmd_report(args)
+    if args.cmd == "freeze":
+        return cmd_freeze(args)
+    p.error("need --smoke, report, or freeze")
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

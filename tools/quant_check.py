@@ -17,6 +17,13 @@ quantization error compounds up the level stack (each level's state has
 passed through more quantized matmuls).  A failure localized to the top
 level with clean lower levels usually means the decoder/top-down weights
 need to stay bf16.
+
+This gate's production counterpart is the serving quality plane
+(``glom_tpu/obs/quality.py``): the per-level ``quality_agreement_l{i}``
+gauges and the ``quality_residual`` drift sketch on ``GET /quality``
+track the same level-wise degradation signature live — quantization rot
+that slips past a one-shot check surfaces there as drift against the
+frozen f32-era reference profile.
 """
 
 from __future__ import annotations

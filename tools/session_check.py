@@ -27,6 +27,13 @@ session path exists for):
     and the warm/full ratio — the number ``tools/bench_gate.py
     --session-json`` gates against (``steady_state_p95_ms``).
 
+The offline twin of the serving quality plane: what this harness checks
+once per deploy decision, the ``quality_agreement_l{i}`` /
+``quality_residual`` gauges (``glom_tpu/obs/quality.py``, ``GET
+/quality``) watch continuously in production — a warm-iteration count
+that passed here but collapses island agreement under real traffic
+shows up there as drift off the reference profile.
+
 The headline verdict: the smallest passing ``warm_iters`` and whether it
 meets the ``<= cold_iters/2`` target (the ROADMAP's measured-savings
 acceptance).  ``--smoke`` runs the demo model in seconds and exits
