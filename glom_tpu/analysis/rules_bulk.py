@@ -1,0 +1,192 @@
+"""glomlint bulk-tier rule pack.
+
+  * ``bulk-isolation`` — the scavenger-class boundary (PR 18): the bulk
+    inference tier fills residual bucket padding and idle flush windows
+    and must stay INVISIBLE to the online plane.  That invisibility is
+    structural, not behavioral: bulk modules (``glom_tpu/bulk/`` and any
+    ``bulk.py`` under ``serving/``) must never import the online
+    admission, SLO, or tenant-quota machinery — a ``TenantAdmission`` or
+    ``SloManager`` reference inside the bulk tier means offline work
+    grew a dependency on (or worse, a write path into) the online
+    control plane, the exact coupling the scavenger contract forbids
+    (bulk slots are never admitted, never quota'd, never SLO'd; they
+    ride whatever the online plane already paid for).  The same rule
+    enforces the bounded-enqueue half of the contract: every per-slot /
+    per-chunk accumulator inside a bulk class must be bounded — a
+    ``deque(maxlen=)``, a ``len()`` cap check, or an eviction call — so
+    a stalled sink or a paused job can never turn the scavenger into an
+    unbounded memory queue riding inside the serving process.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from glom_tpu.analysis.engine import Finding, ModuleContext, Rule, dotted_name
+
+#: online-plane modules the bulk tier must never import (module path
+#: component match on the dotted name)
+_FORBIDDEN_MODULES = {
+    # SLO plane: bulk work is invisible to online SLOs by contract
+    ("obs", "slo"),
+}
+
+#: online admission / quota symbols forbidden in bulk modules wherever
+#: they are imported from
+_FORBIDDEN_SYMBOLS = {
+    "TenantAdmission", "TenantQuotaExceeded", "TokenBucket",
+    "parse_quota", "SloManager", "parse_slo",
+}
+
+#: growth calls that accumulate one element per invocation
+_GROWTH_METHODS = {"append", "extend", "appendleft", "add"}
+#: eviction calls that count as bounding evidence for an attribute
+_EVICT_METHODS = {"pop", "popleft", "popitem", "clear"}
+#: constructors whose result is unbounded by default
+_UNBOUNDED_CTORS = {"list", "dict", "set", "OrderedDict", "defaultdict"}
+
+
+def _self_attr(node) -> str:
+    """``self.X`` -> ``"X"``, else ``""``."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return ""
+
+
+class BulkIsolationRule(Rule):
+    name = "bulk-isolation"
+    severity = "error"
+    description = ("bulk-tier module imports online admission/SLO/quota "
+                   "machinery, or grows an unbounded enqueue buffer — "
+                   "the scavenger class must stay invisible to the "
+                   "online plane and bounded in memory")
+
+    @staticmethod
+    def _in_scope(relpath: str) -> bool:
+        # component match, not substring (the obs-debug-in-cache
+        # convention): glom_tpu/bulk/* and any bulk.py module are the
+        # bulk tier; tests and fixtures resolve their own relpaths
+        parts = relpath.split("/")
+        return "bulk" in parts[:-1] or parts[-1] == "bulk.py"
+
+    # -- forbidden-import half -------------------------------------
+
+    def _import_findings(self, ctx: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.Import, ast.ImportFrom)):
+                continue
+            mod = (node.module or "" if isinstance(node, ast.ImportFrom)
+                   else "")
+            names = [a.name for a in node.names]
+            dotted_all = ([mod] if mod else []) + names
+            for dotted in dotted_all:
+                parts = dotted.split(".")
+                for tail in _FORBIDDEN_MODULES:
+                    n = len(tail)
+                    if any(tuple(parts[i:i + n]) == tail
+                           for i in range(len(parts) - n + 1)):
+                        findings.append(ctx.finding(
+                            self, node,
+                            f"online-plane import {dotted!r} in a bulk "
+                            f"module: the scavenger tier is invisible to "
+                            f"online SLOs by contract — it must not even "
+                            f"know the SLO plane exists"))
+            for sym in _FORBIDDEN_SYMBOLS & set(names):
+                findings.append(ctx.finding(
+                    self, node,
+                    f"admission/quota symbol {sym!r} imported into a "
+                    f"bulk module: bulk slots are never admitted, "
+                    f"quota'd, or SLO'd — they fill padding the online "
+                    f"plane already paid for"))
+        return findings
+
+    # -- bounded-enqueue half (the obs-unbounded-series machinery,
+    #    scoped to bulk classes) -----------------------------------
+
+    @staticmethod
+    def _unbounded_init(value) -> bool:
+        if isinstance(value, (ast.List, ast.Dict, ast.Set,
+                              ast.ListComp, ast.DictComp, ast.SetComp)):
+            return True
+        if isinstance(value, ast.Call):
+            d = dotted_name(value.func) or ""
+            base = d.split(".")[-1]
+            if base == "deque":
+                return not any(kw.arg == "maxlen" for kw in value.keywords)
+            return base in _UNBOUNDED_CTORS
+        return False
+
+    def _class_findings(self, ctx: ModuleContext,
+                        cls: ast.ClassDef) -> List[Finding]:
+        unbounded: dict = {}     # attr -> init node
+        evidence: set = set()    # attrs with cap/eviction anywhere in class
+        growth: List = []        # (attr, node, kind)
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    attr = _self_attr(target)
+                    if attr and self._unbounded_init(node.value):
+                        unbounded.setdefault(attr, node)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                attr = _self_attr(node.target)
+                if attr and self._unbounded_init(node.value):
+                    unbounded.setdefault(attr, node)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        attr = _self_attr(t.value)
+                        if attr:
+                            evidence.add(attr)
+            elif isinstance(node, ast.Call):
+                if (isinstance(node.func, ast.Name)
+                        and node.func.id == "len" and node.args):
+                    attr = _self_attr(node.args[0])
+                    if attr:
+                        evidence.add(attr)
+                elif isinstance(node.func, ast.Attribute):
+                    attr = _self_attr(node.func.value)
+                    if attr and node.func.attr in _EVICT_METHODS:
+                        evidence.add(attr)
+        for method in cls.body:
+            if (not isinstance(method,
+                               (ast.FunctionDef, ast.AsyncFunctionDef))
+                    or method.name == "__init__"):
+                continue
+            for node in ast.walk(method):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _GROWTH_METHODS):
+                    attr = _self_attr(node.func.value)
+                    if attr:
+                        growth.append((attr, node, node.func.attr))
+        findings: List[Finding] = []
+        flagged: set = set()
+        for attr, node, kind in growth:
+            if attr not in unbounded or attr in evidence or attr in flagged:
+                continue
+            flagged.add(attr)
+            findings.append(ctx.finding(
+                self, node,
+                f"self.{attr} enqueues per slot ({kind}) but is "
+                f"initialized unbounded and class {cls.name} never caps "
+                f"or evicts it — a stalled sink would turn the scavenger "
+                f"into an unbounded queue inside the serving process; "
+                f"use deque(maxlen=), a len() bound, or an eviction "
+                f"sweep"))
+        return findings
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        if not self._in_scope(ctx.relpath):
+            return []
+        findings = self._import_findings(ctx)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._class_findings(ctx, node))
+        return findings
+
+
+BULK_RULES = (BulkIsolationRule,)

@@ -1,0 +1,19 @@
+"""Bulk inference tier: the offline job store + exactly-once cursor.
+
+A bulk job is (model, version, dataset, transform, sink) with progress
+tracked as a checkpointed global-slot cursor — the ``ElasticBatches``
+partitioning contract from the training data plane, reused verbatim for
+offline inference (docs/BULK.md).  Execution is the scavenger class in
+:mod:`glom_tpu.serving.bulk`; this package is the durable half.
+"""
+
+from glom_tpu.bulk.jobs import (  # noqa: F401
+    BulkJobSpec,
+    ChunkSink,
+    JobStore,
+    SlotDataset,
+    partition_range,
+)
+
+__all__ = ["BulkJobSpec", "ChunkSink", "JobStore", "SlotDataset",
+           "partition_range"]

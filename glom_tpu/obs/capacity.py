@@ -72,6 +72,8 @@ SIGNAL_SERIES = {
     "shed": "capacity_shed_ratio",
     "queue": "capacity_queue_depth",
     "util": "capacity_utilization",
+    "bulk_backlog": "capacity_bulk_backlog",
+    "bulk_reclaimed": "capacity_bulk_reclaimed",
 }
 
 DEFAULT_POLICY = "p95_ms<250,duty<0.85,shed<0.01"
@@ -197,7 +199,9 @@ class CapacityAccountant:
         out: Dict[str, Any] = {
             "duty": None, "imgs_per_sec": None, "util": None,
             "shed": None, "queue": None, "p95_ms": None,
-            "padding_waste": None, "ceiling_imgs_per_sec": self.ceiling,
+            "padding_waste": None, "bulk_backlog": None,
+            "bulk_reclaimed": None,
+            "ceiling_imgs_per_sec": self.ceiling,
         }
         exec_pts = self._window("serving_execute_ms_sum", now)
         if len(exec_pts) >= 2:
@@ -230,6 +234,16 @@ class CapacityAccountant:
         occ_n = delta(self._window("serving_batch_occupancy_count", now))
         if occ_sum is not None and occ_n:
             out["padding_waste"] = max(0.0, 1.0 - occ_sum / occ_n)
+        # bulk tier: queued offline work is a scale signal (a trough
+        # with a backlog is being scavenged, not idle), and the slot
+        # rate is the utilization the scavenger reclaims from padding
+        # residue + idle windows
+        backlog = self.store.latest("bulk_backlog_slots")
+        if backlog is not None:
+            out["bulk_backlog"] = backlog
+        reclaimed = rate(self._window("bulk_slots_total", now))
+        if reclaimed is not None:
+            out["bulk_reclaimed"] = reclaimed
         return out
 
     def _per_bucket_waste(self, now: float) -> Dict[str, float]:
@@ -268,6 +282,11 @@ class CapacityAccountant:
                        "request p95 latency (reservoir), ms"),
             "padding_waste": ("capacity_padding_waste",
                               "1 - batch occupancy, trailing window"),
+            "bulk_backlog": ("capacity_bulk_backlog",
+                             "bulk slots queued but not durably finished"),
+            "bulk_reclaimed": ("capacity_bulk_reclaimed",
+                               "bulk slots/s reclaimed from bucket "
+                               "padding and idle windows"),
         }
         for key, (name, help_) in gauge_of.items():
             if sig[key] is None:
@@ -375,9 +394,18 @@ class CapacityAdvisor:
             reasons = [f"duty spread {spread:.2f} > {self.duty_spread:.2f} "
                        f"across {len(per_replica_duty)} replicas"]
         elif fractions and max(fractions) < self.low_water:
-            action = ACTION_SCALE_DOWN
-            reasons = [f"all signals under {self.low_water:.0%} of policy "
-                       f"bounds (peak {max(fractions):.0%})"]
+            backlog = signals.get("bulk_backlog")
+            if isinstance(backlog, (int, float)) and backlog > 0:
+                # a quiet fleet with queued bulk work is not idle — it
+                # is a trough being scavenged; shrinking it now would
+                # just stretch the backlog (docs/BULK.md)
+                action = ACTION_HOLD
+                reasons = [f"trough being scavenged: bulk backlog "
+                           f"{backlog:g} slots"]
+            else:
+                action = ACTION_SCALE_DOWN
+                reasons = [f"all signals under {self.low_water:.0%} of "
+                           f"policy bounds (peak {max(fractions):.0%})"]
         else:
             action, reasons = ACTION_HOLD, []
         if action == self._streak_action:
@@ -592,6 +620,8 @@ class FleetCapacityPlane:
         "duty": "mean", "imgs_per_sec": "sum", "util": "mean",
         "shed": "mean", "queue": "sum", "p95_ms": "max",
         "padding_waste": "mean",
+        # bulk tier: backlogs and reclaimed slot rates add across replicas
+        "bulk_backlog": "sum", "bulk_reclaimed": "sum",
     }
 
     def __init__(self, *, policy: str = DEFAULT_POLICY,
@@ -706,4 +736,6 @@ _SIGNAL_SUFFIX = {
     "queue": "queue_depth",
     "p95_ms": "p95_ms",
     "padding_waste": "padding_waste",
+    "bulk_backlog": "bulk_backlog",
+    "bulk_reclaimed": "bulk_reclaimed",
 }

@@ -691,7 +691,9 @@ class FleetObservatory:
     #: capacity series whose fleet roll-up sums over replicas (throughput
     #: and queue depth add; everything else averages, latency takes max)
     _CAP_SUM = frozenset(("capacity_effective_imgs_per_sec",
-                          "capacity_queue_depth"))
+                          "capacity_queue_depth",
+                          "capacity_bulk_reclaimed",
+                          "capacity_bulk_backlog"))
     _CAP_MAX = frozenset(("capacity_p95_ms",))
 
     def _ingest_capacity(self, forensics: Dict[str, dict]) -> None:
@@ -756,6 +758,68 @@ class FleetObservatory:
                 agg[k] = sum(vs) / len(vs)
         if agg:
             self.series.record_snapshot(agg, t=now)
+
+    # -- bulk-job series ---------------------------------------------------
+    def _ingest_bulk(self, forensics: Dict[str, dict]) -> None:
+        """Fold every replica's ``bulk_*`` registry scalars into the
+        fleet series store (caller holds ``_lock``) — same shape as
+        :meth:`_ingest_capacity`.  Every bulk scalar is additive across
+        replicas (slot counters, backlogs, active-job counts), so the
+        fleet aggregate is a plain sum."""
+        now = self._clock()
+        fleet: Dict[str, List[float]] = {}
+        for name, payload in forensics.items():
+            reg = payload.get("registry") or {}
+            bulks = {k: v for k, v in reg.items()
+                     if k.startswith("bulk_")
+                     and isinstance(v, (int, float))}
+            if not bulks:
+                continue
+            self.series.record_snapshot(bulks, t=now,
+                                        labels={"replica": name})
+            for k, v in bulks.items():
+                fleet.setdefault(k, []).append(float(v))
+        if fleet:
+            self.series.record_snapshot(
+                {k: sum(vs) for k, vs in fleet.items()}, t=now)
+
+    def _jobs_pane(self) -> Dict[str, Any]:
+        """Console bulk-jobs view (caller holds ``_lock``): fleet job
+        progress from the router's health block, per-replica scavenge
+        rates from the slope of the labeled ``bulk_slots_total`` series
+        over the last two minutes, and the fleet ETA those two imply."""
+        now = self._clock()
+        replicas: Dict[str, Dict[str, Any]] = {}
+        fleet_rate = 0.0
+        backlog = 0.0
+        for name, payload in sorted(self._forensics_by_replica.items()):
+            reg = payload.get("registry") or {}
+            total = reg.get("bulk_slots_total")
+            if total is None and reg.get("bulk_backlog_slots") is None:
+                continue
+            pts = self.series.points(
+                series_key("bulk_slots_total", {"replica": name}),
+                since=now - 120.0)
+            fit = linear_trend(pts)
+            rate = max(0.0, fit["slope"]) if fit else 0.0
+            fleet_rate += rate
+            backlog += float(reg.get("bulk_backlog_slots") or 0)
+            replicas[name] = {
+                "slots_total": total,
+                "scavenged": reg.get("bulk_scavenged_slots_total"),
+                "idle": reg.get("bulk_idle_slots_total"),
+                "backlog": reg.get("bulk_backlog_slots"),
+                "slots_per_s": round(rate, 3),
+            }
+        health = self._router_health or {}
+        return {
+            "jobs": health.get("bulk_jobs") or {},
+            "replicas": replicas,
+            "backlog_slots": backlog,
+            "scavenged_slots_per_s": round(fleet_rate, 3),
+            "eta_s": (round(backlog / fleet_rate, 1)
+                      if fleet_rate > 0 and backlog else None),
+        }
 
     def _quality_pane(self) -> Dict[str, Any]:
         """Console quality view (caller holds ``_lock``): per-replica
@@ -1036,6 +1100,7 @@ class FleetObservatory:
                 self._forensics_by_replica = forensics
                 self._ingest_capacity(forensics)
                 self._ingest_quality(forensics)
+                self._ingest_bulk(forensics)
                 incidents = self._check_incidents(fresh_events, forensics)
                 return {
                     "poll": self._poll_n,
@@ -1095,6 +1160,7 @@ class FleetObservatory:
             "slo_burn_rates": burn_rates,
             "capacity": self._capacity_pane(),
             "quality": self._quality_pane(),
+            "jobs": self._jobs_pane(),
             "padding_waste": {
                 str(bucket): {
                     "batches": agg["batches"],

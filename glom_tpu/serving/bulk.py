@@ -1,0 +1,404 @@
+"""The scavenger execution class: bulk jobs on residual online capacity.
+
+Online serving pads every batch up to its AOT bucket; those padding rows
+execute anyway and their results are thrown away.  The
+:class:`BulkRunner` turns them into throughput: when the engine's
+execute loop assembles an online group of ``n`` images against bucket
+``b``, it asks the runner for up to ``b - n`` bulk samples and runs the
+FULL bucket through the already-warmed executable — the bulk rows ride
+device work that was already paid for.  Idle flush windows (batcher
+depth zero) run whole bulk buckets the same way.
+
+Priority rules (docs/BULK.md):
+
+  * **Online always wins.**  Bulk never enters the batcher, never takes
+    admission, and the idle loop refuses to start a batch while ANY
+    online work is queued — preemption is at the admission boundary, so
+    the worst case an online request waits behind bulk is one in-flight
+    bucket execution.
+  * **No new compile-cache entries.**  Bulk executes the exact warmed
+    ``(bucket, quant)`` executables; the shared ``serving_xla_compiles``
+    counter stays 0 (polled here too, so a regression fails the same
+    acceptance every endpoint is held to).
+  * **Invisible to online accounting.**  Bulk slots never touch
+    ``serving_requests_total``, tenant quotas, SLO evaluators, shadow
+    mirroring, or quality sampling; they mint their own ``bulk_*``
+    family.  The glomlint ``bulk-isolation`` rule pins the import
+    boundary.
+
+Exactly-once rides the job store's sink-then-cursor order
+(:mod:`glom_tpu.bulk.jobs`): ``fill()`` stages slots in memory only;
+``complete()`` writes the part file then durably advances the cursor;
+``abandon()`` (failed batch, shutdown) rewinds the stage.  A kill at
+ANY point re-executes at most the staged chunk, rewriting identical
+bytes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from glom_tpu.bulk.jobs import BulkJobSpec, ChunkSink, JobStore, SlotDataset
+
+#: idle-loop poll cadence while there is nothing runnable
+DEFAULT_IDLE_POLL_S = 0.002
+
+
+@dataclass
+class _FillToken:
+    """One staged chunk: handed out by :meth:`BulkRunner.fill`, settled
+    by exactly one of ``complete``/``abandon``."""
+
+    job: str
+    shard_lo: int
+    lo: int
+    hi: int
+    imgs: np.ndarray
+    source: str  # "scavenged" | "idle"
+
+
+class _ActiveJob:
+    """In-memory face of one store job: dataset + sink handles and the
+    per-shard staging pointers.  ``staged`` runs ahead of the durable
+    cursor by at most one in-flight chunk per shard (``busy`` enforces
+    it), which is what keeps cursor advances strictly sequential."""
+
+    def __init__(self, spec: BulkJobSpec, doc: dict):
+        self.spec = spec
+        self.dataset = SlotDataset(spec)
+        self.sink = ChunkSink(spec.sink)
+        self.total = int(doc["total"])
+        self.paused = doc["status"] == "paused"
+        # shard_lo -> {"cursor", "hi", "staged", "busy"}
+        self.shards: Dict[int, Dict[str, Any]] = {}
+        self.sync_shards(doc)
+
+    def sync_shards(self, doc: dict) -> None:
+        for s in doc["shards"]:
+            have = self.shards.get(s["lo"])
+            if have is None:
+                self.shards[s["lo"]] = {
+                    "cursor": int(s["cursor"]), "hi": int(s["hi"]),
+                    "staged": int(s["cursor"]), "busy": False,
+                }
+            else:
+                have["hi"] = int(s["hi"])
+
+    @property
+    def remaining(self) -> int:
+        return sum(s["hi"] - s["cursor"] for s in self.shards.values())
+
+    def next_chunk(self, k: int) -> Optional[Dict[str, Any]]:
+        """Reserve up to ``k`` slots from the first shard with staged
+        headroom; caller holds the runner lock."""
+        if self.paused or k < 1:
+            return None
+        for lo, s in sorted(self.shards.items()):
+            if s["busy"] or s["staged"] >= s["hi"]:
+                continue
+            hi = min(s["staged"] + k, s["hi"])
+            chunk = {"shard_lo": lo, "lo": s["staged"], "hi": hi}
+            s["busy"] = True
+            s["staged"] = hi
+            return chunk
+        return None
+
+
+class BulkRunner:
+    """Scavenger-class bulk executor attached to one
+    :class:`~glom_tpu.serving.engine.ServingEngine`.
+
+    Owns the replica's :class:`~glom_tpu.bulk.jobs.JobStore` (adopting
+    unfinished jobs on construction — THAT is resume-after-kill: a fresh
+    engine over the same store directory picks up every durable cursor
+    with zero operator action) and the idle-window thread.  The engine's
+    execute loop calls :meth:`fill`/:meth:`complete` around its primary
+    group to scavenge residual bucket padding."""
+
+    def __init__(self, engine, store_root: str, *,
+                 idle_poll_s: float = DEFAULT_IDLE_POLL_S,
+                 clock=None):
+        self.engine = engine
+        self.store = JobStore(store_root)
+        self.registry = engine.registry
+        self.idle_poll_s = idle_poll_s
+        self._clock = clock if clock is not None else time.monotonic
+        self._lock = threading.Lock()
+        self._jobs: Dict[str, _ActiveJob] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # (t, slots_done) samples for the scavenged-slots/s + ETA view;
+        # bounded ring — the runner must not grow with job size
+        self._progress: deque = deque(maxlen=64)
+        self._slots_done = 0
+        for name in self.store.names():
+            doc = self.store.load(name)
+            if doc["status"] in ("pending", "running", "paused"):
+                self._activate(name, doc)
+        self._gauge_backlog()
+
+    # -- job admin (the /admin/jobs/* verbs) -------------------------------
+    def _activate(self, name: str, doc: dict) -> None:
+        spec = BulkJobSpec.from_json_dict(doc["spec"])
+        if spec.transform not in self.engine.caches:
+            raise ValueError(
+                f"job {name!r} transform {spec.transform!r} not served "
+                f"by this engine")
+        cfg = self.engine.config
+        if (spec.image_size != cfg.image_size
+                or spec.channels != cfg.channels):
+            raise ValueError(
+                f"job {name!r} geometry ({spec.channels}, "
+                f"{spec.image_size}) does not match the served model "
+                f"({cfg.channels}, {cfg.image_size})")
+        with self._lock:
+            job = self._jobs.get(name)
+            if job is None:
+                self._jobs[name] = _ActiveJob(spec, doc)
+            else:
+                job.sync_shards(doc)
+                job.paused = doc["status"] == "paused"
+
+    def submit(self, payload: dict) -> dict:
+        """Create/extend a job from an ``/admin/jobs/submit`` body and
+        activate it.  ``shard`` (``[lo, hi]``) scopes this replica to a
+        fleet partition; ``total`` defaults to the dataset length."""
+        fields = {k: payload[k] for k in (
+            "name", "dataset", "transform", "sink", "model", "version",
+            "seed", "image_size", "channels") if k in payload}
+        cfg = self.engine.config
+        fields.setdefault("image_size", int(cfg.image_size))
+        fields.setdefault("channels", int(cfg.channels))
+        spec = BulkJobSpec(**fields)
+        if (spec.image_size != cfg.image_size
+                or spec.channels != cfg.channels):
+            raise ValueError(
+                f"job geometry ({spec.channels}, {spec.image_size}) does "
+                f"not match the served model "
+                f"({cfg.channels}, {cfg.image_size})")
+        if spec.model != "default" or spec.version is not None:
+            raise ValueError(
+                "bulk jobs execute against the primary default model; "
+                "model/version pinning is recorded but not yet servable")
+        probe = SlotDataset(spec)  # validates the dataset spec eagerly
+        total = int(payload.get("total", len(probe)))
+        if total > len(probe):
+            raise ValueError(
+                f"total {total} exceeds dataset length {len(probe)}")
+        shard = payload.get("shard")
+        shards = [tuple(int(v) for v in shard)] if shard else None
+        doc = self.store.submit(spec, total=total, shards=shards,
+                                owner=str(payload.get("owner", "local")))
+        self._activate(spec.name, doc)
+        self._gauge_backlog()
+        return self.status(spec.name)
+
+    def pause(self, name: str) -> dict:
+        self.store.set_status(name, "paused")
+        with self._lock:
+            if name in self._jobs:
+                self._jobs[name].paused = True
+        return self.status(name)
+
+    def resume(self, name: str) -> dict:
+        doc = self.store.set_status(name, "running")
+        self._activate(name, doc)
+        with self._lock:
+            self._jobs[name].paused = False
+        return self.status(name)
+
+    def cancel(self, name: str) -> dict:
+        self.store.set_status(name, "cancelled")
+        with self._lock:
+            self._jobs.pop(name, None)
+        self._gauge_backlog()
+        return self.status(name)
+
+    def status(self, name: Optional[str] = None) -> dict:
+        if name is not None:
+            return self.store.status(name)
+        return self.summary()
+
+    # -- the scavenger fill/complete/abandon cycle --------------------------
+    def fill(self, endpoint: str, k: int,
+             source: str = "scavenged") -> Optional[_FillToken]:
+        """Stage up to ``k`` slots of some runnable job whose transform
+        is ``endpoint``.  Returns None when nothing is runnable — the
+        overwhelmingly common case, kept to a dict scan.  The staged
+        chunk is NOT durable: only :meth:`complete` commits it."""
+        if k < 1:
+            return None
+        with self._lock:
+            for name, job in self._jobs.items():
+                if job.spec.transform != endpoint:
+                    continue
+                chunk = job.next_chunk(k)
+                if chunk is not None:
+                    break
+            else:
+                return None
+            dataset = job.dataset
+        # materialize OUTSIDE the lock: the shard's busy flag protects
+        # the range, and dataset reads are pure functions of the slots
+        imgs = dataset.read(chunk["lo"], chunk["hi"])
+        return _FillToken(job=name, shard_lo=chunk["shard_lo"],
+                          lo=chunk["lo"], hi=chunk["hi"], imgs=imgs,
+                          source=source)
+
+    def complete(self, token: _FillToken, out: np.ndarray) -> None:
+        """Commit one executed chunk: part file first, cursor second
+        (the exactly-once order), then release the shard."""
+        with self._lock:
+            job = self._jobs.get(token.job)
+        if job is None:  # cancelled while in flight: drop the output
+            return
+        job.sink.write(token.lo, token.hi, np.asarray(out))
+        doc = self.store.advance(token.job, token.shard_lo, token.hi)
+        n = token.hi - token.lo
+        with self._lock:
+            shard = job.shards[token.shard_lo]
+            shard["cursor"] = token.hi
+            shard["busy"] = False
+            self._slots_done += n
+            self._progress.append((self._clock(), self._slots_done))
+            if doc["status"] == "done":
+                self._jobs.pop(token.job, None)
+        reg = self.registry
+        reg.counter("bulk_slots_total",
+                    help="bulk samples executed (all sources)").inc(n)
+        reg.counter(
+            f"bulk_{token.source}_slots_total",
+            help=("bulk samples run in residual bucket padding"
+                  if token.source == "scavenged"
+                  else "bulk samples run in idle flush windows"),
+        ).inc(n)
+        reg.counter("bulk_parts_written_total",
+                    help="sink part files durably written").inc()
+        self._gauge_backlog()
+
+    def abandon(self, token: _FillToken) -> None:
+        """Rewind a staged chunk (failed batch, shutdown mid-flight):
+        the slots return to the pool and will re-execute — exactly-once
+        is preserved because nothing was committed."""
+        with self._lock:
+            job = self._jobs.get(token.job)
+            if job is None:
+                return
+            shard = job.shards.get(token.shard_lo)
+            if shard is not None and shard["busy"]:
+                shard["staged"] = token.lo
+                shard["busy"] = False
+
+    # -- idle-window execution ----------------------------------------------
+    def run_idle_once(self) -> int:
+        """Execute ONE pure-bulk bucket if (and only if) no online work
+        is queued for the job's endpoint — the instant-preemption gate:
+        a bulk batch never starts while an online image waits.  Returns
+        slots executed."""
+        with self._lock:
+            candidates = [(name, job.spec.transform)
+                          for name, job in self._jobs.items()
+                          if not job.paused]
+        for name, endpoint in candidates:
+            engine = self.engine
+            if engine.batchers[endpoint].depth > 0:
+                continue  # online admission preempts before we start
+            cache = engine.caches[endpoint]
+            token = self.fill(endpoint, cache.max_bucket, source="idle")
+            if token is None:
+                continue
+            try:
+                out = np.asarray(cache(engine.params, token.imgs))
+            except Exception:
+                self.abandon(token)
+                self.registry.counter(
+                    "bulk_batch_errors_total",
+                    help="bulk bucket executions that raised "
+                         "(slots were rewound, never dropped)",
+                ).inc()
+                raise
+            self.poll_compiles(cache)
+            self.complete(token, out)
+            return token.hi - token.lo
+        return 0
+
+    def poll_compiles(self, cache) -> None:
+        """Bulk rides warmed executables only: fold any compile into the
+        shared request-path budget so the zero-after-warmup acceptance
+        covers the scavenger too."""
+        new_compiles = cache.poll_compiles()
+        if new_compiles:
+            self.registry.counter(
+                "serving_xla_compiles",
+                help="request-path XLA compiles after warmup (must stay 0)",
+            ).inc(new_compiles)
+
+    def _idle_loop(self) -> None:
+        while not self._stop.wait(self.idle_poll_s):
+            try:
+                if self.run_idle_once() == 0:
+                    continue
+            except Exception:  # glomlint: disable=conc-broad-except -- counted in run_idle_once; a bad batch must not kill the scavenger thread (the slots were rewound)
+                continue
+
+    # -- views ---------------------------------------------------------------
+    def backlog(self) -> int:
+        with self._lock:
+            return sum(job.remaining for job in self._jobs.values())
+
+    def _gauge_backlog(self) -> None:
+        with self._lock:
+            backlog = sum(job.remaining for job in self._jobs.values())
+            active = len(self._jobs)
+        self.registry.gauge(
+            "bulk_backlog_slots",
+            help="bulk slots queued but not yet durably finished",
+        ).set(backlog)
+        self.registry.gauge(
+            "bulk_jobs_active", help="bulk jobs pending/running locally",
+        ).set(active)
+
+    def rate_slots_per_s(self) -> Optional[float]:
+        with self._lock:
+            if len(self._progress) < 2:
+                return None
+            (t0, n0), (t1, n1) = self._progress[0], self._progress[-1]
+        if t1 <= t0:
+            return None
+        return (n1 - n0) / (t1 - t0)
+
+    def summary(self) -> Dict[str, Any]:
+        """The ``/healthz`` ``bulk`` block (and ``/admin/jobs/status``
+        with no name): store summary + live rate/ETA.  The router's
+        health loop ingests this — including per-shard cursors, which is
+        what lets it re-partition a DEAD replica's range from its last
+        witnessed durable cursor."""
+        doc = self.store.summary()
+        rate = self.rate_slots_per_s()
+        doc["rate_slots_per_s"] = None if rate is None else round(rate, 3)
+        doc["eta_s"] = (round(doc["backlog"] / rate, 3)
+                        if rate and doc["backlog"] else None)
+        with self._lock:
+            doc["slots_done_session"] = self._slots_done
+        return doc
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._idle_loop, name="glom-bulk-idle", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)

@@ -262,6 +262,7 @@ class ServingEngine:
         capacity_ceiling: Optional[float] = None,
         quality_sample: float = 1.0,
         quality_seed: int = 0,
+        bulk_dir: Optional[str] = None,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.registry = registry if registry is not None else MetricRegistry()
@@ -617,6 +618,19 @@ class ServingEngine:
                         if self.tenants is not None else None),
         )
 
+        # -- bulk inference tier (glom_tpu.serving.bulk) -------------------
+        # Scavenger-class offline jobs: with a bulk_dir the runner adopts
+        # every unfinished job in that store on construction (resume after
+        # a kill is zero-touch), fills residual bucket padding from
+        # process_once, and runs idle-window buckets from its own thread
+        # (started with the workers).  Bulk work rides the warmed
+        # executables and never touches admission, quotas, or SLOs.
+        self.bulk = None
+        if bulk_dir is not None:
+            from glom_tpu.serving.bulk import BulkRunner
+
+            self.bulk = BulkRunner(self, bulk_dir, clock=self._clock)
+
         # -- staged (two-phase) reload state -------------------------------
         # ``_staged`` holds (step, placed-params) loaded by stage_reload()
         # but not yet serving; ``_prev`` holds the (step, params) a commit
@@ -748,6 +762,10 @@ class ServingEngine:
             )
             t.start()
             self._threads.append(t)
+        if workers and self.bulk is not None:
+            # idle-window scavenging needs live workers to preempt it;
+            # workers=False tests drive bulk.run_idle_once() by hand
+            self.bulk.start()
 
     def shutdown(self, *, drain: bool = True, timeout: float = 30.0) -> None:
         """Graceful stop (the server's SIGTERM path): close admission,
@@ -756,6 +774,12 @@ class ServingEngine:
         for batcher in self.batchers.values():
             batcher.close(drain=drain)
         self._stop.set()
+        if self.bulk is not None:
+            # stop BEFORE joining the workers: any chunk still staged is
+            # simply never committed — the durable cursor stays at the
+            # last completed part, so the next engine over the same
+            # store re-executes it (exactly-once by idempotent rewrite)
+            self.bulk.stop()
         self.deploy.close()
         self.capacity.stop()  # no-op unless the timer thread was started
         deadline = time.monotonic() + timeout  # glomlint: disable=conc-raw-clock -- the drain deadline must track wall time: under a fake test clock the joins would otherwise never time out
@@ -1222,11 +1246,27 @@ class ServingEngine:
             member_ctxs = [it.ctx for it in items if it.ctx is not None]
             contexts = ([batch_span] if batch_span is not None
                         else []) + member_ctxs
+            bulk_token = None
             try:
                 params, cache, retired = self._resolve_group(endpoint, mkey)
+                exec_imgs = imgs
+                if mkey is None and self.bulk is not None:
+                    # scavenge: the bucket pads to ``bucket`` rows anyway
+                    # — fill the residual with bulk samples and run the
+                    # SAME warmed executable.  Online rows stay first, so
+                    # everything below (futures, shadow mirror, quality
+                    # sampling, accounting) sees only ``out[:n]``.
+                    bucket = cache.pick(n)
+                    if bucket is not None and bucket > n:
+                        bulk_token = self.bulk.fill(endpoint, bucket - n)
+                        if bulk_token is not None:
+                            exec_imgs = np.concatenate(
+                                [imgs, bulk_token.imgs])
                 t0 = self._clock()
-                out = np.asarray(cache(params, imgs, tracer=self.tracer,
-                                       contexts=contexts))
+                out_all = np.asarray(cache(params, exec_imgs,
+                                           tracer=self.tracer,
+                                           contexts=contexts))
+                out = out_all[:n] if bulk_token is not None else out_all
                 if mkey is not None and mkey[1] is not None and not retired:
                     # canary group: the injected-candidate fault seam
                     # (chaos's "latency-injected checkpoint" — a delay is
@@ -1238,6 +1278,10 @@ class ServingEngine:
                         raise faultinject.FaultError(
                             "injected candidate error")
             except Exception as e:
+                if bulk_token is not None:
+                    # rewind the staged bulk chunk: nothing was
+                    # committed, the slots simply re-execute later
+                    self.bulk.abandon(bulk_token)
                 for item in items:
                     if not item.future.done():
                         item.future.set_exception(e)
@@ -1254,6 +1298,11 @@ class ServingEngine:
                 primary_items = items
                 primary_params = params
             self._account_batch(endpoint, cache, n, batch_s)
+            if bulk_token is not None:
+                # commit AFTER the online futures resolved: sink part
+                # write + durable cursor advance (exactly-once order)
+                k = bulk_token.hi - bulk_token.lo
+                self.bulk.complete(bulk_token, out_all[n:n + k])
             if mkey is not None and mkey[0] != "default":
                 self.registry.counter(
                     self.registry.labeled("serving_model_requests_",
@@ -1743,6 +1792,11 @@ class ServingEngine:
             # the serialized live sketches, so the router's health poll
             # IS the exact fleet-merge feed (merge is associative)
             "quality": self.quality.summary(),
+            # the bulk summary rides /healthz too: per-shard durable
+            # cursors are what the router's health loop remembers, so a
+            # dead replica's unfinished range can be re-partitioned from
+            # its last witnessed cursor
+            "bulk": None if self.bulk is None else self.bulk.summary(),
             "image_size": c.image_size,
             "channels": c.channels,
             "levels": c.levels,

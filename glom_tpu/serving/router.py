@@ -59,6 +59,7 @@ from __future__ import annotations
 import bisect
 import hashlib
 import json
+import os
 import re
 import threading
 import time
@@ -281,6 +282,16 @@ class FleetRouter:
             clock=self._clock,
         )
 
+        # -- fleet bulk-job sharding (glom_tpu.serving.bulk) ---------------
+        # the router owns the slot-range partition: submit cuts
+        # [0, total) across healthy replicas, the health loop witnesses
+        # per-shard durable cursors riding /healthz["bulk"], and a dead
+        # owner's remaining ranges are re-cut onto survivors from the
+        # last witnessed cursor (stale is safe: re-execution into the
+        # range-keyed sink is idempotent — docs/BULK.md)
+        self._jobs: Dict[str, dict] = {}
+        self._jobs_lock = threading.Lock()
+
         # consistent-hash ring over ALL replicas (ejection skips forward at
         # lookup time, so only the dead replica's keys move)
         self._ring: List[Tuple[int, Replica]] = sorted(
@@ -407,6 +418,7 @@ class FleetRouter:
                                  t=now)
             self.quality.ingest(replica.name, health.get("quality"),
                                 t=now)
+            self._ingest_bulk(replica.name, health.get("bulk"))
             with self._lock:
                 was_down = not replica.healthy
                 if not was_down:
@@ -454,6 +466,10 @@ class FleetRouter:
         # fleet quality rollup rides the same cadence: exact sketch merge
         # across replicas, fleet-aggregate series into the shared store
         self.quality.rollup(now)
+        # bulk-job re-partition rides the health pass too: it needs the
+        # ejection verdicts this pass just rendered, and it POSTs
+        # submits, so it must run outside the dispatch lock
+        self._repartition_jobs()
 
     def _admit(self, replica: Replica, was_down: bool) -> None:
         """Caller holds the lock."""
@@ -472,6 +488,253 @@ class FleetRouter:
     def _health_loop(self) -> None:
         while not self._stop.wait(self.health_interval_s):
             self.check_health_once()
+
+    # -- fleet bulk-job sharding (docs/BULK.md) -----------------------------
+    def _jobs_post(self, replica: Replica, action: str, payload: dict
+                   ) -> Tuple[int, dict]:
+        try:
+            status, _, raw = self._http(
+                "POST", f"{replica.url}/admin/jobs/{action}",
+                json.dumps(payload).encode(),
+                {"Content-Type": "application/json"}, self.admin_timeout_s)
+        except Exception:  # glomlint: disable=conc-broad-except -- a dead replica answers nothing; the caller records a failed assignment and the health loop's ejection + re-partition recover the range
+            return 0, {}
+        try:
+            return status, json.loads(raw)
+        except ValueError:
+            return status, {}
+
+    def _assign(self, name: str, base: dict, replica: Replica,
+                lo: int, hi: int) -> bool:
+        """Land one ``[lo, hi)`` shard of a job on a replica; records the
+        ownership on success."""
+        status, _ = self._jobs_post(
+            replica, "submit",
+            {**base, "shard": [lo, hi], "owner": replica.name})
+        if status != 200:
+            return False
+        with self._jobs_lock:
+            rec = self._jobs.get(name)
+            if rec is not None:
+                rec["owners"].setdefault(replica.name, []).append((lo, hi))
+        return True
+
+    def submit_job(self, payload: dict) -> dict:
+        """Fleet submit: cut ``[0, total)`` across the healthy replicas
+        (``partition_range`` — the ElasticBatches contiguity contract
+        generalized) and land one shard per replica via its
+        ``/admin/jobs/submit``.  Every replica writes into the SAME sink
+        directory (shared filesystem), so the finished parts assemble
+        into one output regardless of which replica ran which range."""
+        from glom_tpu.bulk.jobs import partition_range
+
+        name = payload.get("name")
+        if not name:
+            raise ValueError("fleet submit needs a job name")
+        total = payload.get("total")
+        if total is None:
+            m = re.match(r"^synthetic:([1-9]\d*)$",
+                         str(payload.get("dataset", "")))
+            if m is None:
+                raise ValueError(
+                    "fleet submit needs an explicit total (a file-glob "
+                    "dataset may list differently per host)")
+            total = int(m.group(1))
+        total = int(total)
+        base = {k: v for k, v in payload.items()
+                if k not in ("shard", "owner")}
+        base["total"] = total
+        with self._lock:
+            healthy = [r for r in self.replicas if r.healthy]
+        if not healthy:
+            raise NoHealthyReplica("no healthy replica to take the job")
+        with self._jobs_lock:
+            if name in self._jobs:
+                raise ValueError(f"job {name!r} already submitted to "
+                                 f"the fleet")
+            self._jobs[name] = {
+                "payload": base, "total": total, "status": "running",
+                "owners": {}, "witnessed": {}, "revoked": [],
+            }
+        failed = []
+        for i, (lo, hi) in enumerate(partition_range(0, total,
+                                                     len(healthy))):
+            # first choice by position; a refusal (bulk disabled, dead
+            # mid-submit) falls through the rest of the rotation
+            order = healthy[i % len(healthy):] + healthy[:i % len(healthy)]
+            if not any(self._assign(name, base, r, lo, hi)
+                       for r in order):
+                failed.append((lo, hi))
+        with self._jobs_lock:
+            rec = self._jobs[name]
+            owners = {o: [list(r) for r in rs]
+                      for o, rs in rec["owners"].items()}
+            if failed and not rec["owners"]:
+                del self._jobs[name]  # nobody took anything: clean slate
+        if failed:
+            raise RuntimeError(
+                f"job {name!r}: no replica accepted ranges {failed}")
+        self.note_event("bulk_submit", job=name, total=total,
+                        owners=owners)
+        return self.job_status(name)
+
+    def job_status(self, name: Optional[str] = None) -> dict:
+        """One job's fleet progress (``name``), else every job plus the
+        aggregate backlog — built from the cursors the health loop
+        witnessed, so it costs no extra HTTP."""
+        with self._jobs_lock:
+            names = [name] if name is not None else sorted(self._jobs)
+            jobs = {}
+            backlog = 0
+            for n in names:
+                rec = self._jobs.get(n)
+                if rec is None:
+                    raise KeyError(f"no fleet job {n!r}")
+                shards = []
+                done = 0
+                for owner, w in sorted(rec["witnessed"].items()):
+                    for s in sorted(w.get("shards", {}).values(),
+                                    key=lambda s: s["lo"]):
+                        shards.append({**s, "owner": owner})
+                        done += s["cursor"] - s["lo"]
+                total = rec["total"]
+                done = min(done, total)
+                if rec["status"] not in ("cancelled", "paused") \
+                        and done >= total:
+                    rec["status"] = "done"
+                jobs[n] = {
+                    "name": n, "status": rec["status"], "total": total,
+                    "done": done, "remaining": total - done,
+                    "owners": {o: [list(r) for r in rs]
+                               for o, rs in rec["owners"].items()},
+                    "shards": shards,
+                }
+                if rec["status"] in ("running", "paused"):
+                    backlog += total - done
+        if name is not None:
+            return jobs[name]
+        return {"jobs": jobs, "backlog": backlog}
+
+    def job_admin(self, action: str, name: str) -> dict:
+        """Fan a pause/resume/cancel out to every owning replica."""
+        if action not in ("pause", "resume", "cancel"):
+            raise ValueError(f"no fleet jobs action {action!r}")
+        with self._jobs_lock:
+            rec = self._jobs.get(name)
+            if rec is None:
+                raise KeyError(f"no fleet job {name!r}")
+            owner_names = sorted(rec["owners"])
+        with self._lock:
+            targets = [r for r in self.replicas if r.name in owner_names]
+        acks = {}
+        for replica in targets:
+            status, _ = self._jobs_post(replica, action, {"name": name})
+            acks[replica.name] = status == 200
+        with self._jobs_lock:
+            rec = self._jobs.get(name)
+            if rec is not None:
+                rec["status"] = {"pause": "paused", "resume": "running",
+                                 "cancel": "cancelled"}[action]
+        self.note_event(f"bulk_{action}", job=name, acks=acks)
+        return {"action": action, "acks": acks, **self.job_status(name)}
+
+    def _ingest_bulk(self, replica_name: str,
+                     bulk: Optional[dict]) -> None:
+        """Fold a replica's ``/healthz`` bulk summary into the fleet job
+        registry.  The per-shard durable cursors witnessed here are the
+        resume points a re-partition cuts from when the replica dies —
+        at worst one health interval stale, which only means a survivor
+        re-executes a little of what the dead replica finished (the
+        range-keyed sink makes that rewrite byte-identical)."""
+        if not bulk:
+            return
+        with self._jobs_lock:
+            for jname, jst in (bulk.get("jobs") or {}).items():
+                rec = self._jobs.get(jname)
+                if rec is None:
+                    continue  # locally-submitted job, not fleet-managed
+                w = rec["witnessed"].setdefault(
+                    replica_name, {"status": None, "shards": {}})
+                w["status"] = jst.get("status")
+                for s in jst.get("shards", ()):
+                    w["shards"][str(s["lo"])] = {
+                        "lo": int(s["lo"]), "hi": int(s["hi"]),
+                        "cursor": int(s["cursor"]),
+                    }
+
+    def _repartition_jobs(self) -> None:
+        """Re-cut every dead owner's unfinished ranges onto healthy
+        survivors, each resuming from its last WITNESSED durable cursor.
+        Also revokes (cancels) the job on any moved-away owner that came
+        back, so a re-admitted replica doesn't duplicate work a survivor
+        now owns.  Runs in the health pass, outside the dispatch lock —
+        it POSTs submits."""
+        with self._lock:
+            healthy = [r for r in self.replicas if r.healthy]
+            healthy_names = {r.name for r in healthy}
+        if not healthy:
+            return  # nobody to move work to; retry next pass
+        moves, revokes = [], []
+        with self._jobs_lock:
+            for jname, rec in self._jobs.items():
+                if rec["status"] in ("cancelled", "done"):
+                    continue
+                for owner in [o for o in rec["owners"]
+                              if o not in healthy_names]:
+                    w = rec["witnessed"].get(owner, {}).get("shards", {})
+                    remaining = []
+                    for lo, hi in rec["owners"].pop(owner):
+                        cur = int(w.get(str(lo), {}).get("cursor", lo))
+                        if cur < hi:
+                            remaining.append((cur, hi))
+                    if owner not in rec["revoked"]:
+                        rec["revoked"].append(owner)
+                    if remaining:
+                        moves.append((jname, dict(rec["payload"]),
+                                      owner, remaining))
+                for owner in rec["revoked"]:
+                    if owner in healthy_names:
+                        revokes.append((jname, owner))
+        from glom_tpu.bulk.jobs import partition_range
+
+        for jname, base, dead, remaining in moves:
+            blocks = []
+            for c, hi in remaining:
+                blocks.extend(partition_range(c, hi, len(healthy)))
+            unassigned = []
+            for i, (lo, hi) in enumerate(blocks):
+                order = (healthy[i % len(healthy):]
+                         + healthy[:i % len(healthy)])
+                if not any(self._assign(jname, base, r, lo, hi)
+                           for r in order):
+                    unassigned.append((lo, hi))
+            if unassigned:
+                # nobody took these now: park them back on the dead
+                # owner so the next health pass retries the re-partition
+                with self._jobs_lock:
+                    rec = self._jobs.get(jname)
+                    if rec is not None:
+                        rec["owners"].setdefault(
+                            dead, []).extend(unassigned)
+                        if dead in rec["revoked"]:
+                            rec["revoked"].remove(dead)
+            self.note_event(
+                "bulk_repartition", job=jname, dead=dead,
+                moved=[list(b) for b in blocks if b not in unassigned],
+                survivors=sorted(r.name for r in healthy))
+        for jname, owner in revokes:
+            with self._lock:
+                replica = next((r for r in self.replicas
+                                if r.name == owner), None)
+            if replica is None:
+                continue
+            status, _ = self._jobs_post(replica, "cancel", {"name": jname})
+            if status in (200, 404):
+                with self._jobs_lock:
+                    rec = self._jobs.get(jname)
+                    if rec is not None and owner in rec["revoked"]:
+                        rec["revoked"].remove(owner)
+                self.note_event("bulk_revoke", job=jname, replica=owner)
 
     # -- dispatch -----------------------------------------------------------
     def _hash_pick(self, key: str) -> Optional[Replica]:
@@ -909,6 +1172,10 @@ class FleetRouter:
             "rollout_phase": self.rollout_phase,
             "replicas": replicas,
         }
+        with self._jobs_lock:
+            if self._jobs:
+                out["bulk_jobs"] = {
+                    n: rec["status"] for n, rec in self._jobs.items()}
         if model:
             # surface the model's input contract so loadgen (and any other
             # client) reads the router exactly like a single engine
@@ -1105,6 +1372,17 @@ class _RouterHandler(BaseHTTPRequestHandler):
             # fleet quality rollup: exactly-merged replica sketches plus
             # the per-replica summaries they were merged from
             self._reply(200, router.quality.payload())
+        elif parsed.path == "/admin/jobs/status":
+            # fleet bulk-job progress: built from health-loop-witnessed
+            # cursors, so the read costs no replica HTTP
+            from urllib.parse import parse_qs
+
+            q = parse_qs(parsed.query)
+            try:
+                self._reply(200, router.job_status(q.get("name",
+                                                         [None])[0]))
+            except KeyError as e:
+                self._reply(404, {"error": str(e)})
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 
@@ -1128,6 +1406,44 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 step=int(step) if step is not None else None)
             code = 200 if report["status"] in ("committed", "noop") else 502
             self._reply(code, report)
+            return
+        if self.path.startswith("/admin/jobs/"):
+            # fleet bulk-job admin: submit shards the range across the
+            # healthy replicas; pause/resume/cancel fan out to owners
+            action = self.path[len("/admin/jobs/"):]
+            length = int(self.headers.get("Content-Length") or 0)
+            payload = {}
+            if length:
+                try:
+                    payload = json.loads(self.rfile.read(length))
+                except ValueError as e:
+                    self._reply(400, {"error": f"invalid JSON: {e}"})
+                    return
+            if not isinstance(payload, dict):
+                self._reply(400, {"error": "body must be a JSON object"})
+                return
+            try:
+                if action == "submit":
+                    self._reply(200, router.submit_job(payload))
+                elif action == "status":
+                    self._reply(200,
+                                router.job_status(payload.get("name")))
+                elif action in ("pause", "resume", "cancel"):
+                    name = payload.get("name")
+                    if not name:
+                        self._reply(400, {"error": f"{action} needs a "
+                                                   f"job name"})
+                        return
+                    self._reply(200, router.job_admin(action, name))
+                else:
+                    self._reply(404,
+                                {"error": f"no jobs action {action!r}"})
+            except KeyError as e:
+                self._reply(404, {"error": str(e)})
+            except NoHealthyReplica as e:
+                self._reply(503, {"error": str(e)})
+            except (RuntimeError, ValueError) as e:
+                self._reply(409, {"error": str(e)})
             return
         if self.path not in ROUTED_PATHS:
             self._reply(404, {"error": f"no route {self.path}"})
@@ -1225,6 +1541,9 @@ def _spawn_fleet(n: int, args) -> Tuple[List[str], list]:
             quant=args.quant,
             # passed through raw: the engine normalizes None/'auto'/int
             warm_iters=args.warm_iters,
+            # per-replica job store; the shared sink lives in the specs
+            bulk_dir=(os.path.join(args.bulk_dir, f"r{i}")
+                      if getattr(args, "bulk_dir", None) else None),
         )
         engine.start(watch=False)
         # per-replica capacity sampler: its /healthz summary feeds the
@@ -1290,6 +1609,11 @@ def main(argv=None) -> int:
     p.add_argument("--capacity-persist-windows", type=int, default=5,
                    help="consecutive scale-up windows before a replica-"
                         "side capacity_pressure incident is expected")
+    p.add_argument("--bulk-dir", default=None, metavar="DIR",
+                   help="--spawn mode: enable the bulk inference tier "
+                        "with a per-replica job store under DIR/<name> "
+                        "(docs/BULK.md); the router shards /admin/jobs/* "
+                        "submits across the fleet")
     p.add_argument("--platform", default="auto",
                    help="force a JAX platform for --spawn (e.g. 'cpu')")
     p.add_argument("--verbose", action="store_true")

@@ -243,6 +243,21 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, engine.quality.payload())
         elif parsed.path == "/admin/deploy/status":
             self._reply(200, engine.deploy.status())
+        elif parsed.path == "/admin/jobs/status":
+            # bulk-job progress (read-only GET mirror of the POST verb):
+            # ?name= narrows to one job, else the full summary
+            if engine.bulk is None:
+                self._reply(404, {"error": "bulk tier disabled on this "
+                                           "engine (start with --bulk-dir)"})
+                return
+            from urllib.parse import parse_qs
+
+            q = parse_qs(parsed.query)
+            name = q.get("name", [None])[0]
+            try:
+                self._reply(200, engine.bulk.status(name))
+            except KeyError as e:
+                self._reply(404, {"error": str(e)})
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 
@@ -332,6 +347,40 @@ class _Handler(BaseHTTPRequestHandler):
                             {"error": f"no deploy action {action!r}"})
         except (RuntimeError, ValueError) as e:
             # a second concurrent deploy, a bad fraction: caller error
+            self._reply(409, {"error": str(e)})
+
+    # -- bulk-job admin: the scavenger tier's control verbs ----------------
+    # POSTed by tools/bulk_run.py or the router's fleet sharding
+    # (docs/BULK.md).  Control-plane calls, untraced, mirroring the
+    # /admin/deploy convention.
+    def _do_jobs_admin(self):
+        engine = self.server.engine
+        if engine.bulk is None:
+            self._reply(404, {"error": "bulk tier disabled on this engine "
+                                       "(start with --bulk-dir)"})
+            return
+        action = self.path[len("/admin/jobs/"):]
+        payload = (self._read_json() if int(
+            self.headers.get("Content-Length") or 0) > 0 else {})
+        if payload is None:
+            return
+        try:
+            if action == "submit":
+                self._reply(200, engine.bulk.submit(payload))
+            elif action == "status":
+                self._reply(200, engine.bulk.status(payload.get("name")))
+            elif action == "pause":
+                self._reply(200, engine.bulk.pause(payload["name"]))
+            elif action == "resume":
+                self._reply(200, engine.bulk.resume(payload["name"]))
+            elif action == "cancel":
+                self._reply(200, engine.bulk.cancel(payload["name"]))
+            else:
+                self._reply(404, {"error": f"no jobs action {action!r}"})
+        except KeyError as e:
+            self._reply(404, {"error": f"unknown job: {e}"})
+        except (RuntimeError, ValueError) as e:
+            # identity mismatch, overlapping shard, done-job resubmit
             self._reply(409, {"error": str(e)})
 
     # -- stateful session endpoints ----------------------------------------
@@ -475,6 +524,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.path.startswith("/admin/deploy/"):
             self._do_deploy_admin()
+            return
+        if self.path.startswith("/admin/jobs/"):
+            self._do_jobs_admin()
             return
         if self.path == "/admin/quality/ref":
             # freeze the CURRENT live quality distributions as the drift
@@ -784,6 +836,11 @@ def main(argv=None) -> int:
                    help="consecutive scale-up windows before the advisor "
                         "fires the debounced capacity_pressure forensics "
                         "incident")
+    p.add_argument("--bulk-dir", default=None, metavar="DIR",
+                   help="enable the bulk inference tier: job-store "
+                        "directory for scavenger-class offline jobs "
+                        "(docs/BULK.md); unfinished jobs in the store "
+                        "resume automatically on start")
     p.add_argument("--quality-sample", type=float, default=1.0,
                    help="fraction of served batches fed through the "
                         "model-quality post-pass (island agreement, "
@@ -872,6 +929,7 @@ def main(argv=None) -> int:
                           if args.capacity_ceiling is not None
                           else read_bench_ceiling()),
         quality_sample=args.quality_sample,
+        bulk_dir=args.bulk_dir,
     )
     engine.start()
     engine.capacity.start()  # sampler thread: tests tick() with a fake clock

@@ -1,0 +1,29 @@
+"""bulk-isolation bad fixture: the scavenger tier reaching into the
+online plane, plus an unbounded enqueue buffer.
+
+Shape 1: importing the SLO plane and admission/quota symbols into a
+bulk module — offline work must never know the online control plane
+exists, let alone consult or mutate it.
+
+Shape 2: a per-slot staging list that grows on every fill and is never
+capped or evicted — a stalled sink turns it into an unbounded queue
+riding inside the serving process.
+"""
+
+from glom_tpu.obs.slo import SloManager          # BAD: SLO plane import
+from glom_tpu.serving.batcher import TenantAdmission  # BAD: admission
+
+
+class LeakyBulkRunner:
+    def __init__(self, engine):
+        self.engine = engine
+        self.slo = SloManager([])                # bulk work is SLO'd (!)
+        self.admission = TenantAdmission("bulk=1/1")
+        self._staged = []                        # unbounded enqueue buffer
+
+    def fill(self, imgs):
+        # BAD: consults online admission for offline work
+        self.admission.admit("bulk", 1)
+        # BAD: grows per slot, never capped, never evicted
+        self._staged.append(imgs)
+        return len(imgs)

@@ -1,0 +1,22 @@
+"""bulk-isolation good fixture: the fixed form of bad/bulk/runner.py.
+
+No online-plane imports — the scavenger tier only sees the engine
+surface it is handed — and the staging buffer is a bounded deque, so a
+stalled sink back-pressures instead of queueing without limit.
+"""
+
+from collections import deque
+
+
+class BoundedBulkRunner:
+    def __init__(self, engine, *, max_staged: int = 64):
+        self.engine = engine
+        # bounded: a stalled sink drops the oldest staged fill instead
+        # of growing without limit
+        self._staged = deque(maxlen=max_staged)
+
+    def fill(self, imgs):
+        # no admission check: bulk slots ride padding the online plane
+        # already paid for — they are invisible to quotas by contract
+        self._staged.append(imgs)
+        return len(imgs)

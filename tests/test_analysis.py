@@ -85,6 +85,8 @@ RULE_FIXTURES = [
      "serving/lock_remint.py"),
     ("conc-escaping-state", "serving/spill_escape.py",
      "serving/spill_escape.py"),
+    # -- the bulk tier (PR 18): scavenger-class isolation --
+    ("bulk-isolation", "bulk/runner.py", "bulk/runner.py"),
 ]
 
 #: (fixture, the PR whose review finding it reduces) — each must be

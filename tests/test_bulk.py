@@ -1,0 +1,400 @@
+"""Bulk inference tier: scavenger-class offline jobs with exactly-once
+resume (PR 18).
+
+Tier-1 gates for the bulk job store and the in-engine scavenger:
+
+  * the exactly-once cursor — sink-then-cursor commit order, monotone
+    bounded advance, durable across a kill/reload, and preserved across
+    a re-partition (the dead owner's un-acknowledged tail is re-executed
+    into identical bytes, its orphan parts dropped);
+  * the idempotent chunk sink — rewrite-in-place, orphan-overlap
+    unlinking, and exact-tiling assembly;
+  * the ``ElasticBatches`` addressing pin — a bulk synthetic slot is
+    byte-identical to the trainer's, so the global-slot cursor MEANS the
+    same thing in both exactly-once planes;
+  * the scavenger priority contract — residual bucket padding is filled
+    without changing online outputs, and idle execution is preempted at
+    the admission boundary (depth > 0 => zero bulk slots start);
+  * the two subprocess smokes — ``tools/bulk_run.py --smoke`` (kill
+    mid-job -> resume -> bitwise-identical output, zero compiles) and
+    ``tools/chaos.py --scenario bulk_preemption`` (online p95/shed
+    unchanged under an active job, and the job completes).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from glom_tpu.bulk.jobs import (
+    BulkJobSpec,
+    ChunkSink,
+    JobStore,
+    SlotDataset,
+    partition_range,
+)
+from glom_tpu.serving.engine import (
+    DEMO_CONFIG,
+    ServingEngine,
+    make_demo_checkpoint,
+)
+from glom_tpu.training.data import ElasticBatches
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spec(tmp_path, name="job", total=20, seed=5, **kw):
+    kw.setdefault("image_size", 8)
+    kw.setdefault("channels", 3)
+    return BulkJobSpec(name=name, dataset=f"synthetic:{total}",
+                      transform="embed",
+                      sink=str(tmp_path / f"{name}_out"), seed=seed, **kw)
+
+
+# ---------------------------------------------------------------------------
+# partition math
+# ---------------------------------------------------------------------------
+class TestPartitionRange:
+    def test_near_equal_contiguous_cover(self):
+        parts = partition_range(0, 10, 3)
+        assert parts == [(0, 4), (4, 7), (7, 10)]
+        # disjoint contiguous cover of [0, 10)
+        assert parts[0][0] == 0 and parts[-1][1] == 10
+        assert all(a[1] == b[0] for a, b in zip(parts, parts[1:]))
+
+    def test_more_parts_than_slots_drops_empties(self):
+        assert partition_range(0, 2, 5) == [(0, 1), (1, 2)]
+
+    def test_single_part_identity(self):
+        assert partition_range(3, 9, 1) == [(3, 9)]
+
+    def test_empty_range(self):
+        assert partition_range(4, 4, 2) == []
+
+
+# ---------------------------------------------------------------------------
+# dataset addressing
+# ---------------------------------------------------------------------------
+class TestSlotDataset:
+    def test_synthetic_matches_elastic_batches_addressing(self, tmp_path):
+        """THE contract pin: a bulk job's synthetic slot is derived from
+        SeedSequence([seed, slot]) exactly like the trainer's
+        ElasticBatches sample, so the global-slot cursor means the same
+        thing in both exactly-once planes."""
+        seed = 11
+        ds = SlotDataset(_spec(tmp_path, total=16, seed=seed))
+        stream = ElasticBatches(4, image_size=8, channels=3, seed=seed)
+        for slot in (0, 3, 7, 15):
+            np.testing.assert_array_equal(
+                ds.read(slot, slot + 1)[0], stream._sample(slot))
+
+    def test_read_stacks_range(self, tmp_path):
+        ds = SlotDataset(_spec(tmp_path, total=10))
+        got = ds.read(2, 6)
+        assert got.shape == (4, 3, 8, 8) and got.dtype == np.float32
+        np.testing.assert_array_equal(got[1], ds.read(3, 4)[0])
+
+    def test_read_outside_range_raises(self, tmp_path):
+        ds = SlotDataset(_spec(tmp_path, total=10))
+        with pytest.raises(ValueError, match="outside"):
+            ds.read(4, 11)
+
+    def test_len_is_declared_total(self, tmp_path):
+        assert len(SlotDataset(_spec(tmp_path, total=37))) == 37
+
+
+# ---------------------------------------------------------------------------
+# idempotent sink
+# ---------------------------------------------------------------------------
+class TestChunkSink:
+    def test_rewrite_is_idempotent(self, tmp_path):
+        sink = ChunkSink(str(tmp_path / "out"))
+        data = np.arange(12, dtype=np.float32).reshape(4, 3)
+        sink.write(0, 4, data)
+        sink.write(0, 4, data)  # the resume re-execution shape
+        parts = sink.parts()
+        assert [(lo, hi) for lo, hi, _ in parts] == [(0, 4)]
+        np.testing.assert_array_equal(sink.assemble(4), data)
+
+    def test_orphan_overlap_unlinked_on_rewrite(self, tmp_path):
+        """A dead owner's un-acknowledged part past the durable cursor
+        is chunked at boundaries the re-partitioned owners won't
+        reproduce: writing the new chunks must drop the stale one, or
+        assemble() would see overlap."""
+        sink = ChunkSink(str(tmp_path / "out"))
+        sink.write(4, 12, np.zeros((8, 3), np.float32))  # orphan
+        a = np.ones((4, 3), np.float32)
+        b = np.full((4, 3), 2.0, np.float32)
+        sink.write(4, 8, a)    # new owner 1 re-executes its cut
+        sink.write(8, 12, b)   # new owner 2 re-executes its cut
+        assert [(lo, hi) for lo, hi, _ in sink.parts()] == [(4, 8), (8, 12)]
+        np.testing.assert_array_equal(
+            np.concatenate([np.load(p) for _, _, p in sink.parts()]),
+            np.concatenate([a, b]))
+
+    def test_disjoint_parts_survive_each_other(self, tmp_path):
+        sink = ChunkSink(str(tmp_path / "out"))
+        sink.write(0, 4, np.zeros((4, 3), np.float32))
+        sink.write(4, 8, np.ones((4, 3), np.float32))
+        assert len(sink.parts()) == 2
+        assert sink.assemble(8).shape == (8, 3)
+
+    def test_assemble_rejects_gap(self, tmp_path):
+        sink = ChunkSink(str(tmp_path / "out"))
+        sink.write(0, 4, np.zeros((4, 3), np.float32))
+        sink.write(6, 8, np.zeros((2, 3), np.float32))
+        with pytest.raises(ValueError, match="tile"):
+            sink.assemble(8)
+
+    def test_assemble_rejects_short_cover(self, tmp_path):
+        sink = ChunkSink(str(tmp_path / "out"))
+        sink.write(0, 4, np.zeros((4, 3), np.float32))
+        with pytest.raises(ValueError, match="total"):
+            sink.assemble(8)
+
+    def test_row_count_mismatch_rejected(self, tmp_path):
+        sink = ChunkSink(str(tmp_path / "out"))
+        with pytest.raises(ValueError, match="rows"):
+            sink.write(0, 4, np.zeros((3, 3), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# the exactly-once cursor
+# ---------------------------------------------------------------------------
+class TestJobStore:
+    def test_cursor_durable_across_kill_and_reload(self, tmp_path):
+        """The kill/resume half of exactly-once: a new store over the
+        same root (a restarted process) sees the last durable cursor and
+        nothing past it."""
+        root = str(tmp_path / "store")
+        store = JobStore(root)
+        store.submit(_spec(tmp_path), total=20)
+        store.advance("job", 0, 8)
+        del store  # the "kill": only the durable file survives
+        resumed = JobStore(root)
+        st = resumed.status("job")
+        assert st["done"] == 8 and st["status"] == "running"
+        resumed.advance("job", 0, 20)
+        assert resumed.status("job")["status"] == "done"
+
+    def test_advance_monotone_and_bounded(self, tmp_path):
+        store = JobStore(str(tmp_path / "store"))
+        store.submit(_spec(tmp_path), total=20)
+        store.advance("job", 0, 8)
+        with pytest.raises(ValueError, match="monotone"):
+            store.advance("job", 0, 4)       # backwards
+        with pytest.raises(ValueError, match="monotone"):
+            store.advance("job", 0, 21)      # past hi
+        assert store.status("job")["done"] == 8  # both rejected durably
+
+    def test_resubmit_same_identity_is_idempotent(self, tmp_path):
+        store = JobStore(str(tmp_path / "store"))
+        spec = _spec(tmp_path)
+        store.submit(spec, total=20, shards=[(0, 20)], owner="r0")
+        store.advance("job", 0, 8)
+        doc = store.submit(spec, total=20, shards=[(0, 20)], owner="r0")
+        assert doc["shards"][0]["cursor"] == 8  # progress kept
+
+    def test_resubmit_different_identity_rejected(self, tmp_path):
+        store = JobStore(str(tmp_path / "store"))
+        store.submit(_spec(tmp_path, seed=5), total=20)
+        with pytest.raises(ValueError, match="identity"):
+            store.submit(_spec(tmp_path, seed=6), total=20)
+
+    def test_overlapping_shards_rejected(self, tmp_path):
+        store = JobStore(str(tmp_path / "store"))
+        spec = _spec(tmp_path)
+        store.submit(spec, total=20, shards=[(0, 10)], owner="r0")
+        with pytest.raises(ValueError, match="overlap"):
+            store.submit(spec, total=20, shards=[(5, 15)], owner="r1")
+
+    def test_repartition_moves_only_the_undone_tail(self, tmp_path):
+        """The re-partition half of exactly-once: the dead owner keeps
+        exactly its durable prefix; the tail is re-cut across survivors
+        starting AT the witnessed cursor, so no slot is dropped and none
+        is owned twice."""
+        store = JobStore(str(tmp_path / "store"))
+        spec = _spec(tmp_path, total=40)
+        store.submit(spec, total=40, shards=[(0, 20)], owner="r0")
+        store.submit(spec, total=40, shards=[(20, 40)], owner="r1")
+        store.advance("job", 20, 28)  # r1 died at durable cursor 28
+        new = store.repartition("job", "r1", ["r0", "r2"])
+        assert [(s["lo"], s["hi"], s["owner"]) for s in new] == [
+            (28, 34, "r0"), (34, 40, "r2")]
+        shards = store.status("job")["shards"]
+        # r1 keeps its durable prefix only; the cover is exact
+        assert [(s["lo"], s["hi"], s["owner"], s["cursor"])
+                for s in shards] == [
+            (0, 20, "r0", 0), (20, 28, "r1", 28),
+            (28, 34, "r0", 28), (34, 40, "r2", 34)]
+
+    def test_repartition_unstarted_shard_removed(self, tmp_path):
+        store = JobStore(str(tmp_path / "store"))
+        spec = _spec(tmp_path, total=20)
+        store.submit(spec, total=20, shards=[(0, 10)], owner="r0")
+        store.submit(spec, total=20, shards=[(10, 20)], owner="r1")
+        store.repartition("job", "r1", ["r0"])
+        shards = store.status("job")["shards"]
+        assert [(s["lo"], s["hi"], s["owner"]) for s in shards] == [
+            (0, 10, "r0"), (10, 20, "r0")]
+
+    def test_summary_backlog_counts_unfinished_slots(self, tmp_path):
+        store = JobStore(str(tmp_path / "store"))
+        store.submit(_spec(tmp_path), total=20)
+        store.advance("job", 0, 8)
+        assert store.summary()["backlog"] == 12
+
+
+# ---------------------------------------------------------------------------
+# the in-engine scavenger
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def bulk_ckpt(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("bulk_ckpt"))
+    make_demo_checkpoint(d)
+    return d
+
+
+def _imgs(n, seed=0):
+    c = DEMO_CONFIG
+    return np.random.RandomState(seed).randn(
+        n, c.channels, c.image_size, c.image_size).astype(np.float32)
+
+
+def _engine(ckpt, tmp_path, bulk=True):
+    return ServingEngine(
+        ckpt, buckets=(1, 4), max_wait_ms=0.0, warmup=True,
+        reload_poll_s=0,
+        bulk_dir=str(tmp_path / "bulk_store") if bulk else None)
+
+
+def _payload(tmp_path, total, name="job", seed=5):
+    return {"name": name, "dataset": f"synthetic:{total}",
+            "transform": "embed", "seed": seed,
+            "sink": str(tmp_path / f"{name}_out")}
+
+
+class TestScavenger:
+    def test_residual_fill_leaves_online_outputs_bitwise_identical(
+            self, bulk_ckpt, tmp_path):
+        """Three online images in a 4-bucket leave one residual slot;
+        the scavenger fills it, and the online callers must get bytes
+        identical to a no-bulk engine's — the invisibility contract."""
+        imgs = _imgs(3)
+        ctrl = _engine(bulk_ckpt, tmp_path, bulk=False)
+        try:
+            futs = [ctrl.submit("embed", imgs[i:i + 1]) for i in range(3)]
+            ctrl.process_once("embed", block=True)
+            ref = [f.result(timeout=10) for f in futs]
+        finally:
+            ctrl.shutdown(drain=False)
+
+        eng = _engine(bulk_ckpt, tmp_path)
+        try:
+            eng.bulk.submit(_payload(tmp_path, total=11))
+            futs = [eng.submit("embed", imgs[i:i + 1]) for i in range(3)]
+            eng.process_once("embed", block=True)
+            got = [f.result(timeout=10) for f in futs]
+            for r, g in zip(ref, got):
+                assert np.asarray(r).tobytes() == np.asarray(g).tobytes()
+            snap = eng.registry.snapshot()
+            assert snap.get("bulk_scavenged_slots_total", 0.0) >= 1
+            assert snap.get("serving_xla_compiles", 0.0) == 0
+            # the scavenged slot is durably committed
+            assert eng.bulk.status("job")["done"] >= 1
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_idle_execution_preempted_at_admission_boundary(
+            self, bulk_ckpt, tmp_path):
+        """run_idle_once must execute ZERO bulk slots while an online
+        image is queued — preemption happens before a bulk batch starts,
+        not after."""
+        eng = _engine(bulk_ckpt, tmp_path)
+        try:
+            eng.bulk.submit(_payload(tmp_path, total=9))
+            fut = eng.submit("embed", _imgs(1))
+            assert eng.batchers["embed"].depth > 0
+            assert eng.bulk.run_idle_once() == 0  # preempted
+            eng.process_once("embed", block=True)
+            fut.result(timeout=10)
+            assert eng.bulk.run_idle_once() > 0   # idle again: runs
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_idle_loop_drains_job_and_output_assembles(
+            self, bulk_ckpt, tmp_path):
+        eng = _engine(bulk_ckpt, tmp_path)
+        total = 11
+        try:
+            eng.bulk.submit(_payload(tmp_path, total=total))
+            for _ in range(2 * total):
+                if eng.bulk.status("job")["status"] == "done":
+                    break
+                eng.bulk.run_idle_once()
+            st = eng.bulk.status("job")
+            assert st["status"] == "done" and st["done"] == total
+            out = ChunkSink(str(tmp_path / "job_out")).assemble(total)
+            assert out.shape[0] == total
+            snap = eng.registry.snapshot()
+            assert snap.get("bulk_idle_slots_total", 0.0) >= total - 1
+            assert snap.get("serving_xla_compiles", 0.0) == 0
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_geometry_mismatch_rejected_at_submit(self, bulk_ckpt,
+                                                  tmp_path):
+        eng = _engine(bulk_ckpt, tmp_path)
+        try:
+            with pytest.raises(ValueError, match="geometry"):
+                eng.bulk.submit(dict(_payload(tmp_path, total=4),
+                                     image_size=8))
+        finally:
+            eng.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# the subprocess smokes
+# ---------------------------------------------------------------------------
+class TestSmokes:
+    def test_bulk_run_smoke_kill_resume_bitwise(self):
+        """tools/bulk_run.py --smoke: submit over HTTP, kill the replica
+        mid-job (no drain), resume on a fresh engine over the same
+        store, and the assembled output is bitwise-identical to an
+        uninterrupted control with zero request-path compiles."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "bulk_run.py"),
+             "--smoke"],
+            capture_output=True, text=True, timeout=280, env=env, cwd=ROOT,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        summary = json.loads(proc.stdout)
+        assert summary["smoke"] == "ok"
+        assert summary["checks"]["killed_mid_job"]
+        assert summary["checks"]["bitwise_identical"]
+        assert summary["checks"]["zero_request_path_compiles"]
+        assert 0 < summary["durable_done_at_kill"] < summary["total_slots"]
+
+    def test_chaos_bulk_preemption_scenario(self, tmp_path):
+        """tools/chaos.py bulk_preemption: an online burst during an
+        active bulk job sees control-equal p95/shed, and the job still
+        completes."""
+        out_json = str(tmp_path / "chaos.json")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "chaos.py"),
+             "--smoke", "--scenario", "bulk_preemption",
+             "--json", out_json],
+            capture_output=True, text=True, timeout=280, env=env, cwd=ROOT,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        with open(out_json) as f:
+            summary = json.load(f)
+        assert summary["recovered"] == summary["total"] == 1
+        rec = summary["results"][0]
+        assert rec["outcome"] == "recovered"
+        assert rec["shed"][0] == rec["shed"][1]

@@ -730,6 +730,7 @@ class TestChaosCli:
             "reload_io_error", "train_crash", "replica_kill",
             "canary_regression", "quality_regression",
             "host_preempt", "coordinator_loss", "shrink_restart",
+            "bulk_preemption",
         }
 
     def test_smoke_suite_recovers(self, tmp_path):
@@ -745,7 +746,7 @@ class TestChaosCli:
         assert proc.returncode == 0, proc.stdout + proc.stderr
         with open(out_json) as f:
             summary = json.load(f)
-        assert summary["recovered"] == summary["total"] == 11
+        assert summary["recovered"] == summary["total"] == 12
         for rec in summary["results"]:
             assert rec["outcome"] == "recovered", rec
             assert rec["mttr_s"] >= 0.0
@@ -764,4 +765,4 @@ class TestChaosSoak:
         assert proc.returncode == 0, proc.stdout + proc.stderr
         with open(out_json) as f:
             summary = json.load(f)
-        assert summary["recovered"] == summary["total"] == 11
+        assert summary["recovered"] == summary["total"] == 12

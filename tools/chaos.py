@@ -972,6 +972,103 @@ def scenario_shrink_restart(soak):
                 "resumed_from": sup.plan.resume_step}
 
 
+def scenario_bulk_preemption(soak):
+    """An online burst lands while a scavenger-class bulk job is active:
+    the bulk tier must be INVISIBLE to the online plane — client-observed
+    p95 and the shed count must match a no-bulk control burst on the same
+    warmed engine (generous CI margins; the contract is the order of
+    magnitude), the request path must never compile, and the job must
+    still complete once the burst passes (preemption pauses the
+    scavenger, it does not starve it forever)."""
+    import threading
+
+    import numpy as np
+
+    from glom_tpu.serving.engine import ServingEngine, make_demo_checkpoint
+
+    n_requests, n_threads, total = (60, 3, 400) if not soak \
+        else (240, 4, 1600)
+    with tempfile.TemporaryDirectory() as root:
+        ckpt = os.path.join(root, "ckpt")
+        make_demo_checkpoint(ckpt)
+        eng = ServingEngine(ckpt, buckets=(1, 4), max_wait_ms=1.0,
+                            warmup=True, reload_poll_s=0,
+                            bulk_dir=os.path.join(root, "bulk"))
+        eng.start(watch=False)
+        img = np.zeros((1, 3, 16, 16), np.float32)
+        lock = threading.Lock()
+
+        def burst(latencies):
+            def worker(n):
+                for _ in range(n):
+                    t0 = time.monotonic()
+                    eng.submit("embed", img).result(timeout=30)
+                    dt = time.monotonic() - t0
+                    with lock:
+                        latencies.append(dt)
+            threads = [threading.Thread(
+                target=worker, args=(n_requests // n_threads,),
+                daemon=True) for _ in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+
+        def p95(latencies):
+            return sorted(latencies)[int(0.95 * (len(latencies) - 1))]
+
+        try:
+            # -- control: identical burst, no bulk job anywhere --------
+            control = []
+            shed0 = eng.registry.snapshot().get("serving_shed_total", 0.0)
+            burst(control)
+            shed_control = eng.registry.snapshot().get(
+                "serving_shed_total", 0.0) - shed0
+            # -- the scenario: same burst with an active bulk job ------
+            eng.bulk.submit({
+                "name": "preempt", "dataset": f"synthetic:{total}",
+                "transform": "embed", "seed": 3,
+                "sink": os.path.join(root, "out")})
+            t_fault = time.monotonic()
+            under_bulk = []
+            shed1 = eng.registry.snapshot().get("serving_shed_total", 0.0)
+            burst(under_bulk)
+            shed_bulk = eng.registry.snapshot().get(
+                "serving_shed_total", 0.0) - shed1
+            mid = eng.bulk.status("preempt")
+            # the burst must not have been starved out by bulk work
+            assert len(under_bulk) == len(control) == \
+                n_threads * (n_requests // n_threads)
+            assert shed_bulk == shed_control, (shed_control, shed_bulk)
+            p95_control, p95_bulk = p95(control), p95(under_bulk)
+            # "unchanged": 3x + 50 ms absolute — CPU CI scheduling noise
+            # dwarfs any real signal below that
+            assert p95_bulk <= p95_control * 3 + 0.05, (
+                f"bulk job degraded online p95: control "
+                f"{p95_control * 1e3:.1f} ms -> {p95_bulk * 1e3:.1f} ms")
+            # ...and the job still completes once the burst passes
+            deadline = time.monotonic() + 120
+            while (eng.bulk.status("preempt")["status"] != "done"
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            mttr = time.monotonic() - t_fault
+            st = eng.bulk.status("preempt")
+            assert st["status"] == "done", st
+            snap = eng.registry.snapshot()
+            assert snap.get("serving_xla_compiles", 0.0) == 0, snap
+            assert snap.get("bulk_slots_total", 0.0) >= total
+        finally:
+            eng.shutdown(drain=False)
+        return {"mttr_s": round(mttr, 3),
+                "p95_control_ms": round(p95_control * 1e3, 2),
+                "p95_under_bulk_ms": round(p95_bulk * 1e3, 2),
+                "shed": [shed_control, shed_bulk],
+                "job_done_at_burst_end": mid["done"],
+                "bulk_slots": snap.get("bulk_slots_total", 0.0),
+                "scavenged_slots": snap.get(
+                    "bulk_scavenged_slots_total", 0.0)}
+
+
 SCENARIOS = {
     "torn_ckpt_write": scenario_torn_ckpt_write,
     "corrupt_restore": scenario_corrupt_restore,
@@ -984,6 +1081,7 @@ SCENARIOS = {
     "host_preempt": scenario_host_preempt,
     "coordinator_loss": scenario_coordinator_loss,
     "shrink_restart": scenario_shrink_restart,
+    "bulk_preemption": scenario_bulk_preemption,
 }
 
 
