@@ -202,7 +202,9 @@ class ForensicsManager:
                  config: Optional[Dict[str, Any]] = None, mesh=None,
                  trace_steps: int = 0,
                  snapshot_fn: Optional[Callable[[], Dict[str, Any]]] = None,
-                 registry=None, clock: Optional[Clock] = None):
+                 registry=None, clock: Optional[Clock] = None,
+                 attribution_fn: Optional[Callable[[],
+                                                   Dict[str, Any]]] = None):
         if trace_steps < 0:
             raise ValueError(f"trace_steps must be >= 0, got {trace_steps}")
         self.root = root
@@ -214,6 +216,10 @@ class ForensicsManager:
         self.trace_steps = trace_steps
         self._snapshot_fn = snapshot_fn
         self._registry = registry
+        # attribution_fn() -> verdict dict; regression-class bundles
+        # (slo_burn / capacity_pressure / quality_drift) attach it as
+        # attribution.json so the bundle answers "why" not just "what"
+        self._attribution_fn = attribution_fn
         self._env: Optional[Dict[str, Any]] = None
         self._trace_stop_step: Optional[int] = None
         self._trace_bundle: Optional[str] = None
@@ -251,6 +257,17 @@ class ForensicsManager:
         files: Dict[str, Any] = {"env.json": self._env}
         if extra_files:
             files.update(extra_files)
+        attribution_error = None
+        if self._attribution_fn is not None and trigger in (
+                "slo_burn", "capacity_pressure", "quality_drift"):
+            # regression-class triggers get the automatic root-cause
+            # verdict; a failed attribution must never block the bundle
+            try:
+                verdict = self._attribution_fn()
+                if verdict is not None:
+                    files["attribution.json"] = verdict
+            except Exception as e:  # glomlint: disable=conc-broad-except -- attribution is derived evidence; the primary bundle must land even when the verdict engine breaks
+                attribution_error = f"{type(e).__name__}: {e}"
         if self._config is not None:
             files["config.json"] = self._config
         if self.recorder is not None:
@@ -265,6 +282,8 @@ class ForensicsManager:
             "created_unix": self._clock(),
             "ring_records": len(self.recorder.snapshot()) if self.recorder else 0,
         }
+        if attribution_error is not None:
+            manifest["attribution_error"] = attribution_error
         if snapshot and self._snapshot_fn is not None:
             try:
                 snap = self._snapshot_fn() or {}

@@ -63,6 +63,7 @@ import warnings
 from collections import OrderedDict, deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from glom_tpu.obs import attribution
 from glom_tpu.obs.forensics import is_bundle_dir, write_bundle
 from glom_tpu.obs.registry import MetricRegistry
 from glom_tpu.obs.timeseries import (SeriesStore, linear_trend, series_key,
@@ -783,6 +784,56 @@ class FleetObservatory:
             self.series.record_snapshot(
                 {k: sum(vs) for k, vs in fleet.items()}, t=now)
 
+    # -- serving phase series (attribution evidence) -----------------------
+    def _ingest_serving(self, forensics: Dict[str, dict]) -> None:
+        """Fold the serving phase-timing scalars — the attribution
+        plane's evidence: per-phase histogram ``_sum``/``_count`` pairs
+        plus the request total — into the fleet series store (caller
+        holds ``_lock``).  SUMS across replicas: histogram sums and
+        counts are both additive, so the fleet aggregate stays a valid
+        (sum, count) pair and windowed means stay request-weighted.  No
+        per-replica labeled points (unlike the capacity/quality folds):
+        the phase ladder x replicas would dominate the store's
+        cardinality, and the "why" pane only needs the fleet roll-up."""
+        now = self._clock()
+        fleet: Dict[str, float] = {}
+        for payload in forensics.values():
+            reg = payload.get("registry") or {}
+            for k, v in reg.items():
+                if (isinstance(v, (int, float))
+                        and attribution.is_phase_scalar(k)):
+                    fleet[k] = fleet.get(k, 0.0) + float(v)
+        if fleet:
+            self.series.record_snapshot(fleet, t=now)
+
+    def _why_pane(self) -> Optional[Dict[str, Any]]:
+        """Console attribution verdict (caller holds ``_lock``): the
+        always-on answer to "why did fleet latency move" — the same
+        :func:`~glom_tpu.obs.attribution.attribute` engine the forensics
+        bundles and ``tools/whyslow.py`` run, over the fleet-summed
+        serving phase series and the router timeline.  None until the
+        series show a knee — a healthy fleet has no verdict to show."""
+        series: Dict[str, list] = {}
+        for name in self.series.names("serving_"):
+            pts = self.series.points(name)
+            if pts:
+                series[name] = [[t, v] for t, v in pts]
+        if not series:
+            return None
+        verdict = attribution.attribute(
+            {"series": series, "timeline": list(self._timeline)})
+        if verdict.get("knee") is None:
+            return None
+        return {
+            "verdict": verdict["verdict"],
+            "confidence": verdict["confidence"],
+            "knee": verdict["knee"],
+            "regression": verdict["regression"],
+            "top_phases": [p for p in verdict["phases"]
+                           if p.get("share")][:3],
+            "causes": verdict["causes"][:3],
+        }
+
     def _jobs_pane(self) -> Dict[str, Any]:
         """Console bulk-jobs view (caller holds ``_lock``): fleet job
         progress from the router's health block, per-replica scavenge
@@ -1101,6 +1152,7 @@ class FleetObservatory:
                 self._ingest_capacity(forensics)
                 self._ingest_quality(forensics)
                 self._ingest_bulk(forensics)
+                self._ingest_serving(forensics)
                 incidents = self._check_incidents(fresh_events, forensics)
                 return {
                     "poll": self._poll_n,
@@ -1161,6 +1213,7 @@ class FleetObservatory:
             "capacity": self._capacity_pane(),
             "quality": self._quality_pane(),
             "jobs": self._jobs_pane(),
+            "why": self._why_pane(),
             "padding_waste": {
                 str(bucket): {
                     "batches": agg["batches"],

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import threading
 import time
+import warnings
 from collections import deque
 from dataclasses import dataclass
 from typing import Any, Dict, Optional
@@ -140,17 +141,55 @@ class BulkRunner:
         for name in self.store.names():
             doc = self.store.load(name)
             if doc["status"] in ("pending", "running", "paused"):
-                self._activate(name, doc)
+                try:
+                    self._activate(name, doc)
+                except ValueError as e:
+                    # adopt-on-resume must not fail engine startup: a job
+                    # pinned to a (model, version) that is not resident
+                    # yet parks in the store; `resume` re-activates it
+                    # once the operator load_version's the pin
+                    warnings.warn(
+                        f"bulk job {name!r} not adopted ({e}); parked "
+                        f"until resumed", stacklevel=2)
+                    self.registry.counter(
+                        "bulk_jobs_parked_total",
+                        help="store jobs skipped at adoption (pinned "
+                             "version not resident / stale spec)",
+                    ).inc()
         self._gauge_backlog()
+
+    # -- model/version resolution -------------------------------------------
+    def _resolve_version(self, spec: BulkJobSpec):
+        """The (params, caches, config) a job executes against.
+
+        Unpinned specs (``model="default"``, no version) ride the LIVE
+        primary — re-read per batch, so promotions apply to bulk too.  A
+        pinned (model, version) must be resident in the model registry;
+        its aliased AOT caches keep the zero-compile invariant, and the
+        attribution plane can then blame device time on that exact
+        version instead of lumping it in with online traffic."""
+        engine = self.engine
+        if spec.model == "default" and spec.version is None:
+            return engine.params, engine.caches, engine.config
+        version = engine.models.get(
+            spec.model, None if spec.version is None else int(spec.version))
+        if version is None:
+            raise ValueError(
+                f"pin ({spec.model!r}, {spec.version!r}) is not resident "
+                f"in the model registry; load_version it first")
+        return version.params, version.caches, version.config
+
+    def _pinned(self, spec: BulkJobSpec) -> bool:
+        return spec.model != "default" or spec.version is not None
 
     # -- job admin (the /admin/jobs/* verbs) -------------------------------
     def _activate(self, name: str, doc: dict) -> None:
         spec = BulkJobSpec.from_json_dict(doc["spec"])
-        if spec.transform not in self.engine.caches:
+        _, caches, cfg = self._resolve_version(spec)
+        if spec.transform not in caches:
             raise ValueError(
                 f"job {name!r} transform {spec.transform!r} not served "
                 f"by this engine")
-        cfg = self.engine.config
         if (spec.image_size != cfg.image_size
                 or spec.channels != cfg.channels):
             raise ValueError(
@@ -176,16 +215,20 @@ class BulkRunner:
         fields.setdefault("image_size", int(cfg.image_size))
         fields.setdefault("channels", int(cfg.channels))
         spec = BulkJobSpec(**fields)
-        if (spec.image_size != cfg.image_size
-                or spec.channels != cfg.channels):
+        # resolve the pin BEFORE anything durable is written: a job
+        # against a version that is not resident must fail the submit,
+        # not park a half-created store entry
+        _, vcaches, vcfg = self._resolve_version(spec)
+        if spec.transform not in vcaches:
+            raise ValueError(
+                f"transform {spec.transform!r} not served by pin "
+                f"({spec.model!r}, {spec.version!r})")
+        if (spec.image_size != vcfg.image_size
+                or spec.channels != vcfg.channels):
             raise ValueError(
                 f"job geometry ({spec.channels}, {spec.image_size}) does "
                 f"not match the served model "
-                f"({cfg.channels}, {cfg.image_size})")
-        if spec.model != "default" or spec.version is not None:
-            raise ValueError(
-                "bulk jobs execute against the primary default model; "
-                "model/version pinning is recorded but not yet servable")
+                f"({vcfg.channels}, {vcfg.image_size})")
         probe = SlotDataset(spec)  # validates the dataset spec eagerly
         total = int(payload.get("total", len(probe)))
         if total > len(probe):
@@ -196,6 +239,9 @@ class BulkRunner:
         doc = self.store.submit(spec, total=total, shards=shards,
                                 owner=str(payload.get("owner", "local")))
         self._activate(spec.name, doc)
+        self._note("bulk_submit", name=spec.name, model=spec.model,
+                   version=spec.version, endpoint=spec.transform,
+                   total=total)
         self._gauge_backlog()
         return self.status(spec.name)
 
@@ -211,7 +257,16 @@ class BulkRunner:
         self._activate(name, doc)
         with self._lock:
             self._jobs[name].paused = False
+        self._note("bulk_resume", name=name)
         return self.status(name)
+
+    def _note(self, event: str, **fields) -> None:
+        """Unified timeline record (obs.events): bulk activity carries
+        its (model, version) pin so attribution can tell a bulk job on a
+        pinned version apart from online traffic."""
+        timeline = getattr(self.engine, "timeline", None)
+        if timeline is not None:
+            timeline.note(event, **fields)
 
     def cancel(self, name: str) -> dict:
         self.store.set_status(name, "cancelled")
@@ -226,17 +281,27 @@ class BulkRunner:
         return self.summary()
 
     # -- the scavenger fill/complete/abandon cycle --------------------------
-    def fill(self, endpoint: str, k: int,
-             source: str = "scavenged") -> Optional[_FillToken]:
+    def fill(self, endpoint: str, k: int, source: str = "scavenged",
+             job_name: Optional[str] = None) -> Optional[_FillToken]:
         """Stage up to ``k`` slots of some runnable job whose transform
         is ``endpoint``.  Returns None when nothing is runnable — the
         overwhelmingly common case, kept to a dict scan.  The staged
-        chunk is NOT durable: only :meth:`complete` commits it."""
+        chunk is NOT durable: only :meth:`complete` commits it.
+
+        Scavenged fills ride the ONLINE batch's executable — i.e. the
+        live primary — so jobs pinned to another (model, version) are
+        never scavenged; they only run in idle windows, where the idle
+        loop names the job (``job_name``) and executes the pin's own
+        params/caches."""
         if k < 1:
             return None
         with self._lock:
             for name, job in self._jobs.items():
                 if job.spec.transform != endpoint:
+                    continue
+                if job_name is not None and name != job_name:
+                    continue
+                if source == "scavenged" and self._pinned(job.spec):
                     continue
                 chunk = job.next_chunk(k)
                 if chunk is not None:
@@ -302,19 +367,25 @@ class BulkRunner:
         a bulk batch never starts while an online image waits.  Returns
         slots executed."""
         with self._lock:
-            candidates = [(name, job.spec.transform)
+            candidates = [(name, job.spec)
                           for name, job in self._jobs.items()
                           if not job.paused]
-        for name, endpoint in candidates:
+        for name, spec in candidates:
             engine = self.engine
+            endpoint = spec.transform
             if engine.batchers[endpoint].depth > 0:
                 continue  # online admission preempts before we start
-            cache = engine.caches[endpoint]
-            token = self.fill(endpoint, cache.max_bucket, source="idle")
+            try:
+                params, caches, _ = self._resolve_version(spec)
+            except ValueError:
+                continue  # pin evicted since activation: job waits
+            cache = caches[endpoint]
+            token = self.fill(endpoint, cache.max_bucket, source="idle",
+                              job_name=name)
             if token is None:
                 continue
             try:
-                out = np.asarray(cache(engine.params, token.imgs))
+                out = np.asarray(cache(params, token.imgs))
             except Exception:
                 self.abandon(token)
                 self.registry.counter(

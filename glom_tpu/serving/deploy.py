@@ -402,6 +402,8 @@ class DeployController:
             "deploy_promotes_total",
             help="candidates promoted to primary after clean burn windows",
         ).inc()
+        self._note_event("deploy_promote", step=int(cand.step),
+                         from_step=old_step)
         self.last_report = report
         return report
 
@@ -440,6 +442,8 @@ class DeployController:
             "deploy_rollbacks_total",
             help="candidate deploys auto/operator-rolled-back",
         ).inc()
+        self._note_event("deploy_rollback", step=old_step,
+                         candidate_step=int(cand.step), reason=reason)
         self._capture_rollback(report, offenders, rates)
         self.last_report = report
         return report
@@ -458,6 +462,7 @@ class DeployController:
             self._stop_evaluating()
         self._note_idle()
         self.engine.models.remove("default", cand.step)
+        self._note_event("deploy_abort", candidate_step=int(cand.step))
         self.last_report = {"action": "aborted",
                             "candidate_step": cand.step,
                             "t": round(self._clock(), 3)}
@@ -524,6 +529,15 @@ class DeployController:
             "deploy_candidate_step",
             help="checkpoint step of the active deploy candidate",
         ).set(step)
+        # unified timeline record (obs.events): the attribution plane
+        # correlates these transitions with the regression knee.  Leaf
+        # lock only, so safe under _lock.
+        self._note_event(f"deploy_{phase}", step=int(step))
+
+    def _note_event(self, event: str, **fields) -> None:
+        timeline = getattr(self.engine, "timeline", None)
+        if timeline is not None:
+            timeline.note(event, **fields)
 
     # -- canary assignment -------------------------------------------------
     def assign(self, key: Optional[str]) -> Optional[int]:

@@ -71,6 +71,8 @@ from glom_tpu.config import GlomConfig, TrainConfig
 from glom_tpu.models import glom as glom_model
 from glom_tpu.models.heads import decoder_apply
 from glom_tpu.obs import MetricRegistry
+from glom_tpu.obs import attribution as obs_attribution
+from glom_tpu.obs.events import Timeline
 from glom_tpu.obs.forensics import ForensicsManager
 from glom_tpu.obs.quality import QualityPlane, make_quality_fn, unpack_signals
 from glom_tpu.obs.slo import SLO, SloManager, parse_slo
@@ -482,6 +484,13 @@ class ServingEngine:
             debounce_steps=saturation_debounce, max_captures=max_captures,
             registry=self.registry,
         )
+        # -- unified event timeline (glom_tpu.obs.events) ------------------
+        # One typed ring for every engine-side state transition: deploy
+        # phase changes, capacity-advisor recommendations, bulk job
+        # activity.  Served at GET /debug/timeline (role "engine") and
+        # joined by the attribution plane against the TSDB-lite series.
+        self.timeline = Timeline(clock=self._clock)
+
         self._forensics: Optional[ForensicsManager] = None
         if forensics_dir:
             # snapshot_fn reuses the warmup record for the largest bucket —
@@ -494,6 +503,8 @@ class ServingEngine:
                         "glom": self.config.to_json_dict()},
                 snapshot_fn=lambda: self.caches["embed"].snapshots.get(max_bucket),
                 registry=self.registry,
+                attribution_fn=lambda: obs_attribution.attribute(
+                    obs_attribution.collect_engine_evidence(self)),
             )
         # now that triggers/forensics exist, give quarantine events the
         # full pipeline (debounced ckpt_corrupt trigger -> bundle)
@@ -616,6 +627,10 @@ class ServingEngine:
             forensics=self._forensics,
             tenants_fn=(lambda: self.tenants.snapshot()
                         if self.tenants is not None else None),
+            on_recommend=lambda rec: self.timeline.note(
+                "capacity_recommendation", action=rec["action"],
+                reasons=rec.get("reasons", []),
+                persisted=rec.get("persisted", 0)),
         )
 
         # -- bulk inference tier (glom_tpu.serving.bulk) -------------------

@@ -66,11 +66,11 @@ import time
 import urllib.error
 import urllib.request
 import warnings
-from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from glom_tpu.obs import MetricRegistry
+from glom_tpu.obs.events import Timeline
 from glom_tpu.obs.exporters import (
     OPENMETRICS_CONTENT_TYPE,
     PROM_TEXT_CONTENT_TYPE,
@@ -228,12 +228,11 @@ class FleetRouter:
         # bounded ring of the fleet's state transitions — ejections,
         # re-admissions, rollout phase outcomes — each with a monotone
         # seq so the observatory reads incrementally and correlates them
-        # with replica-side forensics into one incident bundle.  Its own
-        # leaf lock: note_event never acquires another lock, so it is
-        # safely callable from under _lock or _rollout_lock.
-        self._timeline: "deque" = deque(maxlen=256)
-        self._timeline_lock = threading.Lock()
-        self._timeline_seq = 0
+        # with replica-side forensics into one incident bundle.  The ring
+        # is the shared typed Timeline (obs.events): its own leaf lock,
+        # so note_event never acquires another lock and is safely
+        # callable from under _lock or _rollout_lock.
+        self._timeline = Timeline(maxlen=256, clock=self._clock)
         # coarse rollout-state-machine position for the fleet console
         # (plain str store/load — no lock needed for a telemetry read)
         self.rollout_phase = "idle"
@@ -303,22 +302,15 @@ class FleetRouter:
         self._gauge_replicas()
 
     # -- event timeline -----------------------------------------------------
-    def note_event(self, kind: str, **fields) -> None:
+    def note_event(self, event: str, **fields) -> None:
         """Append one fleet state transition to the bounded timeline
-        (``/debug/timeline``).  Leaf operation: takes only its own lock,
-        callable from anywhere including under the dispatch lock."""
-        with self._timeline_lock:
-            self._timeline.append({
-                "seq": self._timeline_seq,
-                "t": round(self._clock(), 6),
-                "event": kind,
-                **fields,
-            })
-            self._timeline_seq += 1
+        (``/debug/timeline``) as a typed TimelineEvent.  Leaf operation:
+        takes only the timeline's own lock, callable from anywhere
+        including under the dispatch lock."""
+        self._timeline.note(event, **fields)
 
     def timeline(self) -> List[dict]:
-        with self._timeline_lock:
-            return list(self._timeline)
+        return self._timeline.events()
 
     # -- metrics helpers ----------------------------------------------------
     def _gauge_replicas(self) -> None:

@@ -235,6 +235,12 @@ class _Handler(BaseHTTPRequestHandler):
             # the TSDB-lite pull plane (glom_tpu.obs.timeseries): ring-
             # bounded history of every registry metric, for trend queries
             self._reply(200, engine.capacity.series_payload(parsed.query))
+        elif parsed.path == "/debug/timeline":
+            # the engine's unified event timeline (glom_tpu.obs.events):
+            # deploy transitions, advisor recommendations, bulk activity —
+            # the attribution plane's event-correlation feed
+            self._reply(200, {"role": "engine", "step": int(engine.step),
+                              "events": engine.timeline.events()})
         elif parsed.path == "/capacity":
             self._reply(200, engine.capacity.payload())
         elif parsed.path == "/quality":

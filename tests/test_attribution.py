@@ -1,0 +1,415 @@
+"""Regression attribution plane tests (glom_tpu/obs/events.py,
+glom_tpu/obs/attribution.py, tools/whyslow.py).
+
+Tier-1 (CPU): the unified TimelineEvent vocabulary (legacy ``kind``
+tolerance, deterministic merge ordering, ring bounds), knee detection
+(dominant step beats trend_flip on deploy-shaped series, trend_flip
+catches gradual drift), phase decomposition (share normalization,
+per-bucket refinement rows excluded from the denominator, counter-reset
+refusal), event scoring (temporal-alignment decay, plane priors, the
+causality filter), snapshot diffing, and the verdict contract itself —
+the golden fixture must reproduce BYTE-IDENTICAL canonical JSON, seeded
+reordering of the same evidence must not move a byte, and evidence with
+no knee or no aligned actor must come back ``inconclusive`` with empty
+causes, never a fabricated one.  The forensics attribution.json hook and
+the tools/whyslow.py --smoke subprocess gate (real engine, injected slow
+canary — the chaos.py pattern) ride at the end.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+
+import pytest
+
+from glom_tpu.obs.attribution import (
+    MIN_CONFIDENCE,
+    attribute,
+    canonical_json,
+    diff_snapshots,
+    find_knee,
+    is_phase_scalar,
+    latency_series,
+    phase_deltas,
+    render_text,
+    score_events,
+    snapshot_phase_deltas,
+)
+from glom_tpu.obs.events import (
+    ADVISORY_EVENTS,
+    BULK_EVENTS,
+    DEPLOY_EVENTS,
+    Timeline,
+    TimelineEvent,
+    merge_events,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE_DIR = os.path.join(ROOT, "tests", "data", "attribution")
+
+
+def _mk_series(series, base, before, after, *, n=20, rate=10):
+    tot_s, tot_c = 0.0, 0
+    s, c = [], []
+    for i in range(n):
+        tot_c += rate
+        tot_s += rate * (before if i < n // 2 else after)
+        s.append([float(i), round(tot_s, 6)])
+        c.append([float(i), float(tot_c)])
+    series[base + "_sum"] = s
+    series[base + "_count"] = c
+
+
+def _deploy_evidence():
+    """A deploy-shaped regression: queue_wait jumps at t=10, a
+    deploy_canary event lands just before the knee."""
+    series = {}
+    _mk_series(series, "serving_request_ms", 10.0, 60.0)
+    _mk_series(series, "serving_queue_wait_ms", 2.0, 48.0)
+    _mk_series(series, "serving_execute_ms", 5.0, 6.0)
+    _mk_series(series, "serving_parse_ms", 1.0, 1.0)
+    _mk_series(series, "serving_respond_ms", 1.0, 1.0)
+    _mk_series(series, "serving_execute_ms_b2", 5.0, 6.0)
+    timeline = [
+        {"seq": 0, "t": 2.0, "event": "reload"},
+        {"seq": 1, "t": 9.6, "event": "deploy_canary", "step": 2,
+         "fraction": 1.0},
+        {"seq": 2, "t": 15.0, "event": "capacity_recommendation",
+         "action": "scale_up"},
+    ]
+    return {"series": series, "timeline": timeline,
+            "window": {"start": 0.0, "end": 19.0}}
+
+
+# ---------------------------------------------------------------------------
+# events.py: the unified timeline vocabulary
+# ---------------------------------------------------------------------------
+class TestTimelineEvents:
+    def test_note_shape_and_monotone_seq(self):
+        tl = Timeline(clock=lambda: 42.125)
+        tl.note("deploy_canary", step=2, fraction=0.5)
+        tl.note("ejection", replica="r1")
+        evs = tl.events()
+        assert [e["seq"] for e in evs] == [0, 1]
+        assert evs[0] == {"seq": 0, "t": 42.125, "event": "deploy_canary",
+                          "step": 2, "fraction": 0.5}
+        assert len(tl) == 2
+
+    def test_ring_bound(self):
+        tl = Timeline(maxlen=4, clock=lambda: 0.0)
+        for i in range(10):
+            tl.note("reload", i=i)
+        evs = tl.events()
+        assert len(evs) == 4
+        # oldest evicted, seq keeps counting
+        assert [e["seq"] for e in evs] == [6, 7, 8, 9]
+
+    def test_from_dict_tolerates_legacy_kind(self):
+        ev = TimelineEvent.from_dict({"kind": "ejection", "t": 1.0,
+                                      "replica": "r0"})
+        assert ev.event == "ejection"
+        assert ev.seq == -1
+        assert ev.fields == {"replica": "r0"}
+
+    def test_merge_events_deterministic_order(self):
+        feed_a = [{"seq": 1, "t": 5.0, "event": "b"},
+                  {"seq": 0, "t": 5.0, "event": "a"}]
+        feed_b = [TimelineEvent(seq=2, t=1.0, event="c")]
+        merged = merge_events(feed_a, feed_b)
+        assert [(e.t, e.seq) for e in merged] == [(1.0, 2), (5.0, 0),
+                                                 (5.0, 1)]
+
+    def test_plane_vocabularies_disjoint(self):
+        assert not (DEPLOY_EVENTS & BULK_EVENTS)
+        assert not (DEPLOY_EVENTS & ADVISORY_EVENTS)
+
+    def test_is_phase_scalar(self):
+        assert is_phase_scalar("serving_queue_wait_ms_sum")
+        assert is_phase_scalar("serving_execute_ms_b4_count")
+        assert not is_phase_scalar("serving_queue_wait_ms_p95")
+        assert not is_phase_scalar("serving_shed_total")
+        assert not is_phase_scalar("capacity_p95_ms")
+
+
+# ---------------------------------------------------------------------------
+# knee detection
+# ---------------------------------------------------------------------------
+class TestFindKnee:
+    def test_step_regression_lands_on_the_step(self):
+        pts = [(float(i), 10.0 if i < 10 else 60.0) for i in range(20)]
+        knee = find_knee(pts)
+        assert knee["kind"] == "step"
+        assert knee["t"] == 10.0
+        assert knee["step"] == 50.0
+
+    def test_gradual_drift_uses_trend_flip(self):
+        pts = [(float(i), 10.0) for i in range(10)]
+        pts += [(float(10 + i), 10.0 + 0.8 * i) for i in range(10)]
+        knee = find_knee(pts)
+        assert knee is not None
+        assert knee["kind"] == "trend_flip"
+
+    def test_flat_series_no_knee(self):
+        assert find_knee([(float(i), 10.0) for i in range(20)]) is None
+        assert find_knee([]) is None
+
+
+# ---------------------------------------------------------------------------
+# phase decomposition
+# ---------------------------------------------------------------------------
+class TestPhaseDeltas:
+    def test_shares_and_bucket_exclusion(self):
+        series = {}
+        _mk_series(series, "serving_queue_wait_ms", 2.0, 42.0)
+        _mk_series(series, "serving_execute_ms", 5.0, 15.0)
+        _mk_series(series, "serving_execute_ms_b2", 5.0, 15.0)
+        rows = phase_deltas(series, 0.0, 10.0, 19.0)
+        by = {r["phase"]: r for r in rows}
+        # bucket row mirrors execute but is EXCLUDED from the share
+        # denominator: shares over {queue_wait: 40, execute: 10}
+        assert by["queue_wait"]["share"] == 0.8
+        assert by["execute"]["share"] == 0.2
+        assert by["execute_b2"]["share"] == 0.2
+        assert by["execute_b2"]["bucket"] == 2
+        assert rows[0]["phase"] == "queue_wait"  # sorted by delta
+
+    def test_counter_reset_refused(self):
+        series = {
+            "serving_execute_ms_sum": [[0.0, 100.0], [5.0, 200.0],
+                                       [9.0, 210.0], [12.0, 50.0],
+                                       [19.0, 60.0]],
+            "serving_execute_ms_count": [[0.0, 10.0], [5.0, 20.0],
+                                         [9.0, 21.0], [12.0, 5.0],
+                                         [19.0, 6.0]],
+        }
+        # the process restarted at t~10: inside the after-window the
+        # counters go BACKWARD
+        rows = phase_deltas(series, 0.0, 8.0, 19.0)
+        by = {r["phase"]: r for r in rows}
+        # the restart makes the after-window deltas negative: refuse
+        assert by["execute"]["after_ms"] is None
+        assert by["execute"]["delta_ms"] is None
+
+    def test_snapshot_phase_deltas_matches_series_math(self):
+        before = {"serving_queue_wait_ms_sum": 200.0,
+                  "serving_queue_wait_ms_count": 100.0,
+                  "serving_execute_ms_sum": 500.0,
+                  "serving_execute_ms_count": 100.0}
+        after = {"serving_queue_wait_ms_sum": 200.0 + 48.0 * 100,
+                 "serving_queue_wait_ms_count": 200.0,
+                 "serving_execute_ms_sum": 500.0 + 5.0 * 100,
+                 "serving_execute_ms_count": 200.0}
+        rows = snapshot_phase_deltas(before, after)
+        by = {r["phase"]: r for r in rows}
+        assert by["queue_wait"]["before_ms"] == 2.0
+        assert by["queue_wait"]["after_ms"] == 48.0
+        assert by["queue_wait"]["share"] == pytest.approx(46.0 / 46.0)
+        assert by["execute"]["after_ms"] == 5.0
+
+    def test_snapshot_counter_reset_refused(self):
+        rows = snapshot_phase_deltas(
+            {"serving_execute_ms_sum": 500.0,
+             "serving_execute_ms_count": 100.0},
+            {"serving_execute_ms_sum": 50.0,
+             "serving_execute_ms_count": 10.0})
+        assert rows[0]["after_ms"] is None
+
+
+# ---------------------------------------------------------------------------
+# event scoring
+# ---------------------------------------------------------------------------
+class TestScoreEvents:
+    def test_alignment_and_plane_priors(self):
+        tl = [
+            {"seq": 0, "t": 9.8, "event": "deploy_canary", "step": 2},
+            {"seq": 1, "t": 9.8, "event": "bulk_submit", "name": "j"},
+            {"seq": 2, "t": 2.0, "event": "deploy_shadow", "step": 2},
+        ]
+        scored = score_events(tl, 0.0, 10.0, 20.0)
+        assert scored[0]["event"] == "deploy_canary"  # same dt, higher prior
+        assert scored[0]["score"] > scored[1]["score"]
+        assert scored[0]["step"] == 2
+        # distance decays the same plane
+        canary = scored[0]["score"]
+        shadow = next(e for e in scored if e["event"] == "deploy_shadow")
+        assert shadow["score"] < canary
+
+    def test_causality_filter(self):
+        tl = [{"seq": 0, "t": 15.0, "event": "deploy_canary", "step": 2}]
+        # an event 5s AFTER the knee cannot have caused it
+        assert score_events(tl, 0.0, 10.0, 20.0) == []
+        # within the slack it survives (sampling granularity)
+        tl = [{"seq": 0, "t": 10.9, "event": "deploy_canary", "step": 2}]
+        assert len(score_events(tl, 0.0, 10.0, 20.0)) == 1
+
+
+# ---------------------------------------------------------------------------
+# snapshot diffing
+# ---------------------------------------------------------------------------
+class TestDiffSnapshots:
+    def test_nothing_moved_is_none(self):
+        snap = {"1": {"quant": "bf16",
+                      "cost_analysis": {"flops": 100.0}}}
+        assert diff_snapshots(snap, json.loads(json.dumps(snap))) is None
+        assert diff_snapshots(None, snap) is None
+
+    def test_quant_and_cost_delta(self):
+        before = {1: {"quant": "bf16",
+                      "cost_analysis": {"flops": 100.0,
+                                        "bytes accessed": 10.0}}}
+        after = {1: {"quant": "int8",
+                     "cost_analysis": {"flops": 200.0,
+                                       "bytes accessed": 10.0}}}
+        d = diff_snapshots(before, after)
+        row = d["buckets"][0]
+        assert row["quant"] == {"before": "bf16", "after": "int8"}
+        assert row["flops"]["ratio"] == 2.0
+
+    def test_bucket_ladder_change(self):
+        d = diff_snapshots({1: {}, 2: {}}, {1: {}, 4: {}})
+        assert d["bucket_ladder"] == {"added": [4], "removed": [2]}
+
+
+# ---------------------------------------------------------------------------
+# the verdict contract
+# ---------------------------------------------------------------------------
+class TestAttribute:
+    def test_deploy_regression_named(self):
+        v = attribute(_deploy_evidence())
+        assert v["verdict"] != "inconclusive"
+        assert v["confidence"] >= MIN_CONFIDENCE
+        assert v["causes"][0]["kind"] == "event:deploy"
+        assert v["causes"][0]["event"]["event"] == "deploy_canary"
+        assert v["causes"][0]["event"]["step"] == 2
+        top = next(p for p in v["phases"] if "bucket" not in p)
+        assert top["phase"] == "queue_wait"
+        assert top["share"] >= 0.5
+        assert v["explained"]["fraction"] >= 0.5
+        assert "verdict:" in render_text(v)
+
+    def test_golden_fixture_byte_stable(self):
+        """The recorded verdict for the recorded evidence, byte for
+        byte — any drift in rounding, ordering, or schema is a diff."""
+        with open(os.path.join(FIXTURE_DIR, "evidence.json")) as f:
+            evidence = json.load(f)
+        with open(os.path.join(FIXTURE_DIR, "golden_verdict.json")) as f:
+            golden = f.read()
+        assert canonical_json(attribute(evidence)) == golden
+
+    def test_determinism_under_seeded_reordering(self):
+        with open(os.path.join(FIXTURE_DIR, "evidence.json")) as f:
+            evidence = json.load(f)
+        baseline = canonical_json(attribute(evidence))
+        rnd = random.Random(99)
+        for _ in range(3):
+            shuffled = json.loads(json.dumps(evidence))
+            rnd.shuffle(shuffled["timeline"])
+            keys = list(shuffled["series"])
+            rnd.shuffle(keys)
+            shuffled["series"] = {k: shuffled["series"][k] for k in keys}
+            assert canonical_json(attribute(shuffled)) == baseline
+
+    def test_honest_inconclusive_flat_series(self):
+        """No knee => inconclusive with EMPTY causes and a stated
+        reason — never a fabricated actor."""
+        series = {}
+        _mk_series(series, "serving_request_ms", 10.0, 10.0)
+        _mk_series(series, "serving_queue_wait_ms", 2.0, 2.0)
+        v = attribute({"series": series, "timeline": [
+            {"seq": 0, "t": 5.0, "event": "deploy_canary", "step": 2}]})
+        assert v["verdict"] == "inconclusive"
+        assert v["causes"] == []
+        assert any("no knee" in r for r in v["reasons"])
+
+    def test_honest_inconclusive_no_aligned_actor(self):
+        """A real knee but the only event is far away and weak: the top
+        cause falls below the confidence bar => inconclusive, with the
+        below-bar reason on record."""
+        ev = _deploy_evidence()
+        ev["timeline"] = [{"seq": 0, "t": 0.5,
+                           "event": "capacity_recommendation",
+                           "action": "hold"}]
+        v = attribute(ev)
+        assert v["verdict"] == "inconclusive"
+        assert v["causes"] == []
+        assert v["reasons"]
+
+    def test_noise_floor_silences_causes(self):
+        series = {}
+        _mk_series(series, "serving_request_ms", 10.0, 10.5)
+        _mk_series(series, "serving_queue_wait_ms", 2.0, 2.5)
+        v = attribute({"series": series, "timeline": [
+            {"seq": 0, "t": 9.9, "event": "deploy_canary", "step": 2}],
+            "window": {"start": 0.0, "end": 19.0, "knee": 10.0}})
+        assert v["causes"] == []
+        assert v["verdict"] == "inconclusive"
+
+    def test_latency_series_pairwise(self):
+        series = {}
+        _mk_series(series, "serving_request_ms", 10.0, 60.0, n=6)
+        lat = latency_series(series)
+        assert [v for _, v in lat] == [10.0, 10.0, 60.0, 60.0, 60.0]
+
+
+# ---------------------------------------------------------------------------
+# forensics hook: bundles answer "why", errors stay on the manifest
+# ---------------------------------------------------------------------------
+class TestForensicsAttribution:
+    def test_slo_burn_bundle_carries_attribution(self, tmp_path):
+        from glom_tpu.obs import ForensicsManager
+
+        verdict = attribute(_deploy_evidence())
+        mgr = ForensicsManager(str(tmp_path / "f"),
+                               attribution_fn=lambda: verdict)
+        path = mgr.capture("slo_burn", 7, {}, snapshot=False, trace=False)
+        got = json.load(open(os.path.join(path, "attribution.json")))
+        assert got["verdict"] == verdict["verdict"]
+
+    def test_non_regression_trigger_skips_attribution(self, tmp_path):
+        from glom_tpu.obs import ForensicsManager
+
+        mgr = ForensicsManager(
+            str(tmp_path / "f"),
+            attribution_fn=lambda: (_ for _ in ()).throw(RuntimeError()))
+        path = mgr.capture("nan", 3, {}, snapshot=False, trace=False)
+        assert not os.path.exists(os.path.join(path, "attribution.json"))
+        manifest = json.load(open(os.path.join(path, "manifest.json")))
+        assert "attribution_error" not in manifest
+
+    def test_attribution_failure_noted_never_fatal(self, tmp_path):
+        from glom_tpu.obs import ForensicsManager
+
+        def boom():
+            raise RuntimeError("evidence store gone")
+
+        mgr = ForensicsManager(str(tmp_path / "f"), attribution_fn=boom)
+        path = mgr.capture("slo_burn", 7, {}, snapshot=False, trace=False)
+        assert path is not None
+        manifest = json.load(open(os.path.join(path, "manifest.json")))
+        assert "evidence store gone" in manifest["attribution_error"]
+        assert not os.path.exists(os.path.join(path, "attribution.json"))
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 subprocess gate (the chaos.py pattern)
+# ---------------------------------------------------------------------------
+class TestWhyslowSmoke:
+    def test_smoke_suite(self):
+        """tools/whyslow.py --smoke: real engine, injected slow canary at
+        fraction 1.0 => exactly one cause naming the deploy event and
+        queue_wait as the majority phase, zero request-path compiles,
+        byte-identical verdict on re-attribution."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "whyslow.py"),
+             "--smoke"],
+            capture_output=True, text=True, timeout=280, env=env, cwd=ROOT,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        summary = json.loads(proc.stdout)
+        assert summary["smoke"] == "ok"
+        assert all(summary["checks"].values()), summary["checks"]
+        verdict = summary["verdict"]
+        assert verdict["causes"][0]["event"]["event"] == "deploy_canary"

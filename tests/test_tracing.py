@@ -461,9 +461,16 @@ class TestHTTPTracePropagation:
         url, eng, trace_log = served
         _post(url, "/embed", {"images": _imgs(1).tolist()},
               headers={"X-Request-Id": "feed-1"})
-        with open(trace_log) as f:
-            recs = [json.loads(line) for line in f if line.strip()]
-        mine = [r for r in recs if r["trace_id"] == "feed-1"]
+
+        # the file write trails the root-end by a scheduling window (the
+        # handler thread exports after the reply is on the wire) — poll
+        # like test_traceparent_joins_remote_trace does
+        def feed_records():
+            with open(trace_log) as f:
+                recs = [json.loads(line) for line in f if line.strip()]
+            return [r for r in recs if r["trace_id"] == "feed-1"]
+
+        mine = poll_until(feed_records) or []
         assert len(mine) == 1
         assert mine[0]["root"] == "request"
         assert mine[0]["duration_ms"] > 0

@@ -14,13 +14,13 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def load_observatory():
-    """Return the :mod:`glom_tpu.obs.observatory` module, via the normal
-    import when the environment has jax, else via stub packages + file
-    loading."""
+def _load_obs_module(modname: str):
+    """Load ``glom_tpu.obs.<modname>`` via the normal import when the
+    environment has jax, else via stub packages + file loading."""
+    import importlib
+
     try:
-        from glom_tpu.obs import observatory
-        return observatory
+        return importlib.import_module(f"glom_tpu.obs.{modname}")
     except ImportError:
         import importlib.util
         import types
@@ -33,9 +33,21 @@ def load_observatory():
                 stub.__path__ = [path]
                 sys.modules[name] = stub
         spec = importlib.util.spec_from_file_location(
-            "glom_tpu.obs.observatory",
-            os.path.join(REPO, "glom_tpu", "obs", "observatory.py"))
+            f"glom_tpu.obs.{modname}",
+            os.path.join(REPO, "glom_tpu", "obs", f"{modname}.py"))
         mod = importlib.util.module_from_spec(spec)
-        sys.modules["glom_tpu.obs.observatory"] = mod
+        sys.modules[f"glom_tpu.obs.{modname}"] = mod
         spec.loader.exec_module(mod)
         return mod
+
+
+def load_observatory():
+    """Return the :mod:`glom_tpu.obs.observatory` module."""
+    return _load_obs_module("observatory")
+
+
+def load_attribution():
+    """Return the :mod:`glom_tpu.obs.attribution` module (stdlib-only —
+    whyslow/forensics_report run it straight off a scp'd bundle on a
+    machine with no jax)."""
+    return _load_obs_module("attribution")

@@ -78,6 +78,49 @@ def run_check(fixture_dir: str) -> int:
     return 0
 
 
+def _attribute_failure(args):
+    """A failing gate answers WHY when it can: ``--attribution-url``
+    pulls a live /debug plane for the full ranked verdict; otherwise a
+    ``--loadgen-json`` report taken with ``--timeline`` at least locates
+    the knee inside the run.  Best-effort — attribution must never turn
+    a clean FAIL exit into a crash."""
+    from glom_tpu.obs import attribution
+
+    try:
+        if args.attribution_url:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            import whyslow
+
+            evidence = whyslow.collect_url_evidence(
+                args.attribution_url, 300.0, 10.0)
+            verdict = attribution.attribute(evidence)
+        elif args.loadgen_json:
+            with open(args.loadgen_json) as f:
+                report = json.load(f)
+            windows = ((report.get("timeline") or {}).get("windows")) or []
+            pts = [(w["t_s"], w["p95_ms"]) for w in windows
+                   if w.get("p95_ms") is not None]
+            knee = attribution.find_knee(pts)
+            verdict = {
+                "schema": attribution.SCHEMA + "+loadgen-knee",
+                "knee": knee,
+                "verdict": (f"p95 knee at t={knee['t']}s into the loadgen "
+                            f"run ({knee['kind']}); point --attribution-url "
+                            f"at the engine for phase/event attribution"
+                            if knee else "inconclusive"),
+            }
+        else:
+            return None
+    except Exception as e:  # glomlint: disable=conc-broad-except -- attribution is advisory; the gate's own verdict already failed the build
+        return {"error": f"{type(e).__name__}: {e}"}
+    print(f"bench_gate: FAIL attribution: {verdict.get('verdict')}",
+          file=sys.stderr)
+    if args.attribution_json:
+        with open(args.attribution_json, "w") as f:
+            f.write(attribution.canonical_json(verdict))
+    return verdict
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--bench-cmd", default=None,
@@ -118,6 +161,12 @@ def main(argv=None) -> int:
     p.add_argument("--prom-textfile", default=None,
                    help="write the verdict as Prometheus gauges via the obs "
                         "registry (textfile-collector format)")
+    p.add_argument("--attribution-url", default=None, metavar="URL",
+                   help="on FAIL, pull /debug/series + /debug/timeline from "
+                        "this live engine/router and attach a ranked "
+                        "root-cause verdict (tools/whyslow.py) to the result")
+    p.add_argument("--attribution-json", default=None, metavar="FILE",
+                   help="also write the failure attribution verdict here")
     p.add_argument("--check", action="store_true",
                    help="self-test the gate logic against the golden "
                         "fixtures (no accelerator, no bench run)")
@@ -184,6 +233,8 @@ def main(argv=None) -> int:
         "trajectory_rounds": len(trajectory),
         "bench_rc": bench_rc,
     }
+    if verdict == perfgate.GATE_FAIL:
+        result["attribution"] = _attribute_failure(args)
     print(json.dumps(result, indent=2))
     if args.prom_textfile:
         from glom_tpu.obs import MetricRegistry

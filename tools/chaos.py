@@ -1069,6 +1069,135 @@ def scenario_bulk_preemption(soak):
                     "bulk_scavenged_slots_total", 0.0)}
 
 
+def scenario_slow_deploy_attribution(soak):
+    """A deliberately SLOW deploy candidate at full canary fraction, and
+    the attribution plane on the hook for the verdict: after a healthy
+    baseline window and a regressed window, ``attribute()`` must name
+    the deploy event (``deploy_canary``, the injected step) as the top
+    cause AND assign the majority of the latency delta to the correct
+    phase — ``queue_wait``, because the injected stall serializes the
+    flush loop so trailing requests pay it as queue time — with ZERO
+    request-path compiles (the candidate aliases the primary's caches)
+    and a byte-identical verdict when the same evidence is re-attributed
+    after seeded reordering (forensics bundles must not flap)."""
+    import http.client
+    import random
+    import threading
+
+    import jax
+    import numpy as np
+
+    from glom_tpu import checkpoint as ckpt_lib
+    from glom_tpu.obs import attribution
+    from glom_tpu.resilience import faultinject
+    from glom_tpu.serving.engine import ServingEngine, make_demo_checkpoint
+    from glom_tpu.serving.server import make_server
+
+    baseline_s, regress_s, n_workers = (3.5, 4.5, 4) if not soak \
+        else (8.0, 10.0, 6)
+    with tempfile.TemporaryDirectory() as root:
+        ckpt = os.path.join(root, "ckpt")
+        make_demo_checkpoint(ckpt)
+        engine = ServingEngine(
+            ckpt, buckets=(1, 2), max_wait_ms=1.0, warmup=True,
+            reload_poll_s=0, capacity_interval_s=0.25,
+            forensics_dir=os.path.join(root, "forensics"))
+        engine.deploy.fault_delay_s = 0.15
+        engine.start(watch=False)
+        srv = make_server(engine)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        host, port = srv.server_address[:2]
+
+        body = json.dumps({"images": np.zeros(
+            (1, 3, 16, 16), np.float32).tolist()}).encode()
+        stop = threading.Event()
+        counts = {"ok": 0, "error": 0}
+        lock = threading.Lock()
+
+        def load(worker):
+            i = 0
+            while not stop.is_set():
+                i += 1
+                try:
+                    conn = http.client.HTTPConnection(host, port,
+                                                      timeout=30)
+                    conn.request("POST", "/embed", body, {
+                        "Content-Type": "application/json",
+                        "X-Affinity-Key": f"key-{worker}-{i % 16}"})
+                    ok = conn.getresponse().status == 200
+                    conn.close()
+                    with lock:
+                        counts["ok" if ok else "error"] += 1
+                except Exception:  # glomlint: disable=conc-broad-except -- the error count IS the scenario's health signal
+                    with lock:
+                        counts["error"] += 1
+
+        workers = [threading.Thread(target=load, args=(w,), daemon=True)
+                   for w in range(n_workers)]
+        for w in workers:
+            w.start()
+        t_fault = None
+        try:
+            deadline = time.monotonic() + baseline_s
+            while time.monotonic() < deadline:
+                engine.capacity.tick()
+                time.sleep(0.1)
+            ckpt_lib.save(ckpt, 2,
+                          {"params": jax.device_get(engine._template)})
+            t_fault = time.monotonic()
+            step = engine.deploy.begin_canary(step=2, fraction=1.0)
+            assert step == 2, f"canary begin failed: {step!r}"
+            with faultinject.injected("candidate:delay*1000000"):
+                deadline = time.monotonic() + regress_s
+                while time.monotonic() < deadline:
+                    engine.capacity.tick()
+                    time.sleep(0.1)
+                stop.set()
+                for w in workers:
+                    w.join(timeout=10)
+
+            evidence = attribution.collect_engine_evidence(engine)
+            verdict = attribution.attribute(evidence)
+            # determinism: seeded reordering of the same evidence must
+            # not move a single byte of the verdict
+            rnd = random.Random(1234)
+            shuffled = json.loads(json.dumps(evidence))
+            rnd.shuffle(shuffled["timeline"])
+            shuffled["series"] = {
+                k: shuffled["series"][k]
+                for k in sorted(shuffled["series"], reverse=True)}
+            rerun = attribution.attribute(shuffled)
+            snap = engine.registry.snapshot()
+            mttr = time.monotonic() - t_fault
+
+            assert counts["error"] == 0, counts
+            assert counts["ok"] >= 20, counts
+            assert verdict["verdict"] != "inconclusive", verdict
+            top = verdict["causes"][0]
+            assert top["kind"] == "event:deploy", top
+            assert top["event"]["event"] == "deploy_canary", top
+            assert top["event"]["step"] == 2, top
+            phases = [p for p in verdict["phases"]
+                      if p.get("share") and "bucket" not in p]
+            assert phases and phases[0]["phase"] == "queue_wait", phases
+            assert phases[0]["share"] >= 0.5, phases[0]
+            assert snap.get("serving_xla_compiles", 0.0) == 0, snap
+            assert (attribution.canonical_json(verdict)
+                    == attribution.canonical_json(rerun)), \
+                "verdict not byte-stable under evidence reordering"
+        finally:
+            stop.set()
+            srv.shutdown()
+            srv.server_close()
+            engine.shutdown(drain=False)
+        return {"mttr_s": round(mttr, 3),
+                "requests_ok": counts["ok"],
+                "verdict": verdict["verdict"],
+                "confidence": verdict["confidence"],
+                "queue_wait_share": phases[0]["share"],
+                "knee_kind": (verdict["knee"] or {}).get("kind")}
+
+
 SCENARIOS = {
     "torn_ckpt_write": scenario_torn_ckpt_write,
     "corrupt_restore": scenario_corrupt_restore,
@@ -1082,6 +1211,7 @@ SCENARIOS = {
     "coordinator_loss": scenario_coordinator_loss,
     "shrink_restart": scenario_shrink_restart,
     "bulk_preemption": scenario_bulk_preemption,
+    "slow_deploy_attribution": scenario_slow_deploy_attribution,
 }
 
 

@@ -162,8 +162,24 @@ def summarize(bundle, compare=None):
             events[ev] = events.get(ev, 0) + 1
     phases, n_before = phase_comparison(ring, manifest.get("step", 0))
     other_cost = None
+    serving_phase_deltas = None
     if compare is not None:
         other_cost = _load_json(compare, "cost_analysis.json")
+        # serving bundles carry a metrics.json registry snapshot each;
+        # two of them bound a window, and the attribution plane's
+        # phase-delta math decomposes the latency move inside it
+        # (--compare B is the BEFORE bundle, the positional one AFTER)
+        mine = _load_json(bundle, "metrics.json")
+        theirs = _load_json(compare, "metrics.json")
+        if mine and theirs:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            try:
+                import _obsload
+            finally:
+                sys.path.pop(0)
+            attribution = _obsload.load_attribution()
+            rows = attribution.snapshot_phase_deltas(theirs, mine)
+            serving_phase_deltas = rows or None
     return {
         "bundle": os.path.abspath(bundle),
         "trigger": manifest.get("trigger"),
@@ -179,6 +195,8 @@ def summarize(bundle, compare=None):
         "events": events,
         "phases": phases,
         "cost": cost_rows(cost, other_cost),
+        "serving_phase_deltas": serving_phase_deltas,
+        "attribution": _load_json(bundle, "attribution.json"),
         "compared_to": os.path.abspath(compare) if compare else None,
         "memory": mem or {},
         "has_hlo": os.path.exists(os.path.join(bundle, "hlo.txt")),
@@ -235,6 +253,19 @@ def print_report(s):
             print("\ntop cost-analysis entries:")
             for row in s["cost"]:
                 print(f"  {row['key']}: {row['value']:.4g}")
+    if s.get("attribution"):
+        attr = s["attribution"]
+        print(f"\nattribution: {attr.get('verdict')} "
+              f"(confidence {_fmt(attr.get('confidence'))})")
+    if s.get("serving_phase_deltas"):
+        print(f"\nserving phase deltas vs {s['compared_to']} "
+              f"(window = requests between the two bundles):")
+        print("| phase | before | window | delta ms | share |")
+        print("|---|---|---|---|---|")
+        for row in s["serving_phase_deltas"][:8]:
+            print(f"| {row['phase']} | {_fmt(row['before_ms'])} | "
+                  f"{_fmt(row['after_ms'])} | {_fmt(row['delta_ms'])} | "
+                  f"{_fmt(row['share'])} |")
     if s["memory"]:
         mem = ", ".join(f"{k}={v}" for k, v in sorted(s["memory"].items()))
         print(f"memory analysis: {mem}")

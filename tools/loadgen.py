@@ -124,6 +124,15 @@ def parse_args(argv=None):
                         "stratified index walk, so the same FRAC always "
                         "corrupts the same requests; the report gains a "
                         "requests_corrupted count")
+    p.add_argument("--regress-at", type=float, default=0.0, metavar="FRAC",
+                   help="deterministic mid-run regression: requests from "
+                        "FRAC of the run onward are sent at the LARGEST "
+                        "--batch-sizes size (a latency/size step at a "
+                        "known request index — the seeded ground-truth "
+                        "knee attribution and chaos tests assert "
+                        "against).  The report records the step under "
+                        "'regress'; combine with --timeline so the knee "
+                        "is visible in the windowed p95")
     p.add_argument("--timeline", action="store_true",
                    help="window the run into per-second "
                         "throughput/p95/error buckets in the report "
@@ -222,6 +231,7 @@ class _Results:
         self.shed = 0
         self.errors = 0
         self.corrupted = 0       # --corrupt: requests sent perturbed
+        self.regressed = 0       # --regress-at: requests sent post-step
         self.id_mismatches = 0   # X-Request-Id failed to round-trip
         # per-replica breakdown (fleet mode): key = the router's
         # X-Served-By echo when present, else the target URL the request
@@ -326,7 +336,7 @@ def parse_tenants(specs):
 
 def run_closed(urls, endpoint, payloads, batch_sizes, n_requests, concurrency,
                timeout, results, tenants=None, corrupt_payloads=None,
-               corrupt_frac=0.0):
+               corrupt_frac=0.0, regress_from=None):
     idx_lock = threading.Lock()
     counter = [0]
 
@@ -342,6 +352,12 @@ def run_closed(urls, endpoint, payloads, batch_sizes, n_requests, concurrency,
             # the two list lengths would pin each target to a fixed
             # batch-size subset and skew the per-replica comparison
             b = batch_sizes[(i // len(urls)) % len(batch_sizes)]
+            if regress_from is not None and i >= regress_from:
+                # --regress-at: the deterministic step — every request
+                # past the knee index jumps to the largest size
+                b = max(batch_sizes)
+                with results.lock:
+                    results.regressed += 1
             body = payloads[b]
             if corrupt_payloads is not None and _corrupt_this(i, corrupt_frac):
                 body = corrupt_payloads[b]
@@ -364,7 +380,8 @@ def run_closed(urls, endpoint, payloads, batch_sizes, n_requests, concurrency,
 
 
 def run_open(urls, endpoint, payloads, batch_sizes, rate, duration, timeout,
-             results, tenants=None, corrupt_payloads=None, corrupt_frac=0.0):
+             results, tenants=None, corrupt_payloads=None, corrupt_frac=0.0,
+             regress_from=None):
     """Fixed arrival schedule: request i fires at ``i / rate`` seconds
     whether or not earlier ones finished (one thread per in-flight
     request; the OS scheduler is the arrival clock)."""
@@ -378,6 +395,10 @@ def run_open(urls, endpoint, payloads, batch_sizes, rate, duration, timeout,
             time.sleep(delay)
         # per-target-round batch cycling — see run_closed for why
         b = batch_sizes[(i // len(urls)) % len(batch_sizes)]
+        if regress_from is not None and i >= regress_from:
+            b = max(batch_sizes)
+            with results.lock:
+                results.regressed += 1
         body = payloads[b]
         if corrupt_payloads is not None and _corrupt_this(i, corrupt_frac):
             body = corrupt_payloads[b]
@@ -659,6 +680,7 @@ def report(results, wall_s, mode, slow_n=0):
         "requests_shed": results.shed,
         "requests_error": results.errors,
         "requests_corrupted": results.corrupted,
+        "requests_regressed": results.regressed,
         "request_id_mismatches": results.id_mismatches,
         "images_ok": results.images_ok,
         "wall_seconds": round(wall_s, 3),
@@ -993,26 +1015,40 @@ def main(argv=None) -> int:
     corrupt_payloads = (_make_corrupt_payloads(health, batch_sizes)
                         if args.corrupt > 0 else None)
     tenants = parse_tenants(args.tenant) if args.tenant else None
+    regress_from = None
+    if args.regress_at > 0:
+        n = (max(1, int(args.rate * args.duration)) if args.rate > 0
+             else args.requests)
+        regress_from = math.ceil(n * min(args.regress_at, 1.0))
     if args.rate > 0:
         wall = run_open(urls, args.endpoint, payloads, batch_sizes,
                         args.rate, args.duration, args.timeout, results,
                         tenants=tenants, corrupt_payloads=corrupt_payloads,
-                        corrupt_frac=args.corrupt)
+                        corrupt_frac=args.corrupt, regress_from=regress_from)
         mode = f"open({args.rate}/s)"
     else:
         wall = run_closed(urls, args.endpoint, payloads, batch_sizes,
                           args.requests, args.concurrency, args.timeout,
                           results, tenants=tenants,
                           corrupt_payloads=corrupt_payloads,
-                          corrupt_frac=args.corrupt)
+                          corrupt_frac=args.corrupt,
+                          regress_from=regress_from)
         mode = f"closed(c={args.concurrency})"
     if args.corrupt > 0:
         mode += f" corrupt({args.corrupt})"
+    if regress_from is not None:
+        mode += f" regress(at={args.regress_at})"
     if tenants:
         mode += f" tenants({','.join(sorted(set(tenants)))})"
     if len(urls) > 1:
         mode += f" x{len(urls)} targets"
     out = report(results, wall, mode, slow_n=args.slow_n)
+    if regress_from is not None:
+        # the ground truth the attribution tests assert their detected
+        # knee against: the exact request index where the step began
+        out["regress"] = {"frac": args.regress_at,
+                          "from_request": regress_from,
+                          "batch_size": max(batch_sizes)}
     if args.timeline:
         out["timeline"] = timeline_report(results, args.timeline_step_s)
     print(json.dumps(out, indent=2))
