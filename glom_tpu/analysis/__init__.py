@@ -9,6 +9,7 @@ from glom_tpu.analysis.engine import (  # noqa: F401
 )
 from glom_tpu.analysis.rules_bulk import BULK_RULES
 from glom_tpu.analysis.rules_concurrency import CONCURRENCY_RULES
+from glom_tpu.analysis.rules_hierarchy import HIERARCHY_RULES
 from glom_tpu.analysis.rules_jax import JAX_RULES
 from glom_tpu.analysis.rules_obs import OBS_RULES
 from glom_tpu.analysis.rules_paths import PATH_RULES
@@ -18,7 +19,7 @@ from glom_tpu.analysis.rules_sharding import SHARDING_RULES
 ALL_RULE_CLASSES = (tuple(JAX_RULES) + tuple(CONCURRENCY_RULES)
                     + tuple(OBS_RULES) + tuple(PATH_RULES)
                     + tuple(SHARDING_RULES) + tuple(RACE_RULES)
-                    + tuple(BULK_RULES))
+                    + tuple(BULK_RULES) + tuple(HIERARCHY_RULES))
 
 
 def default_rules(names=None):

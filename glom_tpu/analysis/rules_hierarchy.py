@@ -1,0 +1,191 @@
+"""glomlint part-whole (hierarchy) rule pack.
+
+  * ``hierarchy-isolation`` — the similarity-index reader boundary
+    (PR 20): ``glom_tpu/hierarchy/index.py`` is the ``/similar`` store's
+    read/write side and is deliberately **jax-free and package-free** —
+    stdlib + numpy + mmap only, loadable on a deviceless audit host via
+    the ``tools/_obsload.py`` stub pattern.  A ``jax`` import there
+    drags the whole runtime (and a device registry probe) into every
+    offline index audit; a ``glom_tpu`` import defeats the stub loader
+    outright (the package __init__ pulls model code).  The same rule
+    pins the bounded-staging half of the query contract: any per-part /
+    per-candidate accumulator inside a hierarchy class must be bounded
+    (a ``deque(maxlen=)``, a ``len()`` cap, an eviction call, or a
+    ``del buf[k:]`` trim) — an index scan that staged every part before
+    ranking would make query memory proportional to the INDEX size
+    instead of one bulk chunk.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from glom_tpu.analysis.engine import Finding, ModuleContext, Rule, dotted_name
+
+#: top-level import roots forbidden in the jax-free index modules
+_FORBIDDEN_ROOTS = {"jax", "jaxlib", "glom_tpu"}
+
+#: growth calls that accumulate one element per invocation
+_GROWTH_METHODS = {"append", "extend", "appendleft", "add"}
+#: eviction calls that count as bounding evidence for an attribute
+_EVICT_METHODS = {"pop", "popleft", "popitem", "clear"}
+#: constructors whose result is unbounded by default
+_UNBOUNDED_CTORS = {"list", "dict", "set", "OrderedDict", "defaultdict"}
+
+
+def _self_attr(node) -> str:
+    """``self.X`` -> ``"X"``, else ``""``."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return ""
+
+
+class HierarchyIsolationRule(Rule):
+    name = "hierarchy-isolation"
+    severity = "error"
+    description = ("hierarchy index module imports jax or the glom_tpu "
+                   "package (it must stay stub-loadable: stdlib + numpy "
+                   "+ mmap only), or grows an unbounded staging buffer — "
+                   "query memory is bounded by one bulk chunk, never the "
+                   "index size")
+
+    @staticmethod
+    def _in_scope(relpath: str) -> bool:
+        # component match, not substring (the obs-debug-in-cache
+        # convention): anything under a hierarchy/ package directory
+        parts = relpath.split("/")
+        return "hierarchy" in parts[:-1]
+
+    @staticmethod
+    def _index_module(relpath: str) -> bool:
+        # the jax-free boundary applies to the index store modules only:
+        # parse.py is the traced half and imports jax on purpose
+        return relpath.split("/")[-1] == "index.py"
+
+    # -- jax-free / package-free half ------------------------------
+
+    def _import_findings(self, ctx: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.Import, ast.ImportFrom)):
+                continue
+            if isinstance(node, ast.ImportFrom) and node.level > 0:
+                # a relative import IS a glom_tpu package import: the
+                # stub loader materializes index.py with its package
+                # replaced by an empty namespace
+                findings.append(ctx.finding(
+                    self, node,
+                    "relative import in a hierarchy index module: the "
+                    "module must load with its package stubbed out "
+                    "(tools/_obsload.py) — inline the helper instead"))
+                continue
+            mod = (node.module or "" if isinstance(node, ast.ImportFrom)
+                   else "")
+            dotted_all = ([mod] if mod else [a.name for a in node.names])
+            for dotted in dotted_all:
+                root = dotted.split(".")[0]
+                if root not in _FORBIDDEN_ROOTS:
+                    continue
+                why = ("drags the jax runtime (and a device probe) into "
+                       "every offline index audit"
+                       if root in ("jax", "jaxlib") else
+                       "defeats the _obsload stub loader — the package "
+                       "__init__ pulls model code")
+                findings.append(ctx.finding(
+                    self, node,
+                    f"forbidden import {dotted!r} in a hierarchy index "
+                    f"module: index.py is the deviceless read side "
+                    f"(stdlib + numpy + mmap only) and a {root} import "
+                    f"{why}"))
+        return findings
+
+    # -- bounded-staging half (the obs-unbounded-series machinery,
+    #    scoped to hierarchy classes) ------------------------------
+
+    @staticmethod
+    def _unbounded_init(value) -> bool:
+        if isinstance(value, (ast.List, ast.Dict, ast.Set,
+                              ast.ListComp, ast.DictComp, ast.SetComp)):
+            return True
+        if isinstance(value, ast.Call):
+            d = dotted_name(value.func) or ""
+            base = d.split(".")[-1]
+            if base == "deque":
+                return not any(kw.arg == "maxlen" for kw in value.keywords)
+            return base in _UNBOUNDED_CTORS
+        return False
+
+    def _class_findings(self, ctx: ModuleContext,
+                        cls: ast.ClassDef) -> List[Finding]:
+        unbounded: dict = {}     # attr -> init node
+        evidence: set = set()    # attrs with cap/eviction anywhere in class
+        growth: List = []        # (attr, node, kind)
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    attr = _self_attr(target)
+                    if attr and self._unbounded_init(node.value):
+                        unbounded.setdefault(attr, node)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                attr = _self_attr(node.target)
+                if attr and self._unbounded_init(node.value):
+                    unbounded.setdefault(attr, node)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        attr = _self_attr(t.value)
+                        if attr:
+                            evidence.add(attr)
+            elif isinstance(node, ast.Call):
+                if (isinstance(node.func, ast.Name)
+                        and node.func.id == "len" and node.args):
+                    attr = _self_attr(node.args[0])
+                    if attr:
+                        evidence.add(attr)
+                elif isinstance(node.func, ast.Attribute):
+                    attr = _self_attr(node.func.value)
+                    if attr and node.func.attr in _EVICT_METHODS:
+                        evidence.add(attr)
+        for method in cls.body:
+            if (not isinstance(method,
+                               (ast.FunctionDef, ast.AsyncFunctionDef))
+                    or method.name == "__init__"):
+                continue
+            for node in ast.walk(method):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _GROWTH_METHODS):
+                    attr = _self_attr(node.func.value)
+                    if attr:
+                        growth.append((attr, node, node.func.attr))
+        findings: List[Finding] = []
+        flagged: set = set()
+        for attr, node, kind in growth:
+            if attr not in unbounded or attr in evidence or attr in flagged:
+                continue
+            flagged.add(attr)
+            findings.append(ctx.finding(
+                self, node,
+                f"self.{attr} stages per part/candidate ({kind}) but is "
+                f"initialized unbounded and class {cls.name} never caps "
+                f"or evicts it — a query over a grown index would stage "
+                f"the whole index in memory; trim to k after every part "
+                f"(deque(maxlen=), a len() bound, or del buf[k:])"))
+        return findings
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        if not self._in_scope(ctx.relpath):
+            return []
+        findings: List[Finding] = []
+        if self._index_module(ctx.relpath):
+            findings.extend(self._import_findings(ctx))
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._class_findings(ctx, node))
+        return findings
+
+
+HIERARCHY_RULES = (HierarchyIsolationRule,)

@@ -45,9 +45,12 @@ import numpy as np
 
 from glom_tpu.checkpoint import _atomic_write
 
-#: the two offline transforms — exactly the online batched endpoints, so
-#: bulk work rides the SAME warmed (bucket, quant) executables
-TRANSFORMS = ("embed", "reconstruct")
+#: the offline transforms — "embed"/"reconstruct" are exactly the online
+#: batched endpoints, so their bulk work rides the SAME warmed (bucket,
+#: quant) executables; "index" is the offline-only similarity-index
+#: build (glom_tpu/hierarchy/) with its own warmed cache and a per-level
+#: part-file sink instead of the flat ChunkSink layout
+TRANSFORMS = ("embed", "reconstruct", "index")
 
 JOB_STATUSES = ("pending", "running", "paused", "done", "cancelled")
 

@@ -1,0 +1,38 @@
+"""Part-whole workload plane — GLOM's islands as a served product.
+
+The paper's central claim (PAPER.md) is that islands of agreement at
+each level ARE a parse of the scene.  This package productizes that
+structure as three workloads:
+
+  * ``/parse`` (:mod:`glom_tpu.hierarchy.parse`) — fixed-iteration
+    jitted connected-components islanding over the neighbor-cosine
+    agreement maps the quality plane already computes, packed per image
+    into one float32 row.  The islanding is a POST-PASS
+    (:func:`parse.make_pack_fn`) riding the ``index`` endpoint's
+    executables through a
+    :class:`~glom_tpu.serving.compile_cache.PostPassCache`: the settle
+    graph compiles once per bucket for embed/index/parse alike
+    (AOT-warmed, zero request-path compiles);
+  * ``/similar`` (:mod:`glom_tpu.hierarchy.index`) — a memory-mapped,
+    shard-append-only level-aware nearest-neighbor index built by the
+    bulk tier's ``transform: "index"`` jobs (exactly-once cursor =>
+    kill/resume yields a bitwise-identical index), queried by part at
+    low levels and by whole at the top level;
+  * ``/session/parse`` — island DELTAS for streaming video: the current
+    frame's islanding diffed against the previous equilibrium resident
+    in the session column-state cache (:func:`parse.island_deltas`).
+
+``index.py`` is deliberately jax-free (stdlib + numpy + mmap): queries
+and audits must run on machines with no device via the
+``tools/_obsload.py`` stub-loading pattern.  ``parse.py`` keeps its jax
+imports lazy inside the fn builders, mirroring ``obs/quality.py``.
+"""
+
+from glom_tpu.hierarchy.parse import (  # noqa: F401
+    island_deltas,
+    make_index_fn,
+    make_pack_fn,
+    parse_row_width,
+    parse_thresholds,
+    unpack_parse,
+)

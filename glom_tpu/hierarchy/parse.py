@@ -1,0 +1,266 @@
+"""Jitted islanding — the ``/parse`` and ``/session/parse`` post-pass.
+
+The reference labeling (:func:`glom_tpu.models.islands.label_islands`)
+is a host-side flood fill: inherently data-dependent control flow, so it
+can never ride an AOT bucket executable.  This module re-derives the
+SAME labeling as a fixed-iteration min-index label propagation:
+
+  1. every above-threshold cell starts labeled with its own row-major
+     flat index (below-threshold cells carry the sentinel ``n``);
+  2. ``n`` propagation steps take the min over the cell and its masked
+     4-neighbors — after ``n`` steps (the longest possible in-component
+     path) every cell holds the min flat index of its component;
+  3. components are renumbered 1..K by the rank of their root index —
+     exactly the reference's row-major first-encounter order, so the
+     two labelings are BITWISE identical (tests pin this).
+
+Output is one packed float32 row per image (labels, counts, sizes,
+per-island mean embeddings), because the compile cache's batch-padding
+slice (``out[:b]``) operates on a single output — the same contract as
+``obs/quality.py``'s signal matrix.  Host-side helpers (threshold
+grammar, row unpacking, frame-to-frame island deltas) are numpy-only;
+jax imports stay lazy inside the fn builders.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+#: default agreement threshold when the operator gives none — the
+#: models/islands.py default, one value broadcast across levels
+DEFAULT_THRESHOLD = 0.9
+
+
+def parse_thresholds(spec: Union[None, float, str, Sequence[float]],
+                     levels: int) -> Tuple[float, ...]:
+    """The threshold grammar (docs/HIERARCHY.md): ``None`` -> the
+    default broadcast per level; a float (or one numeric string) ->
+    broadcast; a comma list (``"0.95,0.9,0.8"``) or sequence -> one
+    threshold per level, length-checked.  Cosine agreement lives in
+    [-1, 1]; values outside are configuration errors, not clamps."""
+    if spec is None:
+        vals = [DEFAULT_THRESHOLD] * levels
+    elif isinstance(spec, str):
+        parts = [p.strip() for p in spec.split(",") if p.strip()]
+        if not parts:
+            raise ValueError(f"empty threshold spec {spec!r}")
+        try:
+            vals = [float(p) for p in parts]
+        except ValueError:
+            raise ValueError(
+                f"bad threshold spec {spec!r}: want a float or a "
+                f"comma-separated list of floats")
+        if len(vals) == 1:
+            vals = vals * levels
+    elif isinstance(spec, (int, float)):
+        vals = [float(spec)] * levels
+    else:
+        vals = [float(v) for v in spec]
+    if len(vals) != levels:
+        raise ValueError(
+            f"threshold spec has {len(vals)} values for {levels} levels")
+    for v in vals:
+        if not -1.0 <= v <= 1.0:
+            raise ValueError(
+                f"threshold {v} outside cosine range [-1, 1]")
+    return tuple(vals)
+
+
+def parse_row_width(levels: int, side: int, dim: int) -> int:
+    """Packed-row column count: per level, ``side*side`` labels + 1
+    island count + ``n`` island sizes + ``n * dim`` island means (both
+    padded to the ``n``-island maximum so the row shape is static)."""
+    n = side * side
+    return levels * (n + 1 + n + n * dim)
+
+
+# -- the traced islanding ---------------------------------------------------
+
+def _island_labels(mask, side: int):
+    """``(side, side)`` bool mask -> ``(labels, count)``, labels int32
+    with 0 below threshold and islands numbered from 1 in row-major
+    first-encounter order — bitwise-identical to
+    :func:`glom_tpu.models.islands.label_islands` on the same mask."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = side * side
+    idx = jnp.arange(n, dtype=jnp.int32).reshape(side, side)
+    sentinel = jnp.int32(n)
+    init = jnp.where(mask, idx, sentinel)
+
+    def step(_, lab):
+        padded = jnp.pad(lab, 1, constant_values=n)
+        neigh = jnp.minimum(
+            jnp.minimum(padded[:-2, 1:-1], padded[2:, 1:-1]),
+            jnp.minimum(padded[1:-1, :-2], padded[1:-1, 2:]),
+        )
+        return jnp.where(mask, jnp.minimum(lab, neigh), sentinel)
+
+    # n steps bound the longest shortest path inside any 4-connected
+    # component of an n-cell grid, so the loop ALWAYS converges — fixed
+    # trip count is what keeps this one warmed executable per bucket
+    root = lax.fori_loop(0, n, step, init).reshape(-1)
+    flat_mask = mask.reshape(-1)
+    is_root = flat_mask & (root == jnp.arange(n, dtype=jnp.int32))
+    rank = jnp.cumsum(is_root.astype(jnp.int32))        # 1-based at roots
+    rank_ext = jnp.concatenate([rank, jnp.zeros((1,), jnp.int32)])
+    labels = jnp.where(flat_mask, rank_ext[root], 0)
+    return labels.reshape(side, side), rank[n - 1]
+
+
+def make_pack_fn(config, thresholds: Sequence[float]):
+    """``(b, n, L, d)`` column state -> ``(b, F)`` packed parse rows —
+    the islanding POST-PASS.  ``/parse`` applies it to the ``index``
+    endpoint's output and ``/session/parse`` to the session caches'
+    carried state, so the expensive settle graph compiles once per
+    bucket for ALL of embed/index/parse (the post-pass alone is a tiny
+    graph — milliseconds to compile, not the seconds a second full
+    settle family would cost at startup)."""
+    import jax
+    import jax.numpy as jnp
+
+    from glom_tpu.obs.quality import agreement_maps
+
+    side = config.image_size // config.patch_size
+    n = side * side
+    thr = tuple(float(t) for t in thresholds)
+    if len(thr) != config.levels:
+        raise ValueError(
+            f"{len(thr)} thresholds for {config.levels} levels")
+    thr_arr = np.asarray(thr, np.float32)
+
+    def per_level(agree_map, emb, t):
+        # agree_map (s, s); emb (n, d); t scalar threshold
+        labels, count = _island_labels(agree_map >= t, side)
+        flat = labels.reshape(-1)
+        sizes = jax.ops.segment_sum(
+            jnp.ones((n,), jnp.float32), flat, num_segments=n + 1)[1:]
+        sums = jax.ops.segment_sum(emb, flat, num_segments=n + 1)[1:]
+        means = sums / jnp.maximum(sizes, 1.0)[:, None]
+        return (labels.reshape(-1).astype(jnp.float32),
+                count.astype(jnp.float32), sizes, means.reshape(-1))
+
+    def pack_one(agree, levels32):
+        # agree (L, s, s); levels32 (n, L, d)
+        emb = jnp.swapaxes(levels32, 0, 1)              # (L, n, d)
+        labels, counts, sizes, means = jax.vmap(per_level)(
+            agree, emb, jnp.asarray(thr_arr))
+        return jnp.concatenate([labels.reshape(-1), counts,
+                                sizes.reshape(-1), means.reshape(-1)])
+
+    def pack_batch(levels):
+        levels32, agree = agreement_maps(levels, side)
+        return jax.vmap(pack_one)(agree, levels32)
+
+    return pack_batch
+
+
+#: back-compat alias — the packer predates its promotion to the public
+#: post-pass factory and tests pin the islanding through this name
+_make_packer = make_pack_fn
+
+
+def make_index_fn(config, iters: Optional[int], *, ff_fn=None, fused_fn=None):
+    """``(params, imgs) -> (b, n, L, d)`` float32 column state — the
+    bulk ``transform: "index"`` forward.  Cast in-graph: under bf16/int8
+    serving the raw state would be an ml_dtypes array a jax-less index
+    reader could not mmap, and the index files are float32 by layout
+    contract (docs/HIERARCHY.md)."""
+    import jax.numpy as jnp
+
+    from glom_tpu.models import glom as glom_model
+
+    def f(params, imgs):
+        levels = glom_model.apply(params["glom"], imgs, config=config,
+                                  iters=iters, ff_fn=ff_fn,
+                                  fused_fn=fused_fn)
+        return levels.astype(jnp.float32)
+
+    return f
+
+
+# -- host-side unpacking / deltas -------------------------------------------
+
+def unpack_parse(row: Sequence[float], levels: int, side: int,
+                 dim: int) -> List[Dict[str, object]]:
+    """One packed parse row -> per-level island dicts with the padding
+    trimmed: ``labels`` (side x side ints, 0 = below threshold),
+    ``num_islands``, ``sizes`` / ``means`` sliced to the real island
+    count (island ``k`` is row ``k-1``)."""
+    n = side * side
+    row = np.asarray(row, np.float32).reshape(-1)
+    want = parse_row_width(levels, side, dim)
+    if row.shape[0] != want:
+        raise ValueError(
+            f"parse row has {row.shape[0]} columns, expected {want}")
+    off = 0
+    labels = np.rint(row[off:off + levels * n]).astype(np.int32)
+    labels = labels.reshape(levels, side, side)
+    off += levels * n
+    counts = np.rint(row[off:off + levels]).astype(np.int32)
+    off += levels
+    sizes = np.rint(row[off:off + levels * n]).astype(np.int32)
+    sizes = sizes.reshape(levels, n)
+    off += levels * n
+    means = row[off:].reshape(levels, n, dim)
+    out: List[Dict[str, object]] = []
+    for lv in range(levels):
+        k = int(counts[lv])
+        out.append({
+            "labels": labels[lv].tolist(),
+            "num_islands": k,
+            "sizes": sizes[lv, :k].tolist(),
+            "means": [[float(v) for v in means[lv, i]] for i in range(k)],
+        })
+    return out
+
+
+def island_deltas(prev_labels: Optional[np.ndarray],
+                  cur_labels: np.ndarray) -> List[Dict[str, List[int]]]:
+    """Frame-to-frame island diff, per level (the ``/session/parse``
+    response's ``deltas``).  Current islands are matched to the previous
+    frame's island with the largest patch overlap (ties break to the
+    smallest previous label — deterministic):
+
+      * ``appeared`` — current islands overlapping no previous island;
+      * ``stable``   — matched with an identical patch set;
+      * ``moved``    — matched but the patch set changed;
+      * ``vanished`` — previous islands no current island matched.
+
+    ``prev_labels`` ``None`` (a cold frame, or the session's baseline
+    was computed by ``/session/embed`` only) makes every current island
+    ``appeared``.  Island ids are per-frame labels, not stable
+    identities across frames."""
+    cur_labels = np.asarray(cur_labels)
+    out: List[Dict[str, List[int]]] = []
+    for lv in range(cur_labels.shape[0]):
+        c = cur_labels[lv]
+        p = (None if prev_labels is None
+             else np.asarray(prev_labels)[lv])
+        deltas: Dict[str, List[int]] = {
+            "appeared": [], "vanished": [], "moved": [], "stable": []}
+        cur_ids = [int(i) for i in np.unique(c) if i > 0]
+        if p is None:
+            deltas["appeared"] = cur_ids
+            out.append(deltas)
+            continue
+        matched: set = set()
+        for k in cur_ids:
+            cells = c == k
+            overlap = np.bincount(p[cells].ravel())
+            if overlap.size:
+                overlap[0] = 0          # below-threshold is not an island
+            best = int(overlap.argmax()) if overlap.size else 0
+            if best == 0 or overlap[best] == 0:
+                deltas["appeared"].append(k)
+                continue
+            matched.add(best)
+            same = bool(np.array_equal(cells, p == best))
+            (deltas["stable"] if same else deltas["moved"]).append(k)
+        deltas["vanished"] = [int(i) for i in np.unique(p)
+                              if i > 0 and int(i) not in matched]
+        out.append(deltas)
+    return out

@@ -123,6 +123,37 @@ class CreditSampler:
 
 # -- the jitted post-pass ---------------------------------------------------
 
+def agreement_maps(levels, side: int):
+    """``(b, n, L, d)`` column state -> ``(levels_f32, agree)`` where
+    ``agree`` is the ``(b, L, side, side)`` neighbor-cosine agreement
+    grid.  THE shared traced sub-function: the quality post-pass and the
+    parse post-pass (``glom_tpu/hierarchy/parse.py``) both build on this
+    one cast + neighbor-cosine computation, so the two planes can never
+    diverge on what "agreement" means.  Lazy jax import — callers are
+    already inside a trace."""
+    import jax.numpy as jnp
+
+    from glom_tpu.models.islands import neighbor_agreement
+
+    levels = levels.astype(jnp.float32)           # (b, n, L, d)
+    return levels, neighbor_agreement(levels, side)
+
+
+def agreement_stats(agree, log_n: float):
+    """``(b, L, s, s)`` agreement maps -> ``(agreement, entropy)`` per-
+    level scalars, both ``(b, L)``: mean neighbor cosine, and the
+    normalized entropy of the agreement mass over patches (shift cosine
+    to [0, 1] mass; eps keeps a uniform -1 map finite)."""
+    import jax.numpy as jnp
+
+    flat = agree.reshape(agree.shape[0], agree.shape[1], -1)
+    agreement = jnp.mean(flat, axis=-1)           # (b, L)
+    w = (flat + 1.0) * 0.5 + 1e-6
+    p = w / jnp.sum(w, axis=-1, keepdims=True)
+    entropy = -jnp.sum(p * jnp.log(p), axis=-1) / log_n     # (b, L)
+    return agreement, entropy
+
+
 def make_quality_fn(config, train_cfg, iters: Optional[int],
                     *, ff_fn=None, fused_fn=None):
     """``(params, imgs) -> (b, 3L + 1)`` float32 PER-IMAGE signal matrix.
@@ -142,7 +173,6 @@ def make_quality_fn(config, train_cfg, iters: Optional[int],
 
     from glom_tpu.models import glom as glom_model
     from glom_tpu.models.heads import decoder_apply
-    from glom_tpu.models.islands import neighbor_agreement
     from glom_tpu.training import denoise
 
     side = config.image_size // config.patch_size
@@ -158,15 +188,8 @@ def make_quality_fn(config, train_cfg, iters: Optional[int],
             params["glom"], imgs, config=config, iters=resolved_iters,
             capture_timestep=timestep, ff_fn=ff_fn, fused_fn=fused_fn,
         )
-        levels = levels.astype(jnp.float32)           # (b, n, L, d)
-        agree = neighbor_agreement(levels, side)      # (b, L, s, s)
-        agree = agree.reshape(agree.shape[0], agree.shape[1], -1)
-        agreement = jnp.mean(agree, axis=-1)          # (b, L)
-        # normalized entropy of the agreement mass over patches: shift
-        # cosine to [0, 1] mass, eps so a uniform -1 map stays finite
-        w = (agree + 1.0) * 0.5 + 1e-6
-        p = w / jnp.sum(w, axis=-1, keepdims=True)
-        entropy = -jnp.sum(p * jnp.log(p), axis=-1) / log_n     # (b, L)
+        levels, agree = agreement_maps(levels, side)  # (b,n,L,d), (b,L,s,s)
+        agreement, entropy = agreement_stats(agree, log_n)      # (b, L) x2
         norms = jnp.mean(
             jnp.sqrt(jnp.sum(levels * levels, axis=-1)), axis=1)  # (b, L)
         recon = decoder_apply(

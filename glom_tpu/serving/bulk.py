@@ -323,7 +323,16 @@ class BulkRunner:
             job = self._jobs.get(token.job)
         if job is None:  # cancelled while in flight: drop the output
             return
-        job.sink.write(token.lo, token.hi, np.asarray(out))
+        if job.spec.transform == "index":
+            # the similarity-index build publishes PER-LEVEL part
+            # families (same tmp+rename + orphan-overlap idempotence,
+            # same sink directory) instead of the flat ChunkSink layout
+            from glom_tpu.hierarchy.index import write_index_parts
+
+            write_index_parts(job.sink.root, token.lo, token.hi,
+                              np.asarray(out))
+        else:
+            job.sink.write(token.lo, token.hi, np.asarray(out))
         doc = self.store.advance(token.job, token.shard_lo, token.hi)
         n = token.hi - token.lo
         with self._lock:
@@ -373,8 +382,15 @@ class BulkRunner:
         for name, spec in candidates:
             engine = self.engine
             endpoint = spec.transform
-            if engine.batchers[endpoint].depth > 0:
-                continue  # online admission preempts before we start
+            batcher = engine.batchers.get(endpoint)
+            if batcher is not None:
+                if batcher.depth > 0:
+                    continue  # online admission preempts before we start
+            elif any(b.depth > 0 for b in engine.batchers.values()):
+                # offline-only transforms ("index") have no batcher of
+                # their own; ANY queued online image preempts them — the
+                # scavenger never competes with admitted work
+                continue
             try:
                 params, caches, _ = self._resolve_version(spec)
             except ValueError:

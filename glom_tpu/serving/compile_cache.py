@@ -325,3 +325,113 @@ class BucketedCompileCache:
         """New jit-dispatch compiles since the last poll — nonzero after
         warmup means the no-compile-on-request-path invariant broke."""
         return self.monitor.poll()
+
+
+class PostPassCache:
+    """An endpoint that is another endpoint's output plus a cheap traced
+    post-pass, sharing the inner cache's executables.
+
+    ``/parse`` is the motivating case: its forward is the ``index``
+    endpoint's settle followed by the islanding pack.  Compiling that as
+    its own :class:`BucketedCompileCache` family duplicates the settle
+    graph — roughly doubling warmup wall time per bucket for a post-pass
+    whose own graph lowers in milliseconds.  This wrapper instead pads
+    the batch up-front (so the inner cache runs at exactly bucket shape
+    and hands back a bucket-shaped output), applies an AOT-compiled
+    post-pass keyed by the intermediate's aval, and slices the batch
+    axis back itself.
+
+    Quacks like :class:`BucketedCompileCache` for everything the engine
+    touches (``pick``/``buckets``/``warmup``/``__call__``/
+    ``poll_compiles``/``snapshots``); trace spans come from the inner
+    cache, so execute time shows under the inner endpoint's name — the
+    honest attribution, since that is the graph doing the work.
+    ``warm_aval`` admits extra intermediate avals (the session caches'
+    carried state rides the same post-pass at its own dtype).
+    """
+
+    def __init__(self, inner: BucketedCompileCache, post_fn: Callable,
+                 post_struct_fn: Callable[[int], jax.ShapeDtypeStruct], *,
+                 name: str, sharding: Optional[Any] = None):
+        self.inner = inner
+        self.name = name
+        self.quant = inner.quant
+        self.buckets = inner.buckets
+        self.donates_input = inner.donates_input
+        self.mesh_axes = inner.mesh_axes
+        self.carries_state = False
+        self.takes_state = False
+        self.stateful = False
+        self.iters = inner.iters
+        kwargs = {}
+        if sharding is not None:
+            # the intermediate and the packed rows both ride the batch
+            # axis: one leading-axis spec covers input and output
+            kwargs.update(in_shardings=(sharding,), out_shardings=sharding)
+        self._jit_fn = jax.jit(post_fn, **kwargs)
+        self._post_struct_fn = post_struct_fn
+        self._compiled: Dict[Tuple, Any] = {}
+        self.monitor = RecompileMonitor(self._jit_fn)
+        self.snapshots: Dict[int, Dict[str, Any]] = {}
+        self.warmed = False
+
+    @property
+    def max_bucket(self) -> int:
+        return self.buckets[-1]
+
+    def pick(self, n: int) -> Optional[int]:
+        return pick_bucket(self.buckets, n)
+
+    def warm_aval(self, struct: jax.ShapeDtypeStruct) -> None:
+        """AOT-compile the post-pass for one intermediate aval
+        (idempotent) — the request path then never enters jit dispatch
+        for that shape/dtype."""
+        key = (tuple(struct.shape), np.dtype(struct.dtype).str)
+        if key not in self._compiled:
+            self._compiled[key] = self._jit_fn.lower(struct).compile()
+
+    def warmup(self, params, img_struct_fn, *, state_struct_fn=None,
+               keep_hlo: bool = True) -> None:
+        """Warm the inner cache (idempotent — it may already have warmed
+        under its own endpoint name) plus the post-pass per bucket."""
+        del state_struct_fn  # stateless by construction
+        if not self.inner.warmed:
+            self.inner.warmup(params, img_struct_fn, keep_hlo=keep_hlo)
+        for bucket in self.buckets:
+            self.warm_aval(self._post_struct_fn(bucket))
+        self.monitor.poll()
+        self.warmed = True
+
+    def apply_post(self, intermediate):
+        """Run the post-pass alone on an already-computed intermediate
+        (the ``/session/parse`` path: the session executables produced
+        the carried state; only the pack remains).  Unknown avals fall
+        back to jit dispatch — correct, and ``poll_compiles`` reports
+        the compile."""
+        key = (tuple(intermediate.shape), np.dtype(intermediate.dtype).str)
+        exe = self._compiled.get(key)
+        if exe is not None:
+            return exe(intermediate)
+        return self._jit_fn(intermediate)
+
+    def __call__(self, params, imgs: np.ndarray, *, state=None, tracer=None,
+                 contexts: Sequence = ()):
+        del state
+        b = imgs.shape[0]
+        bucket = self.pick(b)
+        if bucket is not None:
+            imgs = pad_to_bucket(imgs, bucket)
+        # the inner call sees a batch exactly at bucket size, so its own
+        # slice-back is a no-op and the intermediate keeps the warmed
+        # bucket aval; over-max batches ride the inner jit fallback and
+        # the post-pass jit fallback, both monitored
+        intermediate = self.inner(params, imgs, tracer=tracer,
+                                  contexts=contexts)
+        out = self.apply_post(intermediate)
+        return out[:b] if out.shape[0] != b else out
+
+    def poll_compiles(self) -> int:
+        """Post-pass dispatch compiles PLUS the inner cache's — whichever
+        accounting site polls first claims them; the shared counter sums
+        to the same ``serving_xla_compiles`` either way."""
+        return self.monitor.poll() + self.inner.poll_compiles()

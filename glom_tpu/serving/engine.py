@@ -96,14 +96,15 @@ from glom_tpu.serving.batcher import (  # noqa: F401
     Overloaded,
     TenantQuotaExceeded,
 )
-from glom_tpu.serving.compile_cache import BucketedCompileCache
+from glom_tpu.hierarchy import parse as hierarchy_parse
+from glom_tpu.serving.compile_cache import BucketedCompileCache, PostPassCache
 from glom_tpu.training import denoise
 
-ENDPOINTS = ("embed", "reconstruct")
-# endpoints an SLO may target: the batched stateless pair plus the
-# session (stateful streaming) path, which has no batcher but the same
-# outcome-observation contract
-SLO_ENDPOINTS = ENDPOINTS + ("session",)
+ENDPOINTS = ("embed", "reconstruct", "parse")
+# endpoints an SLO may target: the batched stateless trio plus the
+# session (stateful streaming) and similar (index-query) paths, which
+# have no batcher but the same outcome-observation contract
+SLO_ENDPOINTS = ENDPOINTS + ("session", "similar")
 
 DEMO_CONFIG = GlomConfig(dim=16, levels=3, image_size=16, patch_size=8)
 
@@ -265,6 +266,8 @@ class ServingEngine:
         quality_sample: float = 1.0,
         quality_seed: int = 0,
         bulk_dir: Optional[str] = None,
+        parse_thresholds=None,
+        index_dir: Optional[str] = None,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.registry = registry if registry is not None else MetricRegistry()
@@ -400,6 +403,35 @@ class ServingEngine:
                 donate=donate_inputs,
                 shardings=shardings, mesh_axes=mesh_axes),
         }
+        # -- part-whole workload plane (glom_tpu/hierarchy/) ---------------
+        # The "index" cache is the bulk transform's forward (raw f32
+        # column states) AND the /similar query embedder.  /parse is NOT
+        # a second settle family: it rides the index executables plus an
+        # AOT islanding post-pass (PostPassCache), so the plane costs
+        # ~one compiled family at warmup, not three — and neither path
+        # ever compiles on the request path.
+        self.parse_thresholds = hierarchy_parse.parse_thresholds(
+            parse_thresholds, serve_cfg.levels)
+        self.caches["index"] = BucketedCompileCache(
+            serving_quant.quantized_forward(
+                hierarchy_parse.make_index_fn(
+                    serve_cfg, iters, ff_fn=ff_fn, fused_fn=fused_fn),
+                quant),
+            buckets, name="index", quant=quant, donate=donate_inputs,
+            shardings=shardings, mesh_axes=mesh_axes)
+        c = serve_cfg
+        self.caches["parse"] = PostPassCache(
+            self.caches["index"],
+            hierarchy_parse.make_pack_fn(serve_cfg, self.parse_thresholds),
+            lambda b: jax.ShapeDtypeStruct(
+                (b, c.num_patches, c.levels, c.dim), np.float32),
+            name="parse", sharding=img_sh)
+        self.index_dir = index_dir
+        self._index = None
+        if index_dir is not None:
+            from glom_tpu.hierarchy.index import LevelIndex
+
+            self._index = LevelIndex(index_dir, serve_cfg.levels)
         max_bucket = self.caches["embed"].max_bucket
 
         # -- stateful session serving (glom_tpu.serving.sessions) ----------
@@ -438,7 +470,14 @@ class ServingEngine:
                 donate=donate_inputs, shardings=shardings,
                 mesh_axes=mesh_axes, carries_state=True, takes_state=True,
                 state_sharding=img_sh, iters=warm_iters)
-            # the carried-state aval: what apply() returns under the
+            # /session/parse rides the SAME column state as
+            # /session/embed (one equilibrium per session — the two
+            # frame kinds interleave freely) AND the same executables:
+            # a parse frame runs the embed pair's (batch, stateful)
+            # entry, then the islanding post-pass on the carried state
+            # (warm() admits the state dtype's avals into the parse
+            # PostPassCache) — no extra settle families to compile.
+            # The carried-state aval: what apply() returns under the
             # serving config (compute dtype; quantized trees dequantize
             # in-graph and never change the activation dtype)
             c = serve_cfg
@@ -663,6 +702,8 @@ class ServingEngine:
         # acknowledged frame's state has been put before the spill
         self._session_inflight = 0
         self._session_cv = threading.Condition()
+        # /session/parse delta baselines (see _note_parse_labels)
+        self._parse_labels: Dict[str, np.ndarray] = {}
         self._threads: list = []
         self._stop = threading.Event()
         self._started = False
@@ -702,6 +743,13 @@ class ServingEngine:
             )
             if self._warmup_dir:
                 self._write_warmup_snapshots(ep, cache)
+        if self.sessions is not None:
+            # /session/parse = the session executables (warmed above) +
+            # the islanding post-pass on the carried state — admit the
+            # state dtype's avals so a parse frame never compiles
+            for bucket in self.caches["session_cold"].buckets:
+                self.caches["parse"].warm_aval(
+                    self._session_state_struct(bucket))
         if self.quality_cache is not None and not self.quality_cache.warmed:
             # the quality post-pass warms per bucket alongside the
             # endpoint matrix: sampled batches hit already-compiled
@@ -1390,10 +1438,30 @@ class ServingEngine:
         across sessions the per-session locks let the device interleave
         frames freely.  Everything device-side is an AOT bucket
         executable; the state never leaves the device between frames."""
+        return self._session_frame(session_id, imgs, ctx=ctx,
+                                   tenant=tenant, parse=False)
+
+    def session_parse(self, session_id: str, imgs: np.ndarray, *, ctx=None,
+                      tenant: Optional[str] = None):
+        """One PARSE frame of a stateful session (``/session/parse``):
+        the same carried-equilibrium update as :meth:`session_embed` —
+        one shared column state per session, so parse and embed frames
+        interleave freely — but the output is the packed islanding row,
+        and ``info`` additionally carries per-image island DELTAS
+        (:func:`glom_tpu.hierarchy.parse.island_deltas`) against the
+        previous PARSE frame's labels, computed under the same
+        per-session frame-ordering lock.  A cold frame (or the first
+        parse frame of an embed-only session) reports every island as
+        ``appeared``."""
+        return self._session_frame(session_id, imgs, ctx=ctx,
+                                   tenant=tenant, parse=True)
+
+    def _session_frame(self, session_id: str, imgs: np.ndarray, *, ctx,
+                       tenant, parse: bool):
         if self.sessions is None:
             raise RuntimeError(
                 "sessions disabled on this engine (construct with "
-                "warm_iters= to enable /session/embed)")
+                "warm_iters= to enable /session/embed and /session/parse)")
         if not serving_sessions.valid_session_id(session_id):
             raise ValueError(
                 f"invalid session id {session_id!r} (want "
@@ -1410,6 +1478,9 @@ class ServingEngine:
                 raise
         imgs = np.ascontiguousarray(imgs, dtype=np.float32)
         b = imgs.shape[0]
+        # parse frames run the SAME executables as embed frames — one
+        # (batch, stateful) matrix for both — and add the islanding
+        # post-pass on the carried state afterwards
         cold_cache = self.caches["session_cold"]
         warm_cache = self.caches["session_warm"]
         bucket = cold_cache.pick(b)
@@ -1475,10 +1546,23 @@ class ServingEngine:
                         params, imgs, state=entry.levels,
                         tracer=self.tracer, contexts=contexts)
                     cold, frames = False, entry.frames + 1
+                if parse:
+                    # the pack replaces the embed output; new_levels is
+                    # bucket-shaped (the next frame's executable input),
+                    # so the post-pass hits its warmed aval and only the
+                    # result slices back to the real batch
+                    out = self.caches["parse"].apply_post(new_levels)[:b]
                 elapsed = self._clock() - t0
                 self.sessions.put(session_id, new_levels, batch=b,
                                   bucket=bucket, step=serving_step,
                                   frames=frames)
+                deltas = None
+                if parse:
+                    # still under the session lock: the delta pairs THIS
+                    # frame's labels with the previous parse frame's —
+                    # an interleaved frame must never tear the pairing
+                    out = np.asarray(out)
+                    deltas = self._note_parse_labels(session_id, out, cold)
         finally:
             with self._session_cv:
                 self._session_inflight -= 1
@@ -1494,7 +1578,112 @@ class ServingEngine:
             info["canary_step"] = int(serving_step)
         if restart is not None:
             info["restart"] = restart
+        if deltas is not None:
+            info["deltas"] = deltas
         return out, info
+
+    #: retained per-session parse labels (host-side int32 grids) — the
+    #: delta baseline; bounded so abandoned sessions can never grow an
+    #: unbounded host-side map beside the byte-bounded device store
+    _PARSE_LABELS_MAX = 4096
+
+    def _note_parse_labels(self, session_id: str, packed: np.ndarray,
+                           cold: bool):
+        """Label bookkeeping for one parse frame (caller holds the
+        session lock): diff against the previous parse frame's labels,
+        then retain this frame's as the next baseline.  A cold frame
+        (fresh equilibrium) never diffs against pre-restart labels."""
+        c = self.config
+        side = c.image_size // c.patch_size
+        n = side * side
+        cur = np.rint(packed[:, :c.levels * n]).astype(np.int32)
+        cur = cur.reshape(packed.shape[0], c.levels, side, side)
+        prev = None if cold else self._parse_labels.get(session_id)
+        if prev is not None and prev.shape != cur.shape:
+            prev = None
+        deltas = [hierarchy_parse.island_deltas(
+            None if prev is None else prev[i], cur[i])
+            for i in range(cur.shape[0])]
+        self._parse_labels[session_id] = cur
+        while len(self._parse_labels) > self._PARSE_LABELS_MAX:
+            self._parse_labels.pop(next(iter(self._parse_labels)))
+        return deltas
+
+    # -- similarity queries (the /similar request path) --------------------
+    @property
+    def similar_enabled(self) -> bool:
+        return self._index is not None
+
+    def similar(self, imgs: np.ndarray, *, level: Optional[int] = None,
+                k: int = 5, ctx=None, tenant: Optional[str] = None):
+        """Level-aware nearest-neighbor query (``/similar``): embed the
+        query image(s) through the warmed ``index`` cache — the SAME
+        forward the bulk build ran, so query and index vectors live in
+        one space — then scan this replica's index shards
+        (:class:`glom_tpu.hierarchy.index.LevelIndex`).  Below the top
+        level the query is the image's per-patch vectors ("search by
+        part"); at the top it is the patch-mean whole.  Runs inline on
+        the caller's thread like a session frame: the device half is one
+        AOT bucket executable, the scan is host-side mmap work."""
+        if self._index is None:
+            raise RuntimeError(
+                "similarity index disabled on this engine (construct "
+                "with index_dir= to enable /similar)")
+        if self.tenants is not None:
+            try:
+                self.tenants.admit(tenant, int(imgs.shape[0]))
+            except TenantQuotaExceeded:
+                self._note_tenant_shed(tenant)
+                raise
+        imgs = np.ascontiguousarray(imgs, dtype=np.float32)
+        b = imgs.shape[0]
+        c = self.config
+        level = c.levels - 1 if level is None else int(level)
+        if not 0 <= level < c.levels:
+            raise ValueError(f"level {level} outside [0, {c.levels})")
+        if k < 1:
+            raise ValueError(f"need k >= 1, got {k}")
+        cache = self.caches["index"]
+        if cache.pick(b) is None:
+            raise ValueError(
+                f"query batch {b} exceeds the largest bucket "
+                f"{cache.max_bucket}")
+        contexts = [ctx] if ctx is not None else []
+        t0 = self._clock()
+        states = np.asarray(cache(self.params, imgs, tracer=self.tracer,
+                                  contexts=contexts))    # (b, n, L, d)
+        results = []
+        for i in range(b):
+            if level == c.levels - 1:
+                q = states[i, :, level, :].mean(axis=0, keepdims=True)
+            else:
+                q = states[i, :, level, :]
+            results.append(self._index.query(q, level, k=k))
+        elapsed = self._clock() - t0
+        self._account_similar(b, elapsed)
+        return results, {"level": level, "k": int(k),
+                         "index": self._index.stats()}
+
+    def _account_similar(self, images: int, elapsed_s: float) -> None:
+        reg = self.registry
+        with self._lock:
+            self.request_count += 1
+        reg.counter("serving_requests_total",
+                    help="images served across endpoints").inc(images)
+        reg.counter("serving_similar_queries",
+                    help="similarity queries answered").inc()
+        reg.histogram(
+            "serving_similar_seconds",
+            help="embed + index-scan time per similarity query",
+            unit="seconds",
+        ).observe(elapsed_s)
+        new_compiles = self.caches["index"].poll_compiles()
+        if new_compiles:
+            reg.counter(
+                "serving_xla_compiles",
+                help="request-path XLA compiles after warmup "
+                     "(must stay 0)",
+            ).inc(new_compiles)
 
     def session_reset(self, session_id: str) -> bool:
         """Drop a session's state (``/session/reset``); the next frame
@@ -1505,6 +1694,7 @@ class ServingEngine:
         if self.sessions is None:
             raise RuntimeError("sessions disabled on this engine")
         with self.sessions.locked(session_id):
+            self._parse_labels.pop(session_id, None)
             return self.sessions.reset(session_id)
 
     def _account_session(self, cold: bool, images: int, elapsed_s: float,
@@ -1531,8 +1721,13 @@ class ServingEngine:
                      "change (eviction/failover colds surface as "
                      "serving_session_misses)",
             ).inc()
-        for cache_name in ("session_cold", "session_warm"):
-            new_compiles = self.caches[cache_name].poll_compiles()
+        # "parse" covers /session/parse's post-pass (and, via the shared
+        # counter, its inner index executables)
+        for cache_name in ("session_cold", "session_warm", "parse"):
+            cache = self.caches.get(cache_name)
+            if cache is None:
+                continue
+            new_compiles = cache.poll_compiles()
             if new_compiles:
                 reg.counter(
                     "serving_xla_compiles",
@@ -1812,7 +2007,16 @@ class ServingEngine:
             # dead replica's unfinished range can be re-partitioned from
             # its last witnessed cursor
             "bulk": None if self.bulk is None else self.bulk.summary(),
+            # the part-whole plane's contract surface: the islanding
+            # thresholds clients parsed under, and this replica's index
+            # shard inventory (what /similar fan-out actually scans)
+            "hierarchy": {
+                "parse_thresholds": list(self.parse_thresholds),
+                "index": (None if self._index is None
+                          else self._index.stats()),
+            },
             "image_size": c.image_size,
+            "patch_size": c.patch_size,
             "channels": c.channels,
             "levels": c.levels,
             "dim": c.dim,

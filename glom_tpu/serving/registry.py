@@ -344,7 +344,10 @@ def load_version(model: str, checkpoint_dir: str, *,
     import numpy as np
 
     from glom_tpu.serving import quant as serving_quant
-    from glom_tpu.serving.compile_cache import BucketedCompileCache
+    from glom_tpu.serving.compile_cache import (
+        BucketedCompileCache,
+        PostPassCache,
+    )
     from glom_tpu.training import denoise
 
     loaded_step, config, train_cfg, host_params = (
@@ -358,6 +361,7 @@ def load_version(model: str, checkpoint_dir: str, *,
     if alias is not None:
         caches, aliased = alias.caches, True
     else:
+        from glom_tpu.hierarchy import parse as hierarchy_parse
         from glom_tpu.serving.engine import (
             _make_embed_fn,
             _make_reconstruct_fn,
@@ -373,7 +377,26 @@ def load_version(model: str, checkpoint_dir: str, *,
                     _make_reconstruct_fn(serve_cfg, train_cfg, iters),
                     quant),
                 buckets, name="reconstruct", quant=quant, donate=donate),
+            # the part-whole plane serves non-default models too: /parse
+            # requests may pin a model, and registry-pinned bulk "index"
+            # jobs execute against the pin's own cache namespace
+            "index": BucketedCompileCache(
+                serving_quant.quantized_forward(
+                    hierarchy_parse.make_index_fn(serve_cfg, iters), quant),
+                buckets, name="index", quant=quant, donate=donate),
         }
+        # /parse rides the index executables + the islanding post-pass
+        # (PostPassCache) — the settle graph compiles once per bucket
+        # for this version's whole cache namespace
+        c = serve_cfg
+        caches["parse"] = PostPassCache(
+            caches["index"],
+            hierarchy_parse.make_pack_fn(
+                serve_cfg,
+                hierarchy_parse.parse_thresholds(None, serve_cfg.levels)),
+            lambda b: jax.ShapeDtypeStruct(
+                (b, c.num_patches, c.levels, c.dim), np.float32),
+            name="parse")
         aliased = False
         if warmup:
             c = serve_cfg

@@ -91,7 +91,7 @@ from glom_tpu.obs.tracing import (
     request_trace_id,
 )
 
-ENDPOINTS = ("embed", "reconstruct")
+ENDPOINTS = ("embed", "reconstruct", "parse")
 # proxied POST routes: the stateless pair plus the stateful session
 # endpoints.  Session requests SHOULD carry ``X-Affinity-Key: <session
 # id>`` — the consistent-hash ring then pins the whole stream to one
@@ -103,7 +103,14 @@ ENDPOINTS = ("embed", "reconstruct")
 # start savings are lost).  On ejection the ring moves only the dead
 # replica's keys: those sessions cold-restart on their new replica — the
 # documented cold-restart contract (docs/SERVING.md).
-ROUTED_PATHS = ("/embed", "/reconstruct", "/session/embed", "/session/reset")
+#
+# /parse rides the same single-replica proxy as the stateless pair and
+# /session/parse the same affinity rules as /session/embed.  /similar is
+# the odd one out: it FANS OUT to every healthy replica (each may hold a
+# different index shard family) and merges the per-image top-k here —
+# see similar_fanout for the deterministic merge rule.
+ROUTED_PATHS = ("/embed", "/reconstruct", "/parse", "/similar",
+                "/session/embed", "/session/parse", "/session/reset")
 _VNODES = 64
 _HEX_ID = re.compile(r"[0-9a-f]{1,32}")
 # one Prometheus sample line: name[{labels}] value [timestamp]
@@ -864,6 +871,109 @@ class FleetRouter:
         raise NoHealthyReplica(
             f"all {len(tried)} replicas failed: {last_exc!r}")
 
+    def similar_fanout(self, body: bytes, headers: Dict[str, str],
+                       root_span=None) -> Tuple[int, dict, str]:
+        """POST /similar to EVERY healthy replica and merge the answers.
+
+        Unlike ``dispatch`` (one replica serves the request), a similarity
+        query must see the whole index: replicas may each hold a different
+        shard family (a fleet bulk job shards the slot range, so replica A
+        indexed slots [0,N) while B indexed [N,2N)).  The merge is
+        deterministic regardless of reply order: per image, candidates
+        from all replicas are deduped by slot keeping the best score
+        (shared-index deployments answer identically everywhere, so
+        duplicates are exact), then sorted by ``(-score, slot)`` and cut
+        to k.  Replicas without an index (404) just don't contribute.
+
+        Returns ``(status, payload_dict, served_by)``; raises
+        :class:`NoHealthyReplica` when nothing answered at all.
+        """
+        tracer = self.tracer
+        with self._lock:
+            fleet = [r for r in self.replicas if r.healthy]
+        if not fleet:
+            raise NoHealthyReplica("no healthy replicas for /similar")
+        merged: Optional[List[Dict[int, float]]] = None
+        level = k = None
+        shard_stats: Dict[str, dict] = {}
+        answered: List[str] = []
+        last_err: Optional[Tuple[int, dict]] = None
+        last_exc: Optional[Exception] = None
+        for replica in fleet:
+            proxy_span = None
+            if root_span is not None:
+                proxy_span = tracer.start_span(
+                    SPAN_PROXY, root_span,
+                    attrs={"replica": replica.name, "endpoint": "similar"})
+            try:
+                status, _, resp_body = self._http(
+                    "POST", f"{replica.url}/similar", body, dict(headers),
+                    self.request_timeout_s)
+            except Exception as e:  # connection-level: skip this shard
+                last_exc = e
+                with self._lock:
+                    replica.errors += 1
+                    self._note_failure(replica)
+                if proxy_span is not None:
+                    tracer.end(proxy_span, attrs={"error": repr(e)})
+                continue
+            with self._lock:
+                replica.requests += 1
+                replica.fail_streak = 0
+                if status >= 500:
+                    replica.errors += 1
+            if proxy_span is not None:
+                tracer.end(proxy_span, attrs={"status": status})
+            if status != 200:
+                # 404 = no index on that replica (fine: it holds no
+                # shard).  Anything else is remembered so an all-error
+                # fan-out surfaces a real diagnosis, not a bare 503.
+                if status != 404:
+                    try:
+                        last_err = (status, json.loads(resp_body))
+                    except ValueError:
+                        last_err = (status, {"error": resp_body.decode(
+                            "utf-8", "replace")})
+                continue
+            try:
+                payload = json.loads(resp_body)
+                results = payload["results"]
+            except (ValueError, KeyError, TypeError):
+                last_err = (502, {"error": f"unparseable /similar reply "
+                                           f"from {replica.name}"})
+                continue
+            answered.append(replica.name)
+            if payload.get("index") is not None:
+                shard_stats[replica.name] = payload["index"]
+            if level is None:
+                level, k = payload.get("level"), payload.get("k")
+            if merged is None:
+                merged = [dict() for _ in results]
+            for best, hits in zip(merged, results):
+                for hit in hits:
+                    slot = int(hit["slot"])
+                    score = float(hit["score"])
+                    if slot not in best or score > best[slot]:
+                        best[slot] = score
+        if merged is None:
+            if last_err is not None:
+                return last_err[0], last_err[1], ""
+            raise NoHealthyReplica(
+                f"no replica answered /similar: {last_exc!r}")
+        want = int(k) if k else 5
+        results = []
+        for best in merged:
+            ranked = sorted(best.items(), key=lambda kv: (-kv[1], kv[0]))
+            results.append([{"slot": slot, "score": score}
+                            for slot, score in ranked[:want]])
+        self.registry.counter(
+            "router_similar_fanouts_total",
+            help="similarity queries fanned across the fleet's shards",
+        ).inc()
+        return 200, {"results": results, "level": level, "k": k,
+                     "replicas": answered, "shards": shard_stats}, \
+            ",".join(answered)
+
     # -- coordinated rollout ------------------------------------------------
     def _admin(self, replica: Replica, action: str,
                payload: Optional[dict] = None,
@@ -1171,8 +1281,9 @@ class FleetRouter:
         if model:
             # surface the model's input contract so loadgen (and any other
             # client) reads the router exactly like a single engine
-            for key in ("image_size", "channels", "levels", "dim", "step",
-                        "buckets", "quant", "mesh", "param_sharding"):
+            for key in ("image_size", "patch_size", "channels", "levels",
+                        "dim", "step", "buckets", "quant", "mesh",
+                        "param_sharding", "hierarchy"):
                 if key in model:
                     out[key] = model[key]
         return out
@@ -1481,6 +1592,28 @@ class _RouterHandler(BaseHTTPRequestHandler):
         tenant = self.headers.get("X-Tenant")
         if tenant:
             fwd["X-Tenant"] = tenant
+        if endpoint == "similar":
+            # shard fan-out, not single-replica proxy: every healthy
+            # replica answers from its index shards; merged top-k here
+            try:
+                status, payload, served = router.similar_fanout(
+                    body, fwd, root_span=root)
+            except NoHealthyReplica as e:
+                self._reply(503, {"error": "no_replica", "detail": str(e)})
+                tracer.end(root, attrs={"status": 503})
+                return
+            router.registry.counter(
+                "router_requests_total",
+                help="requests proxied to replicas",
+            ).inc()
+            t_done = tracer.clock()
+            self._reply(status, payload,
+                        extra_headers=({"X-Served-By": served}
+                                       if served else None))
+            t_end = tracer.clock()
+            tracer.record(SPAN_RESPOND, root, t_done, t_end)
+            tracer.end(root, attrs={"status": status}, at=t_end)
+            return
         try:
             status, _resp_headers, resp_body, replica = router.dispatch(
                 endpoint, body, fwd, root_span=root, affinity_key=affinity,
@@ -1536,6 +1669,10 @@ def _spawn_fleet(n: int, args) -> Tuple[List[str], list]:
             # per-replica job store; the shared sink lives in the specs
             bulk_dir=(os.path.join(args.bulk_dir, f"r{i}")
                       if getattr(args, "bulk_dir", None) else None),
+            # one shared index root is fine: the router's /similar merge
+            # dedupes by slot, so full-copy and sharded layouts coexist
+            index_dir=getattr(args, "index_dir", None),
+            parse_thresholds=getattr(args, "parse_thresholds", None),
         )
         engine.start(watch=False)
         # per-replica capacity sampler: its /healthz summary feeds the
@@ -1606,6 +1743,13 @@ def main(argv=None) -> int:
                         "with a per-replica job store under DIR/<name> "
                         "(docs/BULK.md); the router shards /admin/jobs/* "
                         "submits across the fleet")
+    p.add_argument("--index-dir", default=None, metavar="DIR",
+                   help="--spawn mode: similarity-index root handed to "
+                        "every replica (POST /similar fans across the "
+                        "fleet and merges top-k; docs/HIERARCHY.md)")
+    p.add_argument("--parse-thresholds", default=None, metavar="T|T0,T1,..",
+                   help="--spawn mode: per-level agreement thresholds for "
+                        "POST /parse islanding (default 0.9)")
     p.add_argument("--platform", default="auto",
                    help="force a JAX platform for --spawn (e.g. 'cpu')")
     p.add_argument("--verbose", action="store_true")

@@ -392,11 +392,14 @@ class _Handler(BaseHTTPRequestHandler):
     # -- stateful session endpoints ----------------------------------------
     # POST /session/embed: one frame of a stateful stream — warm-starts
     # from the session's resident column state (docs/SERVING.md sessions
-    # section).  POST /session/reset drops the state.  Both need the
-    # engine constructed with warm_iters=.
+    # section).  POST /session/parse: the same frame update, answering
+    # with the islanding plus frame-to-frame island deltas
+    # (docs/HIERARCHY.md).  POST /session/reset drops the state.  All
+    # need the engine constructed with warm_iters=.
     def _do_session(self):
         engine = self.server.engine
         tracer = engine.tracer
+        parse = self.path == "/session/parse"
         if not engine.sessions_enabled:
             self._reply(404, {"error": "sessions disabled on this engine "
                                        "(start the server with --warm-iters)"})
@@ -465,9 +468,9 @@ class _Handler(BaseHTTPRequestHandler):
         import time as _time
 
         t0 = _time.monotonic()
+        run = engine.session_parse if parse else engine.session_embed
         try:
-            out, info = engine.session_embed(session_id, imgs, ctx=root,
-                                             tenant=tenant)
+            out, info = run(session_id, imgs, ctx=root, tenant=tenant)
         except TenantQuotaExceeded as e:
             self._reply(503, {"error": "tenant_overloaded",
                               "tenant": e.tenant,
@@ -496,28 +499,40 @@ class _Handler(BaseHTTPRequestHandler):
         t_done = tracer.clock()
         tracer.record(SPAN_DISPATCH_WAIT, root, t_parsed, t_done)
         engine.registry.histogram(
-            "serving_latency_seconds_session",
-            help="session frame latency, admission to response",
+            "serving_latency_seconds_session"
+            + ("_parse" if parse else ""),
+            help=("session parse-frame latency, admission to response"
+                  if parse else
+                  "session frame latency, admission to response"),
             unit="seconds",
         ).observe(latency)
-        level = payload.get("level")
-        if level is not None:
-            try:
-                out = out[:, int(level)]
-            except (IndexError, TypeError, ValueError):
-                self._reply(400, {"error": (
-                    f"level {level!r} outside this model's "
-                    f"{engine.config.levels} levels"
-                )})
-                _finish(400)
-                return
-        self._reply(200, {
+        resp = {
             "latency_ms": round(latency * 1e3, 3),
             "request_id": self._request_id,
             "session": session_id,
-            "embeddings": out.tolist(),
             **info,  # carries the honest "step" (the version that served)
-        })
+        }
+        if parse:
+            from glom_tpu.hierarchy.parse import unpack_parse
+
+            cfg = engine.config
+            side = cfg.image_size // cfg.patch_size
+            resp["islands"] = [
+                unpack_parse(row, cfg.levels, side, cfg.dim) for row in out]
+        else:
+            level = payload.get("level")
+            if level is not None:
+                try:
+                    out = out[:, int(level)]
+                except (IndexError, TypeError, ValueError):
+                    self._reply(400, {"error": (
+                        f"level {level!r} outside this model's "
+                        f"{engine.config.levels} levels"
+                    )})
+                    _finish(400)
+                    return
+            resp["embeddings"] = out.tolist()
+        self._reply(200, resp)
         t_end = tracer.clock()
         tracer.record(SPAN_RESPOND, root, t_done, t_end)
         _finish(200, latency_ms=latency * 1e3, at=t_end,
@@ -547,10 +562,14 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             self._reply(200, {"written": path, "step": int(engine.step)})
             return
-        if self.path in ("/session/embed", "/session/reset"):
+        if self.path in ("/session/embed", "/session/parse",
+                         "/session/reset"):
             self._do_session()
             return
-        if self.path not in ("/embed", "/reconstruct"):
+        if self.path == "/similar":
+            self._do_similar()
+            return
+        if self.path not in ("/embed", "/reconstruct", "/parse"):
             self._reply(404, {"error": f"no route {self.path}"})
             return
         endpoint = self.path[1:]
@@ -701,6 +720,13 @@ class _Handler(BaseHTTPRequestHandler):
                     _finish(400, at=t_end, version=version)
                     return
             resp["embeddings"] = out.tolist()
+        elif endpoint == "parse":
+            from glom_tpu.hierarchy.parse import unpack_parse
+
+            cfg = model_cfg if model_cfg is not None else engine.config
+            side = cfg.image_size // cfg.patch_size
+            resp["islands"] = [
+                unpack_parse(row, cfg.levels, side, cfg.dim) for row in out]
         else:
             resp["images"] = out.tolist()
         self._reply(200, resp)
@@ -709,6 +735,92 @@ class _Handler(BaseHTTPRequestHandler):
         t_end = tracer.clock()
         tracer.record(SPAN_RESPOND, root, t_done, t_end)
         _finish(200, latency_ms=latency * 1e3, at=t_end, version=version)
+
+    # -- similarity queries (the /similar request path) --------------------
+    # POST /similar: level-aware nearest-neighbor lookup against this
+    # replica's index shards (docs/HIERARCHY.md).  Inline on the handler
+    # thread like a session frame: the device half is one warmed AOT
+    # executable, the scan is host-side mmap work.  Body: images plus
+    # optional "level" (default: the top level) and "k" (default 5).
+    def _do_similar(self):
+        engine = self.server.engine
+        tracer = engine.tracer
+        if not engine.similar_enabled:
+            self._reply(404, {"error": "similarity index disabled on this "
+                                       "engine (start the server with "
+                                       "--index-dir)"})
+            return
+        rid_header = request_trace_id(self.headers.get("X-Request-Id"))
+        remote = parse_traceparent(self.headers.get("traceparent"))
+        root = tracer.start_trace(
+            SPAN_REQUEST,
+            trace_id=rid_header or (remote[0] if remote else None),
+            parent_id=remote[1] if remote else None,
+            attrs={"endpoint": "similar"},
+        )
+        self._trace_root = root
+        self._request_id = rid_header or root.trace_id
+        tenant = self._tenant()
+        if tenant == "":
+            _t = tracer.clock()
+            tracer.record(SPAN_PARSE, root, root.start, _t)
+            tracer.end(root, attrs={"status": 400}, at=_t)
+            return
+
+        def _finish(status: int, latency_ms=None, at=None):
+            tracer.end(root, attrs={"status": status}, at=at)
+            engine.observe_outcome("similar", latency_ms, status >= 500,
+                                   trace_id=root.trace_id, tenant=tenant)
+
+        payload = self._read_json()
+        imgs = self._parse_images(payload) if payload is not None else None
+        t_parsed = tracer.clock()
+        tracer.record(SPAN_PARSE, root, root.start, t_parsed)
+        if imgs is None:
+            _finish(400)
+            return
+        import time as _time
+
+        t0 = _time.monotonic()
+        try:
+            level = payload.get("level")
+            k = payload.get("k", 5)
+            results, info = engine.similar(
+                imgs, level=None if level is None else int(level),
+                k=int(k), ctx=root, tenant=tenant)
+        except TenantQuotaExceeded as e:
+            self._reply(503, {"error": "tenant_overloaded",
+                              "tenant": e.tenant,
+                              "detail": "tenant admission quota exhausted; "
+                                        "back off"})
+            _finish(503)
+            return
+        except (TypeError, ValueError) as e:  # bad level/k, oversize batch
+            self._reply(400, {"error": str(e)})
+            _finish(400)
+            return
+        except Exception as e:
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            _finish(500)
+            return
+        latency = _time.monotonic() - t0
+        t_done = tracer.clock()
+        tracer.record(SPAN_DISPATCH_WAIT, root, t_parsed, t_done)
+        engine.registry.histogram(
+            "serving_latency_seconds_similar",
+            help="similarity query latency, admission to response",
+            unit="seconds",
+        ).observe(latency)
+        self._reply(200, {
+            "step": int(engine.step),
+            "latency_ms": round(latency * 1e3, 3),
+            "request_id": self._request_id,
+            "results": results,
+            **info,
+        })
+        t_end = tracer.clock()
+        tracer.record(SPAN_RESPOND, root, t_done, t_end)
+        _finish(200, latency_ms=latency * 1e3, at=t_end)
 
 
 def make_server(engine: ServingEngine, host: str = "127.0.0.1",
@@ -847,6 +959,16 @@ def main(argv=None) -> int:
                         "directory for scavenger-class offline jobs "
                         "(docs/BULK.md); unfinished jobs in the store "
                         "resume automatically on start")
+    p.add_argument("--index-dir", default=None, metavar="DIR",
+                   help="enable POST /similar: root of a level-aware "
+                        "similarity index built by a bulk 'index' job "
+                        "(docs/HIERARCHY.md).  The directory may fill in "
+                        "later; queries see whatever parts exist")
+    p.add_argument("--parse-thresholds", default=None, metavar="T|T0,T1,..",
+                   help="agreement threshold(s) for POST /parse islanding: "
+                        "one float broadcast to every level, or one per "
+                        "level, comma-separated, each in [-1, 1] "
+                        "(default 0.9)")
     p.add_argument("--quality-sample", type=float, default=1.0,
                    help="fraction of served batches fed through the "
                         "model-quality post-pass (island agreement, "
@@ -936,6 +1058,8 @@ def main(argv=None) -> int:
                           else read_bench_ceiling()),
         quality_sample=args.quality_sample,
         bulk_dir=args.bulk_dir,
+        parse_thresholds=args.parse_thresholds,
+        index_dir=args.index_dir,
     )
     engine.start()
     engine.capacity.start()  # sampler thread: tests tick() with a fake clock

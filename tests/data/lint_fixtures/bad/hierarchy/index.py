@@ -1,0 +1,28 @@
+"""BAD: a hierarchy index module that breaks both halves of the
+isolation contract — it imports jax and the glom_tpu package (index.py
+must stay stub-loadable on a deviceless audit host: stdlib + numpy +
+mmap only), and its query loop stages every candidate from every part
+without ever trimming, so query memory grows with the index size."""
+
+import os
+
+import numpy as np
+
+import jax.numpy as jnp  # BAD: drags the jax runtime into offline audits
+from glom_tpu.core import GlomConfig  # BAD: defeats the _obsload stub loader
+from .parse import unpack_parse  # BAD: relative import = package import
+
+
+class LevelIndex:
+    def __init__(self, root):
+        self.root = root
+        self._staged = []  # BAD: unbounded staging buffer
+
+    def query(self, vec, k):
+        for name in sorted(os.listdir(self.root)):
+            part = np.load(os.path.join(self.root, name), mmap_mode="r")
+            scores = part @ vec
+            for slot, score in enumerate(scores):
+                # BAD: never trimmed to k — stages the whole index
+                self._staged.append((float(score), slot))
+        return sorted(self._staged, reverse=True)[:k]

@@ -1,0 +1,30 @@
+"""GOOD: the same index reader with the contract honored — stdlib +
+numpy only (no jax, no glom_tpu, no relative imports; helpers are
+inlined), and the per-part candidate buffer is re-ranked and trimmed to
+k after every part, so query memory is bounded by one bulk chunk."""
+
+import os
+
+import numpy as np
+
+
+def _part_path(root, name):
+    # inlined helper instead of importing one from the package
+    return os.path.join(root, name)
+
+
+class LevelIndex:
+    def __init__(self, root):
+        self.root = root
+        self._staged = []
+
+    def query(self, vec, k):
+        for name in sorted(os.listdir(self.root)):
+            part = np.load(_part_path(self.root, name), mmap_mode="r")
+            scores = part @ vec
+            for slot, score in enumerate(scores):
+                self._staged.append((float(score), slot))
+            # trim after every part: staging never exceeds chunk + k
+            self._staged.sort(key=lambda t: (-t[0], t[1]))
+            del self._staged[k:]
+        return list(self._staged)

@@ -87,6 +87,8 @@ RULE_FIXTURES = [
      "serving/spill_escape.py"),
     # -- the bulk tier (PR 18): scavenger-class isolation --
     ("bulk-isolation", "bulk/runner.py", "bulk/runner.py"),
+    # -- the part-whole plane (PR 20): jax-free index + bounded staging --
+    ("hierarchy-isolation", "hierarchy/index.py", "hierarchy/index.py"),
 ]
 
 #: (fixture, the PR whose review finding it reduces) — each must be

@@ -1,0 +1,628 @@
+"""Part-whole workload plane (PR 20): `/parse`, `/similar`,
+`/session/parse` (``glom_tpu/hierarchy/``, docs/HIERARCHY.md).
+
+Tier-1 gates:
+
+  * the jitted islanding is BITWISE identical to the reference host-side
+    flood fill (``models/islands.py:label_islands``) — same labels, same
+    row-major first-encounter numbering — across grid sizes, thresholds,
+    and degenerate masks;
+  * the threshold grammar, packed-row layout, and frame-to-frame island
+    delta semantics (appeared / vanished / moved / stable, cold frames
+    report everything appeared);
+  * the index store: per-level part families, top-level patch-mean
+    entries, idempotent rewrite + orphan-overlap unlink, exact-tiling
+    assembly, deterministic bounded top-k queries that see parts landing
+    after the reader was constructed;
+  * the serving integration: an engine (and a fleet behind the router)
+    answers all three endpoints, a bulk ``transform: "index"`` job
+    killed mid-build resumes to a bitwise-identical index, and the
+    request path never compiles (``serving_xla_compiles == 0``).
+"""
+
+import hashlib
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from glom_tpu.bulk.jobs import BulkJobSpec, SlotDataset
+from glom_tpu.hierarchy.index import (
+    INDEX_PART_RE,
+    LevelIndex,
+    assemble_level,
+    index_part_name,
+    level_parts,
+    write_index_parts,
+)
+from glom_tpu.hierarchy.parse import (
+    DEFAULT_THRESHOLD,
+    _island_labels,
+    _make_packer,
+    island_deltas,
+    parse_row_width,
+    parse_thresholds,
+    unpack_parse,
+)
+from glom_tpu.models.islands import label_islands
+from glom_tpu.serving.engine import (
+    DEMO_CONFIG,
+    ServingEngine,
+    make_demo_checkpoint,
+)
+
+
+# ---------------------------------------------------------------------------
+# threshold grammar
+# ---------------------------------------------------------------------------
+class TestThresholdGrammar:
+    def test_none_broadcasts_default(self):
+        assert parse_thresholds(None, 3) == (DEFAULT_THRESHOLD,) * 3
+
+    def test_scalar_and_single_string_broadcast(self):
+        assert parse_thresholds(0.5, 3) == (0.5, 0.5, 0.5)
+        assert parse_thresholds("0.85", 2) == (0.85, 0.85)
+
+    def test_comma_list_is_per_level(self):
+        assert parse_thresholds("0.95, 0.9, 0.8", 3) == (0.95, 0.9, 0.8)
+
+    def test_sequence_accepted(self):
+        assert parse_thresholds([0.1, 0.2], 2) == (0.1, 0.2)
+
+    def test_length_mismatch_rejected(self):
+        with pytest.raises(ValueError, match="values for"):
+            parse_thresholds("0.9,0.8", 3)
+
+    def test_outside_cosine_range_rejected(self):
+        with pytest.raises(ValueError, match="cosine range"):
+            parse_thresholds(1.5, 2)
+
+    def test_garbage_string_rejected(self):
+        with pytest.raises(ValueError, match="bad threshold"):
+            parse_thresholds("hot,cold", 2)
+
+    def test_empty_spec_rejected(self):
+        with pytest.raises(ValueError, match="empty"):
+            parse_thresholds(" , ", 2)
+
+
+# ---------------------------------------------------------------------------
+# islanding: bitwise vs the reference flood fill
+# ---------------------------------------------------------------------------
+class TestIslandingBitwise:
+    @pytest.mark.parametrize("side", [2, 3, 5])
+    def test_random_masks_match_reference(self, side):
+        """THE contract pin: the fixed-iteration min-index propagation
+        reproduces label_islands EXACTLY — labels, numbering order, and
+        island count — for the same above-threshold mask."""
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(100 + side)
+        for trial in range(20):
+            agree = rng.uniform(-1.0, 1.0, size=(side, side))
+            thr = float(rng.uniform(-0.9, 0.9))
+            ref_labels, ref_sizes = label_islands(agree, thr)
+            labels, count = _island_labels(jnp.asarray(agree >= thr), side)
+            np.testing.assert_array_equal(
+                np.asarray(labels), ref_labels,
+                err_msg=f"side={side} trial={trial} thr={thr}")
+            assert int(count) == len(ref_sizes)
+
+    def test_all_below_threshold_is_zero_islands(self):
+        import jax.numpy as jnp
+
+        labels, count = _island_labels(jnp.zeros((3, 3), bool), 3)
+        assert int(count) == 0 and not np.asarray(labels).any()
+
+    def test_full_grid_is_one_island(self):
+        import jax.numpy as jnp
+
+        labels, count = _island_labels(jnp.ones((4, 4), bool), 4)
+        assert int(count) == 1
+        np.testing.assert_array_equal(np.asarray(labels),
+                                      np.ones((4, 4), np.int32))
+
+    def test_diagonal_is_not_connected(self):
+        """4-connectivity: diagonal neighbors are separate islands, in
+        row-major first-encounter order."""
+        import jax.numpy as jnp
+
+        mask = np.eye(3, dtype=bool)
+        labels, count = _island_labels(jnp.asarray(mask), 3)
+        assert int(count) == 3
+        np.testing.assert_array_equal(np.asarray(labels),
+                                      np.diag([1, 2, 3]).astype(np.int32))
+
+
+# ---------------------------------------------------------------------------
+# packed rows
+# ---------------------------------------------------------------------------
+class TestPackedRows:
+    def test_row_width_formula(self):
+        # per level: n labels + 1 count + n sizes + n*dim means
+        assert parse_row_width(3, 2, 16) == 3 * (4 + 1 + 4 + 4 * 16)
+
+    def test_pack_unpack_round_trip_at_threshold_floor(self):
+        """Threshold -1 puts every patch above threshold: one island per
+        level covering the grid, whose mean is the plain patch mean —
+        the full layout checked end to end through the real packer."""
+        c = DEMO_CONFIG
+        side = c.image_size // c.patch_size
+        n = side * side
+        pack = _make_packer(c, (-1.0,) * c.levels)
+        levels = np.random.RandomState(3).randn(
+            2, n, c.levels, c.dim).astype(np.float32)
+        rows = np.asarray(pack(levels))
+        assert rows.shape == (2, parse_row_width(c.levels, side, c.dim))
+        for i in range(2):
+            per_level = unpack_parse(rows[i], c.levels, side, c.dim)
+            assert len(per_level) == c.levels
+            for lv, isl in enumerate(per_level):
+                assert isl["num_islands"] == 1
+                assert isl["sizes"] == [n]
+                assert np.asarray(isl["labels"]).tolist() == (
+                    np.ones((side, side), int).tolist())
+                np.testing.assert_allclose(
+                    isl["means"][0], levels[i, :, lv, :].mean(axis=0),
+                    rtol=1e-5, atol=1e-6)
+
+    def test_unpack_rejects_wrong_width(self):
+        with pytest.raises(ValueError, match="columns"):
+            unpack_parse(np.zeros(7, np.float32), 3, 2, 16)
+
+
+# ---------------------------------------------------------------------------
+# island deltas
+# ---------------------------------------------------------------------------
+def _grid(rows):
+    return np.asarray([rows], np.int32)  # one level
+
+
+class TestIslandDeltas:
+    def test_cold_frame_reports_everything_appeared(self):
+        cur = _grid([[1, 1, 0], [0, 2, 2], [0, 0, 0]])
+        (d,) = island_deltas(None, cur)
+        assert d == {"appeared": [1, 2], "vanished": [], "moved": [],
+                     "stable": []}
+
+    def test_identical_frames_are_stable(self):
+        cur = _grid([[1, 1], [0, 2]])
+        (d,) = island_deltas(cur, cur)
+        assert d == {"appeared": [], "vanished": [], "moved": [],
+                     "stable": [1, 2]}
+
+    def test_shifted_island_is_moved(self):
+        prev = _grid([[1, 1, 0], [0, 0, 0], [0, 0, 0]])
+        cur = _grid([[0, 1, 1], [0, 0, 0], [0, 0, 0]])
+        (d,) = island_deltas(prev, cur)
+        assert d["moved"] == [1] and d["stable"] == []
+        assert d["appeared"] == [] and d["vanished"] == []
+
+    def test_appeared_and_vanished(self):
+        prev = _grid([[1, 1], [0, 0]])
+        cur = _grid([[0, 0], [1, 1]])
+        (d,) = island_deltas(prev, cur)
+        # no overlap: the new island appeared, the old one vanished
+        assert d == {"appeared": [1], "vanished": [1], "moved": [],
+                     "stable": []}
+
+    def test_levels_diff_independently(self):
+        prev = np.stack([np.array([[1, 1], [0, 0]], np.int32),
+                         np.array([[1, 1], [1, 1]], np.int32)])
+        cur = np.stack([np.array([[1, 1], [0, 0]], np.int32),
+                        np.array([[0, 0], [0, 0]], np.int32)])
+        d0, d1 = island_deltas(prev, cur)
+        assert d0["stable"] == [1]
+        assert d1 == {"appeared": [], "vanished": [1], "moved": [],
+                      "stable": []}
+
+
+# ---------------------------------------------------------------------------
+# the index store
+# ---------------------------------------------------------------------------
+def _states(k, n=2, levels=2, dim=3, seed=0):
+    return np.random.RandomState(seed).randn(
+        k, n, levels, dim).astype(np.float32)
+
+
+class TestIndexStore:
+    def test_part_name_round_trips_through_the_pattern(self):
+        m = INDEX_PART_RE.match(index_part_name(2, 0, 1024))
+        assert m and (int(m.group("level")), int(m.group("lo")),
+                      int(m.group("hi"))) == (2, 0, 1024)
+
+    def test_top_level_entries_are_patch_means(self, tmp_path):
+        root = str(tmp_path / "idx")
+        states = _states(4)
+        write_index_parts(root, 0, 4, states)
+        below = np.load(level_parts(root, 0)[0][2])
+        top = np.load(level_parts(root, 1)[0][2])
+        assert below.shape == (4, 2, 3)          # per-patch parts
+        assert top.shape == (4, 1, 3)            # one whole per slot
+        np.testing.assert_allclose(
+            top, states[:, :, 1, :].mean(axis=1, keepdims=True))
+
+    def test_write_rejects_bad_shape(self, tmp_path):
+        with pytest.raises(ValueError, match="states"):
+            write_index_parts(str(tmp_path), 0, 4, _states(3))
+
+    def test_rewrite_is_idempotent_and_orphans_unlink(self, tmp_path):
+        """The resume shape: a dead owner's orphan chunk at boundaries
+        the survivors won't reproduce must vanish when the re-cut chunks
+        land, per level family."""
+        root = str(tmp_path / "idx")
+        write_index_parts(root, 0, 8, _states(8, seed=1))   # orphan
+        a, b = _states(4, seed=2), _states(4, seed=3)
+        write_index_parts(root, 0, 4, a)
+        write_index_parts(root, 4, 8, b)
+        write_index_parts(root, 4, 8, b)                    # re-execution
+        for level in (0, 1):
+            assert [(lo, hi) for lo, hi, _ in level_parts(root, level)] \
+                == [(0, 4), (4, 8)]
+        np.testing.assert_array_equal(
+            assemble_level(root, 0, total=8),
+            np.concatenate([a[:, :, 0, :], b[:, :, 0, :]]))
+
+    def test_assemble_rejects_gap_and_short_cover(self, tmp_path):
+        root = str(tmp_path / "idx")
+        with pytest.raises(ValueError, match="no level"):
+            assemble_level(root, 0)
+        write_index_parts(root, 2, 4, _states(2))
+        with pytest.raises(ValueError, match="tile"):
+            assemble_level(root, 0)
+        root2 = str(tmp_path / "idx2")
+        write_index_parts(root2, 0, 2, _states(2))
+        with pytest.raises(ValueError, match="total"):
+            assemble_level(root2, 0, total=4)
+
+    def test_query_validation(self, tmp_path):
+        idx = LevelIndex(str(tmp_path), levels=2)
+        with pytest.raises(ValueError, match="outside"):
+            idx.query(np.zeros(3), level=2)
+        with pytest.raises(ValueError, match="k >= 1"):
+            idx.query(np.zeros(3), level=0, k=0)
+
+    def test_query_exact_match_wins_and_ties_break_by_slot(self, tmp_path):
+        root = str(tmp_path / "idx")
+        states = np.zeros((3, 1, 1, 3), np.float32)
+        states[0, 0, 0] = [0.0, 1.0, 0.0]
+        states[1, 0, 0] = [1.0, 0.0, 0.0]        # the exact match
+        states[2, 0, 0] = [1.0, 0.0, 0.0]        # tied: higher slot loses
+        write_index_parts(root, 0, 3, states)
+        idx = LevelIndex(root, levels=1)
+        got = idx.query(np.asarray([1.0, 0.0, 0.0]), level=0, k=2)
+        assert [r["slot"] for r in got] == [1, 2]
+        assert got[0]["score"] == pytest.approx(1.0)
+
+    def test_query_sees_parts_landed_after_construction(self, tmp_path):
+        """The long-lived-engine contract: the reader re-lists the
+        directory per query, so a bulk build landing parts AFTER the
+        engine booted is immediately searchable."""
+        root = str(tmp_path / "idx")
+        early = np.zeros((2, 1, 1, 3), np.float32)
+        early[:, 0, 0] = [0.0, 1.0, 0.0]
+        write_index_parts(root, 0, 2, early)
+        idx = LevelIndex(root, levels=1)
+        q = np.asarray([1.0, 0.0, 0.0])
+        assert idx.query(q, level=0, k=1)[0]["score"] < 0.5
+        late = np.zeros((2, 1, 1, 3), np.float32)
+        late[0, 0, 0] = [1.0, 0.0, 0.0]
+        write_index_parts(root, 2, 4, late)
+        top = idx.query(q, level=0, k=1)[0]
+        assert top["slot"] == 2 and top["score"] == pytest.approx(1.0)
+
+    def test_stats_counts_chunks_and_slots(self, tmp_path):
+        root = str(tmp_path / "idx")
+        write_index_parts(root, 0, 2, _states(2))
+        write_index_parts(root, 2, 5, _states(3))
+        st = LevelIndex(root, levels=2).stats()
+        assert st["chunks"] == {"0": 2, "1": 2}
+        assert st["slots"] == {"0": 5, "1": 5}
+
+
+# ---------------------------------------------------------------------------
+# serving integration: engine, bulk index build, router
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def hier_ckpt(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("hier_ckpt"))
+    make_demo_checkpoint(d)
+    return d
+
+
+def _imgs(n, seed=0):
+    c = DEMO_CONFIG
+    return np.random.RandomState(seed).randn(
+        n, c.channels, c.image_size, c.image_size).astype(np.float32)
+
+
+def _engine(ckpt, *, bulk_dir=None, index_dir=None):
+    return ServingEngine(
+        ckpt, buckets=(1, 2), max_wait_ms=0.0, warmup=True,
+        reload_poll_s=0, warm_iters=2,
+        bulk_dir=bulk_dir, index_dir=index_dir)
+
+
+def _index_payload(sink, name="idx", total=6, seed=9):
+    return {"name": name, "dataset": f"synthetic:{total}",
+            "transform": "index", "seed": seed, "sink": sink}
+
+
+def _drain(engine, name, total):
+    for _ in range(4 * total):
+        if engine.bulk.status(name)["status"] == "done":
+            return
+        engine.bulk.run_idle_once()
+    raise AssertionError(f"bulk job {name} never drained")
+
+
+def _level_hashes(root, levels, total):
+    return {lv: hashlib.sha256(
+        np.ascontiguousarray(assemble_level(root, lv, total=total))
+        .tobytes()).hexdigest() for lv in range(levels)}
+
+
+@pytest.fixture(scope="module")
+def hier_engine(hier_ckpt, tmp_path_factory):
+    """One warmed engine shared by the endpoint tests: bulk + sessions +
+    similarity enabled, with its index built by an actual bulk job."""
+    base = tmp_path_factory.mktemp("hier_eng")
+    idx = str(base / "index")
+    eng = _engine(hier_ckpt, bulk_dir=str(base / "store"), index_dir=idx)
+    eng.bulk.submit(_index_payload(idx))
+    _drain(eng, "idx", 6)
+    yield eng
+    eng.shutdown(drain=False)
+
+
+class TestEngineEndpoints:
+    def test_parse_rows_are_internally_consistent(self, hier_engine):
+        """Every reported field is re-derivable from the labels grid:
+        the count is the max label, sizes are the label histogram, and
+        their sum is exactly the above-threshold cell count."""
+        c = DEMO_CONFIG
+        side = c.image_size // c.patch_size
+        fut = hier_engine.submit("parse", _imgs(2, seed=4))
+        hier_engine.process_once("parse", block=True)
+        rows = np.asarray(fut.result(timeout=30))
+        for row in rows:
+            for isl in unpack_parse(row, c.levels, side, c.dim):
+                labels = np.asarray(isl["labels"])
+                k = isl["num_islands"]
+                assert k == int(labels.max())
+                assert isl["sizes"] == [
+                    int((labels == j).sum()) for j in range(1, k + 1)]
+                assert sum(isl["sizes"]) == int((labels > 0).sum())
+                assert np.isfinite(np.asarray(isl["means"])).all()
+
+    def test_parse_labels_match_reference_flood_fill(self, hier_engine):
+        """The served labels ARE the reference labeling: recompute the
+        agreement maps from the same forward (the index cache's raw
+        column states) and flood-fill them with models/islands.py."""
+        import jax.numpy as jnp
+
+        from glom_tpu.models.islands import neighbor_agreement
+
+        c = DEMO_CONFIG
+        side = c.image_size // c.patch_size
+        imgs = _imgs(2, seed=5)
+        fut = hier_engine.submit("parse", imgs)
+        hier_engine.process_once("parse", block=True)
+        rows = np.asarray(fut.result(timeout=30))
+        states = np.asarray(hier_engine.caches["index"](
+            hier_engine.params, imgs))
+        agree = np.asarray(neighbor_agreement(jnp.asarray(states), side))
+        thr = hier_engine.parse_thresholds
+        for i in range(2):
+            got = unpack_parse(rows[i], c.levels, side, c.dim)
+            for lv in range(c.levels):
+                ref_labels, ref_sizes = label_islands(agree[i, lv], thr[lv])
+                np.testing.assert_array_equal(
+                    np.asarray(got[lv]["labels"]), ref_labels)
+                assert got[lv]["sizes"] == ref_sizes.tolist()
+
+    def test_session_parse_deltas_cold_then_consistent(self, hier_engine):
+        img = _imgs(1, seed=6)
+        out1, info1 = hier_engine.session_parse("cam-t", img)
+        assert info1["cold"]
+        c = DEMO_CONFIG
+        side = c.image_size // c.patch_size
+        first = unpack_parse(np.asarray(out1)[0], c.levels, side, c.dim)
+        for lv, d in enumerate(info1["deltas"][0]):
+            # a cold frame diffs against nothing: everything appeared
+            assert d["appeared"] == sorted(
+                set(np.asarray(first[lv]["labels"]).ravel()) - {0})
+            assert d["vanished"] == d["moved"] == d["stable"] == []
+        out2, info2 = hier_engine.session_parse("cam-t", img)
+        assert not info2["cold"]
+        second = unpack_parse(np.asarray(out2)[0], c.levels, side, c.dim)
+        for lv, d in enumerate(info2["deltas"][0]):
+            cur_ids = sorted(
+                set(np.asarray(second[lv]["labels"]).ravel()) - {0})
+            # every current island lands in exactly one outcome bucket
+            assert sorted(d["appeared"] + d["moved"] + d["stable"]) \
+                == cur_ids
+
+    def test_similar_finds_the_corpus_image_itself(self, hier_engine):
+        """Query with slot 3's own image: the index forward IS the query
+        forward, so slot 3 must come back as the top hit with cosine ~1
+        at every level — by part below the top, by whole at it."""
+        c = DEMO_CONFIG
+        spec = BulkJobSpec(name="idx", dataset="synthetic:6",
+                           transform="index", sink="unused", seed=9,
+                           image_size=c.image_size, channels=c.channels)
+        probe = SlotDataset(spec).read(3, 4)
+        for level in range(c.levels):
+            results, info = hier_engine.similar(probe, level=level, k=3)
+            assert info["level"] == level
+            top = results[0][0]
+            assert top["slot"] == 3
+            assert top["score"] == pytest.approx(1.0, abs=1e-4)
+            assert len(results[0]) <= 3
+
+    def test_similar_defaults_to_top_level(self, hier_engine):
+        _, info = hier_engine.similar(_imgs(1, seed=7), k=2)
+        assert info["level"] == DEMO_CONFIG.levels - 1
+        assert info["index"]["slots"][str(info["level"])] == 6
+
+    def test_zero_request_path_compiles(self, hier_engine):
+        # runs after the other endpoint tests in file order; any compile
+        # any of them triggered would have landed in this counter
+        snap = hier_engine.registry.snapshot()
+        assert snap.get("serving_xla_compiles", 0) == 0
+
+    def test_similar_disabled_without_index_dir(self, hier_ckpt,
+                                                tmp_path):
+        eng = ServingEngine(hier_ckpt, buckets=(1,), max_wait_ms=0.0,
+                            warmup=False, reload_poll_s=0)
+        try:
+            assert not eng.similar_enabled
+            with pytest.raises(RuntimeError, match="index_dir"):
+                eng.similar(_imgs(1))
+        finally:
+            eng.shutdown(drain=False)
+
+
+class TestIndexKillResume:
+    def test_killed_build_resumes_bitwise_identical(self, hier_ckpt,
+                                                    hier_engine,
+                                                    tmp_path):
+        """The exactly-once acceptance, in process: kill an engine
+        mid-index-job (no drain), adopt the job on a fresh engine over
+        the same durable store, and the assembled per-level shards hash
+        identical to the shared fixture engine's uninterrupted build of
+        the SAME job identity."""
+        total, levels = 6, DEMO_CONFIG.levels
+        ref_hashes = _level_hashes(hier_engine.index_dir, levels, total)
+        store = str(tmp_path / "store")
+        idx = str(tmp_path / "index")
+        victim = _engine(hier_ckpt, bulk_dir=store, index_dir=idx)
+        try:
+            victim.bulk.submit(_index_payload(idx, total=total))
+            while victim.bulk.status("idx")["done"] < 2:
+                assert victim.bulk.run_idle_once() >= 0
+        finally:
+            victim.shutdown(drain=False)            # the kill
+        done_at_kill = None
+        survivor = _engine(hier_ckpt, bulk_dir=store, index_dir=idx)
+        try:
+            done_at_kill = survivor.bulk.status("idx")["done"]
+            assert 0 < done_at_kill < total
+            _drain(survivor, "idx", total)
+            assert _level_hashes(idx, levels, total) == ref_hashes
+            # and the resumed index answers exactly like the control
+            q = _imgs(1, seed=8)
+            got, _ = survivor.similar(q, level=0, k=3)
+            ref, _ = hier_engine.similar(q, level=0, k=3)
+            assert got == ref
+            assert survivor.registry.snapshot().get(
+                "serving_xla_compiles", 0) == 0
+        finally:
+            survivor.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# through the router
+# ---------------------------------------------------------------------------
+def _post(url, path, payload, headers=None, timeout=60):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, dict(r.headers.items()), json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def hier_fleet(hier_ckpt, tmp_path_factory):
+    """Two replicas behind a router; replica 0 owns the only index
+    shard (built by its own bulk job), replica 1 has no index at all —
+    the fan-out must still answer through either."""
+    from glom_tpu.serving.router import FleetRouter, make_router_server
+    from glom_tpu.serving.server import make_server
+
+    base = tmp_path_factory.mktemp("hier_fleet")
+    idx = str(base / "index")
+    engines = [
+        _engine(hier_ckpt, bulk_dir=str(base / "store"), index_dir=idx),
+        _engine(hier_ckpt),
+    ]
+    engines[0].bulk.submit(_index_payload(idx))
+    _drain(engines[0], "idx", 6)
+    servers = []
+    for eng in engines:
+        eng.start(watch=False)
+        srv = make_server(eng, port=0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        servers.append(srv)
+    urls = ["http://{}:{}".format(*srv.server_address[:2])
+            for srv in servers]
+    router = FleetRouter(urls, health_interval_s=0.2)
+    router.start()
+    rsrv = make_router_server(router)
+    threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+    rurl = "http://{}:{}".format(*rsrv.server_address[:2])
+    yield rurl, engines
+    router.shutdown()
+    rsrv.shutdown()
+    rsrv.server_close()
+    for eng, srv in zip(engines, servers):
+        srv.shutdown()
+        srv.server_close()
+        eng.shutdown(drain=False)
+
+
+class TestRouterIntegration:
+    def test_parse_through_router_mixed_batches(self, hier_fleet):
+        rurl, _ = hier_fleet
+        c = DEMO_CONFIG
+        side = c.image_size // c.patch_size
+        for b in (1, 2, 1):
+            status, headers, resp = _post(
+                rurl, "/parse", {"images": _imgs(b, seed=b).tolist()})
+            assert status == 200 and headers.get("X-Served-By")
+            assert len(resp["islands"]) == b
+            for per_level in resp["islands"]:
+                assert len(per_level) == c.levels
+                assert len(per_level[0]["labels"]) == side
+
+    def test_similar_fans_out_and_merges(self, hier_fleet):
+        """Replica 1 holds no shard (its /similar 404s); the router must
+        still answer from replica 0's shard with the deterministic
+        merged ranking."""
+        rurl, _ = hier_fleet
+        status, headers, resp = _post(
+            rurl, "/similar",
+            {"images": _imgs(1, seed=2).tolist(), "level": 0, "k": 3})
+        assert status == 200
+        assert resp["level"] == 0 and len(resp["results"]) == 1
+        hits = resp["results"][0]
+        assert hits == sorted(hits, key=lambda r: (-r["score"], r["slot"]))
+        assert headers.get("X-Served-By")
+
+    def test_session_parse_through_router_sticks_and_diffs(self,
+                                                           hier_fleet):
+        rurl, _ = hier_fleet
+        img = _imgs(1, seed=11).tolist()
+        # X-Affinity-Key pins the stream to one replica (the router's
+        # session contract — frames scatter without it)
+        pin = {"X-Affinity-Key": "cam-r"}
+        s1, h1, r1 = _post(rurl, "/session/parse",
+                           {"session": "cam-r", "images": img}, pin)
+        s2, h2, r2 = _post(rurl, "/session/parse",
+                           {"session": "cam-r", "images": img}, pin)
+        assert s1 == s2 == 200
+        assert h1.get("X-Served-By") == h2.get("X-Served-By")
+        assert r1["cold"] and not r2["cold"]
+        assert len(r1["islands"]) == 1
+        deltas = r2["deltas"][0]
+        assert len(deltas) == DEMO_CONFIG.levels
+        assert all(set(d) == {"appeared", "vanished", "moved", "stable"}
+                   for d in deltas)
+
+    def test_fleet_never_compiled_on_the_request_path(self, hier_fleet):
+        _, engines = hier_fleet
+        for eng in engines:
+            assert eng.registry.snapshot().get(
+                "serving_xla_compiles", 0) == 0

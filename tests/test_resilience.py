@@ -730,7 +730,7 @@ class TestChaosCli:
             "reload_io_error", "train_crash", "replica_kill",
             "canary_regression", "quality_regression",
             "host_preempt", "coordinator_loss", "shrink_restart",
-            "bulk_preemption", "slow_deploy_attribution",
+            "bulk_preemption", "slow_deploy_attribution", "index_rebuild",
         }
 
     def test_smoke_suite_recovers(self, tmp_path):
@@ -746,7 +746,7 @@ class TestChaosCli:
         assert proc.returncode == 0, proc.stdout + proc.stderr
         with open(out_json) as f:
             summary = json.load(f)
-        assert summary["recovered"] == summary["total"] == 13
+        assert summary["recovered"] == summary["total"] == 14
         for rec in summary["results"]:
             assert rec["outcome"] == "recovered", rec
             assert rec["mttr_s"] >= 0.0
@@ -765,4 +765,4 @@ class TestChaosSoak:
         assert proc.returncode == 0, proc.stdout + proc.stderr
         with open(out_json) as f:
             summary = json.load(f)
-        assert summary["recovered"] == summary["total"] == 13
+        assert summary["recovered"] == summary["total"] == 14

@@ -747,7 +747,7 @@ class TestFleetIntegration:
         health = lg._fetch_health(rurl, timeout=10)
         payloads = lg._make_payloads(health, [1, 2])
         results = lg._Results()
-        wall = lg.run_closed([rurl], "embed", payloads, [1, 2], 24, 4,
+        wall = lg.run_closed([rurl], ["embed"], payloads, [1, 2], 24, 4,
                              30.0, results)
         rep = lg.report(results, wall, "closed(c=4)")
         assert rep["requests_ok"] == 24 and rep["request_id_mismatches"] == 0

@@ -1069,6 +1069,109 @@ def scenario_bulk_preemption(soak):
                     "bulk_scavenged_slots_total", 0.0)}
 
 
+def scenario_index_rebuild(soak):
+    """A replica dies mid-way through a bulk ``index`` build and a
+    survivor adopts the same job store: the resumed build must assemble
+    to a BITWISE-identical index (sha256 over every level family — the
+    exactly-once sink-then-cursor order plus per-level orphan-overlap
+    cleanup is the whole mechanism) and ``/similar`` answers over the
+    rebuilt index must equal the uninterrupted control's exactly.  Zero
+    request-path compiles throughout, on the victim and the survivor."""
+    import hashlib
+
+    import numpy as np
+
+    from glom_tpu.hierarchy.index import assemble_level, level_parts
+    from glom_tpu.serving.engine import ServingEngine, make_demo_checkpoint
+
+    total = 24 if not soak else 96
+    with tempfile.TemporaryDirectory() as root:
+        ckpt = os.path.join(root, "ckpt")
+        make_demo_checkpoint(ckpt)
+        idx_ref = os.path.join(root, "idx_ref")
+        idx_out = os.path.join(root, "idx_out")
+
+        def payload(sink):
+            return {"name": "idx", "dataset": f"synthetic:{total}",
+                    "transform": "index", "seed": 7, "sink": sink}
+
+        def drain(eng):
+            for _ in range(4 * total):
+                if eng.bulk.status("idx")["status"] == "done":
+                    return
+                if eng.bulk.run_idle_once() == 0:
+                    time.sleep(0.005)
+            raise AssertionError(
+                f"index job never drained: {eng.bulk.status('idx')}")
+
+        def level_hashes(idx_dir, levels):
+            return {level: hashlib.sha256(
+                np.ascontiguousarray(
+                    assemble_level(idx_dir, level, total=total)
+                ).tobytes()).hexdigest() for level in range(levels)}
+
+        # -- control: uninterrupted build + reference /similar answers --
+        ctrl = ServingEngine(ckpt, buckets=(1, 4), max_wait_ms=0.0,
+                             warmup=True, reload_poll_s=0,
+                             bulk_dir=os.path.join(root, "store_ref"),
+                             index_dir=idx_ref)
+        try:
+            levels = ctrl.config.levels
+            ctrl.bulk.submit(payload(idx_ref))
+            drain(ctrl)
+            ref_hashes = level_hashes(idx_ref, levels)
+            imgs = np.random.RandomState(11).randn(
+                2, ctrl.config.channels, ctrl.config.image_size,
+                ctrl.config.image_size).astype(np.float32)
+            ref_answers = [ctrl.similar(imgs, level=level, k=5)[0]
+                           for level in range(levels)]
+            assert ctrl.registry.snapshot().get(
+                "serving_xla_compiles", 0.0) == 0
+        finally:
+            ctrl.shutdown(drain=False)
+
+        # -- the fault: kill the owner mid-build ------------------------
+        store = os.path.join(root, "store_shared")
+        victim = ServingEngine(ckpt, buckets=(1, 4), max_wait_ms=0.0,
+                               warmup=True, reload_poll_s=0, bulk_dir=store)
+        try:
+            victim.bulk.submit(payload(idx_out))
+            # two committed chunks: mid-job, durably past zero
+            while victim.bulk.status("idx")["done"] < 8:
+                victim.bulk.run_idle_once()
+            done_at_kill = victim.bulk.status("idx")["done"]
+        finally:
+            victim.shutdown(drain=False)  # the kill: no drain, no goodbye
+        t_fault = time.monotonic()
+        assert 0 < done_at_kill < total, done_at_kill
+
+        # -- recovery: a survivor adopts the same store and finishes ----
+        survivor = ServingEngine(ckpt, buckets=(1, 4), max_wait_ms=0.0,
+                                 warmup=True, reload_poll_s=0,
+                                 bulk_dir=store, index_dir=idx_out)
+        try:
+            drain(survivor)
+            mttr = time.monotonic() - t_fault
+            got_hashes = level_hashes(idx_out, levels)
+            assert got_hashes == ref_hashes, (
+                f"resumed index differs from the uninterrupted build: "
+                f"{got_hashes} != {ref_hashes}")
+            got_answers = [survivor.similar(imgs, level=level, k=5)[0]
+                           for level in range(levels)]
+            assert got_answers == ref_answers, (
+                f"/similar answers moved after resume: "
+                f"{got_answers} != {ref_answers}")
+            assert survivor.registry.snapshot().get(
+                "serving_xla_compiles", 0.0) == 0
+            chunk_count = len(level_parts(idx_out, 0))
+        finally:
+            survivor.shutdown(drain=False)
+        return {"mttr_s": round(mttr, 3), "slots": total,
+                "done_at_kill": done_at_kill,
+                "level_chunks": chunk_count,
+                "levels_verified": levels}
+
+
 def scenario_slow_deploy_attribution(soak):
     """A deliberately SLOW deploy candidate at full canary fraction, and
     the attribution plane on the hook for the verdict: after a healthy
@@ -1212,6 +1315,7 @@ SCENARIOS = {
     "shrink_restart": scenario_shrink_restart,
     "bulk_preemption": scenario_bulk_preemption,
     "slow_deploy_attribution": scenario_slow_deploy_attribution,
+    "index_rebuild": scenario_index_rebuild,
 }
 
 

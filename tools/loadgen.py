@@ -86,7 +86,11 @@ def parse_args(argv=None):
                    help="repeatable: spray requests across several engine "
                         "URLs (client-side fleet mode); overrides --url")
     p.add_argument("--endpoint", default="embed",
-                   choices=["embed", "reconstruct"])
+                   help="comma-cycled endpoint mix from "
+                        "{embed,reconstruct,parse,similar}: "
+                        "'embed,parse' alternates the two and the report "
+                        "gains a per_endpoint p50/p95 split (similar "
+                        "needs the server started with --index-dir)")
     p.add_argument("--requests", type=int, default=100,
                    help="closed loop: total requests to send")
     p.add_argument("--concurrency", type=int, default=4,
@@ -246,6 +250,18 @@ class _Results:
         # per-tenant breakdown (--tenant): the bulkhead evidence — one
         # tenant's sheds must coexist with another's unmoved latencies
         self.tenants = {}
+        # per-endpoint breakdown (--endpoint with a comma mix): parse
+        # rows and similar fan-outs have different cost shapes than
+        # embed, so a blended p95 hides which endpoint regressed
+        self.endpoints = {}
+
+    def _endpoint(self, key):
+        rec = self.endpoints.get(key)
+        if rec is None:
+            rec = self.endpoints[key] = {
+                "latencies_ms": [], "ok": 0, "shed": 0, "errors": 0,
+            }
+        return rec
 
     def _replica(self, key):
         rec = self.replicas.get(key)
@@ -266,10 +282,12 @@ class _Results:
 
     def record(self, latency_ms=None, images=0, shed=False, error=False,
                request_id=None, id_mismatch=False, replica=None,
-               tenant=None):
+               tenant=None, endpoint=None):
         with self.lock:
             rep = self._replica(replica) if replica is not None else None
             ten = self._tenant(tenant) if tenant is not None else None
+            epr = (self._endpoint(endpoint) if endpoint is not None
+                   else None)
             if self.timeline_samples is not None:
                 kind = "shed" if shed else ("error" if error else "ok")
                 self.timeline_samples.append(
@@ -282,12 +300,16 @@ class _Results:
                     rep["shed"] += 1
                 if ten is not None:
                     ten["shed"] += 1
+                if epr is not None:
+                    epr["shed"] += 1
             elif error:
                 self.errors += 1
                 if rep is not None:
                     rep["errors"] += 1
                 if ten is not None:
                     ten["errors"] += 1
+                if epr is not None:
+                    epr["errors"] += 1
             else:
                 self.ok += 1
                 self.images_ok += images
@@ -301,6 +323,9 @@ class _Results:
                 if ten is not None:
                     ten["ok"] += 1
                     ten["latencies_ms"].append(latency_ms)
+                if epr is not None:
+                    epr["ok"] += 1
+                    epr["latencies_ms"].append(latency_ms)
 
     def note_session(self, sid, *, cold=None, latency_ms=None, replica=None):
         with self.lock:
@@ -334,9 +359,9 @@ def parse_tenants(specs):
     return schedule
 
 
-def run_closed(urls, endpoint, payloads, batch_sizes, n_requests, concurrency,
-               timeout, results, tenants=None, corrupt_payloads=None,
-               corrupt_frac=0.0, regress_from=None):
+def run_closed(urls, endpoints, payloads, batch_sizes, n_requests,
+               concurrency, timeout, results, tenants=None,
+               corrupt_payloads=None, corrupt_frac=0.0, regress_from=None):
     idx_lock = threading.Lock()
     counter = [0]
 
@@ -364,8 +389,11 @@ def run_closed(urls, endpoint, payloads, batch_sizes, n_requests, concurrency,
                 with results.lock:
                     results.corrupted += 1
             t0 = time.monotonic()
-            _send(urls[i % len(urls)], endpoint, body, b, timeout,
-                  results, t0, request_id=f"lg-{os.getpid()}-{i}",
+            # endpoint advances with i, batch with i // len(urls): over a
+            # run every endpoint sees every batch size
+            _send(urls[i % len(urls)], endpoints[i % len(endpoints)], body,
+                  b, timeout, results, t0,
+                  request_id=f"lg-{os.getpid()}-{i}",
                   multi_target=len(urls) > 1,
                   tenant=tenants[i % len(tenants)] if tenants else None)
 
@@ -379,7 +407,7 @@ def run_closed(urls, endpoint, payloads, batch_sizes, n_requests, concurrency,
     return time.monotonic() - t_start
 
 
-def run_open(urls, endpoint, payloads, batch_sizes, rate, duration, timeout,
+def run_open(urls, endpoints, payloads, batch_sizes, rate, duration, timeout,
              results, tenants=None, corrupt_payloads=None, corrupt_frac=0.0,
              regress_from=None):
     """Fixed arrival schedule: request i fires at ``i / rate`` seconds
@@ -406,8 +434,8 @@ def run_open(urls, endpoint, payloads, batch_sizes, rate, duration, timeout,
                 results.corrupted += 1
         t = threading.Thread(
             target=_send,
-            args=(urls[i % len(urls)], endpoint, body, b, timeout,
-                  results, time.monotonic()),
+            args=(urls[i % len(urls)], endpoints[i % len(endpoints)], body,
+                  b, timeout, results, time.monotonic()),
             kwargs={"request_id": f"lg-{os.getpid()}-{i}",
                     "multi_target": len(urls) > 1,
                     "tenant": (tenants[i % len(tenants)]
@@ -453,18 +481,19 @@ def _send(url, endpoint, body, n_images, timeout, results, t0,
         results.record(shed=(e.code == 503), error=(e.code != 503),
                        id_mismatch=(request_id is not None
                                     and echoed != request_id),
-                       replica=replica_key(e.headers), tenant=tenant)
+                       replica=replica_key(e.headers), tenant=tenant,
+                       endpoint=endpoint)
         return
     except Exception:  # glomlint: disable=conc-broad-except -- recorded as an error sample; a load generator must keep offering load through any single-request failure
         results.record(error=True,
                        replica=url if multi_target else None,
-                       tenant=tenant)
+                       tenant=tenant, endpoint=endpoint)
         return
     results.record(
         latency_ms=(time.monotonic() - t0) * 1e3, images=n_images,
         request_id=request_id,
         id_mismatch=(request_id is not None and echoed != request_id),
-        replica=replica, tenant=tenant,
+        replica=replica, tenant=tenant, endpoint=endpoint,
     )
 
 
@@ -721,6 +750,20 @@ def report(results, wall_s, mode, slow_n=0):
                 },
             }
         out["per_tenant"] = per_tenant
+    if len(results.endpoints) > 1:
+        per_ep = {}
+        for key, rec in sorted(results.endpoints.items()):
+            elat = rec["latencies_ms"]
+            per_ep[key] = {
+                "requests_ok": rec["ok"],
+                "requests_shed": rec["shed"],
+                "requests_error": rec["errors"],
+                "latency_ms": {
+                    "p50": round(percentile(elat, 50), 3) if elat else None,
+                    "p95": round(percentile(elat, 95), 3) if elat else None,
+                },
+            }
+        out["per_endpoint"] = per_ep
     if results.replicas:
         per = {}
         for key, rec in sorted(results.replicas.items()):
@@ -822,6 +865,73 @@ def _smoke_tenant_bulkhead(ckpt_dir) -> dict:
             "tenantB_shed": b1["shed"],
         }
     finally:
+        server.shutdown()
+        engine.shutdown(drain=False)
+        server.server_close()
+
+
+def _smoke_parse_router(ckpt_dir) -> dict:
+    """The part-whole acceptance leg of ``--smoke``: a /parse round trip
+    THROUGH the router at mixed batch sizes must come back with well-
+    formed per-level islands and — the contract that matters — zero
+    request-path compiles (``serving_xla_compiles`` absent from the
+    engine's registry: the parse post-pass is AOT-warmed like every
+    other endpoint).  Returns the report dict; raises AssertionError on
+    a breach."""
+    from glom_tpu.serving.engine import ServingEngine
+    from glom_tpu.serving.router import FleetRouter, make_router_server
+    from glom_tpu.serving.server import make_server
+
+    engine = ServingEngine(ckpt_dir, buckets=(1, 2), max_wait_ms=1.0,
+                           warmup=True, reload_poll_s=0)
+    engine.start(watch=False)
+    server = make_server(engine)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = "http://{}:{}".format(*server.server_address[:2])
+    router = FleetRouter([url], health_interval_s=0.2)
+    router.start()
+    router_server = make_router_server(router)
+    threading.Thread(target=router_server.serve_forever,
+                     daemon=True).start()
+    front = "http://{}:{}".format(*router_server.server_address[:2])
+    try:
+        health = _fetch_health(front, timeout=10)
+        payloads = _make_payloads(health, [1, 2])
+        results = _Results()
+        for i, b in enumerate([1, 2, 1, 2]):
+            _send(front, "parse", payloads[b], b, 30.0, results,
+                  time.monotonic(), request_id=f"lg-parse-{i}")
+        assert results.ok == 4 and results.errors == 0, vars(results)
+        # one decoded reply, checked structurally: per-level islands
+        # with a labels grid and count-trimmed sizes/means
+        side = health["image_size"] // health["patch_size"]
+        req = urllib.request.Request(
+            f"{front}/parse", data=payloads[2],
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            resp = json.loads(r.read())
+        islands = resp["islands"]
+        assert len(islands) == 2, len(islands)
+        for per_level in islands:
+            assert len(per_level) == health["levels"]
+            for lv in per_level:
+                assert len(lv["labels"]) == side
+                assert len(lv["sizes"]) == lv["num_islands"]
+                assert len(lv["means"]) == lv["num_islands"]
+        snap = engine.registry.snapshot()
+        assert snap.get("serving_xla_compiles", 0) == 0, (
+            f"/parse compiled on the request path: "
+            f"{snap['serving_xla_compiles']}")
+        return {
+            "requests_ok": results.ok,
+            "levels": health["levels"],
+            "islands_l0": islands[0][0]["num_islands"],
+            "serving_xla_compiles": snap.get("serving_xla_compiles", 0),
+        }
+    finally:
+        router.shutdown()
+        router_server.shutdown()
+        router_server.server_close()
         server.shutdown()
         engine.shutdown(drain=False)
         server.server_close()
@@ -942,8 +1052,11 @@ def run_smoke(fleet: bool = False) -> int:
             )
             # tenant-bulkhead acceptance (tenant A past its quota, B
             # unmoved) runs only once the core smoke passed, and lands
-            # INSIDE the one JSON object consumers parse from stdout
+            # INSIDE the one JSON object consumers parse from stdout;
+            # the parse-through-router zero-compile leg rides the same
+            # gate (docs/HIERARCHY.md)
             bulkhead = _smoke_tenant_bulkhead(d) if ok else None
+            parse_leg = _smoke_parse_router(d) if ok else None
             print(json.dumps({
                 "smoke": "ok" if ok else "FAILED",
                 "smoke_mode": "fleet-stitched" if fleet else "engine",
@@ -955,6 +1068,7 @@ def run_smoke(fleet: bool = False) -> int:
                 "perfetto_file": perfetto_path,
                 "perfetto_events": len(perfetto.get("traceEvents", [])),
                 "tenant_bulkhead": bulkhead,
+                "parse_router": parse_leg,
                 **report(results, wall, "smoke"),
             }, indent=2))
             if not ok:
@@ -983,6 +1097,14 @@ def main(argv=None) -> int:
     if args.smoke:
         return run_smoke(fleet=args.fleet)
 
+    endpoints = [e.strip() for e in args.endpoint.split(",") if e.strip()]
+    bad = [e for e in endpoints
+           if e not in ("embed", "reconstruct", "parse", "similar")]
+    if bad or not endpoints:
+        print(f"loadgen: bad --endpoint {args.endpoint!r} "
+              f"(want a comma mix of embed,reconstruct,parse,similar)",
+              file=sys.stderr)
+        return 2
     batch_sizes = [int(b) for b in args.batch_sizes.split(",")]
     urls = [u.rstrip("/") for u in (args.target or [args.url])]
     health = _fetch_health(urls[0], args.timeout)
@@ -1021,19 +1143,21 @@ def main(argv=None) -> int:
              else args.requests)
         regress_from = math.ceil(n * min(args.regress_at, 1.0))
     if args.rate > 0:
-        wall = run_open(urls, args.endpoint, payloads, batch_sizes,
+        wall = run_open(urls, endpoints, payloads, batch_sizes,
                         args.rate, args.duration, args.timeout, results,
                         tenants=tenants, corrupt_payloads=corrupt_payloads,
                         corrupt_frac=args.corrupt, regress_from=regress_from)
         mode = f"open({args.rate}/s)"
     else:
-        wall = run_closed(urls, args.endpoint, payloads, batch_sizes,
+        wall = run_closed(urls, endpoints, payloads, batch_sizes,
                           args.requests, args.concurrency, args.timeout,
                           results, tenants=tenants,
                           corrupt_payloads=corrupt_payloads,
                           corrupt_frac=args.corrupt,
                           regress_from=regress_from)
         mode = f"closed(c={args.concurrency})"
+    if len(endpoints) > 1:
+        mode += f" endpoints({','.join(endpoints)})"
     if args.corrupt > 0:
         mode += f" corrupt({args.corrupt})"
     if regress_from is not None:
